@@ -1,0 +1,38 @@
+"""ElasticDL-TPU: a TPU-native elastic deep-learning framework.
+
+A from-scratch rebuild of the capabilities of ElasticDL (reference:
+``863473007/elasticdl`` — a Kubernetes-native elastic training framework on
+TF2 eager + gRPC parameter servers) re-designed for TPU hardware:
+
+- the worker compute plane is a ``jax.jit``-compiled SPMD train step over a
+  ``jax.sharding.Mesh`` (data / tensor / sequence / expert axes) instead of a
+  TF2 eager GradientTape loop;
+- the gRPC parameter server is eliminated for dense parameters (gradient
+  exchange is an XLA ``psum`` over ICI) and replaced for sparse embeddings by
+  mesh-sharded tables with in-step all-to-all lookup;
+- elasticity (dynamic data sharding + pod relaunch in the reference) becomes
+  dynamic data sharding + JAX mesh re-formation driven by the master.
+
+Package layout:
+
+- ``elasticdl_tpu.utils``    — flags, constants, logging, hashing, serde
+  (reference: ``elasticdl/python/common/``)
+- ``elasticdl_tpu.master``   — control plane: task dispatcher, servicer,
+  evaluation service, instance manager (reference: ``elasticdl/python/master/``)
+- ``elasticdl_tpu.worker``   — compute plane: JAX worker loop, task data
+  service (reference: ``elasticdl/python/worker/``)
+- ``elasticdl_tpu.trainer``  — jitted step builders, train state, metrics,
+  local executor (reference: ``elasticdl/python/elasticdl/local_executor.py``)
+- ``elasticdl_tpu.parallel`` — mesh, sharding rules, collectives, sharded
+  embedding engine, ring attention (replaces PS + FTLib, reference §2.3/§2.8)
+- ``elasticdl_tpu.layers``   — model-building layers incl. the distributed
+  ``Embedding`` (reference: ``elasticdl/python/elasticdl/layers/``)
+- ``elasticdl_tpu.data``     — readers, RecordIO codec, dataset pipeline
+  (reference: ``elasticdl/python/data/``)
+- ``elasticdl_tpu.models``   — the model zoo (reference: ``model_zoo/``)
+- ``elasticdl_tpu.ops``      — Pallas TPU kernels for hot ops
+- ``elasticdl_tpu.rpc``      — gRPC control-plane transport + wire serde
+  (reference: ``elasticdl/proto/elasticdl.proto``)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,4 @@
+"""Common substrate: flags, constants, logging, hashing, serde, timing.
+
+Reference: ``elasticdl/python/common/`` (SURVEY.md §2.7).
+"""

@@ -1,0 +1,436 @@
+"""Flag system: shared argparse groups for master / worker / client roles.
+
+Reference: ``elasticdl/python/common/args.py`` (685 LoC) — three argparse
+trees built from shared flag groups, strategy validation/coercions, and the
+**argv round-trip**: the master reconstructs the exact command line for the
+worker processes it launches from its own parsed namespace
+(``build_arguments_from_parsed_result``, reference args.py:664-685, used at
+master.py:340).
+
+The TPU build keeps the same model-spec / data / train flags so reference
+job specs keep working, drops the PS-pod resource flags (no parameter
+servers exist — dense sync is psum over ICI), and adds the mesh flags that
+describe the SPMD layout (``--mesh_shape``, per-axis parallel degrees,
+``--compute_dtype``).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from elasticdl_tpu.utils.constants import (
+    MASTER_DEFAULT_PORT,
+    DistributionStrategy,
+)
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+
+def pos_int(arg: str) -> int:
+    value = int(arg)
+    if value <= 0:
+        raise argparse.ArgumentTypeError(f"must be a positive integer: {arg}")
+    return value
+
+
+def non_neg_int(arg: str) -> int:
+    value = int(arg)
+    if value < 0:
+        raise argparse.ArgumentTypeError(f"must be >= 0: {arg}")
+    return value
+
+
+def pos_float(arg: str) -> float:
+    value = float(arg)
+    if value <= 0:
+        raise argparse.ArgumentTypeError(f"must be a positive float: {arg}")
+    return value
+
+
+def parse_bool(arg) -> bool:
+    if isinstance(arg, bool):
+        return arg
+    lowered = str(arg).lower()
+    if lowered in ("true", "1", "yes"):
+        return True
+    if lowered in ("false", "0", "no"):
+        return False
+    raise argparse.ArgumentTypeError(f"not a boolean: {arg}")
+
+
+def parse_envs(arg: str | None) -> dict[str, str]:
+    """Parse ``--envs k1=v1,k2=v2`` (reference args.py:62-87)."""
+    envs: dict[str, str] = {}
+    if not arg:
+        return envs
+    for kv in arg.split(","):
+        if not kv:
+            continue
+        k, _, v = kv.partition("=")
+        envs[k.strip()] = v.strip()
+    return envs
+
+
+def parse_params_dict(arg: str | None) -> dict:
+    """Parse the ``k=v;k=v`` mini-DSL used by ``--model_params`` /
+    ``--data_reader_params`` (reference common/model_utils.py:34-50).
+
+    Values are parsed with ``ast.literal_eval`` when possible, else kept as
+    strings (the reference falls back to ``eval``; we deliberately do not).
+    """
+    import ast
+
+    params: dict = {}
+    if not arg:
+        return params
+    for kv in arg.split(";"):
+        if not kv.strip():
+            continue
+        k, sep, v = kv.partition("=")
+        if not sep:
+            raise ValueError(f"malformed params entry (need k=v): {kv!r}")
+        k, v = k.strip(), v.strip()
+        try:
+            params[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            params[k] = v
+    return params
+
+
+def _add_job_params(parser: argparse.ArgumentParser):
+    parser.add_argument("--job_name", default="elasticdl-job", help="Job name")
+    parser.add_argument(
+        "--log_level",
+        default="INFO",
+        choices=["DEBUG", "INFO", "WARNING", "ERROR"],
+        help="Logging level",
+    )
+    parser.add_argument(
+        "--envs",
+        type=str,
+        default="",
+        help="Extra environment variables, comma separated k=v pairs",
+    )
+
+
+def _add_model_spec_params(parser: argparse.ArgumentParser):
+    # reference args.py:448-486 — the model-zoo spec contract
+    parser.add_argument(
+        "--model_zoo",
+        required=False,
+        default="",
+        help=(
+            "Directory that contains user-defined model modules; empty "
+            "means the built-in elasticdl_tpu.models zoo"
+        ),
+    )
+    parser.add_argument(
+        "--model_def",
+        required=True,
+        help=(
+            "Model definition in module path form, e.g. "
+            "mnist_functional_api.mnist_functional_api.custom_model"
+        ),
+    )
+    parser.add_argument(
+        "--model_params",
+        default="",
+        help="Keyword args for custom_model(), 'k=v;k=v' form",
+    )
+    parser.add_argument("--dataset_fn", default="dataset_fn")
+    parser.add_argument("--loss", default="loss")
+    parser.add_argument("--optimizer", default="optimizer")
+    parser.add_argument("--eval_metrics_fn", default="eval_metrics_fn")
+    parser.add_argument(
+        "--custom_data_reader", default="custom_data_reader"
+    )
+    parser.add_argument(
+        "--prediction_outputs_processor",
+        default="PredictionOutputsProcessor",
+        help="Class in the model module that processes prediction outputs",
+    )
+
+
+def _add_data_params(parser: argparse.ArgumentParser):
+    parser.add_argument("--training_data", default="")
+    parser.add_argument("--validation_data", default="")
+    parser.add_argument("--prediction_data", default="")
+    parser.add_argument(
+        "--records_per_task",
+        type=pos_int,
+        default=4096,
+        help="Records per dynamic-sharding task (the elasticity unit)",
+    )
+    parser.add_argument("--minibatch_size", type=pos_int, default=64)
+    parser.add_argument("--num_epochs", type=pos_int, default=1)
+    parser.add_argument(
+        "--data_reader_params",
+        default="",
+        help="Keyword args for the data reader, 'k=v;k=v' form",
+    )
+    parser.add_argument(
+        "--num_minibatches_per_task",
+        type=pos_int,
+        default=None,
+        required=False,
+        help=(
+            "If set, records_per_task = minibatch_size * this "
+            "(convenience; reference derives similarly)"
+        ),
+    )
+
+
+def _add_train_params(parser: argparse.ArgumentParser):
+    parser.add_argument("--evaluation_steps", type=non_neg_int, default=0)
+    parser.add_argument(
+        "--evaluation_start_delay_secs", type=non_neg_int, default=100
+    )
+    parser.add_argument(
+        "--evaluation_throttle_secs", type=non_neg_int, default=0
+    )
+    parser.add_argument("--checkpoint_steps", type=non_neg_int, default=0)
+    parser.add_argument("--checkpoint_dir", default="")
+    parser.add_argument(
+        "--checkpoint_dir_for_init",
+        default="",
+        help="Restore initial model state from this checkpoint directory",
+    )
+    parser.add_argument("--keep_checkpoint_max", type=non_neg_int, default=3)
+    parser.add_argument(
+        "--output", default="", help="Directory for the exported model"
+    )
+    parser.add_argument("--tensorboard_log_dir", default="")
+    parser.add_argument(
+        "--get_model_steps",
+        type=pos_int,
+        default=1,
+        help=(
+            "Apply gradients locally for N steps between global syncs "
+            "(local-SGD; reference worker.py:179-182)"
+        ),
+    )
+    parser.add_argument(
+        "--use_async",
+        type=parse_bool,
+        default=False,
+        help=(
+            "Accepted for compatibility with the reference's async-SGD PS "
+            "mode; the TPU build trains synchronously (ICI makes sync "
+            "cheap) and logs a deviation warning when set"
+        ),
+    )
+    parser.add_argument(
+        "--grads_to_wait",
+        type=pos_int,
+        default=1,
+        help="Compatibility flag from the sync-PS mode; unused on TPU",
+    )
+    parser.add_argument("--learning_rate", type=pos_float, default=None,
+                        required=False,
+                        help="Override the model module's learning rate")
+
+
+def _add_mesh_params(parser: argparse.ArgumentParser):
+    parser.add_argument(
+        "--distribution_strategy",
+        default=DistributionStrategy.LOCAL,
+        choices=list(DistributionStrategy.ALL),
+    )
+    parser.add_argument(
+        "--num_workers",
+        type=pos_int,
+        default=1,
+        help="Number of worker processes (TPU hosts)",
+    )
+    parser.add_argument(
+        "--mesh_shape",
+        default="",
+        help=(
+            "Logical device mesh, e.g. 'dp=8' or 'dp=4,tp=2' or "
+            "'dp=2,sp=4'; empty = all devices on dp"
+        ),
+    )
+    parser.add_argument(
+        "--compute_dtype",
+        default="bfloat16",
+        choices=["bfloat16", "float32"],
+        help="Activation/matmul dtype (params stay float32)",
+    )
+    parser.add_argument(
+        "--remat",
+        type=parse_bool,
+        default=False,
+        help="Rematerialize activations (jax.checkpoint) to save HBM",
+    )
+    parser.add_argument(
+        "--donate_state",
+        type=parse_bool,
+        default=True,
+        help="Donate train-state buffers to the jitted step",
+    )
+
+
+def _add_master_params(parser: argparse.ArgumentParser):
+    parser.add_argument(
+        "--port", type=pos_int, default=MASTER_DEFAULT_PORT
+    )
+    parser.add_argument(
+        "--instance_backend",
+        default="local",
+        choices=["local", "k8s", "none"],
+        help=(
+            "How workers are launched/monitored: local subprocesses, "
+            "Kubernetes pods, or externally managed ('none')"
+        ),
+    )
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--docker_image", default="")
+    parser.add_argument(
+        "--relaunch_on_worker_failure",
+        type=non_neg_int,
+        default=3,
+        help="Max relaunches per failed worker",
+    )
+    parser.add_argument(
+        "--heartbeat_timeout_secs",
+        type=pos_float,
+        default=30.0,
+        help="Declare a worker dead after this long without a heartbeat",
+    )
+    parser.add_argument(
+        "--task_timeout_secs",
+        type=pos_float,
+        default=0.0,
+        help="Re-queue a task held longer than this (0 = never)",
+    )
+
+
+def _add_worker_params(parser: argparse.ArgumentParser):
+    parser.add_argument("--worker_id", type=non_neg_int, required=True)
+    parser.add_argument("--master_addr", required=True)
+    parser.add_argument(
+        "--coordinator_addr",
+        default="",
+        help="jax.distributed coordinator address for multi-host meshes",
+    )
+
+
+_MASTER_GROUPS = (
+    _add_job_params,
+    _add_model_spec_params,
+    _add_data_params,
+    _add_train_params,
+    _add_mesh_params,
+    _add_master_params,
+)
+
+_WORKER_GROUPS = (
+    _add_job_params,
+    _add_model_spec_params,
+    _add_data_params,
+    _add_train_params,
+    _add_mesh_params,
+    _add_worker_params,
+)
+
+
+def _finalize(args: argparse.Namespace) -> argparse.Namespace:
+    """Validation + coercions (reference args.py:595-604)."""
+    if getattr(args, "num_minibatches_per_task", None):
+        args.records_per_task = (
+            args.minibatch_size * args.num_minibatches_per_task
+        )
+    if getattr(args, "use_async", False):
+        # reference coerces async => grads_to_wait=1; we additionally pin the
+        # TPU build to synchronous updates (documented deviation, SURVEY §7).
+        args.grads_to_wait = 1
+        logger.warning(
+            "--use_async is accepted for compatibility but the TPU build "
+            "trains synchronously (gradient psum over ICI); async staleness "
+            "semantics do not apply"
+        )
+    if args.model_params:
+        args.model_params_dict = parse_params_dict(args.model_params)
+    else:
+        args.model_params_dict = {}
+    if args.data_reader_params:
+        args.data_reader_params_dict = parse_params_dict(
+            args.data_reader_params
+        )
+    else:
+        args.data_reader_params_dict = {}
+    args.envs_dict = parse_envs(args.envs)
+    return args
+
+
+def _parse_known(parser: argparse.ArgumentParser, argv):
+    args, unknown = parser.parse_known_args(argv)
+    if unknown:
+        # reference args.py:569-572 — surface, don't swallow, typos
+        logger.warning("Unknown arguments: %s", unknown)
+    return _finalize(args)
+
+
+def parse_master_args(argv=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(description="ElasticDL-TPU master")
+    for group in _MASTER_GROUPS:
+        group(parser)
+    return _parse_known(parser, argv)
+
+
+def parse_worker_args(argv=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(description="ElasticDL-TPU worker")
+    for group in _WORKER_GROUPS:
+        group(parser)
+    return _parse_known(parser, argv)
+
+
+# Flags that exist only on the master and must not be forwarded to workers.
+_MASTER_ONLY_FLAGS = frozenset(
+    {
+        "port",
+        "instance_backend",
+        "namespace",
+        "docker_image",
+        "relaunch_on_worker_failure",
+        "heartbeat_timeout_secs",
+        "task_timeout_secs",
+    }
+)
+
+# Derived (non-flag) namespace entries produced by _finalize.
+_DERIVED_KEYS = frozenset(
+    {"model_params_dict", "data_reader_params_dict", "envs_dict"}
+)
+
+
+def build_arguments_from_parsed_result(
+    args: argparse.Namespace,
+    filter_args: frozenset[str] | set[str] = frozenset(),
+) -> list[str]:
+    """Reconstruct an argv list from a parsed namespace.
+
+    The master uses this to synthesize each worker's command line from its
+    own flags (reference args.py:664-685 + master.py:331-384).  Booleans are
+    rendered as ``true``/``false`` (parse_bool round-trips them); ``None``
+    values are dropped.
+    """
+    argv: list[str] = []
+    skip = set(filter_args) | _DERIVED_KEYS
+    for key, value in sorted(vars(args).items()):
+        if key in skip or value is None:
+            continue
+        if isinstance(value, bool):
+            value = "true" if value else "false"
+        argv.extend([f"--{key}", str(value)])
+    return argv
+
+
+def build_worker_arguments(
+    master_args: argparse.Namespace, worker_id: int, master_addr: str
+) -> list[str]:
+    """The master→worker argv round-trip."""
+    argv = build_arguments_from_parsed_result(
+        master_args, filter_args=_MASTER_ONLY_FLAGS
+    )
+    argv.extend(["--worker_id", str(worker_id), "--master_addr", master_addr])
+    return argv

@@ -1,0 +1,137 @@
+"""Framework-wide constants and enums.
+
+Reference: ``elasticdl/python/common/constants.py`` (strategy / job-type /
+pod-status vocabulary) and ``elasticdl/proto/elasticdl.proto`` (task types).
+The TPU build keeps the same user-facing vocabulary so the CLI surface is
+compatible, and adds TPU-specific mesh-axis names.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class GRPC:
+    # Control-plane traffic only (tasks, metrics, versions) — tensors never
+    # ride RPC on the hot path in the TPU design, but eval-metric reports can
+    # be large, so keep the reference's generous cap
+    # (reference constants.py:1-5: 256MB max message).
+    MAX_SEND_MESSAGE_LENGTH = 256 * 1024 * 1024
+    MAX_RECEIVE_MESSAGE_LENGTH = 256 * 1024 * 1024
+
+
+class InstanceManagerStatus:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    FINISHED = "Finished"
+
+
+class JobType(enum.Enum):
+    # reference common/constants.py:21-25
+    TRAINING_ONLY = "training_only"
+    EVALUATION_ONLY = "evaluation_only"
+    PREDICTION_ONLY = "prediction_only"
+    TRAINING_WITH_EVALUATION = "training_with_evaluation"
+
+
+class TaskType(enum.IntEnum):
+    """Work-unit types served by the master's task dispatcher.
+
+    reference elasticdl.proto (TaskType) — WAIT is the 'no task right now,
+    poll again' sentinel the servicer returns while eval tasks are pending
+    (reference master/servicer.py:32-63).
+    """
+
+    TRAINING = 0
+    EVALUATION = 1
+    PREDICTION = 2
+    WAIT = 3
+    SAVE_MODEL = 4
+
+
+class DistributionStrategy:
+    """User-selectable strategies (reference common/constants.py:43-46).
+
+    The TPU build maps them as:
+
+    - LOCAL: single-process, single-chip (or single-host) jit loop.
+    - PARAMETER_SERVER: accepted for CLI compatibility; dense parameters are
+      *not* served by PS pods — they live on-device and sync via psum.  What
+      survives from the PS design is the sharded embedding table, which
+      becomes a mesh-sharded array with all-to-all lookup.
+    - ALLREDUCE: the native TPU strategy — SPMD data parallelism over a
+      device mesh with XLA collectives over ICI/DCN.
+    """
+
+    LOCAL = "Local"
+    PARAMETER_SERVER = "ParameterServerStrategy"
+    ALLREDUCE = "AllreduceStrategy"
+
+    ALL = (LOCAL, PARAMETER_SERVER, ALLREDUCE)
+
+
+class PodStatus:
+    # reference common/constants.py:62-67
+    INITIAL = "Initial"
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    DELETED = "Deleted"
+
+
+class ReaderType:
+    # reference common/constants.py:69-72
+    CSV_READER = "CSV"
+    ODPS_READER = "ODPS"
+    RECORDIO_READER = "RecordIO"
+
+
+class MeshAxis:
+    """Canonical logical mesh-axis names for the TPU build.
+
+    dp: data parallel (batch sharding; gradient psum rides this axis)
+    fsdp: fully-sharded data parallel (parameter sharding over the dp axis)
+    tp: tensor parallel (feature-dim sharding of weights/activations)
+    sp: sequence/context parallel (ring attention / Ulysses all-to-all)
+    ep: expert / embedding parallel (sharded embedding tables, MoE experts)
+    """
+
+    DP = "dp"
+    FSDP = "fsdp"
+    TP = "tp"
+    SP = "sp"
+    EP = "ep"
+
+    ALL = (DP, FSDP, TP, SP, EP)
+
+
+class WorkerEnv:
+    """Env vars the master injects into worker processes."""
+
+    MASTER_ADDR = "EDL_TPU_MASTER_ADDR"
+    WORKER_ID = "EDL_TPU_WORKER_ID"
+    NUM_WORKERS = "EDL_TPU_NUM_WORKERS"
+    COORDINATOR_ADDR = "EDL_TPU_COORDINATOR_ADDR"
+
+
+class Initializer:
+    """Default initializer names accepted by embedding layers/tables."""
+
+    UNIFORM = "uniform"
+    NORMAL = "normal"
+    ZEROS = "zeros"
+    ONES = "ones"
+
+
+# Auto-distribute threshold for embedding tables: Keras embeddings bigger
+# than this are rewritten to the distributed sharded-table layer by the
+# model handler (reference common/model_handler.py:47-55: 2MB rule).
+EMBEDDING_AUTO_DISTRIBUTE_BYTES = 2 * 1024 * 1024
+
+# Max times a worker retries a minibatch on transient failure
+# (reference worker/worker.py:46).
+MAX_MINIBATCH_RETRY_NUM = 64
+
+# Default port the master control-plane service listens on.
+MASTER_DEFAULT_PORT = 50001

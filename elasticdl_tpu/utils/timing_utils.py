@@ -1,0 +1,63 @@
+"""Wall-clock timing buckets for the worker hot loop.
+
+Reference: ``elasticdl/python/common/timing_utils.py`` — named wall-clock
+buckets (task_process / batch_process / get_model / report_gradient),
+reported per task at DEBUG level.  The TPU build keeps the same shape and
+adds a ``device_step`` bucket for the jitted step (host-side wall clock
+including dispatch; per-op detail belongs to the JAX profiler, see
+``elasticdl_tpu.utils.profiler``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import defaultdict
+
+
+class Timing:
+    def __init__(self, enabled: bool = False, logger: logging.Logger | None = None):
+        self._enabled = enabled
+        self._logger = logger
+        self.reset()
+
+    def reset(self):
+        self._totals: dict[str, float] = defaultdict(float)
+        self._counts: dict[str, int] = defaultdict(int)
+        self._starts: dict[str, float] = {}
+
+    def start_record_time(self, name: str):
+        if self._enabled:
+            self._starts[name] = time.monotonic()
+
+    def end_record_time(self, name: str):
+        if self._enabled and name in self._starts:
+            self._totals[name] += time.monotonic() - self._starts.pop(name)
+            self._counts[name] += 1
+
+    @contextlib.contextmanager
+    def record(self, name: str):
+        self.start_record_time(name)
+        try:
+            yield
+        finally:
+            self.end_record_time(name)
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        return {
+            name: {"total_secs": total, "count": self._counts[name]}
+            for name, total in sorted(self._totals.items())
+        }
+
+    def report_timing(self, reset: bool = False):
+        if self._enabled and self._logger is not None:
+            for name, stats in self.summary().items():
+                self._logger.debug(
+                    "Timing %s: %.6fs over %d calls",
+                    name,
+                    stats["total_secs"],
+                    stats["count"],
+                )
+        if reset:
+            self.reset()

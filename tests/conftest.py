@@ -1,0 +1,23 @@
+"""Test harness configuration.
+
+Force JAX onto a virtual 8-device CPU mesh so multi-chip sharding logic is
+exercised without TPU hardware (SURVEY §4: the reference collapses the
+process boundary but keeps the protocol objects real; we collapse the pod
+slice into 8 host-platform devices but keep the mesh/sharding real).
+
+Must run before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep XLA compile parallelism sane on small CI machines.
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

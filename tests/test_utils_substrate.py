@@ -1,0 +1,198 @@
+"""Tier-1 unit tests for the common substrate (SURVEY §4 tier 1).
+
+Covers: hashing, tensor serde, args parsing + argv round-trip, params DSL.
+Reference counterparts: ``args_test.py``, ``tensor_test.py``,
+``hash_utils_test.py`` in ``elasticdl/python/tests/``.
+"""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.utils import args as args_mod
+from elasticdl_tpu.utils import hash_utils
+from elasticdl_tpu.utils.tensor import (
+    Tensor,
+    deserialize_tensors,
+    serialize_tensors,
+)
+
+
+class TestHashUtils:
+    def test_string_to_id_stable_and_bounded(self):
+        for n in (1, 2, 7, 64):
+            ids = {hash_utils.string_to_id(f"var_{i}", n) for i in range(100)}
+            assert all(0 <= i < n for i in ids)
+        assert hash_utils.string_to_id("dense/kernel", 8) == (
+            hash_utils.string_to_id("dense/kernel", 8)
+        )
+
+    def test_int_to_id(self):
+        assert hash_utils.int_to_id(13, 4) == 1
+        assert hash_utils.int_to_id(0, 4) == 0
+
+    def test_scatter_ids_partitions_everything(self):
+        ids = np.arange(100, dtype=np.int64)
+        groups = hash_utils.scatter_ids(ids, 3)
+        assert sum(len(g) for g in groups) == 100
+        for shard, group in enumerate(groups):
+            assert np.all(group % 3 == shard)
+
+    def test_scatter_with_positions_roundtrip(self):
+        ids = np.array([7, 2, 9, 2, 5, 16], dtype=np.int64)
+        groups, positions = hash_utils.scatter_with_positions(ids, 4)
+        rebuilt = np.empty_like(ids)
+        for g, p in zip(groups, positions):
+            rebuilt[p] = g
+        np.testing.assert_array_equal(rebuilt, ids)
+
+
+class TestTensorSerde:
+    def test_dense_roundtrip(self):
+        t = Tensor("w", np.random.randn(3, 4).astype(np.float32))
+        r = Tensor.from_bytes(t.to_bytes())
+        assert r.name == "w" and not r.is_sparse
+        np.testing.assert_array_equal(r.values, t.values)
+
+    def test_sparse_roundtrip(self):
+        t = Tensor(
+            "emb",
+            np.random.randn(5, 8).astype(np.float32),
+            np.array([3, 1, 4, 1, 5]),
+        )
+        r = Tensor.from_bytes(t.to_bytes())
+        assert r.is_sparse
+        np.testing.assert_array_equal(r.indices, t.indices)
+        np.testing.assert_array_equal(r.values, t.values)
+
+    def test_bfloat16_roundtrip(self):
+        import ml_dtypes
+
+        t = Tensor("b", np.ones((2, 2), dtype=ml_dtypes.bfloat16))
+        r = Tensor.from_bytes(t.to_bytes())
+        assert r.values.dtype == ml_dtypes.bfloat16
+
+    def test_add_dense(self):
+        a = Tensor("x", np.ones((2,), np.float32))
+        b = Tensor("x", np.full((2,), 2.0, np.float32))
+        np.testing.assert_array_equal((a + b).values, [3.0, 3.0])
+
+    def test_add_sparse_concatenates(self):
+        a = Tensor("e", np.ones((2, 3), np.float32), np.array([1, 2]))
+        b = Tensor("e", np.zeros((1, 3), np.float32), np.array([7]))
+        c = a + b
+        np.testing.assert_array_equal(c.indices, [1, 2, 7])
+        assert c.values.shape == (3, 3)
+
+    def test_mixed_add_raises(self):
+        a = Tensor("x", np.ones((2,), np.float32))
+        b = Tensor("x", np.ones((2, 3), np.float32), np.array([0, 1]))
+        with pytest.raises(ValueError):
+            _ = a + b
+
+    def test_collection_roundtrip(self):
+        ts = {
+            "a": Tensor("a", np.arange(6, dtype=np.int32).reshape(2, 3)),
+            "b": Tensor("b", np.ones((4,), np.float64)),
+        }
+        out = deserialize_tensors(serialize_tensors(ts))
+        assert set(out) == {"a", "b"}
+        np.testing.assert_array_equal(out["a"].values, ts["a"].values)
+
+    def test_row_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            Tensor("e", np.ones((2, 3)), np.array([1, 2, 3]))
+
+
+class TestArgs:
+    def _master_argv(self, extra=()):
+        return [
+            "--model_def",
+            "mnist_functional_api.mnist_functional_api.custom_model",
+            "--training_data",
+            "/tmp/mnist/train",
+            *extra,
+        ]
+
+    def test_parse_master_defaults(self):
+        args = args_mod.parse_master_args(self._master_argv())
+        assert args.minibatch_size == 64
+        assert args.num_workers == 1
+        assert args.distribution_strategy == "Local"
+        assert args.model_params_dict == {}
+
+    def test_model_params_dsl(self):
+        args = args_mod.parse_master_args(
+            self._master_argv(
+                ["--model_params", "hidden=128;dropout=0.5;name='deep'"]
+            )
+        )
+        assert args.model_params_dict == {
+            "hidden": 128,
+            "dropout": 0.5,
+            "name": "deep",
+        }
+
+    def test_envs_parse(self):
+        args = args_mod.parse_master_args(
+            self._master_argv(["--envs", "A=1,B=two"])
+        )
+        assert args.envs_dict == {"A": "1", "B": "two"}
+
+    def test_num_minibatches_per_task_coercion(self):
+        args = args_mod.parse_master_args(
+            self._master_argv(
+                ["--minibatch_size", "32", "--num_minibatches_per_task", "8"]
+            )
+        )
+        assert args.records_per_task == 256
+
+    def test_async_coerces_grads_to_wait(self):
+        args = args_mod.parse_master_args(
+            self._master_argv(
+                ["--use_async", "true", "--grads_to_wait", "9"]
+            )
+        )
+        assert args.grads_to_wait == 1
+
+    def test_worker_argv_roundtrip(self):
+        """Master argv -> worker argv -> reparse must preserve train flags
+        (reference args.py:664-685)."""
+        master = args_mod.parse_master_args(
+            self._master_argv(
+                [
+                    "--minibatch_size",
+                    "128",
+                    "--num_epochs",
+                    "3",
+                    "--mesh_shape",
+                    "dp=4,tp=2",
+                    "--remat",
+                    "true",
+                    "--port",
+                    "50099",
+                ]
+            )
+        )
+        argv = args_mod.build_worker_arguments(master, 7, "1.2.3.4:50099")
+        worker = args_mod.parse_worker_args(argv)
+        assert worker.worker_id == 7
+        assert worker.master_addr == "1.2.3.4:50099"
+        assert worker.minibatch_size == 128
+        assert worker.num_epochs == 3
+        assert worker.mesh_shape == "dp=4,tp=2"
+        assert worker.remat is True
+        assert not hasattr(worker, "port")
+
+    def test_bad_params_entry_raises(self):
+        with pytest.raises(ValueError):
+            args_mod.parse_params_dict("novalue")
+
+
+class TestModelUtils:
+    def test_split_model_def(self):
+        from elasticdl_tpu.utils.model_utils import _split_model_def
+
+        path, fn = _split_model_def("a.b.custom_model")
+        assert path.endswith("b.py") and fn == "custom_model"
+        with pytest.raises(ValueError):
+            _split_model_def("nomodule")
