@@ -1,0 +1,4 @@
+"""Control plane: task dispatch, servicer, evaluation, instance management.
+
+Reference: ``elasticdl/python/master/`` (SURVEY.md §2.2).
+"""

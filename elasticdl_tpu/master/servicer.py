@@ -1,0 +1,144 @@
+"""Master service logic, transport-agnostic.
+
+Reference: ``elasticdl/python/master/servicer.py`` — get_task (with the
+WAIT sentinel while eval tasks drain), report_task_result,
+report_evaluation_metrics, report_version.  The TPU build adds a heartbeat
+RPC: with no Kubernetes watch stream in local/managed deployments, worker
+liveness is detected by heartbeat timeout (SURVEY §5 failure detection),
+and the master uses the same channel to signal a quiesce for mesh
+re-formation.
+
+The servicer takes and returns the plain dataclasses of
+:mod:`elasticdl_tpu.rpc.messages`; the gRPC adapter in
+``elasticdl_tpu.rpc.service`` does serialization only.  That split is what
+enables the reference's in-process-master test pattern
+(``tests/in_process_master.py``): tests wire a worker directly to this
+object with zero transport.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from elasticdl_tpu.rpc import messages as msg
+from elasticdl_tpu.utils.constants import TaskType
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+
+class MasterServicer:
+    def __init__(
+        self,
+        minibatch_size: int,
+        task_dispatcher,
+        evaluation_service=None,
+        instance_manager=None,
+    ):
+        self._task_d = task_dispatcher
+        self._minibatch_size = minibatch_size
+        self._evaluation_service = evaluation_service
+        self._instance_manager = instance_manager
+        self._lock = threading.Lock()
+        self._version = 0
+        # worker_id -> last heartbeat wall-clock
+        self._heartbeats: dict[int, float] = {}
+        self._cluster_version = 0
+        self._quiesce = False
+        if evaluation_service is not None:
+            evaluation_service.set_master_servicer(self)
+
+    # ---- model version ----------------------------------------------------
+
+    def get_model_version(self) -> int:
+        return self._version
+
+    # ---- RPC handlers -----------------------------------------------------
+
+    def get_task(self, request: msg.GetTaskRequest) -> msg.TaskResponse:
+        if request.task_type == int(TaskType.EVALUATION):
+            task_id, task = self._task_d.get_eval_task(request.worker_id)
+        else:
+            task_id, task = self._task_d.get(request.worker_id)
+
+        if task is not None:
+            return msg.task_to_response(
+                task_id, task, self._version, self._minibatch_size
+            )
+        if (not self._task_d.finished()) or (
+            self._task_d.invoke_deferred_callback()
+        ):
+            # in-flight tasks may fail and re-queue, or a deferred callback
+            # (SAVE_MODEL) just created new work: tell the worker to wait
+            # (reference servicer.py:53-62)
+            return msg.TaskResponse(
+                type=int(TaskType.WAIT),
+                model_version=self._version,
+                minibatch_size=self._minibatch_size,
+            )
+        return msg.TaskResponse(
+            model_version=self._version, minibatch_size=self._minibatch_size
+        )
+
+    def report_task_result(self, request: msg.ReportTaskResultRequest):
+        if request.err_message:
+            logger.warning("Worker reported error: %s", request.err_message)
+        self._task_d.report(
+            request.task_id,
+            success=not request.err_message,
+            exec_counters=request.exec_counters,
+        )
+
+    def report_version(self, request: msg.ReportVersionRequest):
+        """Workers ping their step count; drives step-based eval triggers
+        (reference servicer.py:79-85, where the PS did the pinging)."""
+        with self._lock:
+            self._version = max(self._version, request.model_version)
+        if self._evaluation_service is not None:
+            self._evaluation_service.add_evaluation_task_if_needed(
+                master_locking=False, model_version=request.model_version
+            )
+
+    def report_evaluation_metrics(
+        self, request: msg.ReportEvaluationMetricsRequest
+    ):
+        if self._evaluation_service is not None:
+            self._evaluation_service.report_evaluation_metrics(
+                request.model_outputs, request.labels
+            )
+
+    def heartbeat(self, request: msg.HeartbeatRequest) -> msg.HeartbeatResponse:
+        with self._lock:
+            self._heartbeats[request.worker_id] = time.monotonic()
+        if self._instance_manager is not None:
+            self._instance_manager.on_heartbeat(request.worker_id)
+        return msg.HeartbeatResponse(
+            should_quiesce=self._quiesce,
+            cluster_version=self._cluster_version,
+        )
+
+    # ---- failure detection / mesh re-formation hooks ----------------------
+
+    def dead_workers(self, timeout_secs: float) -> list[int]:
+        """Workers whose last heartbeat is older than the timeout."""
+        now = time.monotonic()
+        with self._lock:
+            return [
+                wid
+                for wid, at in self._heartbeats.items()
+                if now - at > timeout_secs
+            ]
+
+    def forget_worker(self, worker_id: int):
+        with self._lock:
+            self._heartbeats.pop(worker_id, None)
+
+    def begin_quiesce(self):
+        """Ask all workers to pause at the next task boundary (first phase
+        of mesh re-formation)."""
+        with self._lock:
+            self._quiesce = True
+
+    def end_quiesce(self):
+        with self._lock:
+            self._quiesce = False
+            self._cluster_version += 1

@@ -1,0 +1,8 @@
+"""Control-plane transport: message types + gRPC service plumbing.
+
+Reference: ``elasticdl/proto/elasticdl.proto`` + generated stubs.  The TPU
+build keeps gRPC as the transport but replaces protobuf codegen with
+hand-rolled msgpack message dataclasses (``messages.py``) registered via
+generic method handlers (``service.py``) — no grpc_tools dependency, same
+wire properties (binary, framed, 256MB cap).
+"""

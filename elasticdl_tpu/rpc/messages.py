@@ -1,0 +1,156 @@
+"""Control-plane message types.
+
+Reference: the protobuf messages in ``elasticdl/proto/elasticdl.proto``
+(Task, GetTaskRequest, ReportTaskResultRequest, ReportEvaluationMetricsRequest,
+ReportVersionRequest).  The TPU build represents them as plain dataclasses
+serialized with msgpack; tensors ride as raw frames from
+:mod:`elasticdl_tpu.utils.tensor` inside the msgpack map.  This keeps the
+wire binary and schema'd without a protoc/grpc_tools build step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+
+import msgpack
+
+from elasticdl_tpu.utils.constants import TaskType
+from elasticdl_tpu.utils.tensor import (
+    Tensor,
+    deserialize_tensors,
+    serialize_tensors,
+)
+
+
+@dataclass
+class GetTaskRequest:
+    worker_id: int
+    task_type: int = -1  # -1 = any; TaskType.EVALUATION for eval-only pulls
+
+
+@dataclass
+class TaskResponse:
+    """A leased task (or WAIT/empty sentinel).
+
+    ``task_id == -1`` with ``type == WAIT`` means poll again later;
+    ``task_id == -1`` with ``type == -1`` means the job is complete.
+    """
+
+    task_id: int = -1
+    shard_name: str = ""
+    start: int = 0
+    end: int = 0
+    type: int = -1
+    model_version: int = -1
+    minibatch_size: int = 0
+    extended: dict = field(default_factory=dict)
+
+    @property
+    def is_wait(self) -> bool:
+        return self.task_id == -1 and self.type == int(TaskType.WAIT)
+
+    @property
+    def is_empty(self) -> bool:
+        return self.task_id == -1 and self.type == -1
+
+
+@dataclass
+class ReportTaskResultRequest:
+    task_id: int
+    err_message: str = ""
+    exec_counters: dict = field(default_factory=dict)
+
+
+@dataclass
+class ReportVersionRequest:
+    model_version: int
+    worker_id: int = 0
+
+
+@dataclass
+class ReportEvaluationMetricsRequest:
+    """Eval forward outputs + labels for master-side metric accumulation.
+
+    Tensors are carried out-of-band as serialized frames so msgpack never
+    sees large binary blobs it would copy.
+    """
+
+    model_outputs: dict = field(default_factory=dict)  # name -> Tensor
+    labels: Tensor | None = None
+    model_version: int = -1
+
+
+@dataclass
+class HeartbeatRequest:
+    worker_id: int
+    step: int = 0
+    timestamp: float = 0.0
+
+
+@dataclass
+class HeartbeatResponse:
+    accepted: bool = True
+    # master may instruct the worker to quiesce for mesh re-formation
+    should_quiesce: bool = False
+    cluster_version: int = 0
+
+
+_SIMPLE_TYPES = {
+    "GetTaskRequest": GetTaskRequest,
+    "TaskResponse": TaskResponse,
+    "ReportTaskResultRequest": ReportTaskResultRequest,
+    "ReportVersionRequest": ReportVersionRequest,
+    "HeartbeatRequest": HeartbeatRequest,
+    "HeartbeatResponse": HeartbeatResponse,
+}
+
+
+def encode(msg) -> bytes:
+    """Serialize any message dataclass to bytes."""
+    kind = type(msg).__name__
+    if kind == "ReportEvaluationMetricsRequest":
+        payload = {
+            "model_version": msg.model_version,
+            "outputs": serialize_tensors(msg.model_outputs),
+            "labels": b""
+            if msg.labels is None
+            else msg.labels.to_bytes(),
+        }
+    else:
+        payload = asdict(msg)
+    return msgpack.packb({"kind": kind, "body": payload}, use_bin_type=True)
+
+
+def decode(buf: bytes):
+    """Deserialize bytes back into the right message dataclass."""
+    obj = msgpack.unpackb(buf, raw=False)
+    kind, body = obj["kind"], obj["body"]
+    if kind == "ReportEvaluationMetricsRequest":
+        return ReportEvaluationMetricsRequest(
+            model_outputs=deserialize_tensors(body["outputs"]),
+            labels=Tensor.from_bytes(body["labels"])
+            if body["labels"]
+            else None,
+            model_version=body["model_version"],
+        )
+    cls = _SIMPLE_TYPES.get(kind)
+    if cls is None:
+        raise ValueError(f"unknown message kind: {kind}")
+    return cls(**body)
+
+
+def task_to_response(
+    task_id: int, task, model_version: int, minibatch_size: int
+) -> TaskResponse:
+    return TaskResponse(
+        task_id=task_id,
+        shard_name=task.shard_name,
+        start=task.start,
+        end=task.end,
+        type=int(task.type),
+        model_version=task.model_version
+        if task.type == TaskType.EVALUATION
+        else model_version,
+        minibatch_size=minibatch_size,
+        extended=dict(task.extended),
+    )
