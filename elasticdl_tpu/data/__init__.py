@@ -1,0 +1,13 @@
+"""Data layer: readers, the EDLIO record format, dataset pipeline.
+
+Reference: ``elasticdl/python/data/`` (SURVEY §2.6).  The reference's
+RecordIO dependency (Go ``pyrecordio``) is replaced by EDLIO, our own
+seekable record container (C++ codec + pure-Python fallback), and the
+tf.data pipeline is replaced by a numpy pipeline with threaded prefetch
+feeding ``jax.device_put`` directly.
+"""
+
+from elasticdl_tpu.data.dataset import Dataset
+from elasticdl_tpu.data.reader import AbstractDataReader, Metadata
+
+__all__ = ["Dataset", "AbstractDataReader", "Metadata"]

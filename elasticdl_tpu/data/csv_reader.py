@@ -1,0 +1,64 @@
+"""CSV data reader (local/debug use).
+
+Reference: ``elasticdl/python/data/reader/csv_reader.py`` — line-oriented
+records; unlike EDLIO there is no index, so ranged reads re-scan from the
+top (same limitation as the reference, csv_reader.py:13-21).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Iterator
+
+from elasticdl_tpu.data.reader import AbstractDataReader, Metadata
+
+
+class CSVDataReader(AbstractDataReader):
+    def __init__(self, data_path: str = "", sep: str = ",", **kwargs):
+        super().__init__(**kwargs)
+        self._path = data_path or kwargs.get("data_dir", "")
+        self._sep = sep
+        self._columns: list[str] | None = None
+
+    def _files(self) -> list[str]:
+        if os.path.isdir(self._path):
+            return [
+                os.path.join(self._path, f)
+                for f in sorted(os.listdir(self._path))
+                if f.endswith(".csv")
+            ]
+        return [self._path]
+
+    def read_records(self, task) -> Iterator[list[str]]:
+        with open(task.shard_name, newline="") as f:
+            reader = csv.reader(f, delimiter=self._sep)
+            header = next(reader, None)
+            if header is not None:
+                self._columns = header
+            for i, row in enumerate(reader):
+                if i >= task.end:
+                    break
+                if i >= task.start:
+                    yield row
+
+    def create_shards(self) -> dict[str, tuple[int, int]]:
+        shards = {}
+        for path in self._files():
+            with open(path, newline="") as f:
+                n = sum(1 for _ in f)
+            shards[path] = (0, max(0, n - 1))  # minus header line
+        return shards
+
+    @property
+    def records_output_types(self):
+        return list
+
+    @property
+    def metadata(self) -> Metadata:
+        if self._columns is None:
+            files = self._files()
+            if files:
+                with open(files[0], newline="") as f:
+                    self._columns = next(csv.reader(f, delimiter=self._sep), [])
+        return Metadata(column_names=self._columns or [])

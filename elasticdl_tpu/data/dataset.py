@@ -1,0 +1,161 @@
+"""Numpy dataset pipeline with threaded prefetch.
+
+Replaces the reference's tf.data usage (``worker.py:972-977``:
+``dataset_fn(ds, mode, metadata)`` then ``.batch().prefetch(1)``) with a
+small composable pipeline that produces host numpy batches ready for
+``jax.device_put``.  Transformations are lazy; each ``__iter__`` restarts
+from the source, so a dataset built over a task's record range can be
+re-consumed on retry.
+
+The model-zoo ``dataset_fn(dataset, mode, metadata)`` contract operates on
+this class: readers produce raw records, ``map`` decodes them, the worker
+applies ``batch``/``prefetch``.
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+import threading
+from typing import Any, Callable, Iterable, Iterator
+
+import numpy as np
+
+
+def _stack(elements: list):
+    """Stack a list of pipeline elements into one batched element.
+
+    Handles dicts (by key), tuples/lists (by position), scalars and
+    ndarrays (np.stack).
+    """
+    first = elements[0]
+    if isinstance(first, dict):
+        return {k: _stack([e[k] for e in elements]) for k in first}
+    if isinstance(first, (tuple, list)):
+        cols = [_stack([e[i] for e in elements]) for i in range(len(first))]
+        return tuple(cols) if isinstance(first, tuple) else cols
+    return np.stack([np.asarray(e) for e in elements])
+
+
+class Dataset:
+    def __init__(self, source: Callable[[], Iterator]):
+        self._source = source
+
+    # ---- constructors -----------------------------------------------------
+
+    @staticmethod
+    def from_generator(gen_factory: Callable[[], Iterable]) -> "Dataset":
+        return Dataset(lambda: iter(gen_factory()))
+
+    @staticmethod
+    def from_records(records: Iterable) -> "Dataset":
+        materialized = records if isinstance(records, list) else list(records)
+        return Dataset(lambda: iter(materialized))
+
+    # ---- transformations --------------------------------------------------
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        parent = self._source
+        return Dataset(lambda: (fn(x) for x in parent()))
+
+    def filter(self, predicate: Callable[[Any], bool]) -> "Dataset":
+        parent = self._source
+        return Dataset(lambda: (x for x in parent() if predicate(x)))
+
+    def shuffle(self, buffer_size: int, seed: int | None = None) -> "Dataset":
+        parent = self._source
+
+        def gen():
+            rng = random.Random(seed)
+            buf: list = []
+            for x in parent():
+                buf.append(x)
+                if len(buf) >= buffer_size:
+                    idx = rng.randrange(len(buf))
+                    buf[idx], buf[-1] = buf[-1], buf[idx]
+                    yield buf.pop()
+            rng.shuffle(buf)
+            yield from buf
+
+        return Dataset(gen)
+
+    def batch(
+        self, batch_size: int, drop_remainder: bool = False
+    ) -> "Dataset":
+        parent = self._source
+
+        def gen():
+            acc: list = []
+            for x in parent():
+                acc.append(x)
+                if len(acc) == batch_size:
+                    yield _stack(acc)
+                    acc = []
+            if acc and not drop_remainder:
+                yield _stack(acc)
+
+        return Dataset(gen)
+
+    def repeat(self, count: int = -1) -> "Dataset":
+        parent = self._source
+
+        def gen():
+            n = 0
+            while count < 0 or n < count:
+                yielded = False
+                for x in parent():
+                    yielded = True
+                    yield x
+                if not yielded:
+                    return
+                n += 1
+
+        return Dataset(gen)
+
+    def take(self, count: int) -> "Dataset":
+        parent = self._source
+
+        def gen():
+            for i, x in enumerate(parent()):
+                if i >= count:
+                    return
+                yield x
+
+        return Dataset(gen)
+
+    def prefetch(self, buffer_size: int = 2) -> "Dataset":
+        parent = self._source
+
+        def gen():
+            q: queue.Queue = queue.Queue(maxsize=buffer_size)
+            _END = object()
+            error: list = []
+
+            def producer():
+                try:
+                    for x in parent():
+                        q.put(x)
+                except BaseException as e:  # noqa: BLE001 - re-raised below
+                    error.append(e)
+                finally:
+                    q.put(_END)
+
+            t = threading.Thread(target=producer, daemon=True)
+            t.start()
+            while True:
+                x = q.get()
+                if x is _END:
+                    if error:
+                        raise error[0]
+                    return
+                yield x
+
+        return Dataset(gen)
+
+    # ---- consumption ------------------------------------------------------
+
+    def __iter__(self) -> Iterator:
+        return self._source()
+
+    def as_numpy(self) -> list:
+        return list(self)

@@ -1,0 +1,36 @@
+"""Data reader factory.
+
+Reference: ``elasticdl/python/data/reader/data_reader_factory.py`` —
+ODPS when env-configured, CSV by extension, else RecordIO.  A model module
+can override with ``custom_data_reader`` (reference
+model_utils.py:94-150).
+"""
+
+from __future__ import annotations
+
+from elasticdl_tpu.data.csv_reader import CSVDataReader
+from elasticdl_tpu.data.reader import AbstractDataReader
+from elasticdl_tpu.data.recordio_reader import RecordIODataReader
+
+
+def create_data_reader(
+    data_origin: str,
+    records_per_task: int | None = None,
+    custom_reader=None,
+    **kwargs,
+) -> AbstractDataReader:
+    if custom_reader is not None:
+        return custom_reader(
+            data_origin=data_origin,
+            records_per_task=records_per_task,
+            **kwargs,
+        )
+    from elasticdl_tpu.data.odps_reader import is_odps_configured
+
+    if data_origin.startswith("odps://") or is_odps_configured():
+        from elasticdl_tpu.data.odps_reader import ODPSDataReader
+
+        return ODPSDataReader(table=data_origin, **kwargs)
+    if data_origin.endswith(".csv") or kwargs.get("reader_type") == "CSV":
+        return CSVDataReader(data_path=data_origin, **kwargs)
+    return RecordIODataReader(data_dir=data_origin, **kwargs)

@@ -1,0 +1,93 @@
+"""MaxCompute (ODPS) table reader — gated on the optional odps SDK.
+
+Reference: ``elasticdl/python/data/reader/odps_reader.py`` +
+``data/odps_io.py`` — table scans with shard = row range, threaded chunked
+download.  The TPU build keeps the same shard semantics; the SDK is not in
+the base image, so construction raises a clear error unless ``odps`` is
+importable.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+from elasticdl_tpu.data.reader import AbstractDataReader, Metadata
+
+try:  # pragma: no cover - exercised only with the SDK installed
+    from odps import ODPS  # type: ignore
+
+    _ODPS_AVAILABLE = True
+except ImportError:
+    ODPS = None
+    _ODPS_AVAILABLE = False
+
+
+class ODPSDataReader(AbstractDataReader):
+    def __init__(
+        self,
+        project: str = "",
+        access_id: str = "",
+        access_key: str = "",
+        endpoint: str = "",
+        table: str = "",
+        partition: str | None = None,
+        columns: list[str] | None = None,
+        records_per_shard: int = 16384,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        if not _ODPS_AVAILABLE:
+            raise ImportError(
+                "ODPSDataReader requires the 'odps' SDK, which is not "
+                "installed in this image; use RecordIO or CSV readers, or "
+                "install pyodps"
+            )
+        self._project = project or os.environ.get("ODPS_PROJECT_NAME", "")
+        self._table = table
+        self._partition = partition
+        self._columns = columns
+        self._records_per_shard = records_per_shard
+        self._client = ODPS(
+            access_id or os.environ.get("ODPS_ACCESS_ID", ""),
+            access_key or os.environ.get("ODPS_ACCESS_KEY", ""),
+            self._project,
+            endpoint=endpoint or os.environ.get("ODPS_ENDPOINT", ""),
+        )
+
+    def _table_size(self) -> int:
+        t = self._client.get_table(self._table)
+        with t.open_reader(partition=self._partition) as reader:
+            return reader.count
+
+    def read_records(self, task) -> Iterator[list]:
+        t = self._client.get_table(self._table)
+        with t.open_reader(partition=self._partition) as reader:
+            for rec in reader.read(
+                start=task.start, count=task.end - task.start
+            ):
+                yield [rec[c] for c in (self._columns or rec.keys())]
+
+    def create_shards(self) -> dict[str, tuple[int, int]]:
+        total = self._table_size()
+        shards = {}
+        for start in range(0, total, self._records_per_shard):
+            count = min(self._records_per_shard, total - start)
+            shards[f"odps://{self._project}/{self._table}:{start}"] = (
+                start,
+                count,
+            )
+        return shards
+
+    @property
+    def metadata(self) -> Metadata:
+        return Metadata(column_names=list(self._columns or []))
+
+
+def is_odps_configured() -> bool:
+    """Env-based detection (reference data_reader_factory.py checks the
+    same variables)."""
+    return _ODPS_AVAILABLE and all(
+        os.environ.get(k)
+        for k in ("ODPS_PROJECT_NAME", "ODPS_ACCESS_ID", "ODPS_ACCESS_KEY")
+    )

@@ -1,0 +1,70 @@
+"""Data reader contract + example record codec.
+
+Reference: ``elasticdl/python/data/reader/data_reader.py`` — the ABC every
+reader implements (``read_records(task)``, ``create_shards()``,
+``records_output_types``, ``Metadata``) that ties the data layer to the
+task dispatcher: ``create_shards()`` output is exactly the shard dict the
+dispatcher slices into tasks.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+import numpy as np
+
+from elasticdl_tpu.utils.tensor import (
+    deserialize_tensors,
+    ndarray_to_tensor,
+    serialize_tensors,
+)
+
+
+@dataclass
+class Metadata:
+    """Schema info a reader can surface to ``dataset_fn``
+    (reference data_reader.py:40-49)."""
+
+    column_names: list[str] = field(default_factory=list)
+    column_dtypes: dict[str, Any] = field(default_factory=dict)
+    extra: dict = field(default_factory=dict)
+
+
+class AbstractDataReader(abc.ABC):
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    @abc.abstractmethod
+    def read_records(self, task) -> Iterator:
+        """Yield the raw records of ``task``'s range [task.start, task.end)."""
+
+    @abc.abstractmethod
+    def create_shards(self) -> dict[str, tuple[int, int]]:
+        """Map shard_name -> (start_index, num_records)."""
+
+    @property
+    def records_output_types(self):
+        """Dtype hint for the record stream (bytes by default)."""
+        return bytes
+
+    @property
+    def metadata(self) -> Metadata:
+        return Metadata()
+
+
+def encode_example(features: dict[str, np.ndarray]) -> bytes:
+    """Standard record payload: a named-tensor dict (framework codec used by
+    the synthetic dataset generators and the built-in model zoo).
+
+    Replaces the reference's TF Example/RecordIO payloads with the
+    framework's own tensor frames — no TF proto dependency.
+    """
+    return serialize_tensors(
+        {k: ndarray_to_tensor(k, v) for k, v in features.items()}
+    )
+
+
+def decode_example(payload: bytes) -> dict[str, np.ndarray]:
+    return {k: t.values for k, t in deserialize_tensors(payload).items()}

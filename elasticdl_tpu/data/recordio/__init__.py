@@ -1,0 +1,197 @@
+"""EDLIO: seekable record container (see FORMAT.md).
+
+Public API mirrors the access pattern the reference gets from the external
+``pyrecordio`` package (``recordio_reader.py:20-40``): ``Writer``,
+``Scanner(path, start, length)``, ``num_records(path)``.
+
+Backend selection: the C++ codec (``_native.so``, built by ``build.py``) is
+used when available; otherwise the pure-Python implementation.  Both emit
+and read the identical on-disk format.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+from elasticdl_tpu.data.recordio import _pyimpl
+from elasticdl_tpu.data.recordio._pyimpl import CorruptFileError
+
+__all__ = [
+    "Writer",
+    "Scanner",
+    "num_records",
+    "CorruptFileError",
+    "native_available",
+]
+
+_NATIVE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native.so")
+_lib = None
+
+
+def _load_native():
+    global _lib
+    if _lib is not None or not os.path.exists(_NATIVE_PATH):
+        return _lib
+    lib = ctypes.CDLL(_NATIVE_PATH)
+    lib.edlio_writer_open.restype = ctypes.c_void_p
+    lib.edlio_writer_open.argtypes = [ctypes.c_char_p]
+    lib.edlio_writer_write.restype = ctypes.c_int
+    lib.edlio_writer_write.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+    ]
+    lib.edlio_writer_close.restype = ctypes.c_int
+    lib.edlio_writer_close.argtypes = [ctypes.c_void_p]
+    lib.edlio_num_records.restype = ctypes.c_int64
+    lib.edlio_num_records.argtypes = [ctypes.c_char_p]
+    lib.edlio_scanner_open.restype = ctypes.c_void_p
+    lib.edlio_scanner_open.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+    ]
+    lib.edlio_scanner_next_batch.restype = ctypes.c_int64
+    lib.edlio_scanner_next_batch.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int64,
+    ]
+    lib.edlio_scanner_close.restype = None
+    lib.edlio_scanner_close.argtypes = [ctypes.c_void_p]
+    lib.edlio_last_error.restype = ctypes.c_char_p
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load_native() is not None
+
+
+def _native_error(lib) -> str:
+    return lib.edlio_last_error().decode("utf-8", "replace")
+
+
+class _NativeWriter:
+    def __init__(self, path: str):
+        lib = _load_native()
+        self._lib = lib
+        self._h = lib.edlio_writer_open(path.encode())
+        if not self._h:
+            raise IOError(_native_error(lib))
+
+    def write(self, payload: bytes):
+        if isinstance(payload, str):
+            payload = payload.encode("utf-8")
+        if self._lib.edlio_writer_write(self._h, payload, len(payload)) != 0:
+            raise IOError(_native_error(self._lib))
+
+    def close(self):
+        if self._h:
+            rc = self._lib.edlio_writer_close(self._h)
+            self._h = None
+            if rc != 0:
+                raise IOError(_native_error(self._lib))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class _NativeScanner:
+    """Batch-reading scanner over the C++ codec.
+
+    One FFI call fetches up to ``batch_records`` payloads into a reusable
+    buffer; ``record()``/iteration then slice views out of it.
+    """
+
+    _BUF_CAP = 8 << 20  # 8 MiB
+    _BATCH_RECORDS = 4096
+
+    def __init__(self, path: str, start: int = 0, length: int = -1):
+        lib = _load_native()
+        self._lib = lib
+        self._h = lib.edlio_scanner_open(path.encode(), start, length)
+        if not self._h:
+            raise (
+                IndexError(_native_error(lib))
+                if "out of range" in _native_error(lib)
+                else CorruptFileError(_native_error(lib))
+            )
+        self._buf = ctypes.create_string_buffer(self._BUF_CAP)
+        self._lengths = (ctypes.c_uint64 * self._BATCH_RECORDS)()
+        self._pending: list[bytes] = []
+        self._pending_idx = 0
+        self._exhausted = False
+
+    def _refill(self) -> bool:
+        n = self._lib.edlio_scanner_next_batch(
+            self._h, self._buf, self._BUF_CAP, self._lengths, self._BATCH_RECORDS
+        )
+        if n < 0:
+            raise CorruptFileError(_native_error(self._lib))
+        if n == 0:
+            self._exhausted = True
+            return False
+        raw = self._buf.raw
+        out, off = [], 0
+        for i in range(n):
+            ln = self._lengths[i]
+            out.append(raw[off : off + ln])
+            off += ln
+        self._pending = out
+        self._pending_idx = 0
+        return True
+
+    def record(self) -> bytes | None:
+        if self._pending_idx >= len(self._pending):
+            if self._exhausted or not self._refill():
+                return None
+        rec = self._pending[self._pending_idx]
+        self._pending_idx += 1
+        return rec
+
+    def __iter__(self):
+        while True:
+            rec = self.record()
+            if rec is None:
+                return
+            yield rec
+
+    def close(self):
+        if self._h:
+            self._lib.edlio_scanner_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def Writer(path: str):
+    if native_available():
+        return _NativeWriter(path)
+    return _pyimpl.Writer(path)
+
+
+def Scanner(path: str, start: int = 0, length: int = -1):
+    if native_available():
+        return _NativeScanner(path, start, length)
+    return _pyimpl.Scanner(path, start, length)
+
+
+def num_records(path: str) -> int:
+    lib = _load_native()
+    if lib is not None:
+        n = lib.edlio_num_records(path.encode())
+        if n < 0:
+            raise CorruptFileError(_native_error(lib))
+        return n
+    return _pyimpl.num_records(path)

@@ -1,0 +1,52 @@
+"""Build the native EDLIO codec: ``python -m elasticdl_tpu.data.recordio.build``.
+
+Compiles ``_native.cc`` into ``_native.so`` next to this file.  The Python
+package auto-loads the .so when present and falls back to the pure-Python
+codec otherwise, so the build step is optional but recommended for IO-bound
+jobs.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+SOURCE = os.path.join(_HERE, "_native.cc")
+OUTPUT = os.path.join(_HERE, "_native.so")
+
+
+def build(force: bool = False, quiet: bool = False) -> str | None:
+    """Compile the codec; returns the .so path or None on failure."""
+    if (
+        not force
+        and os.path.exists(OUTPUT)
+        and os.path.getmtime(OUTPUT) >= os.path.getmtime(SOURCE)
+    ):
+        return OUTPUT
+    cmd = [
+        "g++",
+        "-O2",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        SOURCE,
+        "-lz",
+        "-o",
+        OUTPUT,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=quiet)
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        if not quiet:
+            print(f"EDLIO native build failed: {e}", file=sys.stderr)
+        return None
+    return OUTPUT
+
+
+if __name__ == "__main__":
+    path = build(force="--force" in sys.argv)
+    if path is None:
+        sys.exit(1)
+    print(path)

@@ -1,0 +1,10 @@
+"""Synthetic dataset generators writing EDLIO shards.
+
+Reference: ``elasticdl/python/data/recordio_gen/`` (census / frappe /
+heart / mnist generators, ~610 LoC).  The cluster this build runs on has no
+egress, so instead of downloading the real datasets the generators emit
+*learnable* synthetic data with the same schema: each class is a random
+template plus noise, so models genuinely converge and accuracy assertions
+are meaningful (the reference's own tier-2 tests use generated data the
+same way, ``test_utils.py:92-162``).
+"""

@@ -102,7 +102,11 @@ class Tensor:
         return Tensor(self.name, self.values + other.values)
 
     def to_bytes(self) -> bytes:
-        values = np.ascontiguousarray(self.values)
+        values = self.values
+        if not values.flags["C_CONTIGUOUS"]:
+            # note: np.ascontiguousarray would promote 0-d arrays to 1-d,
+            # so only call it when actually needed
+            values = np.ascontiguousarray(values)
         header = {
             "name": self.name,
             "dtype": _dtype_name(values.dtype),
@@ -111,7 +115,9 @@ class Tensor:
         }
         parts = []
         if self.is_sparse:
-            idx = np.ascontiguousarray(self.indices)
+            idx = self.indices
+            if not idx.flags["C_CONTIGUOUS"]:
+                idx = np.ascontiguousarray(idx)
             header["num_indices"] = int(idx.shape[0])
             parts.append(idx.tobytes())
         hdr = json.dumps(header).encode("utf-8")

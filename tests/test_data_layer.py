@@ -1,0 +1,279 @@
+"""Data layer tests: EDLIO codec (python + native interchange), readers,
+dataset pipeline, generators (SURVEY §4 tier 1)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.data import recordio
+from elasticdl_tpu.data.csv_reader import CSVDataReader
+from elasticdl_tpu.data.dataset import Dataset
+from elasticdl_tpu.data.factory import create_data_reader
+from elasticdl_tpu.data.parallel_transform import ParallelTransform
+from elasticdl_tpu.data.reader import decode_example, encode_example
+from elasticdl_tpu.data.recordio import _pyimpl
+from elasticdl_tpu.data.recordio_reader import RecordIODataReader
+from elasticdl_tpu.data.recordio_gen import synthetic
+from elasticdl_tpu.master.task_dispatcher import Task
+from elasticdl_tpu.utils.constants import TaskType
+
+
+def _write_py(path, payloads):
+    with _pyimpl.Writer(path) as w:
+        for p in payloads:
+            w.write(p)
+
+
+PAYLOADS = [b"alpha", b"bravo" * 100, b"", b"delta", bytes(range(256))]
+
+
+class TestPyCodec:
+    def test_roundtrip(self, tmp_path):
+        path = str(tmp_path / "f.edlio")
+        _write_py(path, PAYLOADS)
+        assert _pyimpl.num_records(path) == 5
+        with _pyimpl.Scanner(path) as s:
+            assert list(s) == PAYLOADS
+
+    def test_ranged_scan(self, tmp_path):
+        path = str(tmp_path / "f.edlio")
+        _write_py(path, PAYLOADS)
+        with _pyimpl.Scanner(path, 1, 2) as s:
+            assert list(s) == PAYLOADS[1:3]
+        with _pyimpl.Scanner(path, 4, -1) as s:
+            assert list(s) == PAYLOADS[4:]
+        with _pyimpl.Scanner(path, 5) as s:
+            assert list(s) == []
+
+    def test_out_of_range_start(self, tmp_path):
+        path = str(tmp_path / "f.edlio")
+        _write_py(path, PAYLOADS)
+        with pytest.raises(IndexError):
+            _pyimpl.Scanner(path, 6)
+
+    def test_corrupt_detection(self, tmp_path):
+        path = str(tmp_path / "f.edlio")
+        _write_py(path, PAYLOADS)
+        data = bytearray(open(path, "rb").read())
+        data[10] ^= 0xFF  # flip a payload byte
+        open(path, "wb").write(bytes(data))
+        with pytest.raises(_pyimpl.CorruptFileError):
+            list(_pyimpl.Scanner(path))
+
+    def test_truncated_file(self, tmp_path):
+        path = str(tmp_path / "f.edlio")
+        _write_py(path, PAYLOADS)
+        data = open(path, "rb").read()
+        open(path, "wb").write(data[:-10])
+        with pytest.raises(_pyimpl.CorruptFileError):
+            _pyimpl.num_records(path)
+
+
+class TestNativeCodec:
+    @pytest.fixture(autouse=True)
+    def _build(self):
+        from elasticdl_tpu.data.recordio.build import build
+
+        if build(quiet=True) is None:
+            pytest.skip("g++ unavailable")
+        assert recordio.native_available()
+
+    def test_native_roundtrip(self, tmp_path):
+        path = str(tmp_path / "n.edlio")
+        with recordio.Writer(path) as w:
+            for p in PAYLOADS:
+                w.write(p)
+        assert recordio.num_records(path) == 5
+        with recordio.Scanner(path) as s:
+            assert list(s) == PAYLOADS
+
+    def test_interchange_native_writes_python_reads(self, tmp_path):
+        path = str(tmp_path / "n.edlio")
+        with recordio.Writer(path) as w:  # native
+            for p in PAYLOADS:
+                w.write(p)
+        with _pyimpl.Scanner(path, 1, 3) as s:
+            assert list(s) == PAYLOADS[1:4]
+
+    def test_interchange_python_writes_native_reads(self, tmp_path):
+        path = str(tmp_path / "p.edlio")
+        _write_py(path, PAYLOADS)
+        with recordio.Scanner(path, 2, -1) as s:
+            assert list(s) == PAYLOADS[2:]
+
+    def test_native_large_batch(self, tmp_path):
+        path = str(tmp_path / "big.edlio")
+        payloads = [os.urandom(1000) for _ in range(5000)]
+        with recordio.Writer(path) as w:
+            for p in payloads:
+                w.write(p)
+        with recordio.Scanner(path, 100, 4900) as s:
+            got = list(s)
+        assert got == payloads[100:]
+
+    def test_native_corrupt_detection(self, tmp_path):
+        path = str(tmp_path / "c.edlio")
+        with recordio.Writer(path) as w:
+            for p in PAYLOADS:
+                w.write(p)
+        data = bytearray(open(path, "rb").read())
+        data[9] ^= 0x01
+        open(path, "wb").write(bytes(data))
+        with pytest.raises(recordio.CorruptFileError):
+            list(recordio.Scanner(path))
+
+
+class TestExampleCodec:
+    def test_roundtrip(self):
+        ex = {
+            "image": np.random.randint(0, 255, (28, 28), dtype=np.uint8),
+            "label": np.int64(7),
+        }
+        out = decode_example(encode_example(ex))
+        np.testing.assert_array_equal(out["image"], ex["image"])
+        assert out["label"] == 7
+
+
+class TestReaders:
+    def test_recordio_reader_end_to_end(self, tmp_path):
+        data_dir = synthetic.gen_mnist(
+            str(tmp_path / "mnist"), num_records=64, num_shards=3
+        )
+        reader = RecordIODataReader(data_dir=data_dir)
+        shards = reader.create_shards()
+        assert len(shards) == 3
+        assert sum(n for _, n in shards.values()) == 64
+        name, (start, count) = next(iter(shards.items()))
+        task = Task(name, 0, min(10, count), TaskType.TRAINING)
+        records = list(reader.read_records(task))
+        assert len(records) == task.num_records
+        ex = decode_example(records[0])
+        assert ex["image"].shape == (28, 28)
+
+    def test_csv_reader(self, tmp_path):
+        path = str(tmp_path / "d.csv")
+        with open(path, "w") as f:
+            f.write("a,b,label\n")
+            for i in range(10):
+                f.write(f"{i},{i*2},{i%2}\n")
+        reader = CSVDataReader(data_path=path)
+        shards = reader.create_shards()
+        assert shards == {path: (0, 10)}
+        task = Task(path, 2, 5, TaskType.TRAINING)
+        rows = list(reader.read_records(task))
+        assert rows == [["2", "4", "0"], ["3", "6", "1"], ["4", "8", "0"]]
+        assert reader.metadata.column_names == ["a", "b", "label"]
+
+    def test_factory_dispatch(self, tmp_path):
+        csv = tmp_path / "x.csv"
+        csv.write_text("a\n1\n")
+        assert isinstance(
+            create_data_reader(str(csv)), CSVDataReader
+        )
+        assert isinstance(
+            create_data_reader(str(tmp_path)), RecordIODataReader
+        )
+
+    def test_factory_custom_reader(self):
+        class MyReader:
+            def __init__(self, **kw):
+                self.kw = kw
+
+        r = create_data_reader("/x", custom_reader=MyReader, foo=1)
+        assert isinstance(r, MyReader) and r.kw["foo"] == 1
+
+
+class TestDataset:
+    def test_map_batch(self):
+        ds = (
+            Dataset.from_records(list(range(10)))
+            .map(lambda x: {"v": np.float32(x)})
+            .batch(4)
+        )
+        batches = list(ds)
+        assert len(batches) == 3
+        np.testing.assert_array_equal(batches[0]["v"], [0, 1, 2, 3])
+        assert batches[2]["v"].shape == (2,)
+
+    def test_batch_drop_remainder(self):
+        ds = Dataset.from_records(list(range(10))).batch(4, drop_remainder=True)
+        assert len(list(ds)) == 2
+
+    def test_tuple_elements(self):
+        ds = Dataset.from_records(
+            [(np.ones((2,)), np.int64(i)) for i in range(4)]
+        ).batch(2)
+        x, y = next(iter(ds))
+        assert x.shape == (2, 2) and y.shape == (2,)
+
+    def test_shuffle_deterministic_and_complete(self):
+        base = list(range(100))
+        ds = Dataset.from_records(base).shuffle(16, seed=3)
+        out1, out2 = list(ds), list(ds)
+        assert out1 == out2
+        assert sorted(out1) == base
+        assert out1 != base
+
+    def test_prefetch_preserves_order_and_errors(self):
+        ds = Dataset.from_records(list(range(50))).prefetch(4)
+        assert list(ds) == list(range(50))
+
+        def boom():
+            yield 1
+            raise RuntimeError("producer failed")
+
+        with pytest.raises(RuntimeError, match="producer failed"):
+            list(Dataset.from_generator(boom).prefetch(2))
+
+    def test_repeat_take(self):
+        ds = Dataset.from_records([1, 2, 3]).repeat().take(7)
+        assert list(ds) == [1, 2, 3, 1, 2, 3, 1]
+
+    def test_reiterable(self):
+        ds = Dataset.from_records([1, 2, 3]).map(lambda x: x * 2)
+        assert list(ds) == list(ds) == [2, 4, 6]
+
+
+class TestParallelTransform:
+    def test_order_preserved(self):
+        pt = ParallelTransform(lambda x: x * x, num_workers=4)
+        assert list(pt.apply(range(100))) == [x * x for x in range(100)]
+
+
+class TestGenerators:
+    @pytest.mark.parametrize("name", sorted(synthetic.GENERATORS))
+    def test_all_generators_produce_readable_shards(self, tmp_path, name):
+        out = synthetic.GENERATORS[name](
+            str(tmp_path / name), num_records=32, num_shards=2
+        )
+        reader = RecordIODataReader(data_dir=out)
+        shards = reader.create_shards()
+        assert sum(n for _, n in shards.values()) == 32
+        path, (start, count) = next(iter(shards.items()))
+        rec = next(
+            iter(
+                reader.read_records(
+                    Task(path, 0, 1, TaskType.TRAINING)
+                )
+            )
+        )
+        ex = decode_example(rec)
+        assert isinstance(ex, dict) and len(ex) >= 2
+
+    def test_frappe_labels_learnable(self, tmp_path):
+        """Labels must correlate with features (not pure noise)."""
+        out = synthetic.gen_frappe(
+            str(tmp_path / "frappe"), num_records=512, num_shards=1
+        )
+        reader = RecordIODataReader(data_dir=out)
+        path = next(iter(reader.create_shards()))
+        labels = [
+            int(decode_example(r)["label"])
+            for r in reader.read_records(
+                Task(path, 0, 512, TaskType.TRAINING)
+            )
+        ]
+        # both classes present, neither vanishingly rare
+        pos = sum(labels)
+        assert 64 < pos < 448
