@@ -53,8 +53,12 @@ def gen_mnist(
     image_shape=(28, 28),
     num_classes: int = 10,
 ):
+    # class templates come from a fixed RNG so train/eval/predict splits
+    # (different `seed`s) share one underlying distribution
+    templates = _class_template_images(
+        np.random.RandomState(1234), num_classes, image_shape
+    )
     rng = np.random.RandomState(seed)
-    templates = _class_template_images(rng, num_classes, image_shape)
     examples = []
     for _ in range(num_records):
         label = rng.randint(num_classes)
@@ -71,8 +75,10 @@ def gen_mnist(
 def gen_cifar10(
     out_dir: str, num_records: int = 1024, num_shards: int = 4, seed: int = 0
 ):
+    templates = _class_template_images(
+        np.random.RandomState(1234), 10, (32, 32, 3)
+    )
     rng = np.random.RandomState(seed)
-    templates = _class_template_images(rng, 10, (32, 32, 3))
     examples = []
     for _ in range(num_records):
         label = rng.randint(10)
@@ -96,8 +102,8 @@ def gen_frappe(
 ):
     """Sparse-id dataset for the DeepFM models: the label is a function of a
     hidden per-id weight vector so factorization models can learn it."""
+    id_weights = np.random.RandomState(1234).normal(0, 1.0, size=vocab_size)
     rng = np.random.RandomState(seed)
-    id_weights = rng.normal(0, 1.0, size=vocab_size)
     examples = []
     for _ in range(num_records):
         ids = rng.randint(0, vocab_size, size=num_features).astype(np.int64)
@@ -126,11 +132,12 @@ CENSUS_VOCAB = 100
 def gen_census(
     out_dir: str, num_records: int = 4096, num_shards: int = 4, seed: int = 0
 ):
-    rng = np.random.RandomState(seed)
+    rng_w = np.random.RandomState(1234)
     cat_weights = {
-        c: rng.normal(0, 1.0, size=CENSUS_VOCAB) for c in CENSUS_CATEGORICAL
+        c: rng_w.normal(0, 1.0, size=CENSUS_VOCAB) for c in CENSUS_CATEGORICAL
     }
-    num_weights = rng.normal(0, 1.0, size=len(CENSUS_NUMERIC))
+    num_weights = rng_w.normal(0, 1.0, size=len(CENSUS_NUMERIC))
+    rng = np.random.RandomState(seed)
     examples = []
     for _ in range(num_records):
         numeric = rng.normal(0, 1.0, size=len(CENSUS_NUMERIC))
@@ -171,8 +178,8 @@ HEART_COLUMNS = [
 def gen_heart(
     out_dir: str, num_records: int = 2048, num_shards: int = 2, seed: int = 0
 ):
+    weights = np.random.RandomState(1234).normal(0, 1.0, size=len(HEART_COLUMNS))
     rng = np.random.RandomState(seed)
-    weights = rng.normal(0, 1.0, size=len(HEART_COLUMNS))
     examples = []
     for _ in range(num_records):
         feats = rng.normal(0, 1.0, size=len(HEART_COLUMNS))
@@ -187,8 +194,8 @@ def gen_heart(
 def gen_iris(
     out_dir: str, num_records: int = 512, num_shards: int = 2, seed: int = 0
 ):
+    centers = np.random.RandomState(1234).normal(0, 3.0, size=(3, 4))
     rng = np.random.RandomState(seed)
-    centers = rng.normal(0, 3.0, size=(3, 4))
     examples = []
     for _ in range(num_records):
         label = rng.randint(3)

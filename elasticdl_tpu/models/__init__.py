@@ -1,0 +1,25 @@
+"""The built-in model zoo.
+
+Reference: ``model_zoo/`` — 11 model modules, each exporting the spec
+contract (SURVEY §2.10): ``custom_model``/``CustomModel``, ``loss``,
+``optimizer``, ``dataset_fn``, ``eval_metrics_fn``, and optionally
+``learning_rate_scheduler`` / ``PredictionOutputsProcessor`` /
+``custom_data_reader``.
+
+TPU-build contract (same names, JAX types):
+
+- ``custom_model(**model_params)`` returns a flax ``nn.Module`` whose
+  ``__call__(features, training: bool)`` maps a feature pytree to outputs
+  (array or dict of arrays for multi-output models);
+- ``loss(labels, predictions)`` returns a scalar ``jnp`` loss;
+- ``optimizer(lr=...)`` returns an optax ``GradientTransformation``;
+- ``dataset_fn(dataset, mode, metadata)`` maps a
+  :class:`elasticdl_tpu.data.Dataset` of raw records to one of
+  ``(features, labels)`` elements (or features only for PREDICTION);
+- ``eval_metrics_fn()`` returns a (possibly nested) dict of
+  :class:`elasticdl_tpu.trainer.metrics.Metric` objects.
+
+Modules are importable under the reference's doubled path convention
+(``mnist_functional_api.mnist_functional_api.custom_model``) via
+:func:`elasticdl_tpu.utils.model_utils.load_model_module`.
+"""

@@ -1,0 +1,13 @@
+"""Compute plane: jitted step builders, train state, metrics, local executor.
+
+Reference: the worker's TF2 eager training step
+(``elasticdl/python/worker/worker.py:646-669``) and the single-process
+``LocalExecutor`` (``elasticdl/python/elasticdl/local_executor.py``).  The
+TPU build compiles the whole step — forward, loss, backward, optimizer
+update, gradient psum — into one XLA program via ``jax.jit`` with sharded
+inputs (SURVEY §7).
+"""
+
+from elasticdl_tpu.trainer.state import Modes, TrainState
+
+__all__ = ["TrainState", "Modes"]

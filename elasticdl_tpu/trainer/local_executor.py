@@ -1,0 +1,320 @@
+"""Single-process training/evaluation/prediction loop.
+
+Reference: ``elasticdl/python/elasticdl/local_executor.py`` — the LOCAL
+strategy executor: no master process, no RPC, but the same task-based data
+traversal.  Deviations: where the reference mocks tasks with a namedtuple
+(``_MockedTask``), we drive a real in-process :class:`TaskDispatcher`, so
+the exact task lifecycle (epochs, SAVE_MODEL callback, counters) is
+exercised even in local runs; and the train step is a jitted JAX program
+on the local chip instead of an eager GradientTape.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from elasticdl_tpu.data.dataset import Dataset
+from elasticdl_tpu.data.factory import create_data_reader
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.trainer import metrics as metrics_lib
+from elasticdl_tpu.trainer.state import (
+    Modes,
+    TrainState,
+    checkpoint_to_state,
+    init_model,
+    state_to_checkpoint,
+)
+from elasticdl_tpu.trainer.step import (
+    build_eval_step,
+    build_predict_step,
+    build_train_step,
+    resolve_optimizer,
+)
+from elasticdl_tpu.utils import save_utils, tree_utils
+from elasticdl_tpu.utils.constants import TaskType
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+from elasticdl_tpu.utils.model_utils import get_model_spec
+from elasticdl_tpu.utils.timing_utils import Timing
+
+
+def build_optimizer(spec, learning_rate=None):
+    """Resolve the optimizer, honoring ``learning_rate_scheduler``.
+
+    The reference mutates ``optimizer.learning_rate`` per model version
+    (``common/lr_scheduler.py``); optax expresses the same thing as a
+    schedule callable of the step, which every optax factory accepts as its
+    learning rate.
+    """
+    if learning_rate is None and spec.learning_rate_scheduler is not None:
+        scheduler = spec.learning_rate_scheduler
+        return resolve_optimizer(spec.optimizer, lambda step: scheduler(step))
+    return resolve_optimizer(spec.optimizer, learning_rate)
+
+
+class LocalExecutor:
+    def __init__(self, args):
+        self._args = args
+        self._spec = get_model_spec(
+            args.model_zoo,
+            args.model_def,
+            model_params=args.model_params_dict,
+            dataset_fn=args.dataset_fn,
+            loss=args.loss,
+            optimizer=args.optimizer,
+            eval_metrics_fn=args.eval_metrics_fn,
+        )
+        self._model = self._spec.build_model()
+        self._tx = build_optimizer(self._spec, args.learning_rate)
+        reader_kwargs = dict(args.data_reader_params_dict)
+        self._train_reader = (
+            create_data_reader(
+                args.training_data,
+                records_per_task=args.records_per_task,
+                custom_reader=self._spec.custom_data_reader,
+                **reader_kwargs,
+            )
+            if args.training_data
+            else None
+        )
+        self._eval_reader = (
+            create_data_reader(
+                args.validation_data,
+                records_per_task=args.records_per_task,
+                custom_reader=self._spec.custom_data_reader,
+                **reader_kwargs,
+            )
+            if args.validation_data
+            else None
+        )
+        self._predict_reader = (
+            create_data_reader(
+                args.prediction_data,
+                records_per_task=args.records_per_task,
+                custom_reader=self._spec.custom_data_reader,
+                **reader_kwargs,
+            )
+            if args.prediction_data
+            else None
+        )
+        self._state: TrainState | None = None
+        self._train_step = None
+        self._eval_step = None
+        self._predict_step = None
+        self._saver = (
+            save_utils.CheckpointSaver(
+                args.checkpoint_dir, args.keep_checkpoint_max
+            )
+            if args.checkpoint_dir
+            else None
+        )
+        self._timing = Timing(
+            enabled=args.log_level == "DEBUG", logger=logger
+        )
+
+    # ---- plumbing ---------------------------------------------------------
+
+    def _task_dataset(self, reader, task, mode: Modes) -> Dataset:
+        ds = Dataset.from_generator(lambda: reader.read_records(task))
+        if self._spec.dataset_fn is not None:
+            ds = self._spec.dataset_fn(ds, mode, reader.metadata)
+        return ds.batch(self._args.minibatch_size).prefetch(2)
+
+    def _ensure_state(self, sample_features):
+        if self._state is not None:
+            return
+        params, model_state = init_model(self._model, sample_features)
+        self._state = TrainState.create(
+            self._model.apply, params, self._tx, model_state
+        )
+        if self._args.checkpoint_dir_for_init:
+            dense, _, extra = save_utils.restore_checkpoint(
+                self._args.checkpoint_dir_for_init
+            )
+            self._state = checkpoint_to_state(self._state, dense)
+            logger.info(
+                "Initialized parameters from checkpoint %s (version %s)",
+                self._args.checkpoint_dir_for_init,
+                extra.get("model_version", "?"),
+            )
+        self._train_step = build_train_step(
+            self._spec.loss,
+            compute_dtype=None
+            if self._args.compute_dtype == "float32"
+            else self._args.compute_dtype,
+            remat=self._args.remat,
+            donate=self._args.donate_state,
+        )
+        self._eval_step = build_eval_step(self._spec.loss)
+        self._predict_step = build_predict_step()
+
+    def _maybe_checkpoint(self):
+        if (
+            self._saver is not None
+            and self._args.checkpoint_steps
+            and self._version % self._args.checkpoint_steps == 0
+        ):
+            self._saver.save(
+                self._version,
+                dense=state_to_checkpoint(self._state),
+                extra={"model_version": self._version},
+            )
+
+    @property
+    def _version(self) -> int:
+        return int(self._state.step) if self._state is not None else 0
+
+    # ---- phases -----------------------------------------------------------
+
+    def _train_task(self, task) -> int:
+        processed = 0
+        for batch in self._task_dataset(self._train_reader, task, Modes.TRAINING):
+            features, labels = batch
+            self._ensure_state(features)
+            with self._timing.record("batch_process"):
+                self._state, step_metrics = self._train_step(
+                    self._state, features, labels
+                )
+            processed += _batch_size(labels)
+            if (
+                self._args.evaluation_steps
+                and self._version % self._args.evaluation_steps == 0
+            ):
+                self.evaluate(tag=f"step {self._version}")
+            self._maybe_checkpoint()
+        return processed
+
+    def evaluate(self, tag: str = "final") -> dict:
+        if self._eval_reader is None or self._state is None:
+            return {}
+        eval_metrics = (
+            self._spec.eval_metrics_fn()
+            if self._spec.eval_metrics_fn
+            else {"loss": metrics_lib.Mean()}
+        )
+        shards = self._eval_reader.create_shards()
+        dispatcher = TaskDispatcher(
+            None,
+            evaluation_shards=shards,
+            records_per_task=self._args.records_per_task,
+        )
+        loss_mean = metrics_lib.Mean()
+        while True:
+            tid, task = dispatcher.get_eval_task(0)
+            if task is None:
+                break
+            for features, labels in self._task_dataset(
+                self._eval_reader, task, Modes.EVALUATION
+            ):
+                outputs, loss = self._eval_step(self._state, features, labels)
+                metrics_lib.update_metric_tree(
+                    eval_metrics, np.asarray(labels), _to_numpy(outputs)
+                )
+                loss_mean.update_value(loss, _batch_size(labels))
+            dispatcher.report(tid, True)
+        results = metrics_lib.metric_tree_results(eval_metrics)
+        results["loss"] = loss_mean.result()
+        logger.info("Evaluation (%s): %s", tag, results)
+        return results
+
+    def predict(self) -> list:
+        if self._predict_reader is None:
+            return []
+        shards = self._predict_reader.create_shards()
+        dispatcher = TaskDispatcher(
+            None,
+            prediction_shards=shards,
+            records_per_task=self._args.records_per_task,
+        )
+        outputs_all = []
+        while True:
+            tid, task = dispatcher.get(0)
+            if task is None:
+                break
+            for features in self._task_dataset(
+                self._predict_reader, task, Modes.PREDICTION
+            ):
+                self._ensure_state(features)
+                outputs = self._predict_step(self._state, features)
+                processed = _to_numpy(outputs)
+                if self._spec.prediction_outputs_processor is not None:
+                    self._spec.prediction_outputs_processor.process(
+                        processed, worker_id=0
+                    )
+                outputs_all.append(processed)
+            dispatcher.report(tid, True)
+        return outputs_all
+
+    def run(self) -> dict:
+        """Train (with periodic eval), then final eval; returns final
+        metrics (reference local_executor.py:73-95)."""
+        if self._train_reader is None:
+            if self._eval_reader is not None:
+                # evaluation-only job needs initialized state
+                self._init_from_eval_data()
+                return self.evaluate()
+            self.predict()
+            return {}
+        shards = self._train_reader.create_shards()
+        dispatcher = TaskDispatcher(
+            shards,
+            records_per_task=self._args.records_per_task,
+            num_epochs=self._args.num_epochs,
+        )
+        total = 0
+        while True:
+            tid, task = dispatcher.get(0)
+            if task is None:
+                break
+            with self._timing.record("task_process"):
+                total += self._train_task(task)
+            dispatcher.report(tid, True)
+        logger.info(
+            "Training complete: %d records, %d steps", total, self._version
+        )
+        self._timing.report_timing(reset=True)
+        if self._saver is not None:
+            self._saver.save(
+                self._version,
+                dense=state_to_checkpoint(self._state),
+                extra={"model_version": self._version},
+            )
+        results = self.evaluate()
+        if self._args.output and self._state is not None:
+            from elasticdl_tpu.utils.export_utils import export_model
+
+            export_model(
+                self._args.output, self._state, self._spec, self._args
+            )
+        return results
+
+    def _init_from_eval_data(self):
+        shards = self._eval_reader.create_shards()
+        dispatcher = TaskDispatcher(
+            None,
+            evaluation_shards=shards,
+            records_per_task=self._args.records_per_task,
+        )
+        tid, task = dispatcher.get_eval_task(0)
+        if task is None:
+            return
+        for features, _ in self._task_dataset(
+            self._eval_reader, task, Modes.EVALUATION
+        ):
+            self._ensure_state(features)
+            break
+
+    @property
+    def state(self) -> TrainState | None:
+        return self._state
+
+
+def _batch_size(labels) -> int:
+    if isinstance(labels, dict):
+        labels = next(iter(labels.values()))
+    return int(np.shape(labels)[0]) if np.ndim(labels) else 1
+
+
+def _to_numpy(outputs):
+    if isinstance(outputs, dict):
+        return {k: np.asarray(v) for k, v in outputs.items()}
+    return np.asarray(outputs)

@@ -1,0 +1,115 @@
+"""Train state: the carried pytree of a training run.
+
+Replaces the reference's scattered mutable state (Keras model variables +
+optimizer slots living in PS pods, ``ps/parameters.py``) with one immutable
+pytree that jit steps thread through — params, optax optimizer state,
+mutable model collections (BatchNorm statistics), and the step counter.
+Because it is a single pytree, sharding it over a mesh, checkpointing it,
+and re-sharding it on mesh re-formation are all uniform tree operations.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+class Modes(str, enum.Enum):
+    TRAINING = "training"
+    EVALUATION = "evaluation"
+    PREDICTION = "prediction"
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    model_state: Any  # mutable collections (e.g. batch_stats); {} if none
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: Any = struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads):
+        updates, new_opt_state = self.tx.update(
+            grads, self.opt_state, self.params
+        )
+        new_params = jax.tree_util.tree_map(
+            lambda p, u: p + u, self.params, updates
+        )
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+        )
+
+    @classmethod
+    def create(cls, apply_fn, params, tx, model_state=None):
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            model_state=model_state or {},
+            apply_fn=apply_fn,
+            tx=tx,
+        )
+
+
+def init_model(model, sample_features, rng_seed: int = 0):
+    """Initialize a flax module from one example batch.
+
+    Returns (params, model_state) with mutable collections (batch_stats)
+    split out of the variable dict.
+    """
+    rng = jax.random.PRNGKey(rng_seed)
+    variables = model.init(rng, sample_features, training=False)
+    params = variables.get("params", {})
+    model_state = {k: v for k, v in variables.items() if k != "params"}
+    return params, model_state
+
+
+def state_to_checkpoint(state: TrainState) -> dict:
+    """Flatten params + mutable collections into one name-keyed dict.
+
+    Parameter names get a ``params/`` prefix and collections keep their
+    collection name (``batch_stats/...``), so one flat namespace holds the
+    whole restorable model (reference checkpoints similarly key by
+    variable name, save_utils.py:100-116).
+    """
+    from elasticdl_tpu.utils import tree_utils
+
+    out = {
+        f"params/{k}": v
+        for k, v in tree_utils.tree_to_dict(state.params).items()
+    }
+    if state.model_state:
+        out.update(tree_utils.tree_to_dict(state.model_state))
+    return out
+
+
+def checkpoint_to_state(state: TrainState, flat: dict) -> TrainState:
+    """Inverse of :func:`state_to_checkpoint`; optimizer state restarts
+    fresh (matching the reference, which restores variables only)."""
+    from elasticdl_tpu.utils import tree_utils
+
+    params = tree_utils.dict_to_tree(
+        {
+            k[len("params/"):]: v
+            for k, v in flat.items()
+            if k.startswith("params/")
+        },
+        state.params,
+    )
+    model_state = state.model_state
+    rest = {k: v for k, v in flat.items() if not k.startswith("params/")}
+    if model_state and rest:
+        model_state = tree_utils.dict_to_tree(rest, model_state)
+    return state.replace(params=params, model_state=model_state)
+
+
+def count_params(params) -> int:
+    return sum(
+        leaf.size for leaf in jax.tree_util.tree_leaves(params)
+    )

@@ -1,0 +1,137 @@
+"""Jitted step builders: train / evaluate / predict.
+
+Reference: the worker's training step is a ``tf.function`` GradientTape over
+``model.call`` followed by a gRPC gradient push (``worker.py:646-669`` +
+``:444-530``).  The TPU build fuses all of it — forward, loss, backward,
+optimizer update and (under a mesh) the gradient all-reduce — into a single
+XLA program: with ``jax.jit`` over dp-sharded batches and replicated
+parameters, GSPMD inserts the psum over ICI automatically, so the same step
+function serves single-chip Local runs and multi-host meshes.
+
+No data-dependent Python control flow exists inside the step; retries and
+task accounting live outside (host side), mirroring the reference's split
+between minibatch compute and control.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from elasticdl_tpu.trainer.state import TrainState
+
+
+def _apply(state: TrainState, params, features, training: bool):
+    """Run the model, handling mutable collections (batch_stats)."""
+    variables = {"params": params, **state.model_state}
+    if training and state.model_state:
+        outputs, new_state = state.apply_fn(
+            variables, features, training=True, mutable=list(state.model_state)
+        )
+        return outputs, new_state
+    outputs = state.apply_fn(variables, features, training=training)
+    return outputs, state.model_state
+
+
+def _cast_floats(tree, dtype):
+    if dtype is None:
+        return tree
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+        else x,
+        tree,
+    )
+
+
+def build_train_step(
+    loss_fn: Callable,
+    compute_dtype=None,
+    remat: bool = False,
+    donate: bool = True,
+    extra_grad_fn: Callable | None = None,
+) -> Callable:
+    """Build ``(state, features, labels) -> (state, step_metrics)``.
+
+    loss_fn: the model module's ``loss(labels, predictions)``.
+    compute_dtype: cast float inputs (e.g. bfloat16) before the forward;
+        parameters and optimizer state stay float32 (mixed precision on the
+        MXU without loss-scale bookkeeping, since bf16 keeps fp32 range).
+    remat: wrap the forward in ``jax.checkpoint`` to trade FLOPs for HBM.
+    extra_grad_fn: optional hook ``(grads, state) -> grads`` (gradient
+        clipping etc. normally belongs in the optax chain instead).
+    """
+
+    def forward_loss(params, state, features, labels):
+        features = _cast_floats(features, compute_dtype)
+        outputs, new_model_state = _apply(state, params, features, True)
+        loss = loss_fn(labels, outputs)
+        return loss.astype(jnp.float32), (outputs, new_model_state)
+
+    if remat:
+        forward_loss = jax.checkpoint(
+            forward_loss, static_argnums=(), policy=None
+        )
+
+    def train_step(state: TrainState, features, labels):
+        grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
+        (loss, (_, new_model_state)), grads = grad_fn(
+            state.params, state, features, labels
+        )
+        if extra_grad_fn is not None:
+            grads = extra_grad_fn(grads, state)
+        new_state = state.apply_gradients(grads).replace(
+            model_state=new_model_state
+        )
+        return new_state, {"loss": loss}
+
+    return jax.jit(train_step, donate_argnums=(0,) if donate else ())
+
+
+def build_eval_step(loss_fn: Callable | None = None) -> Callable:
+    """Build ``(state, features, labels) -> outputs_or_(outputs, loss)``.
+
+    Outputs are returned to the host and reported to the master for metric
+    accumulation (reference worker.py:552-565 report_evaluation_metrics) —
+    metrics themselves never run on device.
+    """
+
+    def eval_step(state: TrainState, features, labels):
+        outputs, _ = _apply(state, state.params, features, False)
+        if loss_fn is None:
+            return outputs
+        return outputs, loss_fn(labels, outputs)
+
+    return jax.jit(eval_step)
+
+
+def build_predict_step() -> Callable:
+    def predict_step(state: TrainState, features):
+        outputs, _ = _apply(state, state.params, features, False)
+        return outputs
+
+    return jax.jit(predict_step)
+
+
+def resolve_optimizer(spec_optimizer, learning_rate: float | None = None):
+    """The model module's ``optimizer`` export is either an optax
+    ``GradientTransformation`` or a factory ``(lr=...) -> transformation``
+    (the reference's contract returns a Keras optimizer,
+    ``model_utils.py:94-150``)."""
+    import optax
+
+    if isinstance(spec_optimizer, optax.GradientTransformation):
+        return spec_optimizer
+    if callable(spec_optimizer):
+        try:
+            if learning_rate is not None:
+                return spec_optimizer(lr=learning_rate)
+            return spec_optimizer()
+        except TypeError:
+            return spec_optimizer()
+    raise TypeError(
+        f"optimizer spec must be an optax transformation or factory, got "
+        f"{type(spec_optimizer)!r}"
+    )

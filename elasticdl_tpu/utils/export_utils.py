@@ -1,0 +1,85 @@
+"""Model export / import for serving.
+
+Reference: the SAVE_MODEL flow (``model_handler.py:155-197``) rebuilds a
+pure-Keras model, injects checkpoint weights, and writes a TF SavedModel.
+The TPU-native equivalent is a self-describing export directory:
+
+    {output}/
+      manifest.json   (model_def, model_params, framework version)
+      params.npz      (name-keyed parameters)
+      model_state.npz (batch_stats etc., if any)
+
+``load_exported_model`` rebuilds the flax module from the manifest and
+returns ``(model, params, model_state)`` ready for ``model.apply`` — no
+training framework state required, which is the same property a SavedModel
+gives TF serving.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+import elasticdl_tpu
+from elasticdl_tpu.utils import tree_utils
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+from elasticdl_tpu.utils.model_utils import get_model_spec
+
+_MANIFEST = "manifest.json"
+
+
+def export_model(output_dir: str, state, spec, args) -> str:
+    os.makedirs(output_dir, exist_ok=True)
+    np.savez(
+        os.path.join(output_dir, "params.npz"),
+        **tree_utils.tree_to_dict(state.params),
+    )
+    if state.model_state:
+        np.savez(
+            os.path.join(output_dir, "model_state.npz"),
+            **tree_utils.tree_to_dict(state.model_state),
+        )
+    manifest = {
+        "framework": "elasticdl_tpu",
+        "version": elasticdl_tpu.__version__,
+        "model_zoo": getattr(args, "model_zoo", ""),
+        "model_def": args.model_def,
+        "model_params": getattr(args, "model_params_dict", {}),
+        "model_version": int(state.step),
+    }
+    with open(os.path.join(output_dir, _MANIFEST), "w") as f:
+        json.dump(manifest, f)
+    logger.info("Exported model (version %d) to %s", int(state.step), output_dir)
+    return output_dir
+
+
+def load_exported_model(output_dir: str):
+    with open(os.path.join(output_dir, _MANIFEST)) as f:
+        manifest = json.load(f)
+    spec = get_model_spec(
+        manifest.get("model_zoo", ""),
+        manifest["model_def"],
+        model_params=manifest.get("model_params", {}),
+    )
+    model = spec.build_model()
+    with np.load(os.path.join(output_dir, "params.npz")) as z:
+        flat_params = {k: z[k] for k in z.files}
+    model_state_path = os.path.join(output_dir, "model_state.npz")
+    flat_state = {}
+    if os.path.exists(model_state_path):
+        with np.load(model_state_path) as z:
+            flat_state = {k: z[k] for k in z.files}
+    return model, flat_params, flat_state
+
+
+def rebuild_variables(model, sample_features, flat_params, flat_state):
+    """Shape the flat dicts into the module's variable pytrees."""
+    from elasticdl_tpu.trainer.state import init_model
+
+    params, model_state = init_model(model, sample_features)
+    params = tree_utils.dict_to_tree(flat_params, params)
+    if flat_state:
+        model_state = tree_utils.dict_to_tree(flat_state, model_state)
+    return params, model_state

@@ -1,0 +1,52 @@
+"""Pytree <-> flat name-keyed dict conversion for checkpointing.
+
+Parameter names are '/'-joined pytree paths (e.g. ``Dense_0/kernel``),
+the stable naming checkpoints are keyed by — the analogue of the
+reference's Keras variable names in its pb checkpoints.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def _key_str(entry) -> str:
+    if isinstance(entry, jax.tree_util.DictKey):
+        return str(entry.key)
+    if isinstance(entry, jax.tree_util.SequenceKey):
+        return str(entry.idx)
+    if isinstance(entry, jax.tree_util.GetAttrKey):
+        return str(entry.name)
+    return str(entry)
+
+
+def tree_to_dict(tree) -> dict[str, np.ndarray]:
+    """Flatten a pytree of arrays into {path: numpy array}."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {
+        "/".join(_key_str(k) for k in path): np.asarray(leaf)
+        for path, leaf in flat
+    }
+
+
+def dict_to_tree(values: dict[str, np.ndarray], like):
+    """Rebuild a pytree structured like ``like`` from a flat dict.
+
+    Missing keys raise; extra keys are ignored (they may belong to other
+    subsystems, e.g. embedding tables restored separately).
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+    leaves = []
+    for path, leaf in flat:
+        key = "/".join(_key_str(k) for k in path)
+        if key not in values:
+            raise KeyError(f"checkpoint missing parameter {key!r}")
+        arr = values[key]
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"shape mismatch for {key!r}: checkpoint {arr.shape} vs "
+                f"model {np.shape(leaf)}"
+            )
+        leaves.append(arr.astype(np.asarray(leaf).dtype))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
