@@ -1,0 +1,80 @@
+"""Benchmark: training throughput of the flagship step on real hardware.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric (per BASELINE.md): samples/sec/chip on the MNIST CNN training step
+via the framework's SPMD trainer.  The reference publishes no numbers
+(BASELINE.md), so ``vs_baseline`` is anchored to the measured throughput of
+the reference's own training-loop design — a TF2 ``tf.function``
+GradientTape step for the identical model on this host's CPU (the reference
+trains on CPU pods; measured once with scripts in-repo history):
+757.5 samples/sec.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# The reference's TF2 tf.function GradientTape loop, same model, this host.
+BASELINE_SAMPLES_PER_SEC = 757.5
+
+BATCH = 256
+WARMUP = 5
+STEPS = 30
+
+
+def main():
+    import numpy as np
+    import optax
+
+    from elasticdl_tpu.models import mnist_functional_api as mnist
+    from elasticdl_tpu.parallel.distributed import SPMDTrainer
+    from elasticdl_tpu.parallel.mesh import MeshConfig
+
+    mesh = MeshConfig.from_string("").create()  # all local devices on dp
+    rng = np.random.RandomState(0)
+    feats = {"image": rng.rand(BATCH, 28, 28).astype(np.float32)}
+    labels = rng.randint(0, 10, BATCH).astype(np.int32)
+
+    trainer = SPMDTrainer(
+        mesh,
+        mnist.custom_model(),
+        mnist.loss,
+        optax.sgd(0.1),
+        feats,
+        compute_dtype="bfloat16",
+    )
+    pf, pl = trainer.place_batch(feats), trainer.place_batch(labels)
+    for _ in range(WARMUP):
+        trainer.train_step(pf, pl)
+    import jax
+
+    jax.block_until_ready(trainer.state.params)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        metrics = trainer.train_step(pf, pl)
+    jax.block_until_ready(trainer.state.params)
+    dt = time.perf_counter() - t0
+
+    n_chips = max(1, len(mesh.devices.flatten()))
+    samples_per_sec_per_chip = STEPS * BATCH / dt / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_train_samples_per_sec_per_chip",
+                "value": round(samples_per_sec_per_chip, 1),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(
+                    samples_per_sec_per_chip / BASELINE_SAMPLES_PER_SEC, 2
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

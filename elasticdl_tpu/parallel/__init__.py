@@ -1,0 +1,26 @@
+"""Parallelism: device meshes, sharding rules, collectives, distributed
+training.
+
+This package replaces the reference's entire distribution machinery — the
+gRPC parameter server (``elasticdl/python/ps/``), the FTLib collective
+communicator (``collective_ops/communicator.py``), and the worker's
+push/pull plumbing (``worker.py:295-530``) — with the TPU-native model:
+one logical device mesh, parameters annotated with shardings, and XLA
+inserting the collectives (psum over ICI for gradients, all-to-all for
+sharded embedding lookups).  See SURVEY §7 target-architecture mapping.
+"""
+
+from elasticdl_tpu.parallel.mesh import MeshConfig, parse_mesh_shape
+from elasticdl_tpu.parallel.sharding import (
+    batch_sharding,
+    infer_param_specs,
+    replicated,
+)
+
+__all__ = [
+    "MeshConfig",
+    "parse_mesh_shape",
+    "batch_sharding",
+    "infer_param_specs",
+    "replicated",
+]

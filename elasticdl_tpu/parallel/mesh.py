@@ -1,0 +1,125 @@
+"""Logical device mesh construction.
+
+The mesh is the TPU build's "cluster topology": where the reference
+enumerates PS pods and worker pods (``k8s_instance_manager.py``), we
+enumerate devices into named logical axes:
+
+- ``dp``   data parallel (gradient psum rides here)
+- ``fsdp`` fully-sharded data parallel (parameter sharding)
+- ``tp``   tensor parallel
+- ``sp``   sequence/context parallel (ring attention)
+- ``ep``   expert/embedding parallel (sharded embedding tables)
+
+``--mesh_shape dp=4,tp=2`` on the CLI maps to ``MeshConfig``.  Axes of
+size 1 are kept in the mesh (they cost nothing and keep PartitionSpecs
+uniform), so the same model code runs on any mesh shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from elasticdl_tpu.utils.constants import MeshAxis
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+
+def parse_mesh_shape(mesh_shape: str) -> dict[str, int]:
+    """Parse ``'dp=4,tp=2'`` into an ordered axis-size dict."""
+    out: dict[str, int] = {}
+    if not mesh_shape:
+        return out
+    for part in mesh_shape.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, size = part.partition("=")
+        name = name.strip()
+        if name not in MeshAxis.ALL:
+            raise ValueError(
+                f"unknown mesh axis {name!r}; valid: {MeshAxis.ALL}"
+            )
+        out[name] = int(size)
+        if out[name] <= 0:
+            raise ValueError(f"axis {name!r} must be positive")
+    return out
+
+
+@dataclass
+class MeshConfig:
+    """Axis sizes for the logical mesh; unspecified axes default to 1.
+
+    ``dp = -1`` (the default when no shape is given) means "all remaining
+    devices", so a bare job scales to whatever slice it lands on.
+    """
+
+    axes: dict[str, int] = field(default_factory=dict)
+
+    @classmethod
+    def from_string(cls, mesh_shape: str) -> "MeshConfig":
+        return cls(parse_mesh_shape(mesh_shape))
+
+    def resolved_axes(self, num_devices: int) -> dict[str, int]:
+        sizes = {name: self.axes.get(name, 1) for name in MeshAxis.ALL}
+        fixed = int(np.prod([s for s in sizes.values()]))
+        if MeshAxis.DP not in self.axes:
+            if num_devices % (fixed) != 0:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by mesh "
+                    f"product {fixed}"
+                )
+            sizes[MeshAxis.DP] = num_devices // fixed
+        total = int(np.prod(list(sizes.values())))
+        if total > num_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {total} devices but "
+                f"{num_devices} are available"
+            )
+        return sizes
+
+    def create(self, devices=None) -> Mesh:
+        devices = devices if devices is not None else jax.devices()
+        sizes = self.resolved_axes(len(devices))
+        total = int(np.prod(list(sizes.values())))
+        # an explicitly smaller mesh uses a device subset (useful for
+        # single-device baselines on a multi-device host)
+        devices = list(devices)[:total]
+        axis_names = tuple(sizes)
+        shape = tuple(sizes[a] for a in axis_names)
+        try:
+            from jax.experimental import mesh_utils
+
+            device_array = mesh_utils.create_device_mesh(
+                shape, devices=devices
+            )
+        except Exception:
+            # fallback (e.g. host-platform CPU devices): row-major reshape
+            device_array = np.asarray(devices).reshape(shape)
+        mesh = Mesh(device_array, axis_names)
+        logger.info(
+            "Created mesh %s over %d devices",
+            {a: s for a, s in sizes.items() if s > 1} or {"dp": 1},
+            len(devices),
+        )
+        return mesh
+
+
+def data_parallel_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Axes the batch dimension is sharded over (dp and fsdp both consume
+    batch; fsdp additionally shards parameters)."""
+    return tuple(
+        a for a in (MeshAxis.DP, MeshAxis.FSDP) if a in mesh.axis_names
+    )
+
+
+def batch_divisor(mesh: Mesh) -> int:
+    """Global batch must be divisible by this (dp*fsdp*sp for input
+    sharding)."""
+    n = 1
+    for a in (MeshAxis.DP, MeshAxis.FSDP):
+        if a in mesh.axis_names:
+            n *= mesh.shape[a]
+    return n

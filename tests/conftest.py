@@ -11,7 +11,7 @@ Must run before the first ``import jax`` anywhere in the test session.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -21,3 +21,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The environment's TPU plugin ('axon') registers itself with priority and
+# ignores JAX_PLATFORMS, so force the CPU backend through the config API too
+# (env alone is not enough on this machine).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
