@@ -1,0 +1,315 @@
+"""Feature columns — tabular-feature spec shared by host and device.
+
+Reference: ``elasticdl/python/elasticdl/feature_column/feature_column.py``
+clones ``tf.feature_column.embedding_column`` so lookups route through the
+EmbeddingDelegate RPC; models combine columns with
+``tf.keras.layers.DenseFeatures`` (model_zoo census_feature_columns.py).
+
+The TPU build splits a column into its two natural halves:
+
+- **host half** (:func:`transform_features`): string hashing / vocabulary
+  lookup / dtype coercion on numpy batches, in the data pipeline.  Strings
+  never reach the device — XLA has no string type, and the reference also
+  does this work outside the train step (in the TF input graph).
+- **device half** (:class:`DenseFeatures`): pure array math inside jit —
+  embedding gathers (mesh-sharded tables via layers.Embedding), one-/multi-
+  hot encodings, bucketize, concat.  All static-shaped, MXU-friendly.
+
+Categorical columns produce int32 id arrays with ``-1`` for missing /
+out-of-vocabulary values; embedding and indicator encodings treat negative
+ids as absent (matching safe_embedding_lookup_sparse semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.layers.embedding import Embedding
+from elasticdl_tpu.utils.hash_utils import string_to_id
+
+
+@dataclasses.dataclass(frozen=True)
+class NumericColumn:
+    key: str
+    shape: tuple = (1,)
+    dtype: Any = np.float32
+    normalizer_fn: Optional[Callable] = None
+
+    @property
+    def name(self) -> str:
+        return self.key
+
+    def transform(self, features: dict) -> np.ndarray:
+        return np.asarray(features[self.key]).astype(self.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketizedColumn:
+    source: NumericColumn
+    boundaries: tuple
+
+    @property
+    def key(self) -> str:
+        return self.source.key
+
+    @property
+    def name(self) -> str:
+        return f"{self.key}_bucketized"
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.boundaries) + 1
+
+    def transform(self, features: dict) -> np.ndarray:
+        x = self.source.transform(features)
+        return np.digitize(x, self.boundaries).astype(np.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class HashedCategoricalColumn:
+    key: str
+    hash_bucket_size: int
+
+    @property
+    def name(self) -> str:
+        return self.key
+
+    @property
+    def num_buckets(self) -> int:
+        return self.hash_bucket_size
+
+    def transform(self, features: dict) -> np.ndarray:
+        vals = np.asarray(features[self.key])
+        if vals.dtype.kind in ("U", "S", "O"):
+            flat = np.array(
+                [
+                    string_to_id(
+                        v.decode() if isinstance(v, bytes) else str(v),
+                        self.hash_bucket_size,
+                    )
+                    for v in vals.reshape(-1)
+                ],
+                dtype=np.int32,
+            )
+            return flat.reshape(vals.shape)
+        return (vals.astype(np.int64) % self.hash_bucket_size).astype(np.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class VocabularyCategoricalColumn:
+    key: str
+    vocabulary: tuple
+
+    def __post_init__(self):
+        # transform runs per batch on the input hot path; build the
+        # vocab->index table once
+        object.__setattr__(
+            self, "_table", {v: i for i, v in enumerate(self.vocabulary)}
+        )
+
+    @property
+    def name(self) -> str:
+        return self.key
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.vocabulary)
+
+    def transform(self, features: dict) -> np.ndarray:
+        table = self._table
+        vals = np.asarray(features[self.key])
+
+        def _lookup(v):
+            if isinstance(v, bytes):
+                v = v.decode()
+            return table.get(v, -1)  # OOV -> -1 (absent)
+
+        flat = np.array(
+            [_lookup(v) for v in vals.reshape(-1)], dtype=np.int32
+        )
+        return flat.reshape(vals.shape)
+
+
+@dataclasses.dataclass(frozen=True)
+class IdentityCategoricalColumn:
+    key: str
+    num_buckets: int
+
+    @property
+    def name(self) -> str:
+        return self.key
+
+    def transform(self, features: dict) -> np.ndarray:
+        vals = np.asarray(features[self.key]).astype(np.int64)
+        # out-of-range -> -1 (absent), like TF with default_value unset
+        vals = np.where(
+            (vals >= 0) & (vals < self.num_buckets), vals, -1
+        )
+        return vals.astype(np.int32)
+
+
+CategoricalColumn = (
+    HashedCategoricalColumn,
+    VocabularyCategoricalColumn,
+    IdentityCategoricalColumn,
+    BucketizedColumn,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class EmbeddingColumn:
+    categorical: Any
+    dimension: int
+    combiner: str = "mean"
+    initializer: Any = "uniform"
+
+    @property
+    def key(self) -> str:
+        return self.categorical.key
+
+    @property
+    def name(self) -> str:
+        return f"{self.categorical.name}_embedding"
+
+    def transform(self, features: dict) -> np.ndarray:
+        return self.categorical.transform(features)
+
+
+@dataclasses.dataclass(frozen=True)
+class IndicatorColumn:
+    categorical: Any
+
+    @property
+    def key(self) -> str:
+        return self.categorical.key
+
+    @property
+    def name(self) -> str:
+        return f"{self.categorical.name}_indicator"
+
+    def transform(self, features: dict) -> np.ndarray:
+        return self.categorical.transform(features)
+
+
+# ---- factory functions (tf.feature_column-compatible names) ----------------
+
+
+def numeric_column(key, shape=(1,), dtype=np.float32, normalizer_fn=None):
+    return NumericColumn(key, tuple(np.ravel(shape)), dtype, normalizer_fn)
+
+
+def bucketized_column(source: NumericColumn, boundaries: Sequence[float]):
+    return BucketizedColumn(source, tuple(boundaries))
+
+
+def categorical_column_with_hash_bucket(key, hash_bucket_size, dtype=None):
+    return HashedCategoricalColumn(key, int(hash_bucket_size))
+
+
+def categorical_column_with_vocabulary_list(key, vocabulary_list):
+    return VocabularyCategoricalColumn(key, tuple(vocabulary_list))
+
+
+def categorical_column_with_identity(key, num_buckets):
+    return IdentityCategoricalColumn(key, int(num_buckets))
+
+
+def embedding_column(
+    categorical_column, dimension, combiner="mean", initializer="uniform"
+):
+    """The EDL embedding_column analogue (reference
+    feature_column/feature_column.py:12): same signature, but the table it
+    creates is a mesh-shardable layers.Embedding parameter instead of a
+    delegate routing RPCs."""
+    return EmbeddingColumn(
+        categorical_column, int(dimension), combiner, initializer
+    )
+
+
+def indicator_column(categorical_column):
+    return IndicatorColumn(categorical_column)
+
+
+def transform_features(columns, features: dict) -> dict:
+    """Host half: raw feature dict -> numeric/int arrays keyed by *column
+    name* (two columns deriving from the same source key — e.g. a numeric
+    and a bucketized view of ``age`` — must not clobber each other).  Run
+    inside ``dataset_fn`` on numpy batches (strings hashed / vocab-mapped
+    here, before anything touches the device).  Raw string-valued source
+    keys are dropped so the batch is device-placeable."""
+    out = {
+        k: v
+        for k, v in features.items()
+        if np.asarray(v).dtype.kind not in ("U", "S", "O")
+    }
+    for col in columns:
+        out[col.name] = col.transform(features)
+    return out
+
+
+class DenseFeatures(nn.Module):
+    """Device half: the ``tf.keras.layers.DenseFeatures`` equivalent.
+
+    Consumes the :func:`transform_features` output and produces the
+    concatenated ``(batch, total_dim)`` float tensor, in the given column
+    order.  Embedding columns instantiate :class:`layers.Embedding`
+    submodules named after the column so the auto-partition policy sees
+    them like any other table.
+    """
+
+    columns: tuple
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, features: dict):
+        outputs = []
+        batch = None
+        for col in self.columns:
+            # transform_features keys by column name; accept raw source-key
+            # batches too for columns whose transform is identity-like
+            x = features[col.name] if col.name in features else features[col.key]
+            batch = x.shape[0] if batch is None else batch
+            if isinstance(col, NumericColumn):
+                x = jnp.asarray(x, self.dtype).reshape(batch, -1)
+                if col.normalizer_fn is not None:
+                    x = col.normalizer_fn(x)
+                outputs.append(x)
+            elif isinstance(col, EmbeddingColumn):
+                ids = jnp.asarray(x).reshape(batch, -1)
+                emb = Embedding(
+                    input_dim=col.categorical.num_buckets,
+                    output_dim=col.dimension,
+                    embeddings_initializer=col.initializer,
+                    combiner=col.combiner,
+                    dtype=self.dtype,
+                    name=col.name,
+                )(ids)
+                outputs.append(emb)
+            elif isinstance(col, IndicatorColumn):
+                ids = jnp.asarray(x).reshape(batch, -1)
+                onehot = jax.nn.one_hot(
+                    jnp.maximum(ids, 0),
+                    col.categorical.num_buckets,
+                    dtype=self.dtype,
+                )
+                onehot = onehot * (ids >= 0)[..., None].astype(self.dtype)
+                outputs.append(onehot.sum(axis=1))  # multi-hot over the bag
+            elif isinstance(col, BucketizedColumn):
+                ids = jnp.asarray(x).reshape(batch, -1)
+                onehot = jax.nn.one_hot(
+                    ids, col.num_buckets, dtype=self.dtype
+                )
+                outputs.append(onehot.reshape(batch, -1))
+            else:
+                raise TypeError(
+                    f"column {col!r} cannot be used directly in "
+                    "DenseFeatures; wrap categorical columns in "
+                    "embedding_column or indicator_column"
+                )
+        return jnp.concatenate(outputs, axis=-1)

@@ -1,0 +1,7 @@
+from elasticdl_tpu.layers.embedding import (  # noqa: F401
+    Embedding,
+    SparseEmbedding,
+    embedding_lookup,
+    safe_embedding_lookup_sparse,
+    auto_partition_rules,
+)

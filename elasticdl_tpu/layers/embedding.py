@@ -1,0 +1,244 @@
+"""Distributed embedding layers — the TPU redesign of the EDL sparse path.
+
+The reference implements large embeddings as PS-side hash-sharded python
+dicts (``ps/embedding_table.py``) looked up via a ``pull_embedding_vector``
+RPC issued *in the middle of the forward pass*
+(``elasticdl/embedding_delegate.py:64-96``), with gradients routed back to
+the PS by id-hash scatter (``worker/worker.py:499-511``).
+
+None of that survives contact with XLA: a jit-traced step cannot call out
+over RPC, and per-id dict ops are exactly what kills TPU throughput.  The
+TPU-native design instead:
+
+- the table is ONE array ``(vocab, dim)`` laid out over the mesh with a
+  ``PartitionSpec`` on the vocab dim (the analogue of id-hash sharding —
+  contiguous range sharding instead of mod-N, which is what XLA can tile);
+- lookup is ``jnp.take`` *inside* the jitted step: GSPMD lowers a gather
+  from a vocab-sharded operand to the same all-to-all/allgather exchange
+  the reference does by hand over gRPC, but fused, on ICI, and overlapped
+  with compute;
+- gradients flow to the table like any other parameter (an
+  ``IndexedSlices``-style scatter-add XLA emits natively), so the
+  ``OptimizerWrapper`` slot-injection machinery (ps/optimizer_wrapper.py)
+  collapses into ordinary optax state, sharded identically to the table via
+  the same PartitionSpec rules.
+
+Sparse (ragged) inputs are represented jit-compatibly as a fixed-width
+``(batch, max_ids)`` int array padded with ``-1`` plus optional weights —
+the static-shape analogue of tf.SparseTensor that keeps the MXU fed.
+``Dataset.padded_sparse`` (data layer) produces this layout.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.utils.constants import (
+    EMBEDDING_AUTO_DISTRIBUTE_BYTES,
+    Initializer,
+    MeshAxis,
+)
+
+PAD_ID = -1
+
+Combiner = ("sum", "mean", "sqrtn")
+
+
+def resolve_initializer(name_or_fn) -> Callable:
+    """Map the reference's initializer-name strings (layers/embedding.py
+    accepts Keras initializer names) to jax.nn.initializers."""
+    if callable(name_or_fn):
+        return name_or_fn
+    name = str(name_or_fn).lower()
+    if name in (Initializer.UNIFORM, "uniform", "random_uniform"):
+        return nn.initializers.uniform(scale=0.05)
+    if name in (Initializer.NORMAL, "random_normal"):
+        return nn.initializers.normal(stddev=0.05)
+    if name == Initializer.ZEROS:
+        return nn.initializers.zeros
+    if name == Initializer.ONES:
+        return nn.initializers.ones
+    if name == "glorot_uniform":
+        return nn.initializers.glorot_uniform()
+    raise ValueError(f"unknown embedding initializer: {name_or_fn!r}")
+
+
+def embedding_lookup(table: jax.Array, ids: jax.Array) -> jax.Array:
+    """Dense lookup: ``ids`` of any shape -> ``ids.shape + (dim,)``.
+
+    Padded ids (< 0) return zero vectors (the reference's delegate returns
+    rows for every id; we additionally zero pads so fixed-width batches are
+    safe).
+    """
+    mask = ids >= 0
+    safe = jnp.where(mask, ids, 0)
+    out = jnp.take(table, safe, axis=0)
+    return out * mask[..., None].astype(out.dtype)
+
+
+def safe_embedding_lookup_sparse(
+    table: jax.Array,
+    ids: jax.Array,
+    weights: Optional[jax.Array] = None,
+    combiner: str = "mean",
+) -> jax.Array:
+    """Combined lookup over padded-sparse ids — the in-jit equivalent of the
+    delegate's ``safe_embedding_lookup_sparse`` reimplementation
+    (embedding_delegate.py:98-221): combiners sum/mean/sqrtn, empty rows
+    yield zeros.
+
+    ids: ``(batch, max_ids)`` int, padded with ``PAD_ID``.
+    weights: optional ``(batch, max_ids)`` float; pads are ignored either way.
+    Returns ``(batch, dim)``.
+    """
+    if combiner not in Combiner:
+        raise ValueError(f"combiner must be one of {Combiner}, got {combiner}")
+    mask = (ids >= 0).astype(table.dtype)
+    safe = jnp.where(ids >= 0, ids, 0)
+    emb = jnp.take(table, safe, axis=0)  # (b, k, d)
+    w = mask if weights is None else weights.astype(table.dtype) * mask
+    summed = jnp.einsum("bk,bkd->bd", w, emb)
+    if combiner == "sum":
+        return summed
+    if combiner == "mean":
+        denom = jnp.sum(w, axis=-1)
+    else:  # sqrtn
+        denom = jnp.sqrt(jnp.sum(w * w, axis=-1))
+    return summed / jnp.maximum(denom, 1e-12)[:, None]
+
+
+class Embedding(nn.Module):
+    """The ``elasticdl.layers.Embedding`` equivalent.
+
+    Reference (`elasticdl/python/elasticdl/layers/embedding.py:7-148`):
+    dense-id input -> per-id vectors; sparse input + combiner -> combined
+    row per example.  Here both paths are pure jit-traceable array ops on a
+    mesh-sharded table; distribution is decided by sharding rules (see
+    :func:`auto_partition_rules`), not by a different layer class.
+    """
+
+    input_dim: int
+    output_dim: int
+    embeddings_initializer: Any = Initializer.UNIFORM
+    combiner: Optional[str] = None  # None => dense lookup
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids, weights=None):
+        table = self.param(
+            "embedding",
+            resolve_initializer(self.embeddings_initializer),
+            (self.input_dim, self.output_dim),
+            self.dtype,
+        )
+        ids = jnp.asarray(ids)
+        if ids.dtype not in (jnp.int32, jnp.int64):
+            ids = ids.astype(jnp.int32)
+        if self.combiner is not None:
+            if ids.ndim != 2:
+                raise ValueError(
+                    "combiner lookup expects (batch, max_ids) padded ids, "
+                    f"got shape {ids.shape}"
+                )
+            return safe_embedding_lookup_sparse(
+                table, ids, weights, self.combiner
+            )
+        return embedding_lookup(table, ids)
+
+
+class SparseEmbedding(nn.Module):
+    """Combiner embedding over a local (never-distributed) table — the
+    export-time counterpart (reference keras/layers/sparse_embedding.py:7).
+
+    Same math as :class:`Embedding` with a combiner; kept as a distinct
+    class so the model handler can tell "always local" from "distribute
+    when large" the way the reference distinguishes SparseEmbedding from
+    rewritten Keras Embedding (model_handler.py:199-241).
+    """
+
+    input_dim: int
+    output_dim: int
+    combiner: str = "sum"
+    embeddings_initializer: Any = Initializer.UNIFORM
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids, weights=None):
+        table = self.param(
+            "embedding",
+            resolve_initializer(self.embeddings_initializer),
+            (self.input_dim, self.output_dim),
+            self.dtype,
+        )
+        ids = jnp.asarray(ids)
+        if ids.dtype not in (jnp.int32, jnp.int64):
+            ids = ids.astype(jnp.int32)
+        return safe_embedding_lookup_sparse(table, ids, weights, self.combiner)
+
+
+# ---- distribution policy ---------------------------------------------------
+
+
+def _preferred_axes(mesh) -> list[str]:
+    """Vocab-sharding axis preference: ep (dedicated embedding axis) first,
+    then tp, then fsdp — never bare dp (batch sharding stays on dp)."""
+    return [
+        a
+        for a in (MeshAxis.EP, MeshAxis.TP, MeshAxis.FSDP)
+        if a in mesh.axis_names and mesh.shape[a] > 1
+    ]
+
+
+def auto_partition_rules(
+    params_or_shapes,
+    mesh,
+    threshold_bytes: int = EMBEDDING_AUTO_DISTRIBUTE_BYTES,
+) -> list:
+    """Sharding rules distributing every embedding table bigger than the
+    reference's 2MB policy threshold (model_handler.py:47-55).
+
+    Scans parameter paths ending in ``embedding`` with 2-D shape; tables
+    over the threshold get ``P(axis, None)`` (vocab-range sharding — the
+    contiguous analogue of the reference's id-mod-N hash sharding,
+    hash_utils.py:9) on the best-fitting mesh axis, falling back to the
+    output dim if the vocab doesn't divide.  Returns first-match-wins rules
+    for :func:`elasticdl_tpu.parallel.sharding.infer_param_specs`.
+    """
+    from elasticdl_tpu.parallel.sharding import Rule
+    from elasticdl_tpu.utils.tree_utils import _key_str
+
+    axes = _preferred_axes(mesh)
+    if not axes:
+        return []
+    rules = []
+    flat, _ = jax.tree_util.tree_flatten_with_path(params_or_shapes)
+    for path_entries, leaf in flat:
+        path = "/".join(_key_str(k) for k in path_entries)
+        if not path.split("/")[-1].startswith("embedding"):
+            continue
+        shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+        if len(shape) != 2:
+            continue
+        dtype = getattr(leaf, "dtype", np.dtype(np.float32))
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        if nbytes <= threshold_bytes:
+            continue
+        # anchor at a path boundary so "emb/embedding" can't also claim
+        # "big_emb/embedding"
+        pattern = r"(^|/)" + re.escape(path) + "$"
+        for axis in axes:
+            size = mesh.shape[axis]
+            if shape[0] % size == 0:
+                rules.append(Rule(pattern, P(axis, None)))
+                break
+            if shape[1] % size == 0:
+                rules.append(Rule(pattern, P(None, axis)))
+                break
+    return rules

@@ -20,13 +20,13 @@ from typing import Callable, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from elasticdl_tpu.parallel import sharding as sharding_lib
 from elasticdl_tpu.parallel.mesh import batch_divisor
-from elasticdl_tpu.trainer.state import TrainState, Modes
+from elasticdl_tpu.trainer.state import TrainState
 from elasticdl_tpu.trainer.step import _apply, _cast_floats
-from elasticdl_tpu.utils.log_utils import default_logger as logger
+from elasticdl_tpu.utils.constants import EMBEDDING_AUTO_DISTRIBUTE_BYTES
 
 
 class SPMDTrainer:
@@ -42,7 +42,12 @@ class SPMDTrainer:
         remat: bool = False,
         donate: bool = True,
         rng_seed: int = 0,
+        embedding_threshold: int | None = EMBEDDING_AUTO_DISTRIBUTE_BYTES,
     ):
+        """``embedding_threshold``: tables bigger than this many bytes are
+        auto-distributed over the mesh (the reference's 2MB model-handler
+        policy); pass ``None`` when a ModelHandler supplies the rules
+        explicitly, so the policy has exactly one owner."""
         self.mesh = mesh
         self._model = model
         self._loss_fn = loss_fn
@@ -66,6 +71,14 @@ class SPMDTrainer:
         # state is *created* already laid out over the mesh, so no host
         # copy of a model bigger than one host's RAM is ever needed.
         state_shapes = jax.eval_shape(create_state)
+        if embedding_threshold is not None:
+            from elasticdl_tpu.layers.embedding import auto_partition_rules
+
+            rules = tuple(rules) + tuple(
+                auto_partition_rules(
+                    state_shapes.params, mesh, embedding_threshold
+                )
+            )
         self.state_specs = sharding_lib.infer_param_specs(
             state_shapes, mesh, rules
         )

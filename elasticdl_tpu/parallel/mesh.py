@@ -52,8 +52,9 @@ def parse_mesh_shape(mesh_shape: str) -> dict[str, int]:
 class MeshConfig:
     """Axis sizes for the logical mesh; unspecified axes default to 1.
 
-    ``dp = -1`` (the default when no shape is given) means "all remaining
-    devices", so a bare job scales to whatever slice it lands on.
+    When ``dp`` is omitted it is *inferred* as "all remaining devices"
+    (num_devices / product of the given axes), so a bare job scales to
+    whatever slice it lands on.
     """
 
     axes: dict[str, int] = field(default_factory=dict)
@@ -108,18 +109,20 @@ class MeshConfig:
 
 
 def data_parallel_axes(mesh: Mesh) -> tuple[str, ...]:
-    """Axes the batch dimension is sharded over (dp and fsdp both consume
-    batch; fsdp additionally shards parameters)."""
+    """Axes the batch dimension is sharded over, size-1 axes excluded (dp
+    and fsdp both consume batch; fsdp additionally shards parameters).
+    The single definition of "the batch axes" — batch_sharding and
+    batch_divisor both derive from it."""
     return tuple(
-        a for a in (MeshAxis.DP, MeshAxis.FSDP) if a in mesh.axis_names
+        a
+        for a in (MeshAxis.DP, MeshAxis.FSDP)
+        if a in mesh.axis_names and mesh.shape[a] > 1
     )
 
 
 def batch_divisor(mesh: Mesh) -> int:
-    """Global batch must be divisible by this (dp*fsdp*sp for input
-    sharding)."""
+    """Global batch must be divisible by this for input sharding."""
     n = 1
-    for a in (MeshAxis.DP, MeshAxis.FSDP):
-        if a in mesh.axis_names:
-            n *= mesh.shape[a]
+    for a in data_parallel_axes(mesh):
+        n *= mesh.shape[a]
     return n

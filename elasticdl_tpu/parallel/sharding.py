@@ -33,12 +33,10 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def batch_sharding(mesh: Mesh, ndim: int = 0, sp_dim: int | None = None) -> NamedSharding:
     """Leading-dim batch sharding over dp(+fsdp); optionally shard a
     sequence dimension over sp."""
-    axes = [
-        a
-        for a in (MeshAxis.DP, MeshAxis.FSDP)
-        if a in mesh.axis_names and mesh.shape[a] > 1
-    ]
-    spec = [tuple(axes) if axes else None]
+    from elasticdl_tpu.parallel.mesh import data_parallel_axes
+
+    axes = data_parallel_axes(mesh)
+    spec = [axes if axes else None]
     if ndim:
         rest = [None] * (ndim - 1)
         if (

@@ -1,0 +1,82 @@
+"""Strategy-dependent model distribution policy.
+
+Reference: ``elasticdl/python/common/model_handler.py`` — for the PS
+strategy it *clones the Keras model*, swapping ``tf.keras.layers.Embedding``
+for the RPC-backed EDL layer iff the table exceeds 2MB (:47-55,199-241),
+and reverses the rewrite (plus checkpoint-weight injection) at export time
+(:155-197).
+
+In the TPU build a model never needs rewriting: distribution is a *layout*
+decision, not a *layer* decision.  The handler therefore emits sharding
+rules (consumed by ``SPMDTrainer``) instead of cloned models, and export is
+a host-gather of the (possibly sharded) state — the same user-visible
+contract (small tables stay local, big tables get distributed, exports are
+always dense) with none of the clone/rewrite machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from elasticdl_tpu.utils.constants import (
+    DistributionStrategy,
+    EMBEDDING_AUTO_DISTRIBUTE_BYTES,
+)
+
+
+class ModelHandler:
+    """Base handler: no distribution (Local strategy)."""
+
+    def __init__(self, threshold_bytes: int = EMBEDDING_AUTO_DISTRIBUTE_BYTES):
+        self.threshold_bytes = threshold_bytes
+
+    @classmethod
+    def get_model_handler(
+        cls, distribution_strategy=None, checkpoint_dir=None
+    ) -> "ModelHandler":
+        """Factory mirroring model_handler.py:89-111."""
+        if distribution_strategy in (
+            DistributionStrategy.PARAMETER_SERVER,
+            DistributionStrategy.ALLREDUCE,
+        ):
+            return DistributedModelHandler(checkpoint_dir=checkpoint_dir)
+        return ModelHandler()
+
+    def get_model_to_train(self, model):
+        """Models run unchanged; kept for reference-API compatibility."""
+        return model
+
+    def sharding_rules(self, params_shapes, mesh) -> Sequence:
+        return ()
+
+    def get_model_to_export(self, state) -> dict:
+        """Dense, host-resident name->ndarray dict of the full model —
+        always un-sharded regardless of training layout (the analogue of
+        the reverse rewrite at model_handler.py:155-197)."""
+        from elasticdl_tpu.trainer.state import state_to_checkpoint
+
+        return {
+            k: jax.device_get(v)
+            for k, v in state_to_checkpoint(state).items()
+        }
+
+
+class DistributedModelHandler(ModelHandler):
+    """PS/AllReduce-strategy handler: distribute big embedding tables.
+
+    Same policy knob as the reference (tables > ``threshold_bytes`` get
+    distributed), realized as vocab-dim sharding rules instead of layer
+    swaps."""
+
+    def __init__(self, checkpoint_dir=None, **kwargs):
+        super().__init__(**kwargs)
+        self.checkpoint_dir = checkpoint_dir
+
+    def sharding_rules(self, params_shapes, mesh) -> Sequence:
+        from elasticdl_tpu.layers.embedding import auto_partition_rules
+
+        return auto_partition_rules(
+            params_shapes, mesh, self.threshold_bytes
+        )

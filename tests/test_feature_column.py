@@ -1,0 +1,104 @@
+"""Feature columns: host transform + in-jit DenseFeatures.
+
+Covers the census-model column recipe (reference
+census_feature_columns.py:24-40: numeric + hash-bucket -> embedding(16))."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu import feature_column as fc
+
+
+RAW = {
+    "age": np.array([25.0, 52.0]),
+    "workclass": np.array(["Private", "Self-emp"]),
+    "hours": np.array([40.0, 12.0]),
+    "cls": np.array([1, 7]),
+}
+
+
+def test_numeric_and_hash_transform():
+    cols = [
+        fc.numeric_column("age"),
+        fc.categorical_column_with_hash_bucket("workclass", 64),
+    ]
+    out = fc.transform_features(cols, RAW)
+    assert out["age"].dtype == np.float32
+    assert out["workclass"].dtype == np.int32
+    assert np.all((out["workclass"] >= 0) & (out["workclass"] < 64))
+    # deterministic (sha256, hash_utils.string_to_id)
+    again = fc.transform_features(cols, RAW)
+    np.testing.assert_array_equal(out["workclass"], again["workclass"])
+
+
+def test_vocab_and_identity_oov_to_absent():
+    vocab = fc.categorical_column_with_vocabulary_list(
+        "workclass", ["Private", "Gov"]
+    )
+    ids = vocab.transform(RAW)
+    np.testing.assert_array_equal(ids, [0, -1])  # OOV -> -1
+
+    ident = fc.categorical_column_with_identity("cls", num_buckets=4)
+    np.testing.assert_array_equal(ident.transform(RAW), [1, -1])
+
+
+def test_bucketized():
+    col = fc.bucketized_column(fc.numeric_column("age"), [30.0, 50.0])
+    np.testing.assert_array_equal(col.transform(RAW), [0, 2])
+    assert col.num_buckets == 3
+
+
+def test_dense_features_census_recipe():
+    cols = [
+        fc.numeric_column("age"),
+        fc.numeric_column("hours"),
+        fc.embedding_column(
+            fc.categorical_column_with_hash_bucket("workclass", 64),
+            dimension=16,
+        ),
+    ]
+    feats = fc.transform_features(cols, RAW)
+    layer = fc.DenseFeatures(columns=tuple(cols))
+    params = layer.init(jax.random.PRNGKey(0), feats)
+    out = layer.apply(params, feats)
+    assert out.shape == (2, 1 + 1 + 16)
+    # numeric passthrough in column order
+    np.testing.assert_allclose(np.asarray(out)[:, 0], RAW["age"])
+    np.testing.assert_allclose(np.asarray(out)[:, 1], RAW["hours"])
+    # embedding params named after the column -> policy-visible
+    assert "workclass_embedding" in params["params"]
+
+
+def test_dense_features_indicator_and_bucketized():
+    cols = [
+        fc.indicator_column(
+            fc.categorical_column_with_identity("cls", num_buckets=8)
+        ),
+        fc.bucketized_column(fc.numeric_column("age"), [30.0]),
+    ]
+    feats = fc.transform_features(cols, RAW)
+    layer = fc.DenseFeatures(columns=tuple(cols))
+    params = layer.init(jax.random.PRNGKey(0), feats)
+    out = np.asarray(layer.apply(params, feats))
+    assert out.shape == (2, 8 + 2)
+    assert out[0, 1] == 1.0  # cls=1 one-hot
+    assert out[1, 7] == 1.0  # cls=7 one-hot (valid under 8 buckets)
+    # bucketized one-hot occupies the trailing 2 slots
+    np.testing.assert_array_equal(out[:, 8:], [[1.0, 0.0], [0.0, 1.0]])
+
+
+def test_dense_features_under_jit():
+    cols = (
+        fc.numeric_column("age"),
+        fc.embedding_column(
+            fc.categorical_column_with_hash_bucket("workclass", 32), 4
+        ),
+    )
+    feats = fc.transform_features(cols, RAW)
+    layer = fc.DenseFeatures(columns=cols)
+    params = layer.init(jax.random.PRNGKey(0), feats)
+    jit_apply = jax.jit(lambda p, f: layer.apply(p, f))
+    out = jit_apply(params, feats)
+    assert out.shape == (2, 5)
+    assert np.all(np.isfinite(np.asarray(out)))
