@@ -129,13 +129,22 @@ class Embedding(nn.Module):
     embeddings_initializer: Any = Initializer.UNIFORM
     combiner: Optional[str] = None  # None => dense lookup
     dtype: Any = jnp.float32
+    # Table rows are padded up to a multiple of this so odd vocab sizes
+    # (e.g. frappe's 5383) still divide evenly over mesh axes; padded rows
+    # are never looked up, so their gradients stay zero.  1 = no padding.
+    vocab_pad_multiple: int = 1
+
+    @property
+    def padded_input_dim(self) -> int:
+        m = max(1, self.vocab_pad_multiple)
+        return ((self.input_dim + m - 1) // m) * m
 
     @nn.compact
     def __call__(self, ids, weights=None):
         table = self.param(
             "embedding",
             resolve_initializer(self.embeddings_initializer),
-            (self.input_dim, self.output_dim),
+            (self.padded_input_dim, self.output_dim),
             self.dtype,
         )
         ids = jnp.asarray(ids)

@@ -1,0 +1,68 @@
+"""Census-income DNN, functional style.
+
+Reference: ``model_zoo/census_dnn_model/census_functional_api.py`` —
+DenseFeatures(columns) -> Dense(16, relu) x2 -> Dense(1, sigmoid); binary
+cross-entropy; Adam; rounded-accuracy metric.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu import feature_column as fc
+from elasticdl_tpu.data.reader import decode_example
+from elasticdl_tpu.models.census_dnn_model.census_feature_columns import (
+    LABEL_KEY,
+    get_feature_columns,
+)
+from elasticdl_tpu.trainer.metrics import BinaryAccuracy
+from elasticdl_tpu.trainer.state import Modes
+
+COLUMNS = get_feature_columns()
+
+
+class CensusDNN(nn.Module):
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        x = fc.DenseFeatures(columns=COLUMNS)(features)
+        x = nn.relu(nn.Dense(16)(x))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.sigmoid(nn.Dense(1)(x))
+
+
+def custom_model(**kwargs):
+    return CensusDNN(**kwargs)
+
+
+def loss(labels, predictions):
+    labels = labels.reshape(-1, 1).astype(jnp.float32)
+    probs = jnp.clip(predictions, 1e-7, 1 - 1e-7)
+    return -(
+        labels * jnp.log(probs) + (1 - labels) * jnp.log(1 - probs)
+    ).mean()
+
+
+def optimizer(lr=1e-3):
+    return optax.adam(lr)
+
+
+def dataset_fn(dataset, mode, metadata):
+    def _parse(record):
+        ex = decode_example(record)
+        label = ex.pop(LABEL_KEY, None)
+        feats = fc.transform_features(COLUMNS, ex)
+        if mode == Modes.PREDICTION:
+            return feats
+        return feats, label.astype(np.int32)
+
+    dataset = dataset.map(_parse)
+    if mode == Modes.TRAINING:
+        dataset = dataset.shuffle(1024, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {"accuracy": BinaryAccuracy()}

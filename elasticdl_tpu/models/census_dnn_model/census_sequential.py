@@ -1,0 +1,16 @@
+"""Census-income DNN, sequential style.
+
+Reference: ``model_zoo/census_dnn_model/census_sequential.py`` — the same
+network as the functional variant built with ``tf.keras.Sequential``.
+flax has one module style; this re-exports the shared architecture under
+the sequential entry point.
+"""
+
+from elasticdl_tpu.models.census_dnn_model.census_functional_api import (  # noqa: F401,E501
+    CensusDNN,
+    custom_model,
+    dataset_fn,
+    eval_metrics_fn,
+    loss,
+    optimizer,
+)

@@ -1,0 +1,22 @@
+"""Census-income DNN, subclass style.
+
+Reference: ``model_zoo/census_dnn_model/census_subclass.py`` — the same
+network as the functional variant written as a ``tf.keras.Model``
+subclass (``CustomModel``).
+"""
+
+from elasticdl_tpu.models.census_dnn_model.census_functional_api import (  # noqa: F401,E501
+    CensusDNN,
+    dataset_fn,
+    eval_metrics_fn,
+    loss,
+    optimizer,
+)
+
+
+class CustomModel(CensusDNN):
+    pass
+
+
+def custom_model(**kwargs):
+    return CustomModel(**kwargs)

@@ -1,0 +1,30 @@
+"""CIFAR-10 CNN, subclass style.
+
+Reference: ``model_zoo/cifar10_subclass/cifar10_subclass.py`` — the same
+six-conv network as the functional variant, subclass-styled.  flax has one
+module style, so this re-exports the shared architecture under the
+reference's ``CustomModel`` entry point with the subclass file's
+hyperparameters (SGD 0.1, no LR schedule).
+"""
+
+from __future__ import annotations
+
+from elasticdl_tpu.models.cifar10_functional_api import (  # noqa: F401
+    Cifar10CNN,
+    dataset_fn,
+    eval_metrics_fn,
+    loss,
+)
+import optax
+
+
+class CustomModel(Cifar10CNN):
+    pass
+
+
+def custom_model(**kwargs):
+    return CustomModel(**kwargs)
+
+
+def optimizer(lr=0.1):
+    return optax.sgd(lr)

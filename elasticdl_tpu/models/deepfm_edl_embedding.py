@@ -1,0 +1,45 @@
+"""DeepFM with distribution-eligible embedding tables.
+
+Reference: ``model_zoo/deepfm_edl_embedding/deepfm_edl_embedding.py`` —
+identical DeepFM math, but the tables are EDL ``Embedding`` layers that
+live sharded on parameter servers regardless of size.  In the TPU build a
+table's layout is policy, not layer choice, so the model body is shared;
+this module additionally exports :func:`sharding_rules`, which forces the
+tables onto the mesh's embedding axis the way the reference variant forces
+them onto the PS.  It reaches the trainer as ``ModelSpec.sharding_rules``
+(resolved by model_utils), merged ahead of the auto >2MB policy.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.models.deepfm_functional_api import (  # noqa: F401
+    DeepFM,
+    custom_data_reader,
+    custom_model,
+    dataset_fn,
+    eval_metrics_fn,
+    loss,
+    optimizer,
+)
+from elasticdl_tpu.utils.constants import MeshAxis
+
+
+def sharding_rules(mesh):
+    """Always-distribute rules for this model's two tables (the reference
+    variant unconditionally uses the PS-sharded layer)."""
+    from elasticdl_tpu.parallel.sharding import Rule
+
+    axes = [
+        a
+        for a in (MeshAxis.EP, MeshAxis.TP, MeshAxis.FSDP)
+        if a in mesh.axis_names and mesh.shape[a] > 1
+    ]
+    if not axes:
+        return []
+    axis = axes[0]
+    return [
+        Rule(r"(^|/)embedding/embedding$", P(axis, None)),
+        Rule(r"(^|/)id_bias/embedding$", P(axis, None)),
+    ]

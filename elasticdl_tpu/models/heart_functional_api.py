@@ -1,0 +1,83 @@
+"""Heart-disease classifier over feature columns.
+
+Reference: ``model_zoo/heart_functional_api/heart_functional_api.py`` —
+six numeric columns, bucketized ``age`` (10 boundaries), hashed ``thal``
+(100 buckets) -> embedding(8), DenseFeatures -> Dense(16) x2 ->
+Dense(1, sigmoid); binary cross-entropy on probabilities; SGD(1e-6).
+
+Deviation: the reference's accuracy metric does ``argmax`` over a
+``(batch, 1)`` probability column (always 0); this build uses threshold
+binary accuracy, which is what the metric is plainly meant to be.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu import feature_column as fc
+from elasticdl_tpu.data.reader import decode_example
+from elasticdl_tpu.trainer.metrics import BinaryAccuracy
+from elasticdl_tpu.trainer.state import Modes
+
+NUMERIC_KEYS = ["trestbps", "chol", "thalach", "oldpeak", "slope", "ca"]
+AGE_BOUNDARIES = (18, 25, 30, 35, 40, 45, 50, 55, 60, 65)
+
+
+def get_feature_columns():
+    columns = [fc.numeric_column(k) for k in NUMERIC_KEYS]
+    columns.append(
+        fc.bucketized_column(fc.numeric_column("age"), AGE_BOUNDARIES)
+    )
+    columns.append(
+        fc.embedding_column(
+            fc.categorical_column_with_hash_bucket("thal", 100), dimension=8
+        )
+    )
+    return tuple(columns)
+
+
+COLUMNS = get_feature_columns()
+
+
+class HeartDNN(nn.Module):
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        x = fc.DenseFeatures(columns=COLUMNS)(features)
+        x = nn.relu(nn.Dense(16)(x))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.sigmoid(nn.Dense(1)(x))
+
+
+def custom_model(**kwargs):
+    return HeartDNN(**kwargs)
+
+
+def loss(labels, predictions):
+    labels = labels.reshape(-1).astype(jnp.float32)
+    probs = jnp.clip(predictions.reshape(-1), 1e-7, 1 - 1e-7)
+    return -(
+        labels * jnp.log(probs) + (1 - labels) * jnp.log(1 - probs)
+    ).mean()
+
+
+def optimizer(lr=1e-6):
+    return optax.sgd(lr)
+
+
+def dataset_fn(dataset, mode, metadata):
+    def _parse(record):
+        ex = decode_example(record)
+        label = ex.pop("target", None)
+        feats = fc.transform_features(COLUMNS, ex)
+        if mode == Modes.PREDICTION:
+            return feats
+        return feats, label.astype(np.int32)
+
+    return dataset.map(_parse)
+
+
+def eval_metrics_fn():
+    return {"accuracy": BinaryAccuracy()}

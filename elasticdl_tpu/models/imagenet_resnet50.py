@@ -1,0 +1,50 @@
+"""ImageNet data prep for the ResNet-50 model.
+
+Reference: ``model_zoo/imagenet_resnet50/imagenet_resnet50.py`` — a single
+helper that packs ``<label>_xxx.JPEG`` files from a TAR into labeled
+records (the model itself comes from resnet50_subclass).  This build packs
+the decoded pixel array (the record codec carries dense tensors, not TF
+Example protos); decoding uses PIL when available, else the raw bytes are
+stored for a downstream decoder.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+from elasticdl_tpu.data.reader import encode_example
+
+# re-export the model contract so --model_def=imagenet_resnet50... works
+from elasticdl_tpu.models.resnet50_subclass import (  # noqa: F401
+    CustomModel,
+    dataset_fn,
+    eval_metrics_fn,
+    loss,
+    optimizer,
+)
+
+
+def custom_model(num_classes=1000, **kwargs):
+    return CustomModel(num_classes=num_classes, **kwargs)
+
+
+def prepare_data_for_a_single_file(file_object, filename: str) -> bytes:
+    """``<label_id>_xxx.JPEG`` file -> encoded record
+    (reference imagenet_resnet50.py:4-26)."""
+    label = int(filename.split("/")[-1].split("_")[0])
+    payload = file_object.read()
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise ImportError(
+            "imagenet data prep needs PIL to decode JPEGs; records must "
+            "carry dense (224,224,3) arrays for resnet50's dataset_fn"
+        ) from e
+    try:
+        img = Image.open(io.BytesIO(payload)).convert("RGB")
+    except Exception as e:
+        raise ValueError(f"{filename}: not a decodable image: {e}") from e
+    image = np.asarray(img.resize((224, 224)), dtype=np.uint8)
+    return encode_example({"image": image, "label": np.int64(label)})

@@ -1,0 +1,130 @@
+"""ResNet-50 building blocks in flax.
+
+Reference: ``model_zoo/resnet50_subclass/resnet50_model.py`` —
+IdentityBlock / ConvBlock bottlenecks with BN(momentum=0.9, eps=1e-5),
+he_normal conv init, L2 weight decay 1e-4 on kernels.  Weight decay is
+applied by the optimizer here (``optax.add_decayed_weights`` in
+``resnet50_subclass.optimizer``) instead of per-layer regularizers — with
+plain SGD the two are the same gradient-descent update.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+L2_WEIGHT_DECAY = 1e-4
+BATCH_NORM_DECAY = 0.9
+BATCH_NORM_EPSILON = 1e-5
+
+_conv_init = nn.initializers.he_normal()
+
+
+def _bn(training: bool, name: str):
+    return nn.BatchNorm(
+        use_running_average=not training,
+        momentum=BATCH_NORM_DECAY,
+        epsilon=BATCH_NORM_EPSILON,
+        name=name,
+    )
+
+
+class IdentityBlock(nn.Module):
+    """Bottleneck block whose shortcut is the identity
+    (reference resnet50_model.py:9-81)."""
+
+    kernel_size: int
+    filters: Sequence[int]
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        f1, f2, f3 = self.filters
+        k = self.kernel_size
+        shortcut = x
+        x = nn.Conv(f1, (1, 1), use_bias=False, kernel_init=_conv_init,
+                    name="conv_a")(x)
+        x = _bn(training, "bn_a")(x)
+        x = nn.relu(x)
+        x = nn.Conv(f2, (k, k), padding="SAME", use_bias=False,
+                    kernel_init=_conv_init, name="conv_b")(x)
+        x = _bn(training, "bn_b")(x)
+        x = nn.relu(x)
+        x = nn.Conv(f3, (1, 1), use_bias=False, kernel_init=_conv_init,
+                    name="conv_c")(x)
+        x = _bn(training, "bn_c")(x)
+        return nn.relu(x + shortcut)
+
+
+class ConvBlock(nn.Module):
+    """Bottleneck block with a strided projection shortcut
+    (reference resnet50_model.py:83-178)."""
+
+    kernel_size: int
+    filters: Sequence[int]
+    strides: tuple = (2, 2)
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        f1, f2, f3 = self.filters
+        k = self.kernel_size
+        shortcut = nn.Conv(
+            f3, (1, 1), strides=self.strides, use_bias=False,
+            kernel_init=_conv_init, name="conv_shortcut",
+        )(x)
+        shortcut = _bn(training, "bn_shortcut")(shortcut)
+        x = nn.Conv(f1, (1, 1), strides=self.strides, use_bias=False,
+                    kernel_init=_conv_init, name="conv_a")(x)
+        x = _bn(training, "bn_a")(x)
+        x = nn.relu(x)
+        x = nn.Conv(f2, (k, k), padding="SAME", use_bias=False,
+                    kernel_init=_conv_init, name="conv_b")(x)
+        x = _bn(training, "bn_b")(x)
+        x = nn.relu(x)
+        x = nn.Conv(f3, (1, 1), use_bias=False, kernel_init=_conv_init,
+                    name="conv_c")(x)
+        x = _bn(training, "bn_c")(x)
+        return nn.relu(x + shortcut)
+
+
+# (filters, blocks-per-stage) for ResNet-50: stages 2..5
+RESNET50_STAGES = (
+    ((64, 64, 256), 3, (1, 1)),
+    ((128, 128, 512), 4, (2, 2)),
+    ((256, 256, 1024), 6, (2, 2)),
+    ((512, 512, 2048), 3, (2, 2)),
+)
+
+
+class ResNet50(nn.Module):
+    """Full ResNet-50 (reference resnet50_subclass.py:24-146): zero-pad,
+    7x7/2 stem, 3x3/2 maxpool, 16 bottleneck blocks, global mean pool,
+    Dense(num_classes), softmax output (the reference's loss consumes
+    probabilities)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        x = features["image"] if isinstance(features, dict) else features
+        x = jnp.pad(x, ((0, 0), (3, 3), (3, 3), (0, 0)))
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding="VALID",
+                    use_bias=False, kernel_init=_conv_init, name="conv1")(x)
+        x = _bn(training, "bn_conv1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, (filters, blocks, strides) in enumerate(
+            RESNET50_STAGES, start=2
+        ):
+            x = ConvBlock(
+                3, filters, strides=strides, name=f"conv_block_{stage}"
+            )(x, training)
+            for b in range(1, blocks):
+                x = IdentityBlock(
+                    3, filters, name=f"identity_block_{stage}_{b}"
+                )(x, training)
+        x = x.mean(axis=(1, 2))
+        x = nn.Dense(self.num_classes, name="fc")(x)
+        # cast up before softmax so bf16 compute keeps a stable loss
+        return nn.softmax(x.astype(jnp.float32))

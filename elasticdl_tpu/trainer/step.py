@@ -24,14 +24,29 @@ from elasticdl_tpu.trainer.state import TrainState
 
 
 def _apply(state: TrainState, params, features, training: bool):
-    """Run the model, handling mutable collections (batch_stats)."""
+    """Run the model, handling mutable collections (batch_stats).
+
+    Training forwards get a ``dropout`` rng folded from the step counter:
+    deterministic per step (replay/restore-safe, identical across replicas
+    of an SPMD step) yet fresh every step.
+    """
     variables = {"params": params, **state.model_state}
-    if training and state.model_state:
-        outputs, new_state = state.apply_fn(
-            variables, features, training=True, mutable=list(state.model_state)
-        )
-        return outputs, new_state
-    outputs = state.apply_fn(variables, features, training=training)
+    if training:
+        rngs = {
+            "dropout": jax.random.fold_in(jax.random.PRNGKey(0), state.step)
+        }
+        if state.model_state:
+            outputs, new_state = state.apply_fn(
+                variables,
+                features,
+                training=True,
+                mutable=list(state.model_state),
+                rngs=rngs,
+            )
+            return outputs, new_state
+        outputs = state.apply_fn(variables, features, training=True, rngs=rngs)
+        return outputs, state.model_state
+    outputs = state.apply_fn(variables, features, training=False)
     return outputs, state.model_state
 
 

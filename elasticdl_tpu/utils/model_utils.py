@@ -90,6 +90,10 @@ class ModelSpec:
     learning_rate_scheduler: Any | None = None
     prediction_outputs_processor: Any | None = None
     custom_data_reader: Callable | None = None
+    # optional module hook ``sharding_rules(mesh) -> [Rule]``: model-forced
+    # layout (e.g. deepfm_edl_embedding distributes its tables regardless
+    # of size); merged ahead of the auto policy by the SPMD trainer callers
+    sharding_rules: Callable | None = None
     model_params: dict = field(default_factory=dict)
     module: Any = None
 
@@ -143,6 +147,7 @@ def resolve_model_spec(
         learning_rate_scheduler=_get("learning_rate_scheduler"),
         prediction_outputs_processor=processor,
         custom_data_reader=_get(custom_data_reader),
+        sharding_rules=_get("sharding_rules"),
         module=module,
     )
 

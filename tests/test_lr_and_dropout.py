@@ -1,0 +1,66 @@
+"""Regression tests for two jit hazards in the model-zoo path:
+
+1. learning_rate_scheduler is evaluated on a TRACED step inside the jitted
+   train step (optax schedule), so it must be branch-free;
+2. train-mode dropout requires the step builder to thread a 'dropout' rng.
+"""
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.data.recordio_gen import synthetic
+from elasticdl_tpu.models import cifar10_functional_api as c10
+from elasticdl_tpu.trainer.local_executor import build_optimizer
+from elasticdl_tpu.trainer.state import TrainState, init_model
+from elasticdl_tpu.trainer.step import build_train_step
+from elasticdl_tpu.utils.model_utils import get_model_spec
+
+
+def test_cifar10_scheduler_under_jit():
+    """The production path that used to crash: build_optimizer wires the
+    model's learning_rate_scheduler as an optax schedule evaluated on a
+    tracer (local_executor.build_optimizer)."""
+    spec = get_model_spec(
+        "", "cifar10_functional_api.cifar10_functional_api.custom_model"
+    )
+    assert spec.learning_rate_scheduler is not None
+    model = spec.build_model()
+    rng = np.random.RandomState(0)
+    feats = {"image": rng.rand(4, 32, 32, 3).astype(np.float32)}
+    labels = rng.randint(0, 10, 4).astype(np.int32)
+    params, mstate = init_model(model, feats)
+    tx = build_optimizer(spec)  # schedule path
+    state = TrainState.create(model.apply, params, tx, mstate)
+    train_step = build_train_step(spec.loss, compute_dtype=None)
+    state, metrics = train_step(state, feats, labels)  # jitted: step traced
+    assert np.isfinite(float(metrics["loss"]))
+
+    # schedule values match the reference's milestones
+    sch = spec.learning_rate_scheduler
+    np.testing.assert_allclose(float(sch(0)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(sch(5000)), 0.01, rtol=1e-6)
+    np.testing.assert_allclose(float(sch(15000)), 0.001, rtol=1e-6)
+
+
+def test_dropout_active_in_training():
+    """Same inputs, two different steps -> dropout rng differs by step, and
+    training forward differs from deterministic eval forward."""
+    model = c10.custom_model()
+    rng = np.random.RandomState(0)
+    feats = {"image": rng.rand(4, 32, 32, 3).astype(np.float32)}
+    params, mstate = init_model(model, feats)
+
+    out_eval = model.apply({"params": params, **mstate}, feats, training=False)
+
+    def train_out(step):
+        return model.apply(
+            {"params": params, **mstate},
+            feats,
+            training=True,
+            mutable=["batch_stats"],
+            rngs={"dropout": jax.random.fold_in(jax.random.PRNGKey(0), step)},
+        )[0]
+
+    out_t0, out_t1 = train_out(0), train_out(1)
+    assert not np.allclose(np.asarray(out_t0), np.asarray(out_eval))
+    assert not np.allclose(np.asarray(out_t0), np.asarray(out_t1))

@@ -124,11 +124,12 @@ class TestSPMDTrainer:
                     tr.place_batch(feats), tr.place_batch(labels)
                 )["loss"]
             )
-            for _ in range(8)
+            for _ in range(24)
         ]
-        assert tr.step == 8
-        # memorizing one fixed batch: loss must drop substantially
-        assert losses[-1] < losses[0] * 0.5, losses
+        assert tr.step == 24
+        # memorizing one fixed batch: loss must drop substantially (noisy
+        # early steps allowed — dropout is live in training mode)
+        assert min(losses[-4:]) < losses[0] * 0.5, losses
 
     def test_dp_matches_single_device_training(self):
         """DP over 8 devices must produce the same math as one device
