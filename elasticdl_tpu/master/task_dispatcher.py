@@ -111,9 +111,8 @@ class TaskDispatcher:
         self, task_type: TaskType, model_version: int
     ) -> list[Task]:
         tasks = []
+        # accumulates across epochs (reference task_dispatcher.py:128-137)
         counters = self._counters.setdefault(task_type, JobCounters())
-        counters.total_records = 0
-        counters.failed_records = 0
         for shard_name, (first, count) in self._shards[task_type].items():
             counters.total_records += count
             limit = first + count

@@ -23,20 +23,32 @@ from elasticdl_tpu.models.deepfm_functional_api import (  # noqa: F401
     loss,
     optimizer,
 )
-from elasticdl_tpu.utils.constants import MeshAxis
+
+
+# the /128-padded table height the layers actually allocate (5504)
+PADDED_VOCAB = -(-DeepFM().input_dim // 128) * 128
 
 
 def sharding_rules(mesh):
     """Always-distribute rules for this model's two tables (the reference
-    variant unconditionally uses the PS-sharded layer)."""
+    variant unconditionally uses the PS-sharded layer).  Picks the first
+    preferred axis whose size actually divides the padded vocab; warns and
+    replicates when no axis fits (rather than silently dropping the rule
+    downstream)."""
+    from elasticdl_tpu.layers.embedding import _preferred_axes
     from elasticdl_tpu.parallel.sharding import Rule
+    from elasticdl_tpu.utils.log_utils import default_logger as logger
 
     axes = [
-        a
-        for a in (MeshAxis.EP, MeshAxis.TP, MeshAxis.FSDP)
-        if a in mesh.axis_names and mesh.shape[a] > 1
+        a for a in _preferred_axes(mesh) if PADDED_VOCAB % mesh.shape[a] == 0
     ]
     if not axes:
+        if _preferred_axes(mesh):
+            logger.warning(
+                "deepfm_edl_embedding: no mesh axis divides the padded "
+                "vocab %d; tables stay replicated",
+                PADDED_VOCAB,
+            )
         return []
     axis = axes[0]
     return [

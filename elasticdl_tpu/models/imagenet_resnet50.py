@@ -3,9 +3,10 @@
 Reference: ``model_zoo/imagenet_resnet50/imagenet_resnet50.py`` — a single
 helper that packs ``<label>_xxx.JPEG`` files from a TAR into labeled
 records (the model itself comes from resnet50_subclass).  This build packs
-the decoded pixel array (the record codec carries dense tensors, not TF
-Example protos); decoding uses PIL when available, else the raw bytes are
-stored for a downstream decoder.
+the decoded ``(224, 224, 3)`` pixel array (the record codec carries dense
+tensors, not TF Example protos).  PIL is required for decoding; missing
+PIL or undecodable bytes raise at prep time so a corrupt dataset is never
+written.
 """
 
 from __future__ import annotations

@@ -1,0 +1,2 @@
+from elasticdl_tpu.worker.worker import Worker  # noqa: F401
+from elasticdl_tpu.worker.task_data_service import TaskDataService  # noqa: F401

@@ -1,0 +1,17 @@
+"""User hook for handling prediction outputs.
+
+Reference: ``elasticdl/python/worker/prediction_outputs_processor.py:4-24``.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+
+class BasePredictionOutputsProcessor(ABC):
+    """Subclass in the model module as ``PredictionOutputsProcessor`` to
+    receive each prediction minibatch's outputs."""
+
+    @abstractmethod
+    def process(self, predictions, worker_id):
+        """``predictions``: numpy array or dict of arrays for the batch."""

@@ -1,0 +1,179 @@
+"""Task-queue -> dataset bridge with exactly-once task accounting.
+
+Reference: ``elasticdl/python/worker/task_data_service.py`` — the dataset
+generator pulls tasks from the master *inside* iteration, so one
+continuous record stream spans many tasks, and batches may straddle task
+boundaries.  ``report_record_done`` keeps the cumulative processed-record
+count and pops+reports every pending task the count has covered
+(``task_data_service.py:75-107``), which is what guarantees each task is
+reported exactly once no matter how batch size divides task size.
+
+Deviation: the reference adds a fixed ``minibatch_size`` per batch even
+for the final short batch; this build adds the batch's *actual* length, so
+the cumulative count equals records truly processed (same pop behavior,
+tighter bookkeeping).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from elasticdl_tpu.data.dataset import Dataset
+from elasticdl_tpu.data.factory import create_data_reader
+from elasticdl_tpu.utils.constants import TaskType
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+FAIL_COUNT = "fail_count"
+
+
+class TaskDataService:
+    def __init__(
+        self,
+        worker,
+        training_with_evaluation: bool = False,
+        data_reader_params: dict | None = None,
+        data_origin: str | None = None,
+        custom_data_reader=None,
+        wait_sleep_secs: float = 2.0,
+    ):
+        self._worker = worker
+        self._training_with_evaluation = training_with_evaluation
+        self._wait_sleep_secs = wait_sleep_secs
+        create = custom_data_reader or create_data_reader
+        params = dict(data_reader_params or {})
+        self.data_reader = create(data_origin=data_origin, **params)
+        self._lock = threading.Lock()
+        self._pending_dataset = True
+        self._pending_save_model_task = None
+        self._warm_up_task = None
+        self._has_warmed_up = False
+        self._failed_record_count = 0
+        self._reported_record_count = 0
+        self._current_task = None
+        self._pending_tasks: deque = deque()
+
+    def _reset(self):
+        self._reported_record_count = 0
+        self._failed_record_count = 0
+        self._pending_tasks = deque()
+        self._current_task = None
+
+    def get_current_task(self):
+        return self._current_task
+
+    # ---- exactly-once task reporting --------------------------------------
+
+    def report_record_done(self, count: int, err_msg: str = "") -> bool:
+        """Add ``count`` processed records; report every task that is now
+        fully covered.  Returns True if at least one task completed."""
+        self._reported_record_count += count
+        if err_msg:
+            self._failed_record_count += count
+
+        if not self._pending_tasks:
+            return False
+        task = self._pending_tasks[0]
+        if self._reported_record_count < task.end - task.start:
+            return False
+        if err_msg:
+            logger.warning(
+                "records (%d/%d) failed in task %d: %s",
+                self._failed_record_count,
+                task.end - task.start,
+                task.task_id,
+                err_msg,
+            )
+        # batches may cover several whole tasks: keep popping while the
+        # cumulative count spans the head task (reference :93-104)
+        with self._lock:
+            while self._pending_tasks and self._reported_record_count >= (
+                self._pending_tasks[0].end - self._pending_tasks[0].start
+            ):
+                task = self._pending_tasks.popleft()
+                self._reported_record_count -= task.end - task.start
+                self._do_report_task(task, err_msg)
+                self._failed_record_count = 0
+            if self._pending_tasks:
+                self._current_task = self._pending_tasks[0]
+        return True
+
+    def _do_report_task(self, task, err_msg: str = ""):
+        counters = (
+            {FAIL_COUNT: self._failed_record_count}
+            if self._failed_record_count
+            else {}
+        )
+        self._worker.report_task_result(
+            task.task_id, err_msg, exec_counters=counters
+        )
+
+    # ---- dataset construction ---------------------------------------------
+
+    def get_dataset(self) -> Dataset | None:
+        """A dataset spanning all tasks the master will serve, or None when
+        the job is done / a SAVE_MODEL task arrived / WAIT cleared."""
+        if not self._pending_dataset:
+            return None
+        if self._pending_tasks:
+            logger.error("Cannot get new dataset with pending tasks")
+            return None
+        self._reset()
+        # warm-up: fetch one task and touch the reader so metadata is
+        # available before dataset_fn runs (reference :156-172)
+        if self._warm_up_task is None and not self._has_warmed_up:
+            while True:
+                task = self._worker.get_task()
+                if not task.is_wait:
+                    break
+                time.sleep(self._wait_sleep_secs)
+            if task.type == int(TaskType.SAVE_MODEL):
+                self._pending_save_model_task = task
+                return None
+            if not task.shard_name:
+                logger.info("No more tasks, stopping")
+                return None
+            self._warm_up_task = task
+            for _ in self.data_reader.read_records(task):
+                break
+            self._has_warmed_up = True
+        self._pending_dataset = False
+        return Dataset.from_generator(self._gen)
+
+    def _gen(self):
+        while True:
+            if self._warm_up_task is not None and self._has_warmed_up:
+                task = self._warm_up_task
+                self._warm_up_task = None
+            else:
+                task = self._worker.get_task()
+            if not task.shard_name:
+                if task.is_wait:
+                    # more tasks may appear (e.g. eval) — caller should
+                    # call get_dataset() again
+                    self._pending_dataset = True
+                    logger.info("No tasks for now, maybe more later")
+                else:
+                    logger.info("No more tasks, stopping")
+                break
+            with self._lock:
+                if task.type == int(TaskType.SAVE_MODEL):
+                    self._pending_save_model_task = task
+                    continue
+                self._pending_tasks.append(task)
+                if len(self._pending_tasks) == 1:
+                    self._current_task = task
+            for data in self.data_reader.read_records(task):
+                if data is not None:
+                    yield data
+
+    def get_save_model_task_and_dataset(self):
+        if not self._pending_save_model_task:
+            return None, None
+        task = self._pending_save_model_task
+        self._pending_save_model_task = None
+        ds = Dataset.from_generator(
+            lambda: iter(self.data_reader.read_records(task))
+        )
+        return task, ds

@@ -1,0 +1,367 @@
+"""The worker: task-driven SPMD training/eval/predict runtime.
+
+Reference: ``elasticdl/python/worker/worker.py`` (1085 LoC).  What remains
+after the TPU redesign:
+
+- task flow, minibatch retry (<=64, ``worker.py:46,800-840``), eval tasks
+  interleaved into training (``:945-1048``), SAVE_MODEL handling
+  (``:887-912``), prediction output processing — kept, host-side.
+- ``get_model``/``report_gradient`` PS fan-out (``:295-530``) — gone:
+  parameters live on the mesh inside :class:`SPMDTrainer`; gradient sync is
+  the psum XLA derives from shardings.  A "minibatch retry" therefore
+  re-runs the jitted step, not a parameter re-pull.
+- FTLib collectives + re-broadcast recovery (``:697-758``) — gone: ICI
+  collectives are part of the compiled step; membership changes are
+  handled by master-driven mesh re-formation (parallel.elastic).
+
+The worker talks to the master through any object implementing the
+servicer protocol (``rpc.messages`` dataclasses in/out) — the in-process
+``MasterServicer`` directly (reference in_process_master pattern) or the
+gRPC client adapter.
+"""
+
+from __future__ import annotations
+
+import time
+import traceback
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.parallel.distributed import SPMDTrainer
+from elasticdl_tpu.parallel.mesh import MeshConfig
+from elasticdl_tpu.rpc import messages as msg
+from elasticdl_tpu.trainer.local_executor import build_optimizer
+from elasticdl_tpu.trainer.state import Modes, checkpoint_to_state
+from elasticdl_tpu.utils import save_utils
+from elasticdl_tpu.utils.constants import (
+    JobType,
+    MAX_MINIBATCH_RETRY_NUM,
+    TaskType,
+)
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+from elasticdl_tpu.utils.model_utils import get_model_spec
+from elasticdl_tpu.utils.tensor import ndarray_to_tensor
+from elasticdl_tpu.utils.timing_utils import Timing
+from elasticdl_tpu.worker.task_data_service import TaskDataService
+
+
+def derive_job_type(args) -> JobType:
+    """Reference master.py:233-262: job type from data args."""
+    training = bool(getattr(args, "training_data", ""))
+    evaluation = bool(getattr(args, "validation_data", ""))
+    prediction = bool(getattr(args, "prediction_data", ""))
+    if prediction and not training:
+        return JobType.PREDICTION_ONLY
+    if evaluation and not training:
+        return JobType.EVALUATION_ONLY
+    if training and evaluation:
+        return JobType.TRAINING_WITH_EVALUATION
+    return JobType.TRAINING_ONLY
+
+
+class Worker:
+    def __init__(
+        self,
+        args,
+        master,
+        devices=None,
+        job_type: JobType | None = None,
+    ):
+        self._args = args
+        self._master = master
+        self._worker_id = int(getattr(args, "worker_id", 0) or 0)
+        self._minibatch_size = args.minibatch_size
+        self._job_type = job_type or derive_job_type(args)
+        self._timing = Timing(enabled=False)
+
+        self._spec = get_model_spec(
+            getattr(args, "model_zoo", "") or "",
+            args.model_def,
+            model_params=getattr(args, "model_params_dict", {}) or {},
+            dataset_fn=getattr(args, "dataset_fn", "dataset_fn"),
+            loss=getattr(args, "loss", "loss"),
+            optimizer=getattr(args, "optimizer", "optimizer"),
+            eval_metrics_fn=getattr(args, "eval_metrics_fn", "eval_metrics_fn"),
+        )
+        self._model = self._spec.build_model()
+
+        data_origin = (
+            args.prediction_data
+            if self._job_type == JobType.PREDICTION_ONLY
+            else args.training_data or args.validation_data
+        )
+        self._task_data_service = TaskDataService(
+            self,
+            training_with_evaluation=(
+                self._job_type == JobType.TRAINING_WITH_EVALUATION
+            ),
+            data_reader_params=getattr(args, "data_reader_params_dict", {})
+            or {},
+            data_origin=data_origin,
+            custom_data_reader=self._spec.custom_data_reader,
+        )
+
+        mesh_shape = getattr(args, "mesh_shape", "") or ""
+        self._mesh = MeshConfig.from_string(mesh_shape).create(devices)
+        self._trainer: SPMDTrainer | None = None
+        self._eval_metrics = None
+
+    # ---- master protocol ---------------------------------------------------
+
+    def get_task(self, task_type: int = -1) -> msg.TaskResponse:
+        return self._master.get_task(
+            msg.GetTaskRequest(worker_id=self._worker_id, task_type=task_type)
+        )
+
+    def report_task_result(self, task_id, err_msg="", exec_counters=None):
+        self._master.report_task_result(
+            msg.ReportTaskResultRequest(
+                task_id=task_id,
+                err_message=err_msg,
+                exec_counters=exec_counters or {},
+            )
+        )
+
+    def report_version(self):
+        if self._trainer is not None:
+            self._master.report_version(
+                msg.ReportVersionRequest(
+                    model_version=self._trainer.step,
+                    worker_id=self._worker_id,
+                )
+            )
+
+    def report_evaluation_metrics(self, outputs, labels, model_version):
+        if isinstance(outputs, dict):
+            out_tensors = {
+                k: ndarray_to_tensor(k, np.asarray(v))
+                for k, v in outputs.items()
+            }
+        else:
+            out_tensors = {
+                "output": ndarray_to_tensor("output", np.asarray(outputs))
+            }
+        self._master.report_evaluation_metrics(
+            msg.ReportEvaluationMetricsRequest(
+                model_outputs=out_tensors,
+                labels=ndarray_to_tensor("labels", np.asarray(labels)),
+                model_version=model_version,
+            )
+        )
+
+    # ---- trainer lifecycle -------------------------------------------------
+
+    def _ensure_trainer(self, sample_features):
+        if self._trainer is not None:
+            return
+        rules = ()
+        if self._spec.sharding_rules is not None:
+            rules = tuple(self._spec.sharding_rules(self._mesh))
+        tx = build_optimizer(
+            self._spec, getattr(self._args, "learning_rate", None)
+        )
+        compute_dtype = getattr(self._args, "compute_dtype", "float32")
+        self._trainer = SPMDTrainer(
+            self._mesh,
+            self._model,
+            self._spec.loss,
+            tx,
+            sample_features,
+            rules=rules,
+            compute_dtype=None if compute_dtype == "float32" else compute_dtype,
+            remat=bool(getattr(self._args, "remat", False)),
+            donate=bool(getattr(self._args, "donate_state", True)),
+        )
+        ckpt = getattr(self._args, "checkpoint_dir_for_init", "") or ""
+        if ckpt:
+            dense, _, extra = save_utils.restore_checkpoint(ckpt)
+            self._trainer.state = checkpoint_to_state(
+                self._trainer.state, dense
+            )
+            logger.info(
+                "Worker %d initialized from checkpoint %s (version %s)",
+                self._worker_id,
+                ckpt,
+                extra.get("model_version", "?"),
+            )
+
+    @property
+    def trainer(self):
+        return self._trainer
+
+    # ---- minibatch processing ----------------------------------------------
+
+    def _place(self, tree):
+        padded, _ = self._trainer.pad_batch(tree)
+        return self._trainer.place_batch(padded)
+
+    def _process_minibatch(self, task_type, features, labels):
+        """One minibatch with retry (reference worker.py:800-840; retries
+        there re-pull from the PS — here the state is device-resident, so a
+        retry is just a re-run after a transient failure)."""
+        err = ""
+        for _ in range(MAX_MINIBATCH_RETRY_NUM):
+            try:
+                if task_type == int(TaskType.EVALUATION):
+                    self._eval_minibatch(features, labels)
+                elif task_type == int(TaskType.TRAINING):
+                    self._ensure_trainer(features)
+                    self._timing.start_record_time("batch_process")
+                    self._trainer.train_step(
+                        self._place(features), self._place(labels)
+                    )
+                    self._timing.end_record_time("batch_process")
+                elif task_type == int(TaskType.PREDICTION):
+                    self._ensure_trainer(features)
+                    self._predict_minibatch(features)
+                else:
+                    raise RuntimeError(f"Unknown task type {task_type}")
+                return ""
+            except Exception as ex:  # noqa: BLE001 — report upstream
+                err = str(ex)
+                traceback.print_exc()
+        return err
+
+    def _eval_minibatch(self, features, labels):
+        self._ensure_trainer(features)
+        n = _batch_len(labels)
+        outputs, _ = self._trainer.eval_step(
+            self._place(features), self._place(labels)
+        )
+        outputs = jax.device_get(outputs)
+        outputs = _trim(outputs, n)
+        self.report_evaluation_metrics(outputs, labels, self._trainer.step)
+
+    def _predict_minibatch(self, features):
+        n = _batch_len(features)
+        outputs = jax.device_get(
+            self._trainer.predict_step(self._place(features))
+        )
+        outputs = _trim(outputs, n)
+        if self._spec.prediction_outputs_processor is not None:
+            self._spec.prediction_outputs_processor.process(
+                outputs, self._worker_id
+            )
+
+    # ---- job flows ---------------------------------------------------------
+
+    def _train_and_evaluate(self):
+        evaluation_task_executed = False
+        while True:
+            dataset = self._task_data_service.get_dataset()
+            if dataset is None:
+                # job finished or final SAVE_MODEL arrived
+                # (reference worker.py:969-971)
+                self._process_save_model_task_if_needed()
+                break
+            dataset = self._spec.dataset_fn(
+                dataset, Modes.TRAINING, self._task_data_service.data_reader.metadata
+            )
+            dataset = dataset.batch(self._minibatch_size).prefetch(2)
+            saw_batch = False
+            for features, labels in dataset:
+                saw_batch = True
+                if self._job_type == JobType.TRAINING_WITH_EVALUATION:
+                    evaluation_task_executed = (
+                        self._evaluate_only() or evaluation_task_executed
+                    )
+                task = self._task_data_service.get_current_task()
+                task_type = task.type if task else int(TaskType.TRAINING)
+                err = self._process_minibatch(task_type, features, labels)
+                if self._task_data_service.report_record_done(
+                    _batch_len(labels), err
+                ):
+                    self.report_version()
+            del dataset
+            if self._job_type == JobType.TRAINING_WITH_EVALUATION:
+                evaluation_task_executed = self._evaluate_only()
+            self._process_save_model_task_if_needed()
+            if not saw_batch and self._task_data_service._pending_dataset:
+                # WAIT with nothing to do yet: back off before re-polling
+                time.sleep(self._task_data_service._wait_sleep_secs)
+
+    def _evaluate_only(self) -> bool:
+        """Drain evaluation tasks (reference worker.py:1029-1048)."""
+        executed = False
+        while True:
+            task = self.get_task(int(TaskType.EVALUATION))
+            if not task.shard_name:
+                break
+            self._process_eval_task(task)
+            executed = True
+        return executed
+
+    def _process_eval_task(self, task):
+        reader = self._task_data_service.data_reader
+        from elasticdl_tpu.data.dataset import Dataset
+
+        ds = Dataset.from_generator(lambda: iter(reader.read_records(task)))
+        ds = self._spec.dataset_fn(ds, Modes.EVALUATION, reader.metadata)
+        err = ""
+        for features, labels in ds.batch(self._minibatch_size):
+            e = self._process_minibatch(int(TaskType.EVALUATION), features, labels)
+            err = err or e
+        self.report_task_result(task.task_id, err)
+
+    def _predict_only(self):
+        while True:
+            dataset = self._task_data_service.get_dataset()
+            if dataset is None:
+                break
+            dataset = self._spec.dataset_fn(
+                dataset,
+                Modes.PREDICTION,
+                self._task_data_service.data_reader.metadata,
+            )
+            dataset = dataset.batch(self._minibatch_size).prefetch(2)
+            for features in dataset:
+                task = self._task_data_service.get_current_task()
+                err = self._process_minibatch(
+                    task.type if task else int(TaskType.PREDICTION),
+                    features,
+                    None,
+                )
+                self._task_data_service.report_record_done(
+                    _batch_len(features), err
+                )
+            del dataset
+
+    def _process_save_model_task_if_needed(self) -> bool:
+        task, _ = self._task_data_service.get_save_model_task_and_dataset()
+        if task is None:
+            return False
+        path = task.extended.get("saved_model_path", "") or getattr(
+            self._args, "output", ""
+        )
+        err = ""
+        try:
+            if self._trainer is None:
+                raise RuntimeError("no trained state to save")
+            from elasticdl_tpu.utils.export_utils import export_model
+
+            export_model(path, self._trainer.state, self._spec, self._args)
+        except Exception as ex:  # noqa: BLE001
+            err = str(ex)
+            traceback.print_exc()
+        self.report_task_result(task.task_id, err)
+        return True
+
+    def run(self):
+        """Reference worker.py:1075-1085."""
+        if self._job_type == JobType.PREDICTION_ONLY:
+            self._predict_only()
+        elif self._job_type == JobType.EVALUATION_ONLY:
+            self._evaluate_only()
+        else:
+            self._train_and_evaluate()
+
+
+def _batch_len(tree) -> int:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return int(np.shape(leaves[0])[0]) if leaves else 0
+
+
+def _trim(outputs, n: int):
+    """Drop pad rows added for SPMD batch divisibility."""
+    return jax.tree_util.tree_map(lambda x: np.asarray(x)[:n], outputs)
