@@ -1,0 +1,220 @@
+"""Master-side evaluation: jobs, triggers, metric accumulation.
+
+Reference: ``elasticdl/python/master/evaluation_service.py`` —
+``EvaluationJob`` accumulates Keras metrics from worker-reported
+output/label tensors (chunked at 500 rows to dodge a TF memleak, :110-124
+— unnecessary for numpy metrics, dropped); ``_EvaluationTrigger`` thread
+for time-based eval (:127-159); step-based eval on model-version
+milestones via ``add_evaluation_task_if_needed`` (:246-261); EVALUATION
+tasks created in the dispatcher (:223-244).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from elasticdl_tpu.trainer.metrics import (
+    metric_tree_results,
+    update_metric_tree,
+)
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+
+class EvaluationJob:
+    """One evaluation pass at a model version (reference :14-124)."""
+
+    def __init__(self, metrics_tree, model_version: int, total_tasks: int = -1):
+        self.model_version = model_version
+        self._total_tasks = total_tasks
+        self._completed_tasks = 0
+        self._metrics = metrics_tree
+
+    def complete_task(self):
+        self._completed_tasks += 1
+
+    def finished(self) -> bool:
+        return 0 <= self._total_tasks <= self._completed_tasks
+
+    def report_evaluation_metrics(self, model_outputs, labels) -> bool:
+        """``model_outputs``: name -> Tensor (wire format); labels Tensor."""
+        if labels is None:
+            return False
+        outputs = {
+            name: t.values for name, t in model_outputs.items()
+        }
+        if len(outputs) == 1:
+            outputs = next(iter(outputs.values()))
+        update_metric_tree(self._metrics, labels.values, outputs)
+        return True
+
+    def get_evaluation_summary(self) -> dict:
+        return metric_tree_results(self._metrics)
+
+
+class _EvaluationTrigger(threading.Thread):
+    """Time-based trigger (reference :127-159)."""
+
+    def __init__(self, eval_service, start_delay_secs, throttle_secs):
+        super().__init__(daemon=True)
+        self._eval_service = eval_service
+        self._stopper = threading.Event()
+        self._throttle_secs = throttle_secs
+        self._eval_min_time = time.time() + start_delay_secs
+
+    def stop(self):
+        self._stopper.set()
+
+    def _wait_enough_time(self, cur_time_secs, previous_round_start_secs):
+        if cur_time_secs < self._eval_min_time:
+            return False
+        if (
+            previous_round_start_secs != -1
+            and cur_time_secs - previous_round_start_secs < self._throttle_secs
+        ):
+            return False
+        return True
+
+    def run(self):
+        previous_round_start_secs = -1
+        while not self._stopper.is_set():
+            time_now = time.time()
+            if self._wait_enough_time(time_now, previous_round_start_secs):
+                self._eval_service.add_evaluation_task(is_time_based_eval=True)
+                previous_round_start_secs = time_now
+            time.sleep(5)
+
+
+class EvaluationService:
+    """Schedules EVALUATION tasks and aggregates their metrics
+    (reference :162-293)."""
+
+    def __init__(
+        self,
+        tensorboard_service,
+        task_dispatcher,
+        eval_metrics_fn,
+        start_delay_secs: float = 0,
+        throttle_secs: float = 0,
+        evaluation_steps: int = 0,
+        eval_only: bool = False,
+        eval_exporter=None,
+    ):
+        self._tensorboard_service = tensorboard_service
+        self._task_d = task_dispatcher
+        self._lock = threading.Lock()
+        self._eval_job: EvaluationJob | None = None
+        self.trigger = threading.Event()
+        self._time_based = throttle_secs > 0
+        self._eval_throttle_secs = throttle_secs
+        self._eval_start_delay_secs = start_delay_secs
+        self._eval_checkpoint_versions: list[int] = []
+        self._last_eval_checkpoint_version = -1
+        self._eval_metrics_fn = eval_metrics_fn
+        self._evaluation_steps = evaluation_steps
+        self._eval_only = eval_only
+        self._eval_exporter = eval_exporter
+        self._master_servicer = None
+        self._eval_trigger: _EvaluationTrigger | None = None
+        task_dispatcher.set_evaluation_service(self)
+
+    def set_master_servicer(self, servicer):
+        self._master_servicer = servicer
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        if self._time_based:
+            self._eval_trigger = _EvaluationTrigger(
+                self, self._eval_start_delay_secs, self._eval_throttle_secs
+            )
+            self._eval_trigger.start()
+
+    def stop(self):
+        if self._eval_trigger is not None:
+            self._eval_trigger.stop()
+
+    # ---- task creation -----------------------------------------------------
+
+    def init_eval_only_job(self, num_tasks: int):
+        self._eval_job = EvaluationJob(self._eval_metrics_fn(), -1, num_tasks)
+
+    def add_evaluation_task(
+        self, is_time_based_eval: bool = False, model_version: int | None = None
+    ):
+        """Create EVALUATION tasks at ``model_version`` (reference
+        :223-244)."""
+        if model_version is None:
+            model_version = (
+                self._master_servicer.get_model_version()
+                if self._master_servicer
+                else -1
+            )
+        with self._lock:
+            if (
+                self._eval_job is not None
+                and not self._eval_job.finished()
+            ):
+                # previous eval still running: skip (one at a time)
+                return
+            n = self._task_d.create_evaluation_tasks(model_version)
+            if n == 0:
+                return
+            self._eval_job = EvaluationJob(
+                self._eval_metrics_fn(), model_version, n
+            )
+        logger.info(
+            "Created evaluation job at model version %d (%d tasks)",
+            model_version,
+            n,
+        )
+
+    def add_evaluation_task_if_needed(self, master_locking, model_version):
+        """Step-based trigger: every ``evaluation_steps`` versions
+        (reference :246-261)."""
+        if not self._evaluation_steps:
+            return
+        if model_version is None and self._master_servicer:
+            model_version = self._master_servicer.get_model_version()
+        if (
+            model_version
+            and model_version % self._evaluation_steps == 0
+            and model_version > self._last_eval_checkpoint_version
+        ):
+            self._last_eval_checkpoint_version = model_version
+            self.add_evaluation_task(model_version=model_version)
+
+    # ---- metric flow -------------------------------------------------------
+
+    def report_evaluation_metrics(self, model_outputs, labels) -> bool:
+        with self._lock:
+            if self._eval_job is None:
+                return False
+            return self._eval_job.report_evaluation_metrics(
+                model_outputs, labels
+            )
+
+    def complete_task(self):
+        with self._lock:
+            if self._eval_job is None:
+                return None
+            self._eval_job.complete_task()
+            if not self._eval_job.finished():
+                return None
+            job, self._eval_job = self._eval_job, None
+
+        # job done: publish results (reference :271-293)
+        summary = job.get_evaluation_summary()
+        logger.info(
+            "Evaluation @version %d: %s", job.model_version, summary
+        )
+        if self._tensorboard_service is not None:
+            self._tensorboard_service.write_dict_to_summary(
+                summary, version=max(job.model_version, 0)
+            )
+        if self._eval_exporter is not None:
+            self._eval_exporter(job.model_version, summary)
+        if self._eval_only:
+            self.trigger.set()
+        self.latest_summary = summary
+        return summary

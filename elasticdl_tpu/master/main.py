@@ -1,0 +1,46 @@
+"""Master process entry (reference elasticdl/python/master/main.py:7-11).
+
+``python -m elasticdl_tpu.master.main --model_def=... --training_data=...``
+starts the control plane and, when ``--num_workers > 0``, spawns local
+worker subprocesses wired back over gRPC.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from elasticdl_tpu.master.master import LocalInstanceManager, Master
+from elasticdl_tpu.utils.args import build_worker_arguments, parse_master_args
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+
+def main(argv=None) -> int:
+    args = parse_master_args(argv)
+
+    def im_factory(master):
+        num_workers = getattr(args, "num_workers", 0) or 0
+        if num_workers <= 0:
+            return None
+
+        def build_argv(worker_id, master_addr):
+            return [
+                "elasticdl_tpu.worker.main",
+                *build_worker_arguments(args, worker_id, master_addr),
+            ]
+
+        return LocalInstanceManager(master, num_workers, build_argv)
+
+    master = Master(args, instance_manager_factory=im_factory)
+    master.prepare()
+    logger.info(
+        "Master ready on port %d (job type %s)",
+        master.port,
+        master.job_type.value,
+    )
+    rc = master.run()
+    logger.info("Job summary: %s", master.job_summary())
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,263 @@
+"""The master: job orchestrator and control plane.
+
+Reference: ``elasticdl/python/master/master.py`` — loads the model module,
+decides the JobType (:233-262), builds the task dispatcher / evaluation
+service / gRPC server (:301-324) / instance manager, registers the
+SAVE_MODEL deferred callback (:122-129), and polls ``task_d.finished()``
+(:179-199).  The TPU differences:
+
+- workers are SPMD processes over a device mesh, not eager-TF pods; the
+  master starts them through a pluggable instance manager (local
+  subprocesses here; a k8s backend where pods exist);
+- there is no PS fleet to start;
+- worker liveness is heartbeat-based (servicer) with task recovery on
+  timeout, complementing (or replacing) the k8s watch stream.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+from elasticdl_tpu.data.factory import create_data_reader
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.master.tensorboard_service import TensorboardService
+from elasticdl_tpu.utils.args import derive_job_type
+from elasticdl_tpu.utils.constants import JobType, TaskType
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+from elasticdl_tpu.utils.model_utils import get_model_spec
+
+
+class Master:
+    def __init__(self, args, instance_manager_factory=None):
+        self._args = args
+        self.job_type = derive_job_type(args)
+        self._stop_requested = False
+
+        self._spec = get_model_spec(
+            getattr(args, "model_zoo", "") or "",
+            args.model_def,
+            model_params=getattr(args, "model_params_dict", {}) or {},
+        )
+
+        # ---- task dispatcher over data-reader shards (master.py:35-66)
+        reader_params = getattr(args, "data_reader_params_dict", {}) or {}
+        create = self._spec.custom_data_reader or create_data_reader
+
+        def shards_for(origin):
+            if not origin:
+                return {}
+            return create(data_origin=origin, **reader_params).create_shards()
+
+        self.task_d = TaskDispatcher(
+            shards_for(getattr(args, "training_data", "")),
+            shards_for(getattr(args, "validation_data", "")),
+            shards_for(getattr(args, "prediction_data", "")),
+            records_per_task=args.records_per_task,
+            num_epochs=args.num_epochs,
+            task_timeout_secs=getattr(args, "task_timeout_secs", 0.0),
+            shuffle_seed=getattr(args, "shuffle_seed", None),
+        )
+
+        # ---- tensorboard + evaluation services
+        self.tb_service = None
+        tb_dir = getattr(args, "tensorboard_log_dir", "") or ""
+        if tb_dir:
+            self.tb_service = TensorboardService(tb_dir)
+        self.evaluation_service = None
+        if (
+            self.job_type
+            in (JobType.TRAINING_WITH_EVALUATION, JobType.EVALUATION_ONLY)
+            and self._spec.eval_metrics_fn is not None
+        ):
+            self.evaluation_service = EvaluationService(
+                self.tb_service,
+                self.task_d,
+                self._spec.eval_metrics_fn,
+                start_delay_secs=getattr(
+                    args, "evaluation_start_delay_secs", 0
+                ),
+                throttle_secs=getattr(args, "evaluation_throttle_secs", 0),
+                evaluation_steps=getattr(args, "evaluation_steps", 0),
+                eval_only=self.job_type == JobType.EVALUATION_ONLY,
+            )
+            # (eval-only jobs: set_evaluation_service inside the service's
+            # constructor already initialized the job from the dispatcher)
+            if (
+                self.job_type == JobType.TRAINING_WITH_EVALUATION
+                and not getattr(args, "evaluation_steps", 0)
+                and not getattr(args, "evaluation_throttle_secs", 0)
+            ):
+                # neither trigger configured: guarantee one final evaluation
+                # when training drains (before the SAVE_MODEL callback below)
+                self.task_d.add_deferred_callback(
+                    lambda: self.evaluation_service.add_evaluation_task()
+                )
+
+        # ---- SAVE_MODEL deferred callback (master.py:122-129)
+        output = getattr(args, "output", "") or ""
+        if output and self.job_type in (
+            JobType.TRAINING_ONLY,
+            JobType.TRAINING_WITH_EVALUATION,
+        ):
+            self.task_d.add_deferred_callback_create_save_model_task(output)
+
+        # ---- servicer + transport
+        self.servicer = MasterServicer(
+            args.minibatch_size,
+            self.task_d,
+            evaluation_service=self.evaluation_service,
+        )
+        self._server = None
+        self._port = None
+
+        # ---- worker lifecycle
+        self.instance_manager = (
+            instance_manager_factory(self) if instance_manager_factory else None
+        )
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    @property
+    def port(self):
+        return self._port
+
+    def prepare(self, port: int | None = None):
+        """Start services + control-plane server
+        (reference master.py:150-177)."""
+        from elasticdl_tpu.rpc.service import create_server
+
+        if self.evaluation_service is not None:
+            self.evaluation_service.start()
+        port = port if port is not None else getattr(self._args, "port", 0)
+        self._server = create_server(self.servicer, port)
+        self._server.start()
+        self._port = self._server._edl_bound_port
+        if self.tb_service is not None:
+            self.tb_service.start()
+        if self.instance_manager is not None:
+            self.instance_manager.start_workers()
+
+    def run(self, poll_secs: float = 1.0) -> int:
+        """Poll until all tasks (incl. deferred SAVE_MODEL) are done
+        (reference master.py:179-199, 30s poll shortened — local workers
+        finish in seconds)."""
+        try:
+            while True:
+                if self.task_d.finished() and not (
+                    self.task_d.invoke_deferred_callback()
+                ):
+                    break
+                if self._stop_requested:
+                    break
+                dead = self.servicer.dead_workers(
+                    getattr(self._args, "heartbeat_timeout_secs", 0) or 0
+                )
+                for worker_id in dead:
+                    logger.warning("Worker %d timed out; recovering", worker_id)
+                    self.task_d.recover_tasks(worker_id)
+                    self.servicer.forget_worker(worker_id)
+                    if self.instance_manager is not None:
+                        self.instance_manager.restart_worker(worker_id)
+                time.sleep(poll_secs)
+        except KeyboardInterrupt:
+            logger.warning("Interrupted; shutting down")
+        self.stop()
+        return 0
+
+    def request_stop(self):
+        self._stop_requested = True
+
+    def stop(self):
+        if self.evaluation_service is not None:
+            self.evaluation_service.stop()
+        if self.instance_manager is not None:
+            self.instance_manager.stop_workers()
+        if self._server is not None:
+            self._server.stop(grace=2)
+            self._server = None
+        if self.tb_service is not None:
+            # reference master.py:217-230 keeps TB alive after job end
+            self.tb_service.close()
+
+    # ---- summary ----------------------------------------------------------
+
+    def job_summary(self) -> dict:
+        out = {
+            "job_type": self.job_type.value,
+            "epoch": self.task_d.epoch,
+        }
+        for tt in (TaskType.TRAINING, TaskType.EVALUATION, TaskType.PREDICTION):
+            c = self.task_d.counters(tt)
+            if c.total_records:
+                out[tt.name.lower()] = {
+                    "total_records": c.total_records,
+                    "failed_records": c.failed_records,
+                }
+        summary = getattr(self.evaluation_service, "latest_summary", None)
+        if summary:
+            out["evaluation_metrics"] = summary
+        return out
+
+
+class LocalInstanceManager:
+    """Spawn workers as local subprocesses — the Local/AllReduce-strategy
+    analogue of the k8s InstanceManager (pods -> processes).  Each worker
+    gets the master address and its id via argv (the reference master
+    assembles worker argv the same way, master.py:331-384)."""
+
+    def __init__(self, master, num_workers: int, build_argv):
+        self._master = master
+        self._num_workers = num_workers
+        self._build_argv = build_argv  # (worker_id, master_addr) -> argv
+        self._procs: dict[int, object] = {}
+        self._next_worker_id = num_workers
+        self._lock = threading.Lock()
+
+    def start_workers(self):
+        for worker_id in range(self._num_workers):
+            self._start(worker_id)
+
+    def _start(self, worker_id: int):
+        argv = self._build_argv(worker_id, f"localhost:{self._master.port}")
+        env = dict(os.environ)
+        # make the framework importable regardless of the master's cwd
+        import elasticdl_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(elasticdl_tpu.__file__))
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (pkg_root, env.get("PYTHONPATH", "")) if p
+        )
+        proc = subprocess.Popen([sys.executable, "-m", *argv], env=env)
+        with self._lock:
+            self._procs[worker_id] = proc
+        logger.info("Started worker %d (pid %d)", worker_id, proc.pid)
+
+    def restart_worker(self, worker_id: int):
+        """Relaunch with a NEW worker id (reference
+        k8s_instance_manager.py:266-275)."""
+        with self._lock:
+            proc = self._procs.pop(worker_id, None)
+            new_id = self._next_worker_id
+            self._next_worker_id += 1
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+        self._start(new_id)
+
+    def stop_workers(self):
+        with self._lock:
+            procs = list(self._procs.values())
+            self._procs.clear()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                proc.kill()

@@ -55,6 +55,10 @@ class MasterServicer:
     # ---- RPC handlers -----------------------------------------------------
 
     def get_task(self, request: msg.GetTaskRequest) -> msg.TaskResponse:
+        # every task pull is a liveness signal (cheap implicit heartbeat;
+        # the worker's background heartbeat covers long compute gaps)
+        with self._lock:
+            self._heartbeats[request.worker_id] = time.monotonic()
         if request.task_type == int(TaskType.EVALUATION):
             task_id, task = self._task_d.get_eval_task(request.worker_id)
         else:
@@ -119,7 +123,10 @@ class MasterServicer:
     # ---- failure detection / mesh re-formation hooks ----------------------
 
     def dead_workers(self, timeout_secs: float) -> list[int]:
-        """Workers whose last heartbeat is older than the timeout."""
+        """Workers whose last heartbeat is older than the timeout;
+        ``timeout_secs <= 0`` disables detection."""
+        if timeout_secs <= 0:
+            return []
         now = time.monotonic()
         with self._lock:
             return [

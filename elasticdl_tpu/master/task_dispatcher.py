@@ -168,6 +168,15 @@ class TaskDispatcher:
             task = self._pending.pop()
             return self._lease(worker_id, task), task
 
+    def create_evaluation_tasks(self, model_version: int) -> int:
+        """Locked eval-task creation for the evaluation service; returns
+        how many tasks were created (reference evaluation_service.py:223-244
+        calls into the dispatcher the same way)."""
+        with self._lock:
+            before = len(self._pending_eval)
+            self.create_tasks(TaskType.EVALUATION, model_version)
+            return len(self._pending_eval) - before
+
     def get_eval_task(self, worker_id: int) -> tuple[int, Task | None]:
         with self._lock:
             if not self._pending_eval:
@@ -265,17 +274,23 @@ class TaskDispatcher:
             return not (self._pending or self._pending_eval or self._active)
 
     def invoke_deferred_callback(self) -> bool:
-        """Pop and run one all-tasks-done callback (e.g. SAVE_MODEL creation,
-        reference task_dispatcher.py:221-235)."""
+        """Pop and run one all-tasks-done callback in registration order
+        (e.g. final evaluation, then SAVE_MODEL creation; reference
+        task_dispatcher.py:221-235).  The callback runs outside the lock —
+        callbacks re-enter dispatcher methods (create_evaluation_tasks)."""
         with self._lock:
             if not self._done_callbacks:
                 return False
-            callback = self._done_callbacks.pop()
-            callback()
-            return True
+            callback = self._done_callbacks.pop(0)
+        callback()
+        return True
+
+    def add_deferred_callback(self, callback: Callable[[], None]):
+        """Run ``callback`` once all current tasks drain (FIFO order)."""
+        self._done_callbacks.append(callback)
 
     def add_deferred_callback_create_save_model_task(self, saved_model_path):
-        self._done_callbacks.append(
+        self.add_deferred_callback(
             lambda: self._create_save_model_task(saved_model_path)
         )
 
@@ -287,16 +302,17 @@ class TaskDispatcher:
         if not shards:
             raise RuntimeError("SAVE_MODEL requires training shards")
         shard_name, (first, count) = next(iter(shards.items()))
-        self._counters[TaskType.SAVE_MODEL] = JobCounters()
-        self._pending.append(
-            Task(
-                shard_name=shard_name,
-                start=first,
-                end=first + min(self._records_per_task, count),
-                type=TaskType.SAVE_MODEL,
-                extended={"saved_model_path": saved_model_path},
+        with self._lock:
+            self._counters[TaskType.SAVE_MODEL] = JobCounters()
+            self._pending.append(
+                Task(
+                    shard_name=shard_name,
+                    start=first,
+                    end=first + min(self._records_per_task, count),
+                    type=TaskType.SAVE_MODEL,
+                    extended={"saved_model_path": saved_model_path},
+                )
             )
-        )
 
     def set_evaluation_service(self, evaluation_service):
         with self._lock:

@@ -1,0 +1,91 @@
+"""TensorBoard metrics publishing.
+
+Reference: ``elasticdl/python/master/tensorboard_service.py`` — writes
+eval metrics as TF summaries (:27-34) and launches a ``tensorboard`` CLI
+subprocess on the master (:36-47).  This build writes through
+``torch.utils.tensorboard`` (event-file format without a TF dependency)
+plus an always-on ``metrics.jsonl`` alongside, which is grep-able in
+environments with no TB reader.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+
+class TensorboardService:
+    def __init__(self, tensorboard_log_dir: str, master_ip: str = ""):
+        self._log_dir = tensorboard_log_dir
+        self._master_ip = master_ip
+        self._initialize_summary_writer()
+        self._jsonl_path = os.path.join(self._log_dir, "metrics.jsonl")
+        self.tb_process = None
+
+    def _initialize_summary_writer(self):
+        os.makedirs(self._log_dir, exist_ok=True)
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._summary_writer = SummaryWriter(log_dir=self._log_dir)
+        except Exception as e:  # pragma: no cover - env without torch TB
+            logger.warning("TensorBoard writer unavailable: %s", e)
+            self._summary_writer = None
+
+    def write_dict_to_summary(self, dictionary: dict, version: int):
+        """Reference tensorboard_service.py:27-34."""
+        for k, v in dictionary.items():
+            try:
+                value = float(v)
+            except (TypeError, ValueError):
+                continue
+            if self._summary_writer is not None:
+                self._summary_writer.add_scalar(k, value, global_step=version)
+        with open(self._jsonl_path, "a") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "version": version,
+                        "time": time.time(),
+                        **{
+                            k: float(v)
+                            for k, v in dictionary.items()
+                            if isinstance(v, (int, float))
+                        },
+                    }
+                )
+                + "\n"
+            )
+        if self._summary_writer is not None:
+            self._summary_writer.flush()
+
+    def start(self):
+        """Launch the tensorboard CLI against the log dir
+        (reference :36-47); no-op if the binary is missing."""
+        try:
+            self.tb_process = subprocess.Popen(
+                ["tensorboard", "--logdir", self._log_dir]
+                + (["--host", self._master_ip] if self._master_ip else []),
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+        except FileNotFoundError:
+            logger.warning("tensorboard binary not found; summaries only")
+
+    def keep_running(self, check_fn=lambda: True, poll_secs: float = 10.0):
+        """Block while the TB subprocess serves (reference master.py:217-230
+        keeps TB alive after job end)."""
+        while self.tb_process is not None and check_fn():
+            if self.tb_process.poll() is not None:
+                return
+            time.sleep(poll_secs)
+
+    def close(self):
+        if self._summary_writer is not None:
+            self._summary_writer.close()
+        if self.tb_process is not None and self.tb_process.poll() is None:
+            self.tb_process.terminate()

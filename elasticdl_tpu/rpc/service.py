@@ -1,0 +1,115 @@
+"""gRPC transport for the master control plane.
+
+Reference: the ``Master`` gRPC service (``elasticdl/proto/elasticdl.proto:
+108-113``) built with protoc stubs.  The TPU build keeps gRPC for the same
+low-rate control traffic (tasks, versions, eval metrics, heartbeats) but
+skips the protoc toolchain: methods are registered with
+``grpc.method_handlers_generic_handler`` and payloads are the msgpack
+frames of :mod:`elasticdl_tpu.rpc.messages`.  Handlers delegate to a
+transport-agnostic ``MasterServicer`` — the same object tests call
+directly (the in-process-master pattern, reference test_utils.py:357-360).
+
+Tensor payloads (eval outputs/labels) ride inside the same frames; the
+256MB message cap matches the reference (constants.py:1-5).
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+
+import grpc
+
+from elasticdl_tpu.rpc import messages as msg
+from elasticdl_tpu.utils.constants import GRPC
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+SERVICE_NAME = "elasticdl_tpu.Master"
+
+# method name -> servicer attribute (unary-unary, bytes in/out)
+_METHODS = (
+    "get_task",
+    "report_task_result",
+    "report_version",
+    "report_evaluation_metrics",
+    "heartbeat",
+)
+
+_CHANNEL_OPTIONS = [
+    ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
+    ("grpc.max_receive_message_length", GRPC.MAX_RECEIVE_MESSAGE_LENGTH),
+]
+
+
+def _handler(servicer, name):
+    fn = getattr(servicer, name)
+
+    def unary(request_bytes: bytes, context) -> bytes:
+        request = msg.decode(request_bytes)
+        response = fn(request)
+        return msg.encode(response) if response is not None else b""
+
+    return grpc.unary_unary_rpc_method_handler(unary)
+
+
+def create_server(
+    servicer, port: int, max_workers: int = 64
+) -> grpc.Server:
+    """Bind a MasterServicer behind gRPC (reference master.py:301-324:
+    64-thread pool, 256MB messages)."""
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=_CHANNEL_OPTIONS,
+    )
+    handlers = {name: _handler(servicer, name) for name in _METHODS}
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )
+    bound = server.add_insecure_port(f"[::]:{port}")
+    if bound == 0:
+        raise RuntimeError(f"could not bind master port {port}")
+    logger.info("Master control-plane server bound to port %d", bound)
+    server._edl_bound_port = bound  # for port=0 ephemeral binds in tests
+    return server
+
+
+class MasterClient:
+    """Worker-side stub implementing the servicer protocol over a channel.
+
+    Drop-in for the in-process ``MasterServicer`` object (same method
+    names, same dataclasses), so ``Worker`` code is transport-blind.
+    """
+
+    def __init__(self, addr: str):
+        self._channel = grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
+        self._calls = {
+            name: self._channel.unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=None,
+                response_deserializer=None,
+            )
+            for name in _METHODS
+        }
+
+    def _call(self, name, request):
+        payload = self._calls[name](msg.encode(request))
+        return msg.decode(payload) if payload else None
+
+    def get_task(self, request: msg.GetTaskRequest) -> msg.TaskResponse:
+        return self._call("get_task", request)
+
+    def report_task_result(self, request: msg.ReportTaskResultRequest):
+        return self._call("report_task_result", request)
+
+    def report_version(self, request: msg.ReportVersionRequest):
+        return self._call("report_version", request)
+
+    def report_evaluation_metrics(
+        self, request: msg.ReportEvaluationMetricsRequest
+    ):
+        return self._call("report_evaluation_metrics", request)
+
+    def heartbeat(self, request: msg.HeartbeatRequest) -> msg.HeartbeatResponse:
+        return self._call("heartbeat", request)
+
+    def close(self):
+        self._channel.close()

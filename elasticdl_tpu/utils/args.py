@@ -237,7 +237,9 @@ def _add_mesh_params(parser: argparse.ArgumentParser):
     )
     parser.add_argument(
         "--num_workers",
-        type=pos_int,
+        # 0 = control plane only (workers launched externally, e.g. by the
+        # TPU pod runtime)
+        type=non_neg_int,
         default=1,
         help="Number of worker processes (TPU hosts)",
     )
@@ -271,7 +273,8 @@ def _add_mesh_params(parser: argparse.ArgumentParser):
 
 def _add_master_params(parser: argparse.ArgumentParser):
     parser.add_argument(
-        "--port", type=pos_int, default=MASTER_DEFAULT_PORT
+        # 0 = ephemeral (the OS picks; used by tests and local runs)
+        "--port", type=non_neg_int, default=MASTER_DEFAULT_PORT
     )
     parser.add_argument(
         "--instance_backend",
@@ -401,6 +404,23 @@ _MASTER_ONLY_FLAGS = frozenset(
 _DERIVED_KEYS = frozenset(
     {"model_params_dict", "data_reader_params_dict", "envs_dict"}
 )
+
+
+def derive_job_type(args):
+    """JobType from which data args are set (reference master.py:233-262).
+    Shared by master and worker so they can never disagree."""
+    from elasticdl_tpu.utils.constants import JobType
+
+    training = bool(getattr(args, "training_data", ""))
+    evaluation = bool(getattr(args, "validation_data", ""))
+    prediction = bool(getattr(args, "prediction_data", ""))
+    if prediction and not training:
+        return JobType.PREDICTION_ONLY
+    if evaluation and not training:
+        return JobType.EVALUATION_ONLY
+    if training and evaluation:
+        return JobType.TRAINING_WITH_EVALUATION
+    return JobType.TRAINING_ONLY
 
 
 def build_arguments_from_parsed_result(

@@ -1,0 +1,34 @@
+"""Worker process entry (reference elasticdl/python/worker/main.py:9-40).
+
+Connects to the master control plane over gRPC and runs the task loop:
+``python -m elasticdl_tpu.worker.main --master_addr=... --worker_id=N ...``
+"""
+
+from __future__ import annotations
+
+import sys
+
+from elasticdl_tpu.rpc.service import MasterClient
+from elasticdl_tpu.utils.args import parse_worker_args
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+from elasticdl_tpu.worker.worker import Worker
+
+
+def main(argv=None) -> int:
+    args = parse_worker_args(argv)
+    logger.info(
+        "Worker %d connecting to master at %s",
+        args.worker_id,
+        args.master_addr,
+    )
+    client = MasterClient(args.master_addr)
+    worker = Worker(args, client)
+    try:
+        worker.run()
+    finally:
+        client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

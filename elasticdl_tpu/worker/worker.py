@@ -39,25 +39,12 @@ from elasticdl_tpu.utils.constants import (
     MAX_MINIBATCH_RETRY_NUM,
     TaskType,
 )
+from elasticdl_tpu.utils.args import derive_job_type  # noqa: F401 (re-export)
 from elasticdl_tpu.utils.log_utils import default_logger as logger
 from elasticdl_tpu.utils.model_utils import get_model_spec
 from elasticdl_tpu.utils.tensor import ndarray_to_tensor
 from elasticdl_tpu.utils.timing_utils import Timing
 from elasticdl_tpu.worker.task_data_service import TaskDataService
-
-
-def derive_job_type(args) -> JobType:
-    """Reference master.py:233-262: job type from data args."""
-    training = bool(getattr(args, "training_data", ""))
-    evaluation = bool(getattr(args, "validation_data", ""))
-    prediction = bool(getattr(args, "prediction_data", ""))
-    if prediction and not training:
-        return JobType.PREDICTION_ONLY
-    if evaluation and not training:
-        return JobType.EVALUATION_ONLY
-    if training and evaluation:
-        return JobType.TRAINING_WITH_EVALUATION
-    return JobType.TRAINING_ONLY
 
 
 class Worker:
@@ -247,7 +234,6 @@ class Worker:
     # ---- job flows ---------------------------------------------------------
 
     def _train_and_evaluate(self):
-        evaluation_task_executed = False
         while True:
             dataset = self._task_data_service.get_dataset()
             if dataset is None:
@@ -262,31 +248,42 @@ class Worker:
             saw_batch = False
             for features, labels in dataset:
                 saw_batch = True
-                if self._job_type == JobType.TRAINING_WITH_EVALUATION:
-                    evaluation_task_executed = (
-                        self._evaluate_only() or evaluation_task_executed
-                    )
                 task = self._task_data_service.get_current_task()
                 task_type = task.type if task else int(TaskType.TRAINING)
                 err = self._process_minibatch(task_type, features, labels)
                 if self._task_data_service.report_record_done(
                     _batch_len(labels), err
                 ):
+                    # task boundary: report version (may trigger step-based
+                    # eval) and drain any eval tasks.  Polling here instead
+                    # of every batch (reference worker.py:982-987) keeps the
+                    # get_task RPC out of the minibatch hot loop.
                     self.report_version()
+                    if self._job_type == JobType.TRAINING_WITH_EVALUATION:
+                        self._evaluate_only()
             del dataset
             if self._job_type == JobType.TRAINING_WITH_EVALUATION:
-                evaluation_task_executed = self._evaluate_only()
+                self._evaluate_only()
             self._process_save_model_task_if_needed()
             if not saw_batch and self._task_data_service._pending_dataset:
                 # WAIT with nothing to do yet: back off before re-polling
                 time.sleep(self._task_data_service._wait_sleep_secs)
 
-    def _evaluate_only(self) -> bool:
-        """Drain evaluation tasks (reference worker.py:1029-1048)."""
+    def _evaluate_only(self, wait: bool = False) -> bool:
+        """Drain evaluation tasks (reference worker.py:1029-1048).
+
+        ``wait=True`` (EVALUATION_ONLY jobs): a WAIT sentinel means other
+        workers still hold eval tasks that may be re-queued — keep polling
+        until the master declares the job complete.  ``wait=False``
+        (training interleave): WAIT just means "none right now", return to
+        training."""
         executed = False
         while True:
             task = self.get_task(int(TaskType.EVALUATION))
             if not task.shard_name:
+                if wait and task.is_wait:
+                    time.sleep(self._task_data_service._wait_sleep_secs)
+                    continue
                 break
             self._process_eval_task(task)
             executed = True
@@ -347,14 +344,42 @@ class Worker:
         self.report_task_result(task.task_id, err)
         return True
 
+    def _start_heartbeats(self, interval_secs: float = 5.0):
+        """Background liveness pings so the master's failure detector works
+        across long compute gaps (the TPU-build replacement for the k8s
+        watch stream; every get_task also counts implicitly)."""
+        import threading
+
+        def beat():
+            while not self._stopped:
+                try:
+                    self._master.heartbeat(
+                        msg.HeartbeatRequest(
+                            worker_id=self._worker_id,
+                            step=self._trainer.step if self._trainer else 0,
+                            timestamp=time.time(),
+                        )
+                    )
+                except Exception:  # noqa: BLE001 — master may be gone
+                    pass
+                time.sleep(interval_secs)
+
+        threading.Thread(target=beat, daemon=True).start()
+
     def run(self):
         """Reference worker.py:1075-1085."""
-        if self._job_type == JobType.PREDICTION_ONLY:
-            self._predict_only()
-        elif self._job_type == JobType.EVALUATION_ONLY:
-            self._evaluate_only()
-        else:
-            self._train_and_evaluate()
+        self._stopped = False
+        if hasattr(self._master, "heartbeat"):
+            self._start_heartbeats()
+        try:
+            if self._job_type == JobType.PREDICTION_ONLY:
+                self._predict_only()
+            elif self._job_type == JobType.EVALUATION_ONLY:
+                self._evaluate_only(wait=True)
+            else:
+                self._train_and_evaluate()
+        finally:
+            self._stopped = True
 
 
 def _batch_len(tree) -> int:

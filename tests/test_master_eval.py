@@ -1,0 +1,223 @@
+"""Master orchestration: evaluation service, step-based triggers, the full
+train+eval in-process job, and the real-gRPC transport round trip
+(reference pattern: in-process master + real servers on localhost,
+test_utils.py:192-214 + worker_ps_interaction_test.py)."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.data.recordio_gen import synthetic
+from elasticdl_tpu.master.evaluation_service import (
+    EvaluationJob,
+    EvaluationService,
+)
+from elasticdl_tpu.master.master import Master, derive_job_type
+from elasticdl_tpu.trainer.metrics import Accuracy
+from elasticdl_tpu.utils.args import parse_master_args
+from elasticdl_tpu.utils.constants import JobType, TaskType
+from elasticdl_tpu.utils.tensor import ndarray_to_tensor
+from elasticdl_tpu.worker.worker import Worker
+
+
+def _master_args(train_dir="", eval_dir="", extra=()):
+    argv = [
+        "--model_def",
+        "mnist_functional_api.mnist_functional_api.custom_model",
+        "--minibatch_size",
+        "16",
+        "--records_per_task",
+        "32",
+        "--compute_dtype",
+        "float32",
+        "--port",
+        "0",
+    ]
+    if train_dir:
+        argv += ["--training_data", train_dir]
+    if eval_dir:
+        argv += ["--validation_data", eval_dir]
+    return parse_master_args(argv + list(extra))
+
+
+class TestEvaluationJob:
+    def test_metrics_from_wire_tensors(self):
+        job = EvaluationJob({"accuracy": Accuracy()}, model_version=3,
+                            total_tasks=2)
+        outputs = {
+            "output": ndarray_to_tensor("output", np.eye(3, dtype=np.float32))
+        }
+        labels = ndarray_to_tensor("labels", np.array([0, 1, 2]))
+        assert job.report_evaluation_metrics(outputs, labels)
+        assert job.get_evaluation_summary() == {"accuracy": 1.0}
+        job.complete_task()
+        assert not job.finished()
+        job.complete_task()
+        assert job.finished()
+
+
+def test_step_based_eval_trigger(tmp_path):
+    """report_version at evaluation_steps milestones creates eval tasks
+    (reference ps/servicer.py:198-205 -> servicer.py:79-85 -> eval service)."""
+    train_dir = synthetic.gen_mnist(
+        str(tmp_path / "t"), num_records=64, num_shards=1, seed=0
+    )
+    eval_dir = synthetic.gen_mnist(
+        str(tmp_path / "e"), num_records=32, num_shards=1, seed=1
+    )
+    args = _master_args(train_dir, eval_dir, ["--evaluation_steps", "2"])
+    master = Master(args)
+    assert master.job_type == JobType.TRAINING_WITH_EVALUATION
+
+    from elasticdl_tpu.rpc import messages as msg
+
+    master.servicer.report_version(
+        msg.ReportVersionRequest(model_version=2, worker_id=0)
+    )
+    assert master.task_d._pending_eval  # eval tasks created at milestone
+    # same milestone doesn't double-trigger
+    n = len(master.task_d._pending_eval)
+    master.servicer.report_version(
+        msg.ReportVersionRequest(model_version=2, worker_id=0)
+    )
+    assert len(master.task_d._pending_eval) == n
+
+
+def test_train_with_evaluation_end_to_end(tmp_path):
+    train_dir = synthetic.gen_mnist(
+        str(tmp_path / "t"), num_records=128, num_shards=2, seed=0
+    )
+    eval_dir = synthetic.gen_mnist(
+        str(tmp_path / "e"), num_records=32, num_shards=1, seed=1
+    )
+    args = _master_args(
+        train_dir,
+        eval_dir,
+        ["--evaluation_steps", "4", "--tensorboard_log_dir",
+         str(tmp_path / "tb")],
+    )
+    master = Master(args)
+    worker = Worker(args_worker(train_dir, eval_dir), master.servicer)
+    worker.run()
+
+    assert master.task_d.finished()
+    summary = getattr(master.evaluation_service, "latest_summary", None)
+    assert summary is not None and "accuracy" in summary
+    # tensorboard sidecar wrote events + jsonl
+    tb_dir = str(tmp_path / "tb")
+    import os
+
+    files = os.listdir(tb_dir)
+    assert "metrics.jsonl" in files
+    assert any(f.startswith("events") for f in files)
+
+
+def args_worker(train_dir, eval_dir=""):
+    from elasticdl_tpu.utils.args import parse_worker_args
+
+    argv = [
+        "--model_def",
+        "mnist_functional_api.mnist_functional_api.custom_model",
+        "--training_data",
+        train_dir,
+        "--minibatch_size",
+        "16",
+        "--worker_id",
+        "0",
+        "--master_addr",
+        "inprocess",
+        "--compute_dtype",
+        "float32",
+    ]
+    if eval_dir:
+        argv += ["--validation_data", eval_dir]
+    return parse_worker_args(argv)
+
+
+def test_final_eval_without_triggers(tmp_path):
+    """TRAINING_WITH_EVALUATION with neither evaluation_steps nor
+    throttle configured still evaluates once when training drains."""
+    train_dir = synthetic.gen_mnist(
+        str(tmp_path / "t"), num_records=64, num_shards=1, seed=0
+    )
+    eval_dir = synthetic.gen_mnist(
+        str(tmp_path / "e"), num_records=32, num_shards=1, seed=1
+    )
+    args = _master_args(train_dir, eval_dir)
+    master = Master(args)
+    worker = Worker(args_worker(train_dir, eval_dir), master.servicer)
+    worker.run()
+    assert master.task_d.finished()
+    assert "accuracy" in master.evaluation_service.latest_summary
+
+
+def test_evaluation_only_job(tmp_path):
+    eval_dir = synthetic.gen_mnist(
+        str(tmp_path / "e"), num_records=48, num_shards=1, seed=1
+    )
+    args = _master_args("", eval_dir)
+    master = Master(args)
+    assert master.job_type == JobType.EVALUATION_ONLY
+
+    worker = Worker(
+        args_worker("", eval_dir),
+        master.servicer,
+        job_type=JobType.EVALUATION_ONLY,
+    )
+    worker.run()
+    assert master.task_d.finished()
+    assert master.evaluation_service.trigger.is_set()
+    assert "accuracy" in master.evaluation_service.latest_summary
+
+
+def test_grpc_transport_round_trip(tmp_path):
+    """A real gRPC server on an ephemeral port with a worker driving the
+    whole job through the wire."""
+    from elasticdl_tpu.rpc.service import MasterClient, create_server
+
+    train_dir = synthetic.gen_mnist(
+        str(tmp_path / "t"), num_records=64, num_shards=1, seed=0
+    )
+    args = _master_args(train_dir)
+    master = Master(args)
+    server = create_server(master.servicer, port=0)
+    server.start()
+    client = MasterClient(f"localhost:{server._edl_bound_port}")
+    try:
+        worker = Worker(
+            args_worker(train_dir), client, job_type=JobType.TRAINING_ONLY
+        )
+        worker.run()
+        assert master.task_d.finished()
+        assert master.task_d.counters(TaskType.TRAINING).total_records == 64
+        assert master.servicer.get_model_version() == worker.trainer.step
+    finally:
+        client.close()
+        server.stop(grace=None)
+
+
+def test_master_run_completes(tmp_path):
+    """Master.run() returns once a worker thread finishes the job."""
+    import threading
+
+    train_dir = synthetic.gen_mnist(
+        str(tmp_path / "t"), num_records=64, num_shards=1, seed=0
+    )
+    args = _master_args(train_dir, extra=["--output", str(tmp_path / "out")])
+    master = Master(args)
+    master.prepare()
+    worker = Worker(
+        args_worker(train_dir), master.servicer, job_type=JobType.TRAINING_ONLY
+    )
+    t = threading.Thread(target=worker.run, daemon=True)
+    t.start()
+    rc = master.run(poll_secs=0.2)
+    t.join(timeout=30)
+    assert rc == 0
+    assert master.task_d.finished()
+    summary = master.job_summary()
+    assert summary["training"]["total_records"] == 64
+    # SAVE_MODEL deferred callback exported the model
+    from elasticdl_tpu.utils.export_utils import load_exported_model
+
+    model, flat, _ = load_exported_model(str(tmp_path / "out"))
+    assert flat
