@@ -1,0 +1,117 @@
+"""Job-submission API: the ``elasticdl_tpu`` CLI's backend.
+
+Reference: ``elasticdl/python/elasticdl/api.py`` — ``train``/``evaluate``/
+``predict`` either run a LocalExecutor in-process (LOCAL strategy,
+api.py:20-22) or build+push a docker image and create a master pod on
+Kubernetes (api.py:24-52,138-178).
+
+The TPU build maps the strategies as:
+
+- ``Local``: in-process :class:`LocalExecutor` — one jit loop on the local
+  chip(s), no control plane.
+- ``AllreduceStrategy`` / ``ParameterServerStrategy``: a master control
+  plane in this process with SPMD workers as local subprocesses (the
+  single-host analogue of the reference's pod cluster; each worker runs
+  the same code a multi-host deployment runs per host).
+- Kubernetes submission (``--namespace`` + kubernetes package installed):
+  delegates to the image builder + k8s client (aux subsystem), creating a
+  master pod that runs ``elasticdl_tpu.master.main``.
+"""
+
+from __future__ import annotations
+
+from elasticdl_tpu.utils.constants import DistributionStrategy
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+
+def _run_local(args) -> dict:
+    from elasticdl_tpu.trainer.local_executor import LocalExecutor
+
+    return LocalExecutor(args).run()
+
+
+def _run_distributed(args) -> dict:
+    from elasticdl_tpu.master.main import main as master_main
+    from elasticdl_tpu.utils.args import build_arguments_from_parsed_result
+
+    argv = build_arguments_from_parsed_result(args)
+    rc = master_main(argv)
+    if rc != 0:
+        raise RuntimeError(f"master exited with {rc}")
+    return {"exit_code": rc}
+
+
+def _submit_k8s(args) -> dict:
+    try:
+        import kubernetes  # noqa: F401
+    except ImportError as e:
+        raise RuntimeError(
+            "Kubernetes submission requires the 'kubernetes' package; "
+            "use --distribution_strategy=Local or AllreduceStrategy for "
+            "local execution"
+        ) from e
+    from elasticdl_tpu.k8s.submit import submit_master_pod
+
+    return submit_master_pod(args)
+
+
+def _dispatch(args) -> dict:
+    strategy = getattr(args, "distribution_strategy", "") or (
+        DistributionStrategy.LOCAL
+    )
+    if strategy == DistributionStrategy.LOCAL:
+        return _run_local(args)
+    if getattr(args, "namespace", "") and getattr(args, "docker_image", ""):
+        return _submit_k8s(args)
+    return _run_distributed(args)
+
+
+def train(args) -> dict:
+    """Reference api.py:17-52."""
+    if not getattr(args, "training_data", ""):
+        raise ValueError("train requires --training_data")
+    return _dispatch(args)
+
+
+def evaluate(args) -> dict:
+    """Reference api.py:55-84: evaluation-only job over a checkpoint."""
+    if not getattr(args, "validation_data", ""):
+        raise ValueError("evaluate requires --validation_data")
+    args.training_data = ""
+    return _dispatch(args)
+
+
+def predict(args) -> dict:
+    """Reference api.py:87-135."""
+    if not getattr(args, "prediction_data", ""):
+        raise ValueError("predict requires --prediction_data")
+    args.training_data = ""
+    args.validation_data = ""
+    return _dispatch(args)
+
+
+def clean(args) -> dict:
+    """Reference clean: remove job docker images (image_builder.py:82-128);
+    gated on the docker SDK, with a clear message when absent."""
+    repository = getattr(args, "docker_image_repository", "") or ""
+    removed: list[str] = []
+    try:
+        import docker
+    except ImportError:
+        logger.warning(
+            "docker SDK not installed; nothing to clean "
+            "(local runs leave no images)"
+        )
+        return {"removed": removed}
+    client = docker.from_env()
+    for image in client.images.list():
+        tags = [
+            t
+            for t in image.tags
+            if repository and t.startswith(repository)
+        ]
+        if getattr(args, "all", False) or tags:
+            client.images.remove(image.id, force=True)
+            removed.extend(tags or [image.id])
+    logger.info("Removed %d images", len(removed))
+    return {"removed": removed}

@@ -1,0 +1,53 @@
+"""The ``elasticdl_tpu`` command-line client.
+
+Reference: ``elasticdl/python/elasticdl/client.py:13-47`` — argparse
+subcommands ``train``/``evaluate``/``predict``/``clean`` registered as the
+``elasticdl`` console script (setup.py:27-29).  Same surface here:
+
+    elasticdl_tpu train --model_def=mnist_functional_api... \
+        --training_data=/data/mnist --num_epochs=2
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from elasticdl_tpu import api
+from elasticdl_tpu.utils.args import parse_master_args
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+COMMANDS = ("train", "evaluate", "predict", "clean")
+
+
+def _parse_clean_args(argv):
+    parser = argparse.ArgumentParser(prog="elasticdl_tpu clean")
+    parser.add_argument("--docker_image_repository", default="")
+    parser.add_argument("--all", action="store_true")
+    return parser.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(
+            "usage: elasticdl_tpu {train,evaluate,predict,clean} [options]\n"
+            "Run '<command> --help' for command options."
+        )
+        return 0 if argv else 2
+    command, rest = argv[0], argv[1:]
+    if command not in COMMANDS:
+        logger.error("Unknown command %r; expected one of %s", command, COMMANDS)
+        return 2
+    if command == "clean":
+        result = api.clean(_parse_clean_args(rest))
+    else:
+        args = parse_master_args(rest)
+        result = getattr(api, command)(args)
+    if result:
+        logger.info("%s result: %s", command, result)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -142,8 +142,13 @@ class EvaluationService:
     def add_evaluation_task(
         self, is_time_based_eval: bool = False, model_version: int | None = None
     ):
-        """Create EVALUATION tasks at ``model_version`` (reference
-        :223-244)."""
+        """Queue an evaluation at ``model_version``; it starts immediately
+        if no eval job is running, else when the current one drains
+        (milestone queueing, reference ``_eval_checkpoint_versions``)."""
+        if is_time_based_eval and self._task_d.finished():
+            # time-based fires are for in-progress training only; after the
+            # job drains they would re-create work forever
+            return
         if model_version is None:
             model_version = (
                 self._master_servicer.get_model_version()
@@ -151,12 +156,16 @@ class EvaluationService:
                 else -1
             )
         with self._lock:
-            if (
-                self._eval_job is not None
-                and not self._eval_job.finished()
-            ):
-                # previous eval still running: skip (one at a time)
+            self._eval_checkpoint_versions.append(model_version)
+        self._try_start_next()
+
+    def _try_start_next(self):
+        with self._lock:
+            if self._eval_job is not None and not self._eval_job.finished():
                 return
+            if not self._eval_checkpoint_versions:
+                return
+            model_version = self._eval_checkpoint_versions.pop(0)
             n = self._task_d.create_evaluation_tasks(model_version)
             if n == 0:
                 return
@@ -170,8 +179,10 @@ class EvaluationService:
         )
 
     def add_evaluation_task_if_needed(self, master_locking, model_version):
-        """Step-based trigger: every ``evaluation_steps`` versions
+        """Step-based trigger: every ``evaluation_steps`` versions; each
+        milestone is queued exactly once even while an eval job is running
         (reference :246-261)."""
+        del master_locking  # no master-side version lock on the TPU build
         if not self._evaluation_steps:
             return
         if model_version is None and self._master_servicer:
@@ -217,4 +228,5 @@ class EvaluationService:
         if self._eval_only:
             self.trigger.set()
         self.latest_summary = summary
+        self._try_start_next()  # queued milestones run back-to-back
         return summary

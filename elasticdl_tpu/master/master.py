@@ -75,6 +75,7 @@ class Master:
             in (JobType.TRAINING_WITH_EVALUATION, JobType.EVALUATION_ONLY)
             and self._spec.eval_metrics_fn is not None
         ):
+            eval_only = self.job_type == JobType.EVALUATION_ONLY
             self.evaluation_service = EvaluationService(
                 self.tb_service,
                 self.task_d,
@@ -82,9 +83,13 @@ class Master:
                 start_delay_secs=getattr(
                     args, "evaluation_start_delay_secs", 0
                 ),
-                throttle_secs=getattr(args, "evaluation_throttle_secs", 0),
+                # the time-based trigger is meaningful only while training
+                # runs; an eval-only job evaluates exactly once
+                throttle_secs=0
+                if eval_only
+                else getattr(args, "evaluation_throttle_secs", 0),
                 evaluation_steps=getattr(args, "evaluation_steps", 0),
-                eval_only=self.job_type == JobType.EVALUATION_ONLY,
+                eval_only=eval_only,
             )
             # (eval-only jobs: set_evaluation_service inside the service's
             # constructor already initialized the job from the dispatcher)

@@ -105,6 +105,15 @@ class MasterServicer:
     def report_evaluation_metrics(
         self, request: msg.ReportEvaluationMetricsRequest
     ):
+        if request.task_id >= 0 and not self._task_d.is_active(
+            request.task_id
+        ):
+            # the lease was reclaimed (timeout) or already re-queued; the
+            # re-run will report — accepting this copy would double-count
+            logger.warning(
+                "Dropping eval metrics for inactive task %d", request.task_id
+            )
+            return
         if self._evaluation_service is not None:
             self._evaluation_service.report_evaluation_metrics(
                 request.model_outputs, request.labels

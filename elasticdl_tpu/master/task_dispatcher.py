@@ -76,6 +76,7 @@ class TaskDispatcher:
         """Shard dicts map ``shard_name -> (start_index, num_records)``
         (the output of a data reader's ``create_shards()``)."""
         self._lock = threading.Lock()
+        self._callback_lock = threading.Lock()
         self._rng = random.Random(shuffle_seed)
 
         self._shards = {
@@ -167,6 +168,12 @@ class TaskDispatcher:
                 return -1, None
             task = self._pending.pop()
             return self._lease(worker_id, task), task
+
+    def is_active(self, task_id: int) -> bool:
+        """Whether the lease is still held (metric reports are only
+        accepted for active leases)."""
+        with self._lock:
+            return task_id in self._active
 
     def create_evaluation_tasks(self, model_version: int) -> int:
         """Locked eval-task creation for the evaluation service; returns
@@ -276,13 +283,24 @@ class TaskDispatcher:
     def invoke_deferred_callback(self) -> bool:
         """Pop and run one all-tasks-done callback in registration order
         (e.g. final evaluation, then SAVE_MODEL creation; reference
-        task_dispatcher.py:221-235).  The callback runs outside the lock —
-        callbacks re-enter dispatcher methods (create_evaluation_tasks)."""
-        with self._lock:
-            if not self._done_callbacks:
-                return False
-            callback = self._done_callbacks.pop(0)
-        callback()
+        task_dispatcher.py:221-235).
+
+        Serialized by a dedicated lock so concurrent callers (master poll
+        loop + every worker's get_task) can't run callbacks out of order,
+        and re-checked against task state so a callback that created new
+        work postpones the rest until that work drains.  The callback
+        itself runs outside the main lock — callbacks re-enter dispatcher
+        methods (create_evaluation_tasks)."""
+        with self._callback_lock:
+            with self._lock:
+                if not self._done_callbacks:
+                    return False
+                if self._pending or self._pending_eval or self._active:
+                    # an earlier callback created work that hasn't drained;
+                    # report "still busy" without consuming the next one
+                    return True
+                callback = self._done_callbacks.pop(0)
+            callback()
         return True
 
     def add_deferred_callback(self, callback: Callable[[], None]):

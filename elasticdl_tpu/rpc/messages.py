@@ -78,6 +78,9 @@ class ReportEvaluationMetricsRequest:
     model_outputs: dict = field(default_factory=dict)  # name -> Tensor
     labels: Tensor | None = None
     model_version: int = -1
+    # lease guard: metrics are dropped unless this task is still actively
+    # leased, so a reclaimed/retried eval task can't double-count
+    task_id: int = -1
 
 
 @dataclass
@@ -111,6 +114,7 @@ def encode(msg) -> bytes:
     if kind == "ReportEvaluationMetricsRequest":
         payload = {
             "model_version": msg.model_version,
+            "task_id": msg.task_id,
             "outputs": serialize_tensors(msg.model_outputs),
             "labels": b""
             if msg.labels is None
@@ -132,6 +136,7 @@ def decode(buf: bytes):
             if body["labels"]
             else None,
             model_version=body["model_version"],
+            task_id=body.get("task_id", -1),
         )
     cls = _SIMPLE_TYPES.get(kind)
     if cls is None:

@@ -39,6 +39,15 @@ def non_neg_int(arg: str) -> int:
     return value
 
 
+def non_neg_float(arg: str) -> float:
+    value = float(arg)
+    if value < 0:
+        raise argparse.ArgumentTypeError(
+            f"must be a non-negative float: {arg}"
+        )
+    return value
+
+
 def pos_float(arg: str) -> float:
     value = float(arg)
     if value <= 0:
@@ -295,13 +304,15 @@ def _add_master_params(parser: argparse.ArgumentParser):
     )
     parser.add_argument(
         "--heartbeat_timeout_secs",
-        type=pos_float,
+        # 0 disables heartbeat-timeout failure detection
+        type=non_neg_float,
         default=30.0,
         help="Declare a worker dead after this long without a heartbeat",
     )
     parser.add_argument(
         "--task_timeout_secs",
-        type=pos_float,
+        # 0 disables lease-timeout reclaim
+        type=non_neg_float,
         default=0.0,
         help="Re-queue a task held longer than this (0 = never)",
     )

@@ -165,6 +165,15 @@ def restore_checkpoint(
     (save_utils.py:208-261).  Dense params are returned whole to every
     shard (they are replicated on the mesh).
     """
+    # accept a direct version dir ({root}/version-N) like the reference's
+    # --checkpoint_dir_for_init usage (tests point at version-100 dirs)
+    base = os.path.basename(os.path.normpath(checkpoint_dir))
+    if version is None and base.startswith("version-"):
+        try:
+            version = int(base.split("-", 1)[1])
+            checkpoint_dir = os.path.dirname(os.path.normpath(checkpoint_dir))
+        except ValueError:
+            pass
     if version is None:
         version = latest_version(checkpoint_dir)
         if version is None:

@@ -127,6 +127,11 @@ class TaskDataService:
                 task = self._worker.get_task()
                 if not task.is_wait:
                     break
+                # WAIT may mean "only eval tasks remain" — let the worker
+                # drain them instead of deadlocking on the training queue
+                on_wait = getattr(self._worker, "on_wait", None)
+                if on_wait is not None:
+                    on_wait()
                 time.sleep(self._wait_sleep_secs)
             if task.type == int(TaskType.SAVE_MODEL):
                 self._pending_save_model_task = task

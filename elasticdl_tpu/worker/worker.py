@@ -119,7 +119,9 @@ class Worker:
                 )
             )
 
-    def report_evaluation_metrics(self, outputs, labels, model_version):
+    def report_evaluation_metrics(
+        self, outputs, labels, model_version, task_id=-1
+    ):
         if isinstance(outputs, dict):
             out_tensors = {
                 k: ndarray_to_tensor(k, np.asarray(v))
@@ -134,6 +136,7 @@ class Worker:
                 model_outputs=out_tensors,
                 labels=ndarray_to_tensor("labels", np.asarray(labels)),
                 model_version=model_version,
+                task_id=task_id,
             )
         )
 
@@ -190,9 +193,7 @@ class Worker:
         err = ""
         for _ in range(MAX_MINIBATCH_RETRY_NUM):
             try:
-                if task_type == int(TaskType.EVALUATION):
-                    self._eval_minibatch(features, labels)
-                elif task_type == int(TaskType.TRAINING):
+                if task_type == int(TaskType.TRAINING):
                     self._ensure_trainer(features)
                     self._timing.start_record_time("batch_process")
                     self._trainer.train_step(
@@ -210,16 +211,6 @@ class Worker:
                 traceback.print_exc()
         return err
 
-    def _eval_minibatch(self, features, labels):
-        self._ensure_trainer(features)
-        n = _batch_len(labels)
-        outputs, _ = self._trainer.eval_step(
-            self._place(features), self._place(labels)
-        )
-        outputs = jax.device_get(outputs)
-        outputs = _trim(outputs, n)
-        self.report_evaluation_metrics(outputs, labels, self._trainer.step)
-
     def _predict_minibatch(self, features):
         n = _batch_len(features)
         outputs = jax.device_get(
@@ -232,6 +223,14 @@ class Worker:
             )
 
     # ---- job flows ---------------------------------------------------------
+
+    def on_wait(self):
+        """Called by TaskDataService while the master says WAIT.  Eval
+        tasks may be all that's left (e.g. a restarted worker after
+        training drained, or recovered eval leases): drain them so the job
+        can finish."""
+        if self._job_type == JobType.TRAINING_WITH_EVALUATION:
+            self._evaluate_only()
 
     def _train_and_evaluate(self):
         while True:
@@ -290,15 +289,42 @@ class Worker:
         return executed
 
     def _process_eval_task(self, task):
+        """Evaluate one task, buffering outputs+labels and reporting them
+        ONCE with the task's lease id just before task completion — a
+        retried or lease-reclaimed task therefore can't double-count
+        metrics (the master drops reports for inactive leases)."""
         reader = self._task_data_service.data_reader
         from elasticdl_tpu.data.dataset import Dataset
 
         ds = Dataset.from_generator(lambda: iter(reader.read_records(task)))
         ds = self._spec.dataset_fn(ds, Modes.EVALUATION, reader.metadata)
         err = ""
+        all_outputs, all_labels = [], []
         for features, labels in ds.batch(self._minibatch_size):
-            e = self._process_minibatch(int(TaskType.EVALUATION), features, labels)
-            err = err or e
+            for _ in range(MAX_MINIBATCH_RETRY_NUM):
+                try:
+                    self._ensure_trainer(features)
+                    n = _batch_len(labels)
+                    outputs, _ = self._trainer.eval_step(
+                        self._place(features), self._place(labels)
+                    )
+                    all_outputs.append(_trim(jax.device_get(outputs), n))
+                    all_labels.append(np.asarray(labels))
+                    err = ""
+                    break
+                except Exception as ex:  # noqa: BLE001
+                    err = str(ex)
+                    traceback.print_exc()
+            if err:
+                break
+        if not err and all_outputs:
+            outputs = jax.tree_util.tree_map(
+                lambda *xs: np.concatenate(xs, axis=0), *all_outputs
+            )
+            labels = np.concatenate(all_labels, axis=0)
+            self.report_evaluation_metrics(
+                outputs, labels, task.model_version, task_id=task.task_id
+            )
         self.report_task_result(task.task_id, err)
 
     def _predict_only(self):

@@ -1,0 +1,154 @@
+"""CLI surface: train/evaluate/predict/clean subcommands
+(reference client.py:13-47 + the client_test.sh end-to-end pattern)."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu import api
+from elasticdl_tpu.client import main as cli_main
+from elasticdl_tpu.data.recordio_gen import synthetic
+
+
+def _common(model="mnist_functional_api.mnist_functional_api.custom_model"):
+    return [
+        "--model_def",
+        model,
+        "--minibatch_size",
+        "16",
+        "--records_per_task",
+        "32",
+        "--compute_dtype",
+        "float32",
+        "--distribution_strategy",
+        "Local",
+    ]
+
+
+def test_cli_train_local(tmp_path):
+    train = synthetic.gen_mnist(
+        str(tmp_path / "t"), num_records=64, num_shards=1, seed=0
+    )
+    rc = cli_main(
+        [
+            "train",
+            *_common(),
+            "--training_data",
+            train,
+            "--checkpoint_dir",
+            str(tmp_path / "ckpt"),
+            "--checkpoint_steps",
+            "2",
+        ]
+    )
+    assert rc == 0
+    import os
+
+    assert any(
+        d.startswith("version-") for d in os.listdir(str(tmp_path / "ckpt"))
+    )
+
+
+def test_cli_evaluate_from_checkpoint(tmp_path):
+    train = synthetic.gen_mnist(
+        str(tmp_path / "t"), num_records=64, num_shards=1, seed=0
+    )
+    rc = cli_main(
+        [
+            "train",
+            *_common(),
+            "--training_data",
+            train,
+            "--checkpoint_dir",
+            str(tmp_path / "ckpt"),
+            "--checkpoint_steps",
+            "2",
+        ]
+    )
+    assert rc == 0
+    evald = synthetic.gen_mnist(
+        str(tmp_path / "e"), num_records=32, num_shards=1, seed=1
+    )
+    import os
+
+    versions = sorted(os.listdir(str(tmp_path / "ckpt")))
+    rc = cli_main(
+        [
+            "evaluate",
+            *_common(),
+            "--validation_data",
+            evald,
+            "--checkpoint_dir_for_init",
+            str(tmp_path / "ckpt" / versions[-1]),
+        ]
+    )
+    assert rc == 0
+
+
+def test_cli_predict(tmp_path):
+    pred = synthetic.gen_mnist(
+        str(tmp_path / "p"), num_records=32, num_shards=1, seed=2
+    )
+    rc = cli_main(["predict", *_common(), "--prediction_data", pred])
+    assert rc == 0
+
+
+def test_cli_clean_without_docker():
+    import argparse
+
+    result = api.clean(argparse.Namespace(docker_image_repository="", all=False))
+    assert "removed" in result
+
+
+def test_cli_rejects_unknown_command():
+    assert cli_main(["frobnicate"]) == 2
+    assert cli_main([]) == 2
+    assert cli_main(["--help"]) == 0
+
+
+def test_api_validates_required_data(tmp_path):
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    args = parse_master_args(_common())
+    with pytest.raises(ValueError, match="training_data"):
+        api.train(args)
+    with pytest.raises(ValueError, match="validation_data"):
+        api.evaluate(args)
+    with pytest.raises(ValueError, match="prediction_data"):
+        api.predict(args)
+
+
+@pytest.mark.slow
+def test_cli_distributed_train(tmp_path):
+    """AllreduceStrategy routes through the master + subprocess workers
+    (the client_test.sh analogue, minikube collapsed to localhost)."""
+    train = synthetic.gen_mnist(
+        str(tmp_path / "t"), num_records=64, num_shards=1, seed=0
+    )
+    rc = cli_main(
+        [
+            "train",
+            "--model_def",
+            "mnist_functional_api.mnist_functional_api.custom_model",
+            "--training_data",
+            train,
+            "--minibatch_size",
+            "16",
+            "--records_per_task",
+            "32",
+            "--compute_dtype",
+            "float32",
+            "--distribution_strategy",
+            "AllreduceStrategy",
+            "--num_workers",
+            "1",
+            "--port",
+            "0",
+            "--output",
+            str(tmp_path / "export"),
+        ]
+    )
+    assert rc == 0
+    from elasticdl_tpu.utils.export_utils import load_exported_model
+
+    model, flat, _ = load_exported_model(str(tmp_path / "export"))
+    assert flat

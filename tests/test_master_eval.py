@@ -133,6 +133,49 @@ def args_worker(train_dir, eval_dir=""):
     return parse_worker_args(argv)
 
 
+def test_eval_milestones_queue_not_dropped(tmp_path):
+    """A milestone arriving while an eval job runs is queued, not dropped
+    (reference keeps _eval_checkpoint_versions for this)."""
+    train_dir = synthetic.gen_mnist(
+        str(tmp_path / "t"), num_records=64, num_shards=1, seed=0
+    )
+    eval_dir = synthetic.gen_mnist(
+        str(tmp_path / "e"), num_records=32, num_shards=1, seed=1
+    )
+    args = _master_args(train_dir, eval_dir, ["--evaluation_steps", "2"])
+    master = Master(args)
+    from elasticdl_tpu.rpc import messages as msg
+
+    master.servicer.report_version(msg.ReportVersionRequest(model_version=2))
+    # eval job for v2 is running (tasks pending); v4 arrives
+    master.servicer.report_version(msg.ReportVersionRequest(model_version=4))
+    svc = master.evaluation_service
+    assert svc._eval_job is not None and svc._eval_job.model_version == 2
+    assert svc._eval_checkpoint_versions == [4]  # queued, not dropped
+
+
+def test_inactive_lease_metrics_dropped(tmp_path):
+    """Metrics for a reclaimed/unknown lease are rejected — the
+    double-count guard for retried eval tasks."""
+    eval_dir = synthetic.gen_mnist(
+        str(tmp_path / "e"), num_records=32, num_shards=1, seed=1
+    )
+    args = _master_args("", eval_dir)
+    master = Master(args)
+    from elasticdl_tpu.rpc import messages as msg
+
+    req = msg.ReportEvaluationMetricsRequest(
+        model_outputs={
+            "output": ndarray_to_tensor("output", np.eye(3, dtype=np.float32))
+        },
+        labels=ndarray_to_tensor("labels", np.array([0, 1, 2])),
+        task_id=999,  # never leased
+    )
+    master.servicer.report_evaluation_metrics(req)
+    job = master.evaluation_service._eval_job
+    assert job.get_evaluation_summary()["accuracy"] == 0.0  # nothing counted
+
+
 def test_final_eval_without_triggers(tmp_path):
     """TRAINING_WITH_EVALUATION with neither evaluation_steps nor
     throttle configured still evaluates once when training drains."""
