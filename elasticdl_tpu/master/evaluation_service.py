@@ -24,11 +24,25 @@ from elasticdl_tpu.utils.log_utils import default_logger as logger
 class EvaluationJob:
     """One evaluation pass at a model version (reference :14-124)."""
 
-    def __init__(self, metrics_tree, model_version: int, total_tasks: int = -1):
+    def __init__(
+        self,
+        metrics_tree,
+        model_version: int,
+        total_tasks: int = -1,
+        job_id: int = 0,
+    ):
         self.model_version = model_version
+        # identity used to tie task completions to THIS job: a stale eval
+        # task re-queued by a lease timeout and finished after the job
+        # rotated must not count toward the next job's total
+        self.job_id = job_id
         self._total_tasks = total_tasks
         self._completed_tasks = 0
         self._metrics = metrics_tree
+        # the step the reporting worker actually evaluated with (may be
+        # later than the milestone version — documented deviation from the
+        # reference, which restores the checkpoint at the milestone)
+        self.evaluated_version = -1
 
     def complete_task(self):
         self._completed_tasks += 1
@@ -36,10 +50,13 @@ class EvaluationJob:
     def finished(self) -> bool:
         return 0 <= self._total_tasks <= self._completed_tasks
 
-    def report_evaluation_metrics(self, model_outputs, labels) -> bool:
+    def report_evaluation_metrics(
+        self, model_outputs, labels, evaluated_version: int = -1
+    ) -> bool:
         """``model_outputs``: name -> Tensor (wire format); labels Tensor."""
         if labels is None:
             return False
+        self.evaluated_version = max(self.evaluated_version, evaluated_version)
         outputs = {
             name: t.values for name, t in model_outputs.items()
         }
@@ -109,7 +126,10 @@ class EvaluationService:
         self._eval_throttle_secs = throttle_secs
         self._eval_start_delay_secs = start_delay_secs
         self._eval_checkpoint_versions: list[int] = []
-        self._last_eval_checkpoint_version = -1
+        # highest milestone index (model_version // evaluation_steps)
+        # already queued by the step-based trigger
+        self._last_eval_milestone = 0
+        self._job_seq = 0
         self._eval_metrics_fn = eval_metrics_fn
         self._evaluation_steps = evaluation_steps
         self._eval_only = eval_only
@@ -137,6 +157,8 @@ class EvaluationService:
     # ---- task creation -----------------------------------------------------
 
     def init_eval_only_job(self, num_tasks: int):
+        # eval-only tasks are created by the dispatcher constructor with no
+        # job id; completions arriving with job_id=None are accepted
         self._eval_job = EvaluationJob(self._eval_metrics_fn(), -1, num_tasks)
 
     def add_evaluation_task(
@@ -166,48 +188,77 @@ class EvaluationService:
             if not self._eval_checkpoint_versions:
                 return
             model_version = self._eval_checkpoint_versions.pop(0)
-            n = self._task_d.create_evaluation_tasks(model_version)
+            self._job_seq += 1
+            job_id = self._job_seq
+            n = self._task_d.create_evaluation_tasks(
+                model_version, eval_job_id=job_id
+            )
             if n == 0:
                 return
             self._eval_job = EvaluationJob(
-                self._eval_metrics_fn(), model_version, n
+                self._eval_metrics_fn(), model_version, n, job_id=job_id
             )
         logger.info(
-            "Created evaluation job at model version %d (%d tasks)",
+            "Created evaluation job %d at model version %d (%d tasks)",
+            job_id,
             model_version,
             n,
         )
 
     def add_evaluation_task_if_needed(self, master_locking, model_version):
-        """Step-based trigger: every ``evaluation_steps`` versions; each
-        milestone is queued exactly once even while an eval job is running
-        (reference :246-261)."""
+        """Step-based trigger on milestone *crossing*: workers report
+        versions only at task boundaries, so requiring an exact multiple of
+        ``evaluation_steps`` (the reference's check, :246-261) silently
+        skips milestones whenever the boundary step isn't aligned.  Trigger
+        whenever ``model_version // evaluation_steps`` advances instead,
+        with the check-and-set under the lock (concurrent report_version
+        RPCs must not queue the same milestone twice)."""
         del master_locking  # no master-side version lock on the TPU build
         if not self._evaluation_steps:
             return
         if model_version is None and self._master_servicer:
             model_version = self._master_servicer.get_model_version()
-        if (
-            model_version
-            and model_version % self._evaluation_steps == 0
-            and model_version > self._last_eval_checkpoint_version
-        ):
-            self._last_eval_checkpoint_version = model_version
-            self.add_evaluation_task(model_version=model_version)
+        if not model_version:
+            return
+        with self._lock:
+            milestone = model_version // self._evaluation_steps
+            if milestone <= self._last_eval_milestone:
+                return
+            self._last_eval_milestone = milestone
+            # enqueue under the SAME lock: concurrent reports crossing
+            # different milestones must land in version order
+            self._eval_checkpoint_versions.append(model_version)
+        self._try_start_next()
 
     # ---- metric flow -------------------------------------------------------
 
-    def report_evaluation_metrics(self, model_outputs, labels) -> bool:
+    def report_evaluation_metrics(
+        self, model_outputs, labels, evaluated_version: int = -1
+    ) -> bool:
         with self._lock:
             if self._eval_job is None:
                 return False
             return self._eval_job.report_evaluation_metrics(
-                model_outputs, labels
+                model_outputs, labels, evaluated_version=evaluated_version
             )
 
-    def complete_task(self):
+    def complete_task(self, eval_job_id: int | None = None):
         with self._lock:
             if self._eval_job is None:
+                return None
+            if (
+                eval_job_id is not None
+                and eval_job_id != self._eval_job.job_id
+            ):
+                # a lease-reclaimed task from an earlier job finished late:
+                # its metrics were already dropped by the lease guard, and
+                # its completion must not advance THIS job's count
+                logger.warning(
+                    "Dropping completion for stale eval job %d "
+                    "(current job %d)",
+                    eval_job_id,
+                    self._eval_job.job_id,
+                )
                 return None
             self._eval_job.complete_task()
             if not self._eval_job.finished():
@@ -217,7 +268,10 @@ class EvaluationService:
         # job done: publish results (reference :271-293)
         summary = job.get_evaluation_summary()
         logger.info(
-            "Evaluation @version %d: %s", job.model_version, summary
+            "Evaluation @version %d (evaluated with step-%d state): %s",
+            job.model_version,
+            job.evaluated_version,
+            summary,
         )
         if self._tensorboard_service is not None:
             self._tensorboard_service.write_dict_to_summary(

@@ -116,7 +116,9 @@ class MasterServicer:
             return
         if self._evaluation_service is not None:
             self._evaluation_service.report_evaluation_metrics(
-                request.model_outputs, request.labels
+                request.model_outputs,
+                request.labels,
+                evaluated_version=request.evaluated_version,
             )
 
     def heartbeat(self, request: msg.HeartbeatRequest) -> msg.HeartbeatResponse:

@@ -109,7 +109,10 @@ class TaskDispatcher:
     # ---- task creation ----------------------------------------------------
 
     def _slice_shards(
-        self, task_type: TaskType, model_version: int
+        self,
+        task_type: TaskType,
+        model_version: int,
+        extended: dict | None = None,
     ) -> list[Task]:
         tasks = []
         # accumulates across epochs (reference task_dispatcher.py:128-137)
@@ -125,12 +128,18 @@ class TaskDispatcher:
                         end=min(lo + self._records_per_task, limit),
                         type=task_type,
                         model_version=model_version,
+                        extended=dict(extended or {}),
                     )
                 )
         return tasks
 
-    def create_tasks(self, task_type: TaskType, model_version: int = -1):
-        tasks = self._slice_shards(task_type, model_version)
+    def create_tasks(
+        self,
+        task_type: TaskType,
+        model_version: int = -1,
+        extended: dict | None = None,
+    ):
+        tasks = self._slice_shards(task_type, model_version, extended)
         if task_type == TaskType.TRAINING:
             self._rng.shuffle(tasks)
             self._pending.extend(tasks)
@@ -175,13 +184,21 @@ class TaskDispatcher:
         with self._lock:
             return task_id in self._active
 
-    def create_evaluation_tasks(self, model_version: int) -> int:
+    def create_evaluation_tasks(
+        self, model_version: int, eval_job_id: int | None = None
+    ) -> int:
         """Locked eval-task creation for the evaluation service; returns
         how many tasks were created (reference evaluation_service.py:223-244
-        calls into the dispatcher the same way)."""
+        calls into the dispatcher the same way).  ``eval_job_id`` stamps the
+        tasks so their completions can be tied to the issuing job."""
         with self._lock:
             before = len(self._pending_eval)
-            self.create_tasks(TaskType.EVALUATION, model_version)
+            extended = (
+                {"eval_job_id": eval_job_id}
+                if eval_job_id is not None
+                else None
+            )
+            self.create_tasks(TaskType.EVALUATION, model_version, extended)
             return len(self._pending_eval) - before
 
     def get_eval_task(self, worker_id: int) -> tuple[int, Task | None]:
@@ -233,7 +250,9 @@ class TaskDispatcher:
                     len(self._pending) + len(self._active),
                 )
         if eval_completed:
-            self._evaluation_service.complete_task()
+            self._evaluation_service.complete_task(
+                eval_job_id=task.extended.get("eval_job_id")
+            )
 
     def recover_tasks(self, worker_id: int):
         """Re-queue everything a dead worker held

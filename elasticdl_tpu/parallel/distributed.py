@@ -18,14 +18,17 @@ from __future__ import annotations
 from typing import Callable, Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
 from elasticdl_tpu.parallel import sharding as sharding_lib
 from elasticdl_tpu.parallel.mesh import batch_divisor
 from elasticdl_tpu.trainer.state import TrainState
-from elasticdl_tpu.trainer.step import _apply, _cast_floats
+from elasticdl_tpu.trainer.step import (
+    build_eval_step,
+    build_predict_step,
+    build_train_step,
+)
 from elasticdl_tpu.utils.constants import EMBEDDING_AUTO_DISTRIBUTE_BYTES
 
 
@@ -49,11 +52,6 @@ class SPMDTrainer:
         policy); pass ``None`` when a ModelHandler supplies the rules
         explicitly, so the policy has exactly one owner."""
         self.mesh = mesh
-        self._model = model
-        self._loss_fn = loss_fn
-        self._tx = tx
-        self._compute_dtype = compute_dtype
-        self._remat = remat
 
         sample_features = _host_slice_for_init(sample_features)
 
@@ -91,41 +89,17 @@ class SPMDTrainer:
             )()
         self._batch_shardings_cache: dict = {}
 
-        def train_step(state: TrainState, features, labels):
-            def forward_loss(params):
-                feats = _cast_floats(features, compute_dtype)
-                outputs, new_model_state = _apply(state, params, feats, True)
-                return self._loss_fn(labels, outputs).astype(jnp.float32), (
-                    outputs,
-                    new_model_state,
-                )
-
-            fl = jax.checkpoint(forward_loss) if remat else forward_loss
-            (loss, (_, new_model_state)), grads = jax.value_and_grad(
-                fl, has_aux=True
-            )(state.params)
-            new_state = state.apply_gradients(grads).replace(
-                model_state=new_model_state
-            )
-            return new_state, {"loss": loss}
-
-        self._train_step = jax.jit(
-            train_step,
-            out_shardings=(self.state_shardings, None),
-            donate_argnums=(0,) if donate else (),
+        # the SAME builders LocalExecutor uses (trainer/step.py) — the only
+        # SPMD addition is pinning the updated state to the mesh layout
+        self._train_step = build_train_step(
+            loss_fn,
+            compute_dtype=compute_dtype,
+            remat=remat,
+            donate=donate,
+            state_shardings=self.state_shardings,
         )
-
-        def eval_step(state: TrainState, features, labels):
-            outputs, _ = _apply(state, state.params, features, False)
-            return outputs, self._loss_fn(labels, outputs)
-
-        self._eval_step = jax.jit(eval_step)
-
-        def predict_step(state: TrainState, features):
-            outputs, _ = _apply(state, state.params, features, False)
-            return outputs
-
-        self._predict_step = jax.jit(predict_step)
+        self._eval_step = build_eval_step(loss_fn)
+        self._predict_step = build_predict_step()
 
     # ---- batch placement --------------------------------------------------
 

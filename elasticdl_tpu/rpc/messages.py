@@ -81,6 +81,9 @@ class ReportEvaluationMetricsRequest:
     # lease guard: metrics are dropped unless this task is still actively
     # leased, so a reclaimed/retried eval task can't double-count
     task_id: int = -1
+    # the step of the state the worker ACTUALLY evaluated with (may trail
+    # or lead the milestone model_version; surfaced in the eval summary)
+    evaluated_version: int = -1
 
 
 @dataclass
@@ -115,6 +118,7 @@ def encode(msg) -> bytes:
         payload = {
             "model_version": msg.model_version,
             "task_id": msg.task_id,
+            "evaluated_version": msg.evaluated_version,
             "outputs": serialize_tensors(msg.model_outputs),
             "labels": b""
             if msg.labels is None
@@ -137,6 +141,7 @@ def decode(buf: bytes):
             else None,
             model_version=body["model_version"],
             task_id=body.get("task_id", -1),
+            evaluated_version=body.get("evaluated_version", -1),
         )
     cls = _SIMPLE_TYPES.get(kind)
     if cls is None:

@@ -67,6 +67,7 @@ def build_train_step(
     remat: bool = False,
     donate: bool = True,
     extra_grad_fn: Callable | None = None,
+    state_shardings=None,
 ) -> Callable:
     """Build ``(state, features, labels) -> (state, step_metrics)``.
 
@@ -77,6 +78,10 @@ def build_train_step(
     remat: wrap the forward in ``jax.checkpoint`` to trade FLOPs for HBM.
     extra_grad_fn: optional hook ``(grads, state) -> grads`` (gradient
         clipping etc. normally belongs in the optax chain instead).
+    state_shardings: optional sharding pytree matching the TrainState; when
+        given, the updated state is pinned to the same mesh layout (the
+        SPMD path) — this is the ONE step builder both LocalExecutor and
+        SPMDTrainer share, so their step semantics cannot drift.
     """
 
     def forward_loss(params, state, features, labels):
@@ -102,7 +107,13 @@ def build_train_step(
         )
         return new_state, {"loss": loss}
 
-    return jax.jit(train_step, donate_argnums=(0,) if donate else ())
+    return jax.jit(
+        train_step,
+        donate_argnums=(0,) if donate else (),
+        out_shardings=None
+        if state_shardings is None
+        else (state_shardings, None),
+    )
 
 
 def build_eval_step(loss_fn: Callable | None = None) -> Callable:

@@ -60,7 +60,12 @@ class Worker:
         self._worker_id = int(getattr(args, "worker_id", 0) or 0)
         self._minibatch_size = args.minibatch_size
         self._job_type = job_type or derive_job_type(args)
-        self._timing = Timing(enabled=False)
+        # DEBUG-gated like the reference (common/timing_utils.py:3-8) and
+        # LocalExecutor; per-task buckets are reported at task boundaries
+        self._timing = Timing(
+            enabled=getattr(args, "log_level", "INFO") == "DEBUG",
+            logger=logger,
+        )
 
         self._spec = get_model_spec(
             getattr(args, "model_zoo", "") or "",
@@ -137,6 +142,12 @@ class Worker:
                 labels=ndarray_to_tensor("labels", np.asarray(labels)),
                 model_version=model_version,
                 task_id=task_id,
+                # the state actually used (no checkpoint restore at the
+                # milestone version — documented deviation; the master
+                # surfaces this step in the eval summary log)
+                evaluated_version=self._trainer.step
+                if self._trainer
+                else -1,
             )
         )
 
@@ -257,6 +268,7 @@ class Worker:
                     # eval) and drain any eval tasks.  Polling here instead
                     # of every batch (reference worker.py:982-987) keeps the
                     # get_task RPC out of the minibatch hot loop.
+                    self._timing.report_timing(reset=True)
                     self.report_version()
                     if self._job_type == JobType.TRAINING_WITH_EVALUATION:
                         self._evaluate_only()
