@@ -14,23 +14,42 @@ from elasticdl_tpu.utils.args import build_worker_arguments, parse_master_args
 from elasticdl_tpu.utils.log_utils import default_logger as logger
 
 
-def main(argv=None) -> int:
-    args = parse_master_args(argv)
+def build_master(args) -> Master:
+    """Assemble a Master with the local instance manager (exposed so tests
+    and embedding callers can drive the lifecycle themselves)."""
 
     def im_factory(master):
         num_workers = getattr(args, "num_workers", 0) or 0
         if num_workers <= 0:
             return None
 
-        def build_argv(worker_id, master_addr):
-            return [
+        def build_argv(worker_id, master_addr, **world_kwargs):
+            argv = [
                 "elasticdl_tpu.worker.main",
                 *build_worker_arguments(args, worker_id, master_addr),
             ]
+            # lockstep world coordinates (multi-process SPMD): the
+            # instance manager assigns these per process / per generation
+            for key, value in world_kwargs.items():
+                argv.extend([f"--{key}", str(value)])
+            return argv
 
-        return LocalInstanceManager(master, num_workers, build_argv)
+        return LocalInstanceManager(
+            master,
+            num_workers,
+            build_argv,
+            envs=getattr(args, "envs_dict", {}) or {},
+            # N>1 workers = one jax.distributed world training ONE model
+            lockstep=num_workers > 1,
+            max_reforms=getattr(args, "relaunch_on_worker_failure", 3),
+        )
 
-    master = Master(args, instance_manager_factory=im_factory)
+    return Master(args, instance_manager_factory=im_factory)
+
+
+def main(argv=None) -> int:
+    args = parse_master_args(argv)
+    master = build_master(args)
     master.prepare()
     logger.info(
         "Master ready on port %d (job type %s)",

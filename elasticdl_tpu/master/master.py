@@ -38,6 +38,8 @@ class Master:
         self._args = args
         self.job_type = derive_job_type(args)
         self._stop_requested = False
+        self._job_failed = False
+        self.reform_events: list[dict] = []
 
         self._spec = get_model_spec(
             getattr(args, "model_zoo", "") or "",
@@ -163,17 +165,87 @@ class Master:
                 dead = self.servicer.dead_workers(
                     getattr(self._args, "heartbeat_timeout_secs", 0) or 0
                 )
-                for worker_id in dead:
-                    logger.warning("Worker %d timed out; recovering", worker_id)
-                    self.task_d.recover_tasks(worker_id)
-                    self.servicer.forget_worker(worker_id)
-                    if self.instance_manager is not None:
-                        self.instance_manager.restart_worker(worker_id)
+                if dead and self.instance_manager is not None:
+                    # a killed stale worker's last in-flight RPC can
+                    # re-register its id after forget_worker; ids the
+                    # instance manager no longer tracks are ghosts, not
+                    # failures — drop them instead of re-forming a
+                    # healthy world
+                    live = set(self.instance_manager.worker_ids())
+                    for ghost in [w for w in dead if w not in live]:
+                        self.servicer.forget_worker(ghost)
+                    dead = [w for w in dead if w in live]
+                if dead:
+                    self._handle_dead_workers(dead)
+                if (
+                    self.reform_events
+                    and "latency_secs" not in self.reform_events[-1]
+                ):
+                    # re-form latency = detection -> first step-task pull
+                    # of the new world (BASELINE.md config 5 metric)
+                    pull_at = self.servicer.first_stream_pull_at()
+                    if pull_at is not None:
+                        event = self.reform_events[-1]
+                        event["latency_secs"] = (
+                            pull_at - event["detected_at"]
+                        )
+                        logger.info(
+                            "World re-formed in %.2fs (cluster version %d)",
+                            event["latency_secs"],
+                            event["cluster_version"],
+                        )
                 time.sleep(poll_secs)
         except KeyboardInterrupt:
             logger.warning("Interrupted; shutting down")
         self.stop()
-        return 0
+        return 1 if self._job_failed else 0
+
+    def _handle_dead_workers(self, dead: list[int]):
+        """Failure recovery (reference k8s_instance_manager.py:198-281).
+
+        Task-stream workers are independent: re-queue the dead worker's
+        tasks and relaunch it with a new id.  A lockstep world is one SPMD
+        program: losing any process stalls every collective, so the whole
+        world is re-formed — kill survivors, re-queue every leased task,
+        reset the step stream, and relaunch a fresh world (new cluster
+        version, new coordinator) that resumes from the newest checkpoint.
+        """
+        im = self.instance_manager
+        if im is not None and getattr(im, "lockstep", False):
+            t0 = time.monotonic()
+            logger.warning(
+                "Workers %s timed out; re-forming the distributed world",
+                dead,
+            )
+            # fence FIRST: from here every stale worker's get_step_task is
+            # rejected, so none can re-lease a task we are about to recover
+            new_version = self.servicer.bump_cluster_version()
+            all_ids = set(dead) | set(im.worker_ids())
+            for worker_id in all_ids:
+                self.task_d.recover_tasks(worker_id)
+                self.servicer.forget_worker(worker_id)
+            self.servicer.reset_step_stream()
+            try:
+                im.reform_world(new_version)
+            except RuntimeError as ex:
+                logger.error("Giving up on the job: %s", ex)
+                self._job_failed = True
+                self.request_stop()
+                return
+            self.reform_events.append(
+                {
+                    "detected_at": t0,
+                    "cluster_version": new_version,
+                    "dead_workers": sorted(dead),
+                }
+            )
+            return
+        for worker_id in dead:
+            logger.warning("Worker %d timed out; recovering", worker_id)
+            self.task_d.recover_tasks(worker_id)
+            self.servicer.forget_worker(worker_id)
+            if im is not None:
+                im.restart_worker(worker_id)
 
     def request_stop(self):
         self._stop_requested = True
@@ -207,30 +279,91 @@ class Master:
         summary = getattr(self.evaluation_service, "latest_summary", None)
         if summary:
             out["evaluation_metrics"] = summary
+        events = getattr(self, "reform_events", None)
+        if events:
+            out["reforms"] = [
+                {
+                    k: v
+                    for k, v in event.items()
+                    if k in ("cluster_version", "dead_workers", "latency_secs")
+                }
+                for event in events
+            ]
         return out
 
 
 class LocalInstanceManager:
-    """Spawn workers as local subprocesses — the Local/AllReduce-strategy
-    analogue of the k8s InstanceManager (pods -> processes).  Each worker
-    gets the master address and its id via argv (the reference master
-    assembles worker argv the same way, master.py:331-384)."""
+    """Spawn workers as local subprocesses — the process analogue of the
+    k8s InstanceManager (pods -> processes).  Each worker gets the master
+    address and its id via argv (the reference master assembles worker
+    argv the same way, master.py:331-384).
 
-    def __init__(self, master, num_workers: int, build_argv):
+    With ``lockstep=True`` (``num_workers > 1``) the workers form one
+    ``jax.distributed`` world: this manager allocates the coordinator
+    port, assigns process ids 0..N-1, and re-forms the whole world on
+    failure (``reform_world``) — the local equivalent of the reference's
+    pod-relaunch elasticity (k8s_instance_manager.py:241-281), adapted to
+    the SPMD constraint that a world is indivisible.
+    """
+
+    def __init__(
+        self,
+        master,
+        num_workers: int,
+        build_argv,
+        envs: dict[str, str] | None = None,
+        lockstep: bool = False,
+        max_reforms: int = 3,
+    ):
         self._master = master
         self._num_workers = num_workers
-        self._build_argv = build_argv  # (worker_id, master_addr) -> argv
+        # (worker_id, master_addr, **world_kwargs) -> argv
+        self._build_argv = build_argv
+        self._envs = dict(envs or {})
+        self.lockstep = lockstep and num_workers > 1
+        self._max_reforms = max_reforms
+        self._reforms = 0
         self._procs: dict[int, object] = {}
-        self._next_worker_id = num_workers
+        self._next_worker_id = 0
         self._lock = threading.Lock()
 
-    def start_workers(self):
-        for worker_id in range(self._num_workers):
-            self._start(worker_id)
+    def worker_ids(self) -> list[int]:
+        with self._lock:
+            return list(self._procs)
 
-    def _start(self, worker_id: int):
-        argv = self._build_argv(worker_id, f"localhost:{self._master.port}")
+    def start_workers(self):
+        if self.lockstep:
+            self._start_world(cluster_version=0)
+        else:
+            for _ in range(self._num_workers):
+                self._start(self._claim_worker_id())
+
+    def _claim_worker_id(self) -> int:
+        with self._lock:
+            worker_id = self._next_worker_id
+            self._next_worker_id += 1
+            return worker_id
+
+    def _start_world(self, cluster_version: int, num_processes: int | None = None):
+        from elasticdl_tpu.parallel import elastic
+
+        n = num_processes if num_processes is not None else self._num_workers
+        coordinator = f"localhost:{elastic.pick_coordinator_port()}"
+        for process_id in range(n):
+            self._start(
+                self._claim_worker_id(),
+                coordinator_addr=coordinator,
+                num_processes=n,
+                process_id=process_id,
+                cluster_version=cluster_version,
+            )
+
+    def _start(self, worker_id: int, **world_kwargs):
+        argv = self._build_argv(
+            worker_id, f"localhost:{self._master.port}", **world_kwargs
+        )
         env = dict(os.environ)
+        env.update(self._envs)
         # make the framework importable regardless of the master's cwd
         import elasticdl_tpu
 
@@ -245,14 +378,39 @@ class LocalInstanceManager:
 
     def restart_worker(self, worker_id: int):
         """Relaunch with a NEW worker id (reference
-        k8s_instance_manager.py:266-275)."""
+        k8s_instance_manager.py:266-275).  Task-stream workers only; a
+        lockstep worker cannot be replaced individually (reform_world)."""
         with self._lock:
             proc = self._procs.pop(worker_id, None)
-            new_id = self._next_worker_id
-            self._next_worker_id += 1
         if proc is not None and proc.poll() is None:
             proc.terminate()
-        self._start(new_id)
+        self._start(self._claim_worker_id())
+
+    def reform_world(self, cluster_version: int):
+        """Kill the old world and launch a new one.  Survivors may be
+        blocked inside a collective that will never complete — SIGKILL,
+        not SIGTERM, is the correct mercy.  The old world is ALWAYS torn
+        down; only the relaunch is subject to the reform budget (a
+        deterministic crash must not loop forever, reference OOM
+        blacklist k8s_instance_manager.py:225-240)."""
+        with self._lock:
+            procs = list(self._procs.values())
+            self._procs.clear()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+        self._reforms += 1
+        if self._reforms > self._max_reforms:
+            raise RuntimeError(
+                f"world re-formed {self._reforms - 1} times "
+                f"(--relaunch_on_worker_failure limit); giving up"
+            )
+        self._start_world(cluster_version=cluster_version)
 
     def stop_workers(self):
         with self._lock:

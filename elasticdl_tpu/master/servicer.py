@@ -44,6 +44,13 @@ class MasterServicer:
         self._heartbeats: dict[int, float] = {}
         self._cluster_version = 0
         self._quiesce = False
+        # lockstep step-task stream: seq -> memoized TaskResponse.  Every
+        # process of a multi-process world pulls the same seq and must see
+        # the same answer (the lockstep invariant); WAIT is the only
+        # non-final answer and is never memoized.
+        self._step_stream: dict[int, msg.TaskResponse] = {}
+        self._stream_lock = threading.Lock()
+        self._first_stream_pull_at: float | None = None
         if evaluation_service is not None:
             evaluation_service.set_master_servicer(self)
 
@@ -82,6 +89,78 @@ class MasterServicer:
         return msg.TaskResponse(
             model_version=self._version, minibatch_size=self._minibatch_size
         )
+
+    def get_step_task(
+        self, request: msg.GetStepTaskRequest
+    ) -> msg.TaskResponse:
+        """Resolve one lockstep stream position (multi-process SPMD).
+
+        The first request for an unresolved ``seq`` leases the next task
+        (eval tasks interleave ahead of training, like the reference's
+        worker-side interleave) and memoizes the response; all other
+        processes replay it.  End-of-job is memoized too, so every
+        process terminates at the same seq.
+        """
+        with self._lock:
+            if request.cluster_version != self._cluster_version:
+                # stale world (pre-re-formation): tell it to exit WITHOUT
+                # recording a heartbeat — a forgotten worker's last pull
+                # must not re-register it as a ghost liveness entry
+                return msg.TaskResponse(
+                    model_version=self._version,
+                    minibatch_size=self._minibatch_size,
+                )
+            self._heartbeats[request.worker_id] = time.monotonic()
+        with self._stream_lock:
+            if self._first_stream_pull_at is None:
+                self._first_stream_pull_at = time.monotonic()
+            memo = self._step_stream.get(request.seq)
+            if memo is not None:
+                return memo
+            task_id, task = self._task_d.get_eval_task(request.worker_id)
+            if task is None:
+                task_id, task = self._task_d.get(request.worker_id)
+            if task is not None:
+                resp = msg.task_to_response(
+                    task_id, task, self._version, self._minibatch_size
+                )
+                self._step_stream[request.seq] = resp
+                return resp
+            if (not self._task_d.finished()) or (
+                self._task_d.invoke_deferred_callback()
+            ):
+                return msg.TaskResponse(
+                    type=int(TaskType.WAIT),
+                    model_version=self._version,
+                    minibatch_size=self._minibatch_size,
+                )
+            resp = msg.TaskResponse(
+                model_version=self._version,
+                minibatch_size=self._minibatch_size,
+            )
+            self._step_stream[request.seq] = resp
+            return resp
+
+    def reset_step_stream(self):
+        """Drop all memoized stream state (mesh re-formation: the new
+        world restarts at seq 0 and re-pulls from the recovered queue)."""
+        with self._stream_lock:
+            self._step_stream.clear()
+            self._first_stream_pull_at = None
+
+    def bump_cluster_version(self) -> int:
+        """Advance the world generation; stale workers are fenced out of
+        the step stream from this point on."""
+        with self._lock:
+            self._cluster_version += 1
+            return self._cluster_version
+
+    def first_stream_pull_at(self) -> float | None:
+        """Monotonic time of the first step-task resolution since the last
+        stream reset — the 'new world is training again' signal used to
+        measure re-formation latency."""
+        with self._stream_lock:
+            return self._first_stream_pull_at
 
     def report_task_result(self, request: msg.ReportTaskResultRequest):
         if request.err_message:

@@ -21,6 +21,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
+from elasticdl_tpu.parallel import elastic
 from elasticdl_tpu.parallel import sharding as sharding_lib
 from elasticdl_tpu.parallel.mesh import batch_divisor
 from elasticdl_tpu.trainer.state import TrainState
@@ -88,6 +89,13 @@ class SPMDTrainer:
                 create_state, out_shardings=self.state_shardings
             )()
         self._batch_shardings_cache: dict = {}
+        # mesh topology is immutable for this trainer's lifetime: resolve
+        # the multi-process layout once, not per minibatch
+        self._multiprocess = elastic.is_multiprocess_mesh(mesh)
+        self._process_index = (
+            elastic.my_process_index(mesh) if self._multiprocess else 0
+        )
+        self._local_range_cache: dict = {}
 
         # the SAME builders LocalExecutor uses (trainer/step.py) — the only
         # SPMD addition is pinning the updated state to the mesh layout
@@ -113,19 +121,28 @@ class SPMDTrainer:
     def place_batch(self, tree):
         """Shard a host-global batch over the mesh's data axes.
 
-        Single-process: a plain sharded device_put.  Multi-process: each
-        process contributes its local slice
-        (``jax.make_array_from_process_local_data``), the per-host analogue
-        of the reference's per-worker task data.
+        Single-process: a plain sharded device_put.  Multi-process mesh:
+        every process passes the SAME host-global batch; each contributes
+        the rows its devices own — no cross-host copy, and the global
+        Array equals the host batch.  Row-range lookups are memoized per
+        shape (pure functions of the immutable mesh/sharding).
         """
-        multiprocess = jax.process_count() > 1
 
         def _place(x):
             x = np.asarray(x)
             sh = self._batch_sharding(x.ndim)
-            if multiprocess:
-                return jax.make_array_from_process_local_data(sh, x)
-            return jax.device_put(x, sh)
+            if not self._multiprocess:
+                return jax.device_put(x, sh)
+            ranges = self._local_range_cache.get(x.shape)
+            if ranges is None:
+                ranges = elastic.local_batch_ranges(
+                    sh, x.shape, self._process_index
+                )
+                self._local_range_cache[x.shape] = ranges
+            local = np.concatenate([x[lo:hi] for lo, hi in ranges], axis=0)
+            return jax.make_array_from_process_local_data(
+                sh, local, global_shape=x.shape
+            )
 
         return jax.tree_util.tree_map(_place, tree)
 

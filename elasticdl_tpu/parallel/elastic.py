@@ -1,0 +1,146 @@
+"""Multi-process SPMD runtime: world formation and host-data placement.
+
+This is the TPU-native replacement for the reference's cross-worker data
+plane (PS pull/push ``elasticdl/python/worker/worker.py:295-530``; FTLib
+allreduce ``collective_ops/communicator.py:30-67``): N worker processes —
+one per TPU host — join ONE ``jax.distributed`` world, build ONE global
+mesh, and run the SAME jitted step in lockstep; gradient exchange is the
+psum XLA derives from shardings, riding ICI (and DCN across slices).
+
+Membership is master-owned (the reference's k8s watch equivalent): the
+master assigns ``process_id``/``num_processes``/``coordinator_addr`` via
+the argv round-trip and re-forms the world (new cluster_version, new
+coordinator) when a worker dies — there is no gossip.
+
+Worker liveness inside the world is the coordination service's concern;
+liveness *of* the world is the master's (heartbeat timeouts).
+"""
+
+from __future__ import annotations
+
+import socket
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+
+def configure_platform(platform: str | None):
+    """Pin the JAX platform before any backend initializes.
+
+    ``JAX_PLATFORMS=cpu`` in the environment is not always authoritative
+    (platform plugins may still register and initialize — e.g. a tunneled
+    TPU plugin — which poisons ``jax.process_count()`` for the CPU
+    backend); setting the config explicitly is.
+    """
+    if platform:
+        jax.config.update("jax_platforms", platform)
+        if platform == "cpu":
+            # cross-process CPU collectives need an explicit implementation
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+
+def initialize_world(
+    coordinator_addr: str,
+    num_processes: int,
+    process_id: int,
+    platform: str | None = None,
+    timeout_secs: int = 60,
+):
+    """Join the job's ``jax.distributed`` world (process 0 additionally
+    hosts the coordination service at ``coordinator_addr``)."""
+    configure_platform(platform)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_addr,
+        num_processes=num_processes,
+        process_id=process_id,
+        initialization_timeout=timeout_secs,
+    )
+    logger.info(
+        "Joined distributed world: process %d/%d (coordinator %s)",
+        process_id,
+        num_processes,
+        coordinator_addr,
+    )
+
+
+def shutdown_world():
+    try:
+        jax.distributed.shutdown()
+    except Exception:  # noqa: BLE001 — peers may already be gone
+        pass
+
+
+def pick_coordinator_port() -> int:
+    """A free TCP port for the next world's coordination service (each
+    re-formation gets a fresh one: the old coordinator died with its
+    process 0)."""
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+# ---- host-data placement ---------------------------------------------------
+
+
+def mesh_process_indices(mesh) -> list[int]:
+    """Sorted process indices participating in the mesh."""
+    return sorted({d.process_index for d in mesh.devices.flat})
+
+
+def is_multiprocess_mesh(mesh) -> bool:
+    """Mesh spans >1 process.  (Do NOT use ``jax.process_count()`` for
+    this: it reports the default backend, which may be a single-process
+    platform plugin even when the mesh's backend is multi-process.)"""
+    return len(mesh_process_indices(mesh)) > 1
+
+
+def my_process_index(mesh) -> int:
+    """This process's index in the mesh's backend (NOT
+    ``jax.process_index()``, which reads the default backend)."""
+    return mesh.devices.flat[0].client.process_index()
+
+
+def local_batch_ranges(
+    sharding, global_shape: tuple, process_index: int
+) -> list[tuple[int, int]]:
+    """The ascending, de-duplicated dim-0 ``[start, stop)`` ranges of the
+    global batch owned by ``process_index`` under ``sharding``.
+
+    This is the contract of ``jax.make_array_from_process_local_data``:
+    each process contributes its shards' rows in global index order.
+    Deriving the ranges from ``devices_indices_map`` (instead of assuming
+    process-contiguous layout) keeps placement correct for ANY device
+    order the mesh builder chose — including ICI-topology-optimized
+    orders on real pods.
+    """
+    ranges = set()
+    for device, idx in sharding.devices_indices_map(global_shape).items():
+        if device.process_index != process_index:
+            continue
+        sl = idx[0]
+        start = sl.start if sl.start is not None else 0
+        stop = sl.stop if sl.stop is not None else global_shape[0]
+        ranges.add((start, stop))
+    return sorted(ranges)
+
+
+def replicate_to_hosts(tree, mesh):
+    """All-gather a (possibly sharded) device tree so every process holds
+    the full values — the collective equivalent of ``device_get`` on a
+    single-process mesh.  Used to materialize eval outputs and state for
+    host-side reporting/export; runs on ALL processes (it is a collective
+    program)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def _sharding_tree(t):
+        return jax.tree_util.tree_map(lambda _: replicated, t)
+
+    with mesh:
+        gathered = jax.jit(
+            lambda t: t, out_shardings=_sharding_tree(tree)
+        )(tree)
+    return jax.device_get(gathered)

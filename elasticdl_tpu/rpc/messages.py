@@ -55,6 +55,23 @@ class TaskResponse:
 
 
 @dataclass
+class GetStepTaskRequest:
+    """Lockstep task pull for multi-process SPMD training.
+
+    All processes of one distributed world request the same monotonically
+    increasing ``seq``; the master resolves each seq to ONE task exactly
+    once and memoizes the answer, so every process sees an identical task
+    stream (the lockstep invariant: the same jitted collectives run on
+    every process).  ``cluster_version`` fences stale worlds after a mesh
+    re-formation.
+    """
+
+    seq: int
+    worker_id: int
+    cluster_version: int = 0
+
+
+@dataclass
 class ReportTaskResultRequest:
     task_id: int
     err_message: str = ""
@@ -103,6 +120,7 @@ class HeartbeatResponse:
 
 _SIMPLE_TYPES = {
     "GetTaskRequest": GetTaskRequest,
+    "GetStepTaskRequest": GetStepTaskRequest,
     "TaskResponse": TaskResponse,
     "ReportTaskResultRequest": ReportTaskResultRequest,
     "ReportVersionRequest": ReportVersionRequest,

@@ -28,6 +28,7 @@ SERVICE_NAME = "elasticdl_tpu.Master"
 # method name -> servicer attribute (unary-unary, bytes in/out)
 _METHODS = (
     "get_task",
+    "get_step_task",
     "report_task_result",
     "report_version",
     "report_evaluation_metrics",
@@ -96,6 +97,11 @@ class MasterClient:
 
     def get_task(self, request: msg.GetTaskRequest) -> msg.TaskResponse:
         return self._call("get_task", request)
+
+    def get_step_task(
+        self, request: msg.GetStepTaskRequest
+    ) -> msg.TaskResponse:
+        return self._call("get_step_task", request)
 
     def report_task_result(self, request: msg.ReportTaskResultRequest):
         return self._call("report_task_result", request)

@@ -259,6 +259,7 @@ class LocalExecutor:
             shards,
             records_per_task=self._args.records_per_task,
             num_epochs=self._args.num_epochs,
+            shuffle_seed=getattr(self._args, "shuffle_seed", None),
         )
         total = 0
         while True:

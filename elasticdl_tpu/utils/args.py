@@ -177,6 +177,16 @@ def _add_data_params(parser: argparse.ArgumentParser):
         help="Keyword args for the data reader, 'k=v;k=v' form",
     )
     parser.add_argument(
+        "--shuffle_seed",
+        type=int,
+        default=None,
+        required=False,
+        help=(
+            "Seed for training-task shuffling; unset = nondeterministic "
+            "order (set it for reproducible runs and A/B comparisons)"
+        ),
+    )
+    parser.add_argument(
         "--num_minibatches_per_task",
         type=pos_int,
         default=None,
@@ -278,6 +288,14 @@ def _add_mesh_params(parser: argparse.ArgumentParser):
         default=True,
         help="Donate train-state buffers to the jitted step",
     )
+    parser.add_argument(
+        "--jax_platform",
+        default="",
+        help=(
+            "Pin the JAX platform (e.g. 'cpu' for tests/virtual meshes, "
+            "'tpu'); empty = JAX default.  Forwarded to workers."
+        ),
+    )
 
 
 def _add_master_params(parser: argparse.ArgumentParser):
@@ -324,7 +342,31 @@ def _add_worker_params(parser: argparse.ArgumentParser):
     parser.add_argument(
         "--coordinator_addr",
         default="",
-        help="jax.distributed coordinator address for multi-host meshes",
+        help=(
+            "jax.distributed coordinator address; non-empty selects the "
+            "multi-process lockstep runtime (one model over all workers)"
+        ),
+    )
+    parser.add_argument(
+        "--num_processes",
+        type=pos_int,
+        default=1,
+        help="Processes in the distributed world this worker joins",
+    )
+    parser.add_argument(
+        "--process_id",
+        type=non_neg_int,
+        default=0,
+        help="This worker's process index in the distributed world",
+    )
+    parser.add_argument(
+        "--cluster_version",
+        type=non_neg_int,
+        default=0,
+        help=(
+            "World generation assigned by the master; fences stale "
+            "workers after a mesh re-formation"
+        ),
     )
 
 

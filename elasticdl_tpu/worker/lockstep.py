@@ -1,0 +1,449 @@
+"""Lockstep worker: the multi-process SPMD training runtime.
+
+This is what makes ``--num_workers N`` train ONE model: all N worker
+processes join one ``jax.distributed`` world (``parallel.elastic``), build
+one global mesh, and execute the SAME sequence of jitted steps — the
+lockstep invariant every multi-process XLA program must satisfy.  The
+reference achieves N-workers-one-model with PS pull/push over gRPC
+(``elasticdl/python/worker/worker.py:295-530``) or FTLib allreduce
+(``:697-758``); here gradient sync is the psum GSPMD derives from
+shardings, and the only cross-process coordination is the master's
+memoized step-task stream (``MasterServicer.get_step_task``).
+
+Data path: tasks are small, addressable record ranges, so EVERY process
+reads the full range of each task and contributes the rows its devices
+own (``SPMDTrainer.place_batch`` over ``elastic.local_batch_ranges``) —
+no host-to-host data transfer, identical global batches to a
+single-process run, and any process can be lost without losing data (the
+task re-queues).
+
+Per-task batching: each task's records are batched independently (the
+final short batch padded), so the number of steps per task is a pure
+function of the task — every process agrees on it without communication.
+This deviates from the task-stream Worker's batches-straddle-tasks
+pipelining (task_data_service.py), trading a few padded rows for a
+communication-free lockstep schedule.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+import traceback
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.data.dataset import Dataset
+from elasticdl_tpu.data.factory import create_data_reader
+from elasticdl_tpu.master.task_dispatcher import FAIL_COUNT
+from elasticdl_tpu.parallel import elastic
+from elasticdl_tpu.parallel.distributed import SPMDTrainer
+from elasticdl_tpu.parallel.mesh import MeshConfig
+from elasticdl_tpu.rpc import messages as msg
+from elasticdl_tpu.trainer.local_executor import build_optimizer
+from elasticdl_tpu.trainer.state import Modes, checkpoint_to_state
+from elasticdl_tpu.utils import save_utils
+from elasticdl_tpu.utils.args import derive_job_type
+from elasticdl_tpu.utils.constants import JobType, TaskType
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+from elasticdl_tpu.utils.model_utils import get_model_spec
+from elasticdl_tpu.utils.timing_utils import Timing
+
+# Debug hook: when set, each process dumps its final dense state to
+# $ELASTICDL_TPU_DUMP_STATE/final_state_p{process_id}.npz — used by tests
+# to assert bitwise-identical parameters across processes.
+_DUMP_STATE_ENV = "ELASTICDL_TPU_DUMP_STATE"
+
+
+class LockstepWorker:
+    def __init__(self, args, master, devices=None):
+        self._args = args
+        self._master = master
+        self._worker_id = int(getattr(args, "worker_id", 0) or 0)
+        self._process_id = int(getattr(args, "process_id", 0) or 0)
+        self._num_processes = int(getattr(args, "num_processes", 1) or 1)
+        self._cluster_version = int(getattr(args, "cluster_version", 0) or 0)
+        self._minibatch_size = args.minibatch_size
+        self._job_type = derive_job_type(args)
+        self._timing = Timing(
+            enabled=getattr(args, "log_level", "INFO") == "DEBUG",
+            logger=logger,
+        )
+
+        self._spec = get_model_spec(
+            getattr(args, "model_zoo", "") or "",
+            args.model_def,
+            model_params=getattr(args, "model_params_dict", {}) or {},
+            dataset_fn=getattr(args, "dataset_fn", "dataset_fn"),
+            loss=getattr(args, "loss", "loss"),
+            optimizer=getattr(args, "optimizer", "optimizer"),
+            eval_metrics_fn=getattr(args, "eval_metrics_fn", "eval_metrics_fn"),
+        )
+        self._model = self._spec.build_model()
+
+        data_origin = (
+            args.prediction_data
+            if self._job_type == JobType.PREDICTION_ONLY
+            else args.training_data or args.validation_data
+        )
+        create = self._spec.custom_data_reader or create_data_reader
+        self._reader = create(
+            data_origin=data_origin,
+            **(getattr(args, "data_reader_params_dict", {}) or {}),
+        )
+
+        mesh_shape = getattr(args, "mesh_shape", "") or ""
+        self._mesh = MeshConfig.from_string(mesh_shape).create(devices)
+        self._trainer: SPMDTrainer | None = None
+        self._stopped = False
+        self._last_ckpt_milestone = 0
+        ckpt_dir = getattr(args, "checkpoint_dir", "") or ""
+        self._saver = (
+            save_utils.CheckpointSaver(
+                ckpt_dir, getattr(args, "keep_checkpoint_max", 3)
+            )
+            if ckpt_dir
+            else None
+        )
+
+    # ---- process-0-only master reporting -----------------------------------
+
+    @property
+    def _is_chief(self) -> bool:
+        return self._process_id == 0
+
+    def _report_task_result(self, task_id, err_msg="", fail_count=0):
+        if not self._is_chief:
+            return
+        self._master.report_task_result(
+            msg.ReportTaskResultRequest(
+                task_id=task_id,
+                err_message=err_msg,
+                exec_counters={FAIL_COUNT: fail_count} if fail_count else {},
+            )
+        )
+
+    def _report_version(self):
+        if self._is_chief and self._trainer is not None:
+            self._master.report_version(
+                msg.ReportVersionRequest(
+                    model_version=self._trainer.step,
+                    worker_id=self._worker_id,
+                )
+            )
+
+    # ---- trainer lifecycle -------------------------------------------------
+
+    def _ensure_trainer(self, sample_features):
+        if self._trainer is not None:
+            return
+        rules = ()
+        if self._spec.sharding_rules is not None:
+            rules = tuple(self._spec.sharding_rules(self._mesh))
+        tx = build_optimizer(
+            self._spec, getattr(self._args, "learning_rate", None)
+        )
+        compute_dtype = getattr(self._args, "compute_dtype", "float32")
+        self._trainer = SPMDTrainer(
+            self._mesh,
+            self._model,
+            self._spec.loss,
+            tx,
+            sample_features,
+            rules=rules,
+            compute_dtype=None if compute_dtype == "float32" else compute_dtype,
+            remat=bool(getattr(self._args, "remat", False)),
+            donate=bool(getattr(self._args, "donate_state", True)),
+        )
+        self._maybe_restore()
+
+    def _maybe_restore(self):
+        """Resume-from-own-checkpoint first (mesh re-formation restart),
+        then --checkpoint_dir_for_init (fresh start from a prior job)."""
+        restore_dir = ""
+        ckpt_dir = getattr(self._args, "checkpoint_dir", "") or ""
+        if ckpt_dir and save_utils.latest_version(ckpt_dir) is not None:
+            restore_dir = ckpt_dir
+        elif getattr(self._args, "checkpoint_dir_for_init", "") or "":
+            restore_dir = self._args.checkpoint_dir_for_init
+        if not restore_dir:
+            return
+        dense, _, extra = save_utils.restore_checkpoint(restore_dir)
+        state = checkpoint_to_state(self._trainer.state, dense)
+        version = int(extra.get("model_version", 0) or 0)
+        state = state.replace(step=np.asarray(version, dtype=np.int32))
+        # re-place explicitly: host arrays -> the mesh layout (each process
+        # puts only its addressable shards)
+        self._trainer.state = jax.device_put(
+            state, self._trainer.state_shardings
+        )
+        self._last_ckpt_milestone = (
+            version // self._args.checkpoint_steps
+            if getattr(self._args, "checkpoint_steps", 0)
+            else 0
+        )
+        logger.info(
+            "Process %d restored state at version %d from %s",
+            self._process_id,
+            version,
+            restore_dir,
+        )
+
+    def _maybe_checkpoint(self):
+        """Periodic checkpoint every ``checkpoint_steps`` (reference
+        ps/servicer.py:216-231 checkpoints on the PS; here the chief
+        writes after a collective gather).  Runs at task boundaries only,
+        so every process agrees on when the collective happens."""
+        steps = getattr(self._args, "checkpoint_steps", 0) or 0
+        if not steps or self._saver is None or self._trainer is None:
+            return
+        milestone = self._trainer.step // steps
+        if milestone <= self._last_ckpt_milestone:
+            return
+        self._last_ckpt_milestone = milestone
+        self._checkpoint_now()
+
+    def _checkpoint_now(self):
+        from elasticdl_tpu.trainer.state import state_to_checkpoint
+
+        host_state = elastic.replicate_to_hosts(
+            self._trainer.state, self._mesh
+        )
+        if self._is_chief:
+            self._saver.save(
+                self._trainer.step,
+                dense=state_to_checkpoint(host_state),
+                extra={"model_version": self._trainer.step},
+            )
+
+    # ---- batching ----------------------------------------------------------
+
+    def _task_batches(self, task, mode: Modes):
+        """Global minibatches of one task — identical on every process."""
+        ds = Dataset.from_generator(
+            lambda: iter(self._reader.read_records(task))
+        )
+        if self._spec.dataset_fn is not None:
+            ds = self._spec.dataset_fn(ds, mode, self._reader.metadata)
+        return ds.batch(self._minibatch_size)
+
+    def _place(self, tree):
+        padded, _ = self._trainer.pad_batch(tree)
+        return self._trainer.place_batch(padded)
+
+    # ---- task execution ----------------------------------------------------
+
+    def _train_task(self, task):
+        with self._crash_on_error(task):
+            for features, labels in self._task_batches(task, Modes.TRAINING):
+                self._ensure_trainer(features)
+                with self._timing.record("batch_process"):
+                    self._trainer.train_step(
+                        self._place(features), self._place(labels)
+                    )
+        self._report_task_result(task.task_id)
+        self._timing.report_timing(reset=True)
+        self._report_version()
+        self._maybe_checkpoint()
+
+    @contextlib.contextmanager
+    def _crash_on_error(self, task):
+        """Lockstep error policy: an error on ONE process desyncs the
+        world's collectives — peers may already be blocked in a psum this
+        process will never join.  Catch-and-continue (the task-stream
+        Worker's minibatch retry, reference worker.py:800-840) is
+        therefore UNSAFE here; the only sound recovery is to report and
+        crash, stopping the heartbeat so the master re-forms the world
+        and re-queues the task.  A deterministic failure is bounded by
+        the master's reform budget (--relaunch_on_worker_failure)."""
+        try:
+            yield
+        except Exception as ex:  # noqa: BLE001
+            traceback.print_exc()
+            self._report_task_result(
+                task.task_id, str(ex), fail_count=task.end - task.start
+            )
+            self._stopped = True
+            logger.error(
+                "Process %d crashing after task %d failed: %s",
+                self._process_id,
+                task.task_id,
+                ex,
+            )
+            raise
+
+    def _eval_task(self, task):
+        all_outputs, all_labels = [], []
+        with self._crash_on_error(task):
+            for features, labels in self._task_batches(task, Modes.EVALUATION):
+                self._ensure_trainer(features)
+                n = _batch_len(labels)
+                outputs, _ = self._trainer.eval_step(
+                    self._place(features), self._place(labels)
+                )
+                # collective gather so the chief holds full outputs, in
+                # global batch order (matches the labels read host-side)
+                host = elastic.replicate_to_hosts(outputs, self._mesh)
+                all_outputs.append(_trim(host, n))
+                all_labels.append(np.asarray(labels))
+        if all_outputs and self._is_chief:
+            outputs = jax.tree_util.tree_map(
+                lambda *xs: np.concatenate(xs, axis=0), *all_outputs
+            )
+            labels = np.concatenate(all_labels, axis=0)
+            self._report_eval_metrics(outputs, labels, task)
+        self._report_task_result(task.task_id)
+
+    def _report_eval_metrics(self, outputs, labels, task):
+        from elasticdl_tpu.utils.tensor import ndarray_to_tensor
+
+        if isinstance(outputs, dict):
+            out_tensors = {
+                k: ndarray_to_tensor(k, np.asarray(v))
+                for k, v in outputs.items()
+            }
+        else:
+            out_tensors = {
+                "output": ndarray_to_tensor("output", np.asarray(outputs))
+            }
+        self._master.report_evaluation_metrics(
+            msg.ReportEvaluationMetricsRequest(
+                model_outputs=out_tensors,
+                labels=ndarray_to_tensor("labels", labels),
+                model_version=task.model_version,
+                task_id=task.task_id,
+                evaluated_version=self._trainer.step if self._trainer else -1,
+            )
+        )
+
+    def _predict_task(self, task):
+        with self._crash_on_error(task):
+            for features in self._task_batches(task, Modes.PREDICTION):
+                self._ensure_trainer(features)
+                n = _batch_len(features)
+                outputs = self._trainer.predict_step(self._place(features))
+                host = _trim(
+                    elastic.replicate_to_hosts(outputs, self._mesh), n
+                )
+                if (
+                    self._is_chief
+                    and self._spec.prediction_outputs_processor is not None
+                ):
+                    self._spec.prediction_outputs_processor.process(
+                        host, self._worker_id
+                    )
+        self._report_task_result(task.task_id)
+
+    def _save_model_task(self, task):
+        with self._crash_on_error(task):
+            if self._trainer is None:
+                # export requested with no training step run (restart after
+                # training drained): initialize from one example batch
+                for features, _ in self._task_batches(task, Modes.TRAINING):
+                    self._ensure_trainer(features)
+                    break
+            if self._trainer is None:
+                raise RuntimeError("no trained state to save")
+            host_state = elastic.replicate_to_hosts(
+                self._trainer.state, self._mesh
+            )
+            if self._is_chief:
+                path = task.extended.get("saved_model_path", "") or getattr(
+                    self._args, "output", ""
+                )
+                from elasticdl_tpu.utils.export_utils import export_model
+
+                export_model(path, host_state, self._spec, self._args)
+        self._report_task_result(task.task_id)
+
+    # ---- main loop ---------------------------------------------------------
+
+    def _start_heartbeats(self, interval_secs: float = 2.0):
+        import threading
+
+        def beat():
+            while not self._stopped:
+                try:
+                    self._master.heartbeat(
+                        msg.HeartbeatRequest(
+                            worker_id=self._worker_id,
+                            step=self._trainer.step if self._trainer else 0,
+                            timestamp=time.time(),
+                        )
+                    )
+                except Exception:  # noqa: BLE001 — master may be gone
+                    pass
+                time.sleep(interval_secs)
+
+        threading.Thread(target=beat, daemon=True).start()
+
+    def run(self, wait_sleep_secs: float = 1.0):
+        self._stopped = False
+        if hasattr(self._master, "heartbeat"):
+            self._start_heartbeats()
+        try:
+            seq = 0
+            while True:
+                task = self._master.get_step_task(
+                    msg.GetStepTaskRequest(
+                        seq=seq,
+                        worker_id=self._worker_id,
+                        cluster_version=self._cluster_version,
+                    )
+                )
+                if task.is_wait:
+                    time.sleep(wait_sleep_secs)
+                    continue
+                if not task.shard_name:
+                    logger.info(
+                        "Process %d: stream ended at seq %d",
+                        self._process_id,
+                        seq,
+                    )
+                    break
+                seq += 1
+                if task.type == int(TaskType.TRAINING):
+                    self._train_task(task)
+                elif task.type == int(TaskType.EVALUATION):
+                    self._eval_task(task)
+                elif task.type == int(TaskType.PREDICTION):
+                    self._predict_task(task)
+                elif task.type == int(TaskType.SAVE_MODEL):
+                    self._save_model_task(task)
+                else:
+                    self._report_task_result(
+                        task.task_id, f"unknown task type {task.type}"
+                    )
+            self._dump_state_if_requested()
+        finally:
+            self._stopped = True
+
+    def _dump_state_if_requested(self):
+        out_dir = os.environ.get(_DUMP_STATE_ENV, "")
+        if not out_dir or self._trainer is None:
+            return
+        from elasticdl_tpu.trainer.state import state_to_checkpoint
+
+        host_state = elastic.replicate_to_hosts(
+            self._trainer.state, self._mesh
+        )
+        os.makedirs(out_dir, exist_ok=True)
+        np.savez(
+            os.path.join(out_dir, f"final_state_p{self._process_id}.npz"),
+            **state_to_checkpoint(host_state),
+        )
+
+    @property
+    def trainer(self):
+        return self._trainer
+
+
+def _batch_len(tree) -> int:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return int(np.shape(leaves[0])[0]) if leaves else 0
+
+
+def _trim(outputs, n: int):
+    return jax.tree_util.tree_map(lambda x: np.asarray(x)[:n], outputs)

@@ -2,6 +2,14 @@
 
 Connects to the master control plane over gRPC and runs the task loop:
 ``python -m elasticdl_tpu.worker.main --master_addr=... --worker_id=N ...``
+
+Two runtimes, selected by the master via the argv round-trip:
+
+- ``--coordinator_addr`` set: the **lockstep** multi-process SPMD runtime
+  — this process joins the job's ``jax.distributed`` world and trains the
+  ONE shared model with its peers (worker/lockstep.py).
+- otherwise: the single-process task-stream runtime (worker/worker.py),
+  an SPMD program over this process's local devices only.
 """
 
 from __future__ import annotations
@@ -11,7 +19,6 @@ import sys
 from elasticdl_tpu.rpc.service import MasterClient
 from elasticdl_tpu.utils.args import parse_worker_args
 from elasticdl_tpu.utils.log_utils import default_logger as logger
-from elasticdl_tpu.worker.worker import Worker
 
 
 def main(argv=None) -> int:
@@ -21,10 +28,29 @@ def main(argv=None) -> int:
         args.worker_id,
         args.master_addr,
     )
+    coordinator_addr = getattr(args, "coordinator_addr", "") or ""
     client = MasterClient(args.master_addr)
-    worker = Worker(args, client)
     try:
-        worker.run()
+        if coordinator_addr:
+            from elasticdl_tpu.parallel import elastic
+            from elasticdl_tpu.worker.lockstep import LockstepWorker
+
+            elastic.initialize_world(
+                coordinator_addr,
+                args.num_processes,
+                args.process_id,
+                platform=getattr(args, "jax_platform", "") or None,
+            )
+            try:
+                LockstepWorker(args, client).run()
+            finally:
+                elastic.shutdown_world()
+        else:
+            from elasticdl_tpu.parallel.elastic import configure_platform
+            from elasticdl_tpu.worker.worker import Worker
+
+            configure_platform(getattr(args, "jax_platform", "") or None)
+            Worker(args, client).run()
     finally:
         client.close()
     return 0
