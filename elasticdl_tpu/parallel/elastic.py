@@ -126,6 +126,147 @@ def local_batch_ranges(
     return sorted(ranges)
 
 
+def state_checkpoint_parts(state, mesh, materialize_dense: bool = True):
+    """Split the live device state into ``(dense, parts)`` for part-based
+    checkpointing, driven by each array's ACTUAL sharding (the sharded
+    analogue of ``trainer.state.state_to_checkpoint``):
+
+    - fully-replicated leaves -> ``dense`` (read from the local replica,
+      no communication; skipped entirely when ``materialize_dense`` is
+      False — non-chief processes discard them, so they must not pay N-1
+      device-to-host copies);
+    - 2-D leaves range-sharded on dim 0 only (embedding tables, and any
+      fsdp dim-0 shard) -> ``parts``: ``name -> (ids, rows)`` for the
+      row ranges this process OWNS — when dp replicates a range across
+      processes, only the lowest process index owning it writes it, so
+      parts are disjoint and each host writes exactly its slice (a table
+      larger than one host's RAM never materializes; reference
+      per-PS-shard checkpointing, common/save_utils.py:100-116);
+    - anything else sharded -> gathered collectively into ``dense``.
+
+    Collective: every process of the mesh must call this at the same
+    point (leaf classification is identical everywhere, so the gathers
+    line up).
+    """
+    flat = flat_state_arrays(state)
+    my_proc = my_process_index(mesh) if is_multiprocess_mesh(mesh) else None
+
+    dense: dict = {}
+    parts: dict = {}
+    to_gather: dict = {}
+    for name, arr in flat.items():
+        if not isinstance(arr, jax.Array):
+            if materialize_dense:
+                dense[name] = np.asarray(arr)
+            continue
+        sharding = arr.sharding
+        if sharding.is_fully_replicated:
+            if materialize_dense:
+                dense[name] = np.asarray(arr)
+            continue
+        if arr.ndim == 2 and _dim0_sharded_only(arr):
+            owned = _owned_row_ranges(sharding, arr.shape, my_proc)
+            ranges: dict[tuple[int, int], np.ndarray] = {}
+            for shard in arr.addressable_shards:
+                r = _dim0_range(shard.index, arr.shape)
+                if r in owned:
+                    ranges[r] = np.asarray(shard.data)
+            ordered = sorted(ranges)
+            if ordered:
+                ids = np.concatenate(
+                    [np.arange(lo, hi, dtype=np.int64) for lo, hi in ordered]
+                )
+                rows = np.concatenate([ranges[r] for r in ordered], axis=0)
+            else:
+                ids = np.zeros((0,), dtype=np.int64)
+                rows = np.zeros((0, arr.shape[1]), dtype=arr.dtype)
+            parts[name] = (ids, rows)
+        else:
+            to_gather[name] = arr
+    if to_gather:
+        gathered = replicate_to_hosts(to_gather, mesh)
+        if materialize_dense:
+            dense.update(gathered)
+    return dense, parts
+
+
+def _owned_row_ranges(sharding, shape, my_proc) -> set[tuple[int, int]]:
+    """Dim-0 ranges this process WRITES: when dp replicates a range over
+    several processes, the lowest process index owning it is the writer
+    (deterministic, communication-free)."""
+    if my_proc is None:
+        # single-process mesh: everything addressable is owned
+        return {
+            _dim0_range(idx, shape)
+            for idx in sharding.devices_indices_map(shape).values()
+        }
+    owner: dict[tuple[int, int], int] = {}
+    for device, idx in sharding.devices_indices_map(shape).items():
+        r = _dim0_range(idx, shape)
+        prev = owner.get(r)
+        if prev is None or device.process_index < prev:
+            owner[r] = device.process_index
+    return {r for r, proc in owner.items() if proc == my_proc}
+
+
+def _dim0_range(idx, shape) -> tuple[int, int]:
+    sl = idx[0]
+    lo = sl.start if sl.start is not None else 0
+    hi = sl.stop if sl.stop is not None else shape[0]
+    return (lo, hi)
+
+
+def local_table_row_ranges(state, mesh) -> dict:
+    """Per-table dim-0 row ranges this process's devices hold — the keep
+    filter a restore passes to ``save_utils.restore_checkpoint`` so no
+    host ever accumulates a whole distributed table."""
+    proc = my_process_index(mesh)
+    out = {}
+    for name, arr in flat_state_arrays(state).items():
+        if (
+            isinstance(arr, jax.Array)
+            and arr.ndim == 2
+            and not arr.sharding.is_fully_replicated
+            and _dim0_sharded_only(arr)
+        ):
+            out[name] = local_batch_ranges(arr.sharding, arr.shape, proc)
+    return out
+
+
+def flat_state_arrays(state) -> dict:
+    """Checkpoint-named flat view of the state's restorable leaves
+    (``params/...`` + mutable collections), KEEPING device arrays as-is
+    (tree_to_dict would device_get sharded arrays whole, which is exactly
+    what part-based checkpointing exists to avoid)."""
+    flat = {
+        f"params/{k}": v for k, v in _flat_arrays(state.params).items()
+    }
+    if state.model_state:
+        flat.update(_flat_arrays(state.model_state))
+    return flat
+
+
+def _flat_arrays(tree) -> dict:
+    from elasticdl_tpu.utils.tree_utils import _key_str
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {
+        "/".join(_key_str(k) for k in path): leaf for path, leaf in flat
+    }
+
+
+def _dim0_sharded_only(arr) -> bool:
+    """Sharded along dim 0 with dim 1 unsharded."""
+    for idx in arr.sharding.devices_indices_map(arr.shape).values():
+        sl = idx[1]
+        if not (
+            sl.start in (None, 0)
+            and sl.stop in (None, arr.shape[1])
+        ):
+            return False
+    return True
+
+
 def replicate_to_hosts(tree, mesh):
     """All-gather a (possibly sharded) device tree so every process holds
     the full values — the collective equivalent of ``device_get`` on a

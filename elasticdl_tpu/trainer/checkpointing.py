@@ -1,0 +1,191 @@
+"""Periodic checkpoint + resume, shared by both worker runtimes.
+
+Reference: the PS checkpoints its shard every ``checkpoint_steps``
+(``elasticdl/python/ps/servicer.py:216-231`` ->
+``common/save_utils.py:126-150``) and restores re-sharded across a
+different PS count (``save_utils.py:208-261``).  TPU equivalents:
+
+- saving is driven by the live arrays' shardings
+  (``elastic.state_checkpoint_parts``): replicated leaves come from the
+  local replica, vocab-sharded tables are written as per-host
+  ``(ids, rows)`` parts — no host ever materializes a whole distributed
+  table;
+- restore assembles parts into full tables by explicit row ids and
+  re-places the state onto the CURRENT mesh (``jax.device_put`` with the
+  trainer's shardings), so a checkpoint written on ``ep=4`` restores onto
+  ``ep=2`` — same property, range-sharded instead of hash-sharded.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from elasticdl_tpu.parallel import elastic
+from elasticdl_tpu.utils import save_utils
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+
+class PeriodicCheckpointer:
+    """Milestone-crossing periodic saver (task boundaries are not step
+    multiples, so exact-multiple checks would skip saves — same reasoning
+    as the eval trigger fix)."""
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        checkpoint_steps: int,
+        keep_checkpoint_max: int = 3,
+        process_id: int = 0,
+        num_parts: int = 1,
+    ):
+        self._saver = (
+            save_utils.CheckpointSaver(checkpoint_dir, keep_checkpoint_max)
+            if checkpoint_dir
+            else None
+        )
+        self._steps = checkpoint_steps or 0
+        self._process_id = process_id
+        self._num_parts = max(1, num_parts)
+        self._last_milestone = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self._saver is not None
+
+    @property
+    def is_chief(self) -> bool:
+        return self._process_id == 0
+
+    def note_restored_version(self, version: int):
+        if self._steps:
+            self._last_milestone = version // self._steps
+
+    def maybe_save(self, trainer, mesh) -> bool:
+        """Save if a ``checkpoint_steps`` milestone was crossed.  Call at
+        task boundaries on EVERY process (saving is collective when any
+        leaf needs a gather)."""
+        if self._saver is None or not self._steps or trainer is None:
+            return False
+        milestone = trainer.step // self._steps
+        if milestone <= self._last_milestone:
+            return False
+        self._last_milestone = milestone
+        self.save_now(trainer, mesh)
+        return True
+
+    def save_now(self, trainer, mesh):
+        # non-chiefs only write their table parts: don't pay device->host
+        # copies for replicated leaves they would discard
+        dense, parts = elastic.state_checkpoint_parts(
+            trainer.state, mesh, materialize_dense=self.is_chief
+        )
+        version = trainer.step
+        self._saver.save(
+            version,
+            dense=dense,
+            embeddings=parts,
+            part=self._process_id,
+            num_parts=self._num_parts,
+            extra={"model_version": version},
+            # concurrent part writers must not race retention deletes
+            enforce_retention=self.is_chief,
+        )
+
+
+def restore_trainer_state(trainer, args, process_id: int = 0) -> int | None:
+    """Resume-from-own-checkpoint first (re-formation restart), then
+    ``--checkpoint_dir_for_init`` (warm start from a prior job).  Returns
+    the restored step (0 for a warm start), or None if nothing restored.
+
+    Re-shardable restore (reference save_utils.py:208-261): sharded table
+    parts carry explicit row ids, and each process places ONLY the rows
+    its devices own under the CURRENT mesh — the checkpoint's part count
+    / layout and the new mesh are independent, and no process
+    materializes a whole distributed table.  Warm starts restore weights
+    but reset the step counter (the old-job step count must not trigger
+    this job's step-based eval/checkpoint milestones).
+    """
+    import jax
+
+    from elasticdl_tpu.trainer.state import checkpoint_to_state
+
+    ckpt_dir = getattr(args, "checkpoint_dir", "") or ""
+    resume = bool(ckpt_dir) and save_utils.latest_version(ckpt_dir) is not None
+    restore_dir = (
+        ckpt_dir
+        if resume
+        else (getattr(args, "checkpoint_dir_for_init", "") or "")
+    )
+    if not restore_dir:
+        return None
+    dense, embeddings, extra = save_utils.restore_checkpoint(
+        restore_dir,
+        # keep only rows this process's devices hold, per part, so a
+        # table sharded across N hosts is never whole on any of them
+        table_row_ranges=elastic.local_table_row_ranges(
+            trainer.state, trainer.mesh
+        ),
+    )
+    values = dict(dense)
+    if embeddings:
+        flat_state = elastic.flat_state_arrays(trainer.state)
+        for name, (ids, rows) in embeddings.items():
+            target = flat_state.get(name)
+            if target is None:
+                logger.warning(
+                    "Checkpoint table %r has no model counterpart; skipped",
+                    name,
+                )
+                continue
+            values[name] = _place_table_rows(target, ids, rows, trainer.mesh)
+    state = checkpoint_to_state(trainer.state, values)
+    version = int(extra.get("model_version", 0) or 0)
+    restored_step = version if resume else 0
+    state = state.replace(step=np.asarray(restored_step, dtype=np.int32))
+    trainer.state = jax.device_put(state, trainer.state_shardings)
+    logger.info(
+        "Process %d restored state at version %d from %s%s",
+        process_id,
+        version,
+        restore_dir,
+        "" if resume else " (warm start; step reset to 0)",
+    )
+    return restored_step
+
+
+def _place_table_rows(target, ids, rows, mesh):
+    """Build the device Array for one restored table: select the rows this
+    process's devices own (by explicit checkpoint ids) and assemble the
+    global Array without materializing the full table on any host."""
+    import jax
+
+    sharding = getattr(target, "sharding", None)
+    if sharding is None or not elastic.is_multiprocess_mesh(mesh):
+        # single process: all rows are local; plain assembly
+        return save_utils.assemble_embedding_tables({"t": (ids, rows)})["t"]
+    shape = tuple(target.shape)
+    ranges = elastic.local_batch_ranges(
+        sharding, shape, elastic.my_process_index(mesh)
+    )
+    order = np.argsort(ids)
+    ids_sorted = ids[order]
+    segments = []
+    for lo, hi in ranges:
+        want = np.arange(lo, hi, dtype=ids_sorted.dtype)
+        pos = np.searchsorted(ids_sorted, want)
+        if pos.size and (
+            pos.max() >= len(ids_sorted)
+            or not np.array_equal(ids_sorted[pos], want)
+        ):
+            raise ValueError(
+                f"checkpoint parts missing rows [{lo}, {hi}) of a table"
+            )
+        segments.append(rows[order[pos]])
+    local = (
+        np.concatenate(segments, axis=0)
+        if segments
+        else np.zeros((0,) + shape[1:], dtype=rows.dtype)
+    )
+    return jax.make_array_from_process_local_data(
+        sharding, local, global_shape=shape
+    )

@@ -127,9 +127,11 @@ class LocalExecutor:
             self._model.apply, params, self._tx, model_state
         )
         if self._args.checkpoint_dir_for_init:
-            dense, _, extra = save_utils.restore_checkpoint(
+            dense, embeddings, extra = save_utils.restore_checkpoint(
                 self._args.checkpoint_dir_for_init
             )
+            # worker-written checkpoints carry sharded tables as parts
+            dense.update(save_utils.assemble_embedding_tables(embeddings))
             self._state = checkpoint_to_state(self._state, dense)
             logger.info(
                 "Initialized parameters from checkpoint %s (version %s)",

@@ -23,7 +23,6 @@ import shutil
 
 import numpy as np
 
-from elasticdl_tpu.utils import hash_utils
 from elasticdl_tpu.utils.log_utils import default_logger as logger
 
 _MANIFEST = "manifest.json"
@@ -64,11 +63,14 @@ class CheckpointSaver:
         part: int = 0,
         num_parts: int = 1,
         extra: dict | None = None,
+        enforce_retention: bool = True,
     ):
         """Save one part of checkpoint ``version``.
 
         dense: name -> array (only part 0 should carry dense params).
         embeddings: table_name -> (ids [n], rows [n, dim]) owned by this part.
+        enforce_retention: pass False on parts written concurrently with
+        part 0 (exactly one writer should delete old versions).
         """
         vdir = _version_dir(self._dir, version)
         os.makedirs(vdir, exist_ok=True)
@@ -80,7 +82,16 @@ class CheckpointSaver:
             names["embeddings"].append(name)
             payload[f"emb_ids/{name}"] = np.asarray(ids, dtype=np.int64)
             payload[f"emb_rows/{name}"] = np.asarray(rows)
-        np.savez(os.path.join(vdir, _part_file(part, num_parts)), **payload)
+        # atomic publish: a SIGKILL mid-save (mesh re-formation kills
+        # workers) must never leave a torn npz behind a complete-looking
+        # file set — write to a temp name, then rename
+        final = os.path.join(vdir, _part_file(part, num_parts))
+        # keep the .npz suffix so np.savez doesn't append another one
+        tmp = os.path.join(
+            vdir, f".tmp-{os.getpid()}-{_part_file(part, num_parts)}"
+        )
+        np.savez(tmp, **payload)
+        os.replace(tmp, final)
         if part == 0:
             manifest = {
                 "version": version,
@@ -90,7 +101,8 @@ class CheckpointSaver:
             }
             with open(os.path.join(vdir, _MANIFEST), "w") as f:
                 json.dump(manifest, f)
-        self._enforce_retention()
+        if enforce_retention:
+            self._enforce_retention()
         logger.info(
             "Saved checkpoint version %d part %d/%d to %s",
             version,
@@ -100,16 +112,7 @@ class CheckpointSaver:
         )
 
     def _versions(self) -> list[int]:
-        out = []
-        if not os.path.isdir(self._dir):
-            return out
-        for name in os.listdir(self._dir):
-            if name.startswith("version-"):
-                try:
-                    out.append(int(name.split("-", 1)[1]))
-                except ValueError:
-                    continue
-        return sorted(out)
+        return _list_versions(self._dir)
 
     def _enforce_retention(self):
         if self._keep_max <= 0:
@@ -137,18 +140,12 @@ def checkpoint_is_valid(checkpoint_dir: str, version: int) -> bool:
 
 
 def latest_version(checkpoint_dir: str) -> int | None:
-    saver_versions = []
-    if not os.path.isdir(checkpoint_dir):
-        return None
-    for name in os.listdir(checkpoint_dir):
-        if name.startswith("version-"):
-            try:
-                v = int(name.split("-", 1)[1])
-            except ValueError:
-                continue
-            if checkpoint_is_valid(checkpoint_dir, v):
-                saver_versions.append(v)
-    return max(saver_versions) if saver_versions else None
+    valid = [
+        v
+        for v in _list_versions(checkpoint_dir)
+        if checkpoint_is_valid(checkpoint_dir, v)
+    ]
+    return max(valid) if valid else None
 
 
 def restore_checkpoint(
@@ -156,6 +153,7 @@ def restore_checkpoint(
     version: int | None = None,
     num_shards: int = 1,
     shard_id: int = 0,
+    table_row_ranges: dict[str, list[tuple[int, int]]] | None = None,
 ) -> tuple[dict[str, np.ndarray], dict[str, tuple[np.ndarray, np.ndarray]], dict]:
     """Restore (dense, embeddings, extra) for ``shard_id`` of ``num_shards``.
 
@@ -164,6 +162,15 @@ def restore_checkpoint(
     by ``int_to_id(id, num_shards)`` — the reference's resharding property
     (save_utils.py:208-261).  Dense params are returned whole to every
     shard (they are replicated on the mesh).
+
+    ``table_row_ranges``: optional per-table ``[(lo, hi), ...]`` keep
+    filters applied WHILE iterating parts, so a caller restoring a
+    mesh-sharded table keeps only its own rows and never holds the full
+    table in host memory.
+
+    With ``version=None``, versions are tried newest-first: a torn or
+    unreadable version (e.g. a save raced by a worker SIGKILL) falls back
+    to the next older intact one instead of failing the restore.
     """
     # accept a direct version dir ({root}/version-N) like the reference's
     # --checkpoint_dir_for_init usage (tests point at version-100 dirs)
@@ -174,16 +181,49 @@ def restore_checkpoint(
             checkpoint_dir = os.path.dirname(os.path.normpath(checkpoint_dir))
         except ValueError:
             pass
-    if version is None:
-        version = latest_version(checkpoint_dir)
-        if version is None:
+    if version is not None:
+        if not checkpoint_is_valid(checkpoint_dir, version):
             raise FileNotFoundError(
-                f"no valid checkpoint under {checkpoint_dir}"
+                f"checkpoint version {version} under {checkpoint_dir} "
+                f"is invalid"
             )
-    if not checkpoint_is_valid(checkpoint_dir, version):
-        raise FileNotFoundError(
-            f"checkpoint version {version} under {checkpoint_dir} is invalid"
+        return _load_version(
+            checkpoint_dir, version, num_shards, shard_id, table_row_ranges
         )
+    candidates = [
+        v
+        for v in _list_versions(checkpoint_dir)
+        if checkpoint_is_valid(checkpoint_dir, v)
+    ]
+    if not candidates:
+        raise FileNotFoundError(f"no valid checkpoint under {checkpoint_dir}")
+    last_error: Exception | None = None
+    for v in reversed(candidates):
+        try:
+            return _load_version(
+                checkpoint_dir, v, num_shards, shard_id, table_row_ranges
+            )
+        except Exception as ex:  # noqa: BLE001 — torn files fall through
+            logger.warning(
+                "Checkpoint version %d under %s unreadable (%s); "
+                "falling back to an older version",
+                v,
+                checkpoint_dir,
+                ex,
+            )
+            last_error = ex
+    raise FileNotFoundError(
+        f"all checkpoint versions under {checkpoint_dir} unreadable"
+    ) from last_error
+
+
+def _load_version(
+    checkpoint_dir: str,
+    version: int,
+    num_shards: int,
+    shard_id: int,
+    table_row_ranges: dict[str, list[tuple[int, int]]] | None,
+):
     vdir = _version_dir(checkpoint_dir, version)
     with open(os.path.join(vdir, _MANIFEST)) as f:
         manifest = json.load(f)
@@ -202,15 +242,64 @@ def restore_checkpoint(
                     emb_ids.setdefault(name, []).append(z[key])
                 elif kind == "emb_rows":
                     emb_rows.setdefault(name, []).append(z[key])
+        # filter per part so only locally-owned rows accumulate
+        if table_row_ranges:
+            for name in list(emb_ids):
+                if name not in table_row_ranges or not emb_ids[name]:
+                    continue
+                ids = emb_ids[name][-1]
+                if ids.size == 0:
+                    continue
+                keep = np.zeros(ids.shape, dtype=bool)
+                for lo, hi in table_row_ranges[name]:
+                    keep |= (ids >= lo) & (ids < hi)
+                emb_ids[name][-1] = ids[keep]
+                emb_rows[name][-1] = emb_rows[name][-1][keep]
 
     embeddings: dict[str, tuple[np.ndarray, np.ndarray]] = {}
     for name in emb_ids:
         ids = np.concatenate(emb_ids[name])
         rows = np.concatenate(emb_rows[name], axis=0)
-        if num_shards > 1 or n > 1:
-            mask = np.asarray(
-                [hash_utils.int_to_id(i, num_shards) == shard_id for i in ids]
-            )
+        if num_shards > 1:
+            # vectorized int_to_id (hash_utils.py: id mod N)
+            mask = (ids % num_shards) == shard_id
             ids, rows = ids[mask], rows[mask]
         embeddings[name] = (ids, rows)
     return dense, embeddings, manifest.get("extra", {})
+
+
+def _list_versions(checkpoint_dir: str) -> list[int]:
+    out = []
+    if not os.path.isdir(checkpoint_dir):
+        return out
+    for name in os.listdir(checkpoint_dir):
+        if name.startswith("version-"):
+            try:
+                out.append(int(name.split("-", 1)[1]))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def assemble_embedding_tables(
+    embeddings: dict[str, tuple[np.ndarray, np.ndarray]],
+) -> dict[str, np.ndarray]:
+    """Reassemble full tables from ``(ids, rows)`` parts.
+
+    Parts carry explicit global row ids, so this is independent of how
+    the writer's mesh laid the table out — the ids must simply cover
+    ``0..V-1`` exactly once (range-sharded writers do).  Use with
+    ``restore_checkpoint(..., num_shards=1)``.
+    """
+    out: dict[str, np.ndarray] = {}
+    for name, (ids, rows) in embeddings.items():
+        order = np.argsort(ids)
+        ids_sorted = ids[order]
+        expected = np.arange(len(ids_sorted), dtype=ids_sorted.dtype)
+        if len(ids_sorted) == 0 or not np.array_equal(ids_sorted, expected):
+            raise ValueError(
+                f"embedding parts for {name!r} do not cover a full "
+                f"contiguous table (got {len(ids_sorted)} ids)"
+            )
+        out[name] = rows[order]
+    return out

@@ -48,5 +48,10 @@ def dict_to_tree(values: dict[str, np.ndarray], like):
                 f"shape mismatch for {key!r}: checkpoint {arr.shape} vs "
                 f"model {np.shape(leaf)}"
             )
-        leaves.append(arr.astype(np.asarray(leaf).dtype))
+        # read dtype without np.asarray(leaf): a multi-process-sharded
+        # model leaf is not fully addressable and would raise
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is None:
+            dtype = np.asarray(leaf).dtype
+        leaves.append(arr.astype(dtype))
     return jax.tree_util.tree_unflatten(treedef, leaves)

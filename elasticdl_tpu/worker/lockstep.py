@@ -42,9 +42,12 @@ from elasticdl_tpu.parallel import elastic
 from elasticdl_tpu.parallel.distributed import SPMDTrainer
 from elasticdl_tpu.parallel.mesh import MeshConfig
 from elasticdl_tpu.rpc import messages as msg
+from elasticdl_tpu.trainer.checkpointing import (
+    PeriodicCheckpointer,
+    restore_trainer_state,
+)
 from elasticdl_tpu.trainer.local_executor import build_optimizer
-from elasticdl_tpu.trainer.state import Modes, checkpoint_to_state
-from elasticdl_tpu.utils import save_utils
+from elasticdl_tpu.trainer.state import Modes
 from elasticdl_tpu.utils.args import derive_job_type
 from elasticdl_tpu.utils.constants import JobType, TaskType
 from elasticdl_tpu.utils.log_utils import default_logger as logger
@@ -98,14 +101,12 @@ class LockstepWorker:
         self._mesh = MeshConfig.from_string(mesh_shape).create(devices)
         self._trainer: SPMDTrainer | None = None
         self._stopped = False
-        self._last_ckpt_milestone = 0
-        ckpt_dir = getattr(args, "checkpoint_dir", "") or ""
-        self._saver = (
-            save_utils.CheckpointSaver(
-                ckpt_dir, getattr(args, "keep_checkpoint_max", 3)
-            )
-            if ckpt_dir
-            else None
+        self._checkpointer = PeriodicCheckpointer(
+            getattr(args, "checkpoint_dir", "") or "",
+            getattr(args, "checkpoint_steps", 0) or 0,
+            getattr(args, "keep_checkpoint_max", 3),
+            process_id=self._process_id,
+            num_parts=self._num_processes,
         )
 
     # ---- process-0-only master reporting -----------------------------------
@@ -157,66 +158,18 @@ class LockstepWorker:
             remat=bool(getattr(self._args, "remat", False)),
             donate=bool(getattr(self._args, "donate_state", True)),
         )
-        self._maybe_restore()
-
-    def _maybe_restore(self):
-        """Resume-from-own-checkpoint first (mesh re-formation restart),
-        then --checkpoint_dir_for_init (fresh start from a prior job)."""
-        restore_dir = ""
-        ckpt_dir = getattr(self._args, "checkpoint_dir", "") or ""
-        if ckpt_dir and save_utils.latest_version(ckpt_dir) is not None:
-            restore_dir = ckpt_dir
-        elif getattr(self._args, "checkpoint_dir_for_init", "") or "":
-            restore_dir = self._args.checkpoint_dir_for_init
-        if not restore_dir:
-            return
-        dense, _, extra = save_utils.restore_checkpoint(restore_dir)
-        state = checkpoint_to_state(self._trainer.state, dense)
-        version = int(extra.get("model_version", 0) or 0)
-        state = state.replace(step=np.asarray(version, dtype=np.int32))
-        # re-place explicitly: host arrays -> the mesh layout (each process
-        # puts only its addressable shards)
-        self._trainer.state = jax.device_put(
-            state, self._trainer.state_shardings
+        version = restore_trainer_state(
+            self._trainer, self._args, self._process_id
         )
-        self._last_ckpt_milestone = (
-            version // self._args.checkpoint_steps
-            if getattr(self._args, "checkpoint_steps", 0)
-            else 0
-        )
-        logger.info(
-            "Process %d restored state at version %d from %s",
-            self._process_id,
-            version,
-            restore_dir,
-        )
+        if version is not None:
+            self._checkpointer.note_restored_version(version)
 
     def _maybe_checkpoint(self):
         """Periodic checkpoint every ``checkpoint_steps`` (reference
-        ps/servicer.py:216-231 checkpoints on the PS; here the chief
-        writes after a collective gather).  Runs at task boundaries only,
-        so every process agrees on when the collective happens."""
-        steps = getattr(self._args, "checkpoint_steps", 0) or 0
-        if not steps or self._saver is None or self._trainer is None:
-            return
-        milestone = self._trainer.step // steps
-        if milestone <= self._last_ckpt_milestone:
-            return
-        self._last_ckpt_milestone = milestone
-        self._checkpoint_now()
-
-    def _checkpoint_now(self):
-        from elasticdl_tpu.trainer.state import state_to_checkpoint
-
-        host_state = elastic.replicate_to_hosts(
-            self._trainer.state, self._mesh
-        )
-        if self._is_chief:
-            self._saver.save(
-                self._trainer.step,
-                dense=state_to_checkpoint(host_state),
-                extra={"model_version": self._trainer.step},
-            )
+        ps/servicer.py:216-231 checkpoints on the PS; here each process
+        writes its own part).  Runs at task boundaries only, so every
+        process agrees on when any gather collective happens."""
+        self._checkpointer.maybe_save(self._trainer, self._mesh)
 
     # ---- batching ----------------------------------------------------------
 

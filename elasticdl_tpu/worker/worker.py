@@ -31,9 +31,12 @@ import numpy as np
 from elasticdl_tpu.parallel.distributed import SPMDTrainer
 from elasticdl_tpu.parallel.mesh import MeshConfig
 from elasticdl_tpu.rpc import messages as msg
+from elasticdl_tpu.trainer.checkpointing import (
+    PeriodicCheckpointer,
+    restore_trainer_state,
+)
 from elasticdl_tpu.trainer.local_executor import build_optimizer
-from elasticdl_tpu.trainer.state import Modes, checkpoint_to_state
-from elasticdl_tpu.utils import save_utils
+from elasticdl_tpu.trainer.state import Modes
 from elasticdl_tpu.utils.constants import (
     JobType,
     MAX_MINIBATCH_RETRY_NUM,
@@ -98,6 +101,13 @@ class Worker:
         self._mesh = MeshConfig.from_string(mesh_shape).create(devices)
         self._trainer: SPMDTrainer | None = None
         self._eval_metrics = None
+        # periodic checkpointing (reference ps/servicer.py:216-231 — the
+        # PS saved its shard; here the worker saves, sharding-aware)
+        self._checkpointer = PeriodicCheckpointer(
+            getattr(args, "checkpoint_dir", "") or "",
+            getattr(args, "checkpoint_steps", 0) or 0,
+            getattr(args, "keep_checkpoint_max", 3),
+        )
 
     # ---- master protocol ---------------------------------------------------
 
@@ -174,18 +184,9 @@ class Worker:
             remat=bool(getattr(self._args, "remat", False)),
             donate=bool(getattr(self._args, "donate_state", True)),
         )
-        ckpt = getattr(self._args, "checkpoint_dir_for_init", "") or ""
-        if ckpt:
-            dense, _, extra = save_utils.restore_checkpoint(ckpt)
-            self._trainer.state = checkpoint_to_state(
-                self._trainer.state, dense
-            )
-            logger.info(
-                "Worker %d initialized from checkpoint %s (version %s)",
-                self._worker_id,
-                ckpt,
-                extra.get("model_version", "?"),
-            )
+        version = restore_trainer_state(self._trainer, self._args)
+        if version is not None:
+            self._checkpointer.note_restored_version(version)
 
     @property
     def trainer(self):
@@ -270,6 +271,7 @@ class Worker:
                     # get_task RPC out of the minibatch hot loop.
                     self._timing.report_timing(reset=True)
                     self.report_version()
+                    self._checkpointer.maybe_save(self._trainer, self._mesh)
                     if self._job_type == JobType.TRAINING_WITH_EVALUATION:
                         self._evaluate_only()
             del dataset
