@@ -61,7 +61,12 @@ def _dispatch(args) -> dict:
     )
     if strategy == DistributionStrategy.LOCAL:
         return _run_local(args)
-    if getattr(args, "namespace", "") and getattr(args, "docker_image", ""):
+    if getattr(args, "docker_image", "") or getattr(
+        args, "docker_image_repository", ""
+    ):
+        # a prebuilt image OR a repository to build+push into means a
+        # cluster submission (reference api.py:24-33); otherwise the job
+        # runs as local subprocesses under an in-process master
         return _submit_k8s(args)
     return _run_distributed(args)
 
@@ -93,25 +98,12 @@ def predict(args) -> dict:
 def clean(args) -> dict:
     """Reference clean: remove job docker images (image_builder.py:82-128);
     gated on the docker SDK, with a clear message when absent."""
+    from elasticdl_tpu.image_builder import remove_images
+
     repository = getattr(args, "docker_image_repository", "") or ""
-    removed: list[str] = []
     try:
-        import docker
-    except ImportError:
-        logger.warning(
-            "docker SDK not installed; nothing to clean "
-            "(local runs leave no images)"
-        )
-        return {"removed": removed}
-    client = docker.from_env()
-    for image in client.images.list():
-        tags = [
-            t
-            for t in image.tags
-            if repository and t.startswith(repository)
-        ]
-        if getattr(args, "all", False) or tags:
-            client.images.remove(image.id, force=True)
-            removed.extend(tags or [image.id])
-    logger.info("Removed %d images", len(removed))
+        removed = remove_images(docker_image_repository=repository)
+    except RuntimeError as ex:
+        logger.warning("%s; nothing to clean (local runs leave no images)", ex)
+        removed = []
     return {"removed": removed}

@@ -1,0 +1,174 @@
+"""Docker image assembly for cluster submission.
+
+Reference: ``elasticdl/python/elasticdl/image_builder.py:12-212`` —
+copies the framework source + model zoo into a docker context,
+synthesizes a Dockerfile on a framework base image, builds, pushes, and
+can remove job images.  TPU differences: the base image must carry
+``jax[tpu]`` (default below) instead of TensorFlow, and the sanity check
+asserts jax imports.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import uuid
+from urllib.parse import urlparse
+
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+DEFAULT_BASE_IMAGE = "python:3.12-slim"
+
+
+def _framework_root() -> str:
+    """Directory containing the ``elasticdl_tpu`` package."""
+    import elasticdl_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(
+        elasticdl_tpu.__file__
+    )))
+
+
+def create_dockerfile(
+    model_zoo: str,
+    base_image: str = "",
+    extra_pypi_index: str = "",
+) -> str:
+    """Synthesize the job Dockerfile (reference :137-212).
+
+    The framework source is COPYed to ``/elasticdl_tpu``; a local model
+    zoo is COPYed to ``/model_zoo``, a remote (git URL) zoo is cloned.
+    The final check fails the build early if jax is missing from the
+    base image rather than at pod start.
+    """
+    base = base_image or DEFAULT_BASE_IMAGE
+    index = (
+        f' --extra-index-url="{extra_pypi_index}"' if extra_pypi_index else ""
+    )
+    lines = [
+        f"FROM {base} as base",
+        "ENV PYTHONPATH=/framework:/model_zoo",
+        "COPY elasticdl_tpu /framework/elasticdl_tpu",
+        f"RUN pip install 'jax[tpu]' flax optax msgpack grpcio numpy{index}",
+    ]
+    if model_zoo:
+        parsed = urlparse(model_zoo)
+        if not parsed.path:
+            raise ValueError(f"model_zoo has no path: {model_zoo!r}")
+        if parsed.scheme in ("", "file"):
+            zoo_base = os.path.basename(os.path.abspath(parsed.path))
+            lines.append(f"COPY {zoo_base} /model_zoo/{zoo_base}")
+            lines.append(
+                f"RUN if [ -f /model_zoo/{zoo_base}/requirements.txt ]; then"
+                f" pip install -r /model_zoo/{zoo_base}/requirements.txt"
+                f"{index}; fi"
+            )
+        else:
+            lines.append("RUN apt-get update && apt-get install -y git")
+            lines.append(f"RUN git clone --recursive {model_zoo} /model_zoo")
+    lines.append(
+        'RUN python -c "import jax; print(\'jax\', jax.__version__)"'
+    )
+    return "\n".join(lines) + "\n"
+
+
+def build_and_push_docker_image(
+    model_zoo: str,
+    docker_image_repository: str = "",
+    base_image: str = "",
+    extra_pypi: str = "",
+    docker_base_url: str = "unix://var/run/docker.sock",
+    docker_tlscert: str = "",
+    docker_tlskey: str = "",
+    client=None,
+) -> str:
+    """Assemble the context, build, and (when a repository is given) push.
+    Returns the full image name (reference :12-79)."""
+    image_name = _unique_image_name(docker_image_repository)
+    with tempfile.TemporaryDirectory() as ctx_dir:
+        src = os.path.join(_framework_root(), "elasticdl_tpu")
+        shutil.copytree(src, os.path.join(ctx_dir, "elasticdl_tpu"))
+        if model_zoo:
+            parsed = urlparse(model_zoo)
+            if parsed.scheme in ("", "file"):
+                zoo = os.path.abspath(parsed.path)
+                shutil.copytree(
+                    zoo, os.path.join(ctx_dir, os.path.basename(zoo))
+                )
+        dockerfile = os.path.join(ctx_dir, "Dockerfile")
+        with open(dockerfile, "w") as f:
+            f.write(create_dockerfile(model_zoo, base_image, extra_pypi))
+
+        client = client or _docker_client(
+            docker_base_url, docker_tlscert, docker_tlskey
+        )
+        logger.info("Building image %s", image_name)
+        for line in client.api.build(
+            path=ctx_dir,
+            dockerfile=dockerfile,
+            rm=True,
+            tag=image_name,
+            decode=True,
+        ):
+            _log_docker_line(line)
+        if docker_image_repository:
+            logger.info("Pushing image %s", image_name)
+            for line in client.api.push(image_name, stream=True, decode=True):
+                _log_docker_line(line)
+    return image_name
+
+
+def remove_images(
+    docker_image_repository: str = "",
+    docker_base_url: str = "unix://var/run/docker.sock",
+    docker_tlscert: str = "",
+    docker_tlskey: str = "",
+    client=None,
+) -> list[str]:
+    """Remove job images by repository prefix (reference :82-128)."""
+    client = client or _docker_client(
+        docker_base_url, docker_tlscert, docker_tlskey
+    )
+    removed: list[str] = []
+    for image in client.images.list():
+        tags = [
+            t
+            for t in image.tags
+            if not docker_image_repository
+            or t.startswith(docker_image_repository)
+        ]
+        if tags:
+            client.images.remove(image.id, force=True)
+            removed.extend(tags)
+    logger.info("Removed %d images", len(removed))
+    return removed
+
+
+def _unique_image_name(repository: str) -> str:
+    basename = f"elasticdl-tpu-{uuid.uuid4().hex[:12]}"
+    return f"{repository}:{basename}" if repository else basename
+
+
+def _docker_client(base_url: str, tlscert: str, tlskey: str):
+    try:
+        import docker
+    except ImportError as ex:  # gated: not baked into this image
+        raise RuntimeError(
+            "docker SDK is required to build job images; install 'docker' "
+            "or pass --docker_image to use a prebuilt image"
+        ) from ex
+    if tlscert and tlskey:
+        tls_config = docker.tls.TLSConfig(client_cert=(tlscert, tlskey))
+        return docker.DockerClient(base_url=base_url, tls=tls_config)
+    return docker.DockerClient(base_url=base_url)
+
+
+def _log_docker_line(line: dict):
+    text = line.get("stream") or line.get("status") or line.get("error")
+    if text:
+        text = str(text).strip()
+        if text:
+            logger.info("docker: %s", text)
+        if line.get("error"):
+            raise RuntimeError(f"docker build/push failed: {text}")

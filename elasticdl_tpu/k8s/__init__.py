@@ -1,0 +1,14 @@
+"""Kubernetes backend: pod lifecycle for cloud-deployed jobs.
+
+Reference: ``elasticdl/python/common/k8s_client.py`` (476 LoC),
+``master/k8s_instance_manager.py`` (285), ``common/k8s_resource.py`` /
+``k8s_volume.py``, ``common/k8s_tensorboard_client.py``.
+
+TPU redesign notes: there are no PS pods; worker pods are TPU hosts that
+join one ``jax.distributed`` world, so the instance manager implements
+the SAME lockstep world lifecycle as the local backend (start_workers /
+reform_world / restart_worker) and the coordinator address is the
+process-0 pod's headless service.  All manifests are plain dicts — the
+kubernetes package is only required at the API boundary, so every piece
+of policy here is unit-testable with a fake API.
+"""

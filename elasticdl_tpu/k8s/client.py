@@ -1,0 +1,296 @@
+"""Kubernetes API wrapper: labeled pods/services, watch, owner refs.
+
+Reference: ``elasticdl/python/common/k8s_client.py`` — label scheme
+(app/job/replica-type/replica-index), event watch thread with auto-retry
+(:84-98), owner references binding worker pods to the master pod
+(:206-221), pod/service CRUD.  Differences: manifests are plain dicts
+(no kubernetes client objects — the SDK is only touched inside
+``_default_api``), and the per-worker service exists to give the
+``jax.distributed`` coordinator a stable DNS name rather than to expose
+a PS port.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+
+from elasticdl_tpu.k8s import resource as k8s_resource
+from elasticdl_tpu.k8s import volume as k8s_volume
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+APP_NAME = "elasticdl-tpu"
+JOB_KEY = "elasticdl-job-name"
+REPLICA_TYPE_KEY = "elasticdl-replica-type"
+REPLICA_INDEX_KEY = "elasticdl-replica-index"
+
+# jax.distributed coordination service port on worker pods
+COORDINATOR_PORT = 8476
+# master control-plane (gRPC) port on the master pod
+MASTER_PORT = 50001
+
+
+def master_pod_name(job_name: str) -> str:
+    return f"elasticdl-{job_name}-master"
+
+
+def worker_pod_name(job_name: str, worker_id: int) -> str:
+    return f"elasticdl-{job_name}-worker-{worker_id}"
+
+
+def _default_api():
+    """Build the real CoreV1Api (in-cluster config when running inside a
+    pod, kubeconfig otherwise).  Kept separate so everything else works
+    with any object exposing the same methods (tests use a fake)."""
+    from kubernetes import client as k8s_sdk
+    from kubernetes import config
+
+    if os.getenv("KUBERNETES_SERVICE_HOST"):
+        config.load_incluster_config()
+    else:
+        config.load_kube_config()
+    return k8s_sdk.CoreV1Api()
+
+
+class Client:
+    def __init__(
+        self,
+        *,
+        image_name: str,
+        namespace: str,
+        job_name: str,
+        event_callback=None,
+        api=None,
+        watch: bool | None = None,
+    ):
+        """``watch=False`` disables the stream thread (tests drive the
+        event callback directly through a fake API)."""
+        self._api = api if api is not None else _default_api()
+        self.namespace = namespace
+        self.job_name = job_name
+        self.image_name = image_name
+        self._event_cb = event_callback
+        self._watching = (
+            event_callback is not None if watch is None else watch
+        )
+        if self._watching:
+            threading.Thread(
+                target=self._watch, name="k8s_event_watcher", daemon=True
+            ).start()
+
+    # ---- watch -------------------------------------------------------------
+
+    def stop_watching(self):
+        self._watching = False
+
+    def _watch(self):
+        """Label-filtered pod event stream with auto-retry (reference
+        k8s_client.py:84-98)."""
+        from kubernetes import watch as k8s_watch
+
+        while self._watching:
+            try:
+                stream = k8s_watch.Watch().stream(
+                    self._api.list_namespaced_pod,
+                    self.namespace,
+                    label_selector=f"{JOB_KEY}={self.job_name}",
+                )
+                for event in stream:
+                    if not self._watching:
+                        return
+                    self._event_cb(event)
+            except Exception:  # noqa: BLE001 — flaky API streams
+                traceback.print_exc()
+            time.sleep(5)
+
+    # ---- names / labels ----------------------------------------------------
+
+    def get_master_pod_name(self) -> str:
+        return master_pod_name(self.job_name)
+
+    def get_worker_pod_name(self, worker_id: int) -> str:
+        return worker_pod_name(self.job_name, worker_id)
+
+    def service_address(self, service_name: str, port: int) -> str:
+        return f"{service_name}.{self.namespace}.svc:{port}"
+
+    def worker_service_address(
+        self, worker_id: int, port: int = COORDINATOR_PORT
+    ) -> str:
+        return self.service_address(self.get_worker_pod_name(worker_id), port)
+
+    def master_service_address(self, port: int = MASTER_PORT) -> str:
+        return self.service_address(self.get_master_pod_name(), port)
+
+    def _labels(self, replica_type: str, replica_index=None) -> dict:
+        labels = {
+            "app": APP_NAME,
+            JOB_KEY: self.job_name,
+            REPLICA_TYPE_KEY: replica_type,
+        }
+        if replica_index is not None:
+            labels[REPLICA_INDEX_KEY] = str(replica_index)
+        return labels
+
+    # ---- manifests ---------------------------------------------------------
+
+    def owner_reference(self, owner_pod) -> list[dict]:
+        """Bind a pod's lifetime to its owner (the master): deleting the
+        master garbage-collects the fleet (reference :206-221)."""
+        if not owner_pod:
+            return []
+        meta = owner_pod["metadata"] if isinstance(owner_pod, dict) else None
+        if meta is None:  # kubernetes SDK object
+            meta = {
+                "name": owner_pod.metadata.name,
+                "uid": owner_pod.metadata.uid,
+            }
+        return [
+            {
+                "apiVersion": "v1",
+                "blockOwnerDeletion": True,
+                "kind": "Pod",
+                "name": meta["name"],
+                "uid": meta["uid"],
+            }
+        ]
+
+    def build_pod_manifest(
+        self,
+        *,
+        pod_name: str,
+        replica_type: str,
+        replica_index=None,
+        command: list[str] | None = None,
+        args: list[str] | None = None,
+        resource_requests: str = "",
+        resource_limits: str = "",
+        pod_priority: str = "",
+        volume: str = "",
+        image_pull_policy: str = "",
+        restart_policy: str = "Never",
+        envs: dict[str, str] | None = None,
+        owner_pod=None,
+    ) -> dict:
+        limits = resource_limits or resource_requests
+        env = [
+            # the pod learns its own IP (master uses it to build the
+            # worker argv; reference master-pod-IP env injection :288-295)
+            {
+                "name": "MY_POD_IP",
+                "valueFrom": {"fieldRef": {"fieldPath": "status.podIP"}},
+            }
+        ]
+        for key, value in (envs or {}).items():
+            env.append({"name": key, "value": value})
+        container: dict = {
+            "name": pod_name,
+            "image": self.image_name,
+            "command": command or [],
+            "args": args or [],
+            "env": env,
+            "resources": {
+                "requests": k8s_resource.parse(resource_requests),
+                "limits": k8s_resource.parse(limits),
+            },
+        }
+        if image_pull_policy:
+            container["imagePullPolicy"] = image_pull_policy
+        spec: dict = {
+            "containers": [container],
+            "restartPolicy": restart_policy,
+        }
+        if pod_priority:
+            spec["priorityClassName"] = pod_priority
+        if volume:
+            volumes, mounts = k8s_volume.volumes_and_mounts(volume, pod_name)
+            spec["volumes"] = volumes
+            container["volumeMounts"] = mounts
+        manifest = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": pod_name,
+                "namespace": self.namespace,
+                "labels": self._labels(replica_type, replica_index),
+                "ownerReferences": self.owner_reference(owner_pod),
+            },
+            "spec": spec,
+        }
+        return manifest
+
+    def build_service_manifest(
+        self, name: str, selector: dict, port: int
+    ) -> dict:
+        """Headless single-pod service: a stable DNS name (the coordinator
+        address must survive pod IP churn).  ``selector`` must match the
+        labels the target pod actually carries (``replica_selector``)."""
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": name,
+                "namespace": self.namespace,
+                "labels": self._labels("service"),
+            },
+            "spec": {
+                "clusterIP": "None",
+                "selector": dict(selector),
+                "ports": [{"port": port, "targetPort": port}],
+            },
+        }
+
+    def replica_selector(self, replica_type: str, replica_index=None) -> dict:
+        """Selector matching exactly the labels ``build_pod_manifest``
+        stamps on a replica pod."""
+        return self._labels(replica_type, replica_index)
+
+    # ---- CRUD --------------------------------------------------------------
+
+    def create_pod(self, manifest: dict):
+        return self._api.create_namespaced_pod(self.namespace, manifest)
+
+    def create_service(self, manifest: dict):
+        return self._api.create_namespaced_service(self.namespace, manifest)
+
+    def read_pod(self, pod_name: str):
+        try:
+            return self._api.read_namespaced_pod(
+                name=pod_name, namespace=self.namespace
+            )
+        except Exception as ex:  # noqa: BLE001 — absent pod is not fatal
+            logger.warning("Exception reading pod %s: %s", pod_name, ex)
+            return None
+
+    def delete_pod(self, pod_name: str):
+        try:
+            return self._api.delete_namespaced_pod(
+                name=pod_name, namespace=self.namespace
+            )
+        except Exception as ex:  # noqa: BLE001 — already gone is fine
+            logger.warning("Exception deleting pod %s: %s", pod_name, ex)
+            return None
+
+    def delete_service(self, name: str):
+        try:
+            return self._api.delete_namespaced_service(
+                name=name, namespace=self.namespace
+            )
+        except Exception as ex:  # noqa: BLE001
+            logger.warning("Exception deleting service %s: %s", name, ex)
+            return None
+
+    def patch_labels_to_pod(self, pod_name: str, labels: dict):
+        body = {"metadata": {"labels": labels}}
+        try:
+            return self._api.patch_namespaced_pod(
+                name=pod_name, namespace=self.namespace, body=body
+            )
+        except Exception as ex:  # noqa: BLE001
+            logger.warning("Exception patching pod %s: %s", pod_name, ex)
+            return None
+
+    def get_master_pod(self):
+        return self.read_pod(self.get_master_pod_name())

@@ -1,0 +1,55 @@
+"""k8s resource-string parsing.
+
+Reference: ``elasticdl/python/common/k8s_resource.py:38-80`` — the CLI
+accepts ``"cpu=250m,memory=32Mi,gpu=1"``; this build adds TPU resource
+names (``google.com/tpu``) since workers are TPU hosts.
+"""
+
+from __future__ import annotations
+
+import re
+
+_MEM_RE = re.compile(r"^[1-9][0-9]*(E|P|T|G|M|K|Ei|Pi|Ti|Gi|Mi|Ki)?$")
+_CPU_RE = re.compile(r"^([0-9]+\.?[0-9]*|[1-9][0-9]*m)$")
+_COUNT_RE = re.compile(r"^[1-9][0-9]*$")
+_VENDOR_RE = re.compile(r"^[a-z0-9.\-]+/(gpu|tpu)$")
+
+_MEM_KEYS = ("memory", "disk", "ephemeral-storage")
+
+
+def parse(resource_str: str) -> dict[str, str]:
+    """Parse ``"cpu=1,memory=4096Mi,tpu=4"`` into a k8s resources dict.
+
+    ``gpu`` shorthand becomes ``nvidia.com/gpu``; ``tpu`` becomes
+    ``google.com/tpu``.  Duplicate keys and unknown resource types are
+    errors (reference behavior).
+    """
+    out: dict[str, str] = {}
+    if not resource_str or not resource_str.strip():
+        return out
+    for kv in resource_str.strip().split(","):
+        if not kv.strip():
+            continue
+        key, sep, value = kv.partition("=")
+        if not sep:
+            raise ValueError(f"malformed resource entry (need k=v): {kv!r}")
+        key, value = key.strip(), value.strip()
+        if key == "gpu":
+            key = "nvidia.com/gpu"
+        elif key == "tpu":
+            key = "google.com/tpu"
+        if key in out:
+            raise ValueError(f"duplicate resource name: {key}")
+        if key in _MEM_KEYS:
+            if not _MEM_RE.match(value):
+                raise ValueError(f"invalid memory spec: {value!r}")
+        elif key == "cpu":
+            if not _CPU_RE.match(value):
+                raise ValueError(f"invalid cpu spec: {value!r}")
+        elif _VENDOR_RE.match(key):
+            if not _COUNT_RE.match(value):
+                raise ValueError(f"invalid accelerator count: {value!r}")
+        else:
+            raise ValueError(f"unknown resource type: {key!r}")
+        out[key] = value
+    return out

@@ -1,0 +1,82 @@
+"""Job submission: create the master pod on Kubernetes.
+
+Reference: ``elasticdl/python/elasticdl/api.py:138-178`` — the client
+builds+pushes an image, then creates a master pod running the master
+module with the job's argv; everything else (workers) is created BY the
+master from inside the cluster.
+"""
+
+from __future__ import annotations
+
+from elasticdl_tpu.k8s.client import MASTER_PORT, Client
+from elasticdl_tpu.utils.args import build_arguments_from_parsed_result
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+
+def submit_master_pod(args, api=None) -> dict:
+    """Build (and optionally push) the job image, then create the master
+    pod.  Returns a summary dict for the CLI."""
+    image_name = getattr(args, "docker_image", "") or ""
+    repository = getattr(args, "docker_image_repository", "") or ""
+    if not image_name:
+        from elasticdl_tpu.image_builder import build_and_push_docker_image
+
+        image_name = build_and_push_docker_image(
+            model_zoo=getattr(args, "model_zoo", "") or "",
+            docker_image_repository=repository,
+            base_image=getattr(args, "docker_base_image", "") or "",
+        )
+
+    client = Client(
+        image_name=image_name,
+        namespace=args.namespace,
+        job_name=args.job_name,
+        api=api,
+    )
+    master_argv = build_arguments_from_parsed_result(
+        args, filter_args=frozenset({"docker_image", "model_zoo"})
+    )
+    # the in-cluster master creates worker pods from THIS image, and the
+    # model zoo lives at its in-image location, not the submitter's path
+    master_argv.extend(["--docker_image", image_name])
+    model_zoo = getattr(args, "model_zoo", "") or ""
+    if model_zoo:
+        import os
+
+        master_argv.extend(
+            ["--model_zoo", f"/model_zoo/{os.path.basename(os.path.abspath(model_zoo))}"]
+        )
+    manifest = client.build_pod_manifest(
+        pod_name=client.get_master_pod_name(),
+        replica_type="master",
+        command=["python", "-m"],
+        args=["elasticdl_tpu.master.main", *master_argv],
+        resource_requests=getattr(
+            args, "master_resource_request", "cpu=1,memory=4096Mi"
+        ),
+        resource_limits=getattr(args, "master_resource_limit", "") or "",
+        pod_priority=getattr(args, "master_pod_priority", "") or "",
+        volume=getattr(args, "volume", "") or "",
+        image_pull_policy=getattr(args, "image_pull_policy", "Always"),
+        envs=getattr(args, "envs_dict", {}) or {},
+    )
+    client.create_pod(manifest)
+    # the control-plane service workers dial (stable DNS for MASTER_PORT)
+    client.create_service(
+        client.build_service_manifest(
+            client.get_master_pod_name(),
+            client.replica_selector("master"),
+            MASTER_PORT,
+        )
+    )
+    logger.info(
+        "Submitted master pod %s (image %s) to namespace %s",
+        client.get_master_pod_name(),
+        image_name,
+        args.namespace,
+    )
+    return {
+        "master_pod": client.get_master_pod_name(),
+        "image": image_name,
+        "namespace": args.namespace,
+    }

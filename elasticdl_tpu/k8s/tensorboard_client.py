@@ -1,0 +1,79 @@
+"""Expose the master's TensorBoard through a LoadBalancer service.
+
+Reference: ``elasticdl/python/common/k8s_tensorboard_client.py:20-52`` —
+creates a service targeting the master pod's TB port and polls for the
+external ingress IP.
+"""
+
+from __future__ import annotations
+
+import time
+
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+TENSORBOARD_PORT = 6006
+
+
+class TensorBoardClient:
+    def __init__(self, k8s_client):
+        self._client = k8s_client
+
+    def _service_name(self) -> str:
+        return f"tensorboard-{self._client.job_name}"
+
+    def create_tensorboard_service(self) -> dict:
+        manifest = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": self._service_name(),
+                "namespace": self._client.namespace,
+            },
+            "spec": {
+                "type": "LoadBalancer",
+                "selector": self._client.replica_selector("master"),
+                "ports": [
+                    {"port": TENSORBOARD_PORT, "targetPort": TENSORBOARD_PORT}
+                ],
+            },
+        }
+        self._client.create_service(manifest)
+        return manifest
+
+    def get_tensorboard_external_ip(
+        self, check_interval_secs: float = 5, max_checks: int = 60
+    ) -> str | None:
+        """Poll until the LoadBalancer gets an ingress IP (reference
+        :37-52)."""
+        for _ in range(max_checks):
+            svc = self._read_service()
+            ip = _ingress_ip(svc)
+            if ip:
+                return ip
+            time.sleep(check_interval_secs)
+        logger.warning("TensorBoard service never received an external IP")
+        return None
+
+    def _read_service(self):
+        try:
+            return self._client._api.read_namespaced_service(
+                name=self._service_name(),
+                namespace=self._client.namespace,
+            )
+        except Exception as ex:  # noqa: BLE001
+            logger.warning("Exception reading TB service: %s", ex)
+            return None
+
+
+def _ingress_ip(svc) -> str | None:
+    if svc is None:
+        return None
+    if isinstance(svc, dict):
+        ingress = (
+            (svc.get("status") or {}).get("loadBalancer") or {}
+        ).get("ingress") or []
+        return ingress[0].get("ip") if ingress else None
+    ingress = getattr(
+        getattr(svc.status, "load_balancer", None), "ingress", None
+    )
+    return ingress[0].ip if ingress else None

@@ -1,0 +1,65 @@
+"""k8s volume-string parsing (dict manifests).
+
+Reference: ``elasticdl/python/common/k8s_volume.py:6-46`` — volume
+strings like ``"host_path=/data,mount_path=/data;claim_name=c1,
+mount_path=/ckpt"``.  Emits plain manifest dicts instead of kubernetes
+client objects so no SDK is needed to construct or test pods.
+"""
+
+from __future__ import annotations
+
+_ALLOWED_KEYS = {"claim_name", "host_path", "type", "mount_path"}
+
+
+def parse(volume_str: str) -> list[dict[str, str]]:
+    """Split ``;``-separated volume specs into dicts of their ``k=v``
+    pairs, validating key names."""
+    out = []
+    for spec in (volume_str or "").strip().split(";"):
+        if not spec.strip():
+            continue
+        entry: dict[str, str] = {}
+        for kv in spec.split(","):
+            key, sep, value = kv.partition("=")
+            if not sep:
+                raise ValueError(f"malformed volume entry (need k=v): {kv!r}")
+            key, value = key.strip(), value.strip()
+            if key not in _ALLOWED_KEYS:
+                raise ValueError(
+                    f"unknown volume key {key!r}; allowed: "
+                    f"{sorted(_ALLOWED_KEYS)}"
+                )
+            entry[key] = value
+        if "mount_path" not in entry:
+            raise ValueError(f"volume spec missing mount_path: {spec!r}")
+        if "claim_name" not in entry and "host_path" not in entry:
+            raise ValueError(
+                f"volume spec needs claim_name or host_path: {spec!r}"
+            )
+        out.append(entry)
+    return out
+
+
+def volumes_and_mounts(
+    volume_str: str, pod_name: str
+) -> tuple[list[dict], list[dict]]:
+    """Manifest fragments: (spec.volumes, container.volumeMounts)."""
+    volumes, mounts = [], []
+    for i, entry in enumerate(parse(volume_str)):
+        name = f"{pod_name}-volume-{i}"
+        if "claim_name" in entry:
+            volume = {
+                "name": name,
+                "persistentVolumeClaim": {
+                    "claimName": entry["claim_name"],
+                    "readOnly": False,
+                },
+            }
+        else:
+            host_path: dict = {"path": entry["host_path"]}
+            if entry.get("type"):
+                host_path["type"] = entry["type"]
+            volume = {"name": name, "hostPath": host_path}
+        volumes.append(volume)
+        mounts.append({"name": name, "mountPath": entry["mount_path"]})
+    return volumes, mounts

@@ -15,33 +15,69 @@ from elasticdl_tpu.utils.log_utils import default_logger as logger
 
 
 def build_master(args) -> Master:
-    """Assemble a Master with the local instance manager (exposed so tests
-    and embedding callers can drive the lifecycle themselves)."""
+    """Assemble a Master with the configured instance manager backend
+    (exposed so tests and embedding callers can drive the lifecycle)."""
+
+    def build_argv(worker_id, master_addr, **world_kwargs):
+        argv = [
+            "elasticdl_tpu.worker.main",
+            *build_worker_arguments(args, worker_id, master_addr),
+        ]
+        # lockstep world coordinates (multi-process SPMD): the instance
+        # manager assigns these per process / per generation
+        for key, value in world_kwargs.items():
+            argv.extend([f"--{key}", str(value)])
+        return argv
 
     def im_factory(master):
         num_workers = getattr(args, "num_workers", 0) or 0
-        if num_workers <= 0:
+        backend = getattr(args, "instance_backend", "local") or "local"
+        if num_workers <= 0 or backend == "none":
             return None
+        lockstep = num_workers > 1
+        max_reforms = getattr(args, "relaunch_on_worker_failure", 3)
+        if backend == "k8s":
+            import os
 
-        def build_argv(worker_id, master_addr, **world_kwargs):
-            argv = [
-                "elasticdl_tpu.worker.main",
-                *build_worker_arguments(args, worker_id, master_addr),
-            ]
-            # lockstep world coordinates (multi-process SPMD): the
-            # instance manager assigns these per process / per generation
-            for key, value in world_kwargs.items():
-                argv.extend([f"--{key}", str(value)])
-            return argv
+            from elasticdl_tpu.k8s.instance_manager import K8sInstanceManager
 
+            return K8sInstanceManager(
+                num_workers=num_workers,
+                build_argv=build_argv,
+                # lazy: the control-plane port binds in Master.prepare()
+                master_addr=lambda: (
+                    f"{os.environ.get('MY_POD_IP', 'localhost')}:"
+                    f"{master.port}"
+                ),
+                image_name=getattr(args, "docker_image", "") or "",
+                namespace=args.namespace,
+                job_name=args.job_name,
+                envs=getattr(args, "envs_dict", {}) or {},
+                lockstep=lockstep,
+                max_reforms=max_reforms,
+                worker_resource_request=getattr(
+                    args, "worker_resource_request", "cpu=1,memory=4096Mi"
+                ),
+                worker_resource_limit=getattr(
+                    args, "worker_resource_limit", ""
+                )
+                or "",
+                worker_pod_priority=getattr(args, "worker_pod_priority", "")
+                or "",
+                volume=getattr(args, "volume", "") or "",
+                image_pull_policy=getattr(
+                    args, "image_pull_policy", "Always"
+                ),
+                on_worker_failure=master.servicer.mark_worker_dead,
+            )
         return LocalInstanceManager(
             master,
             num_workers,
             build_argv,
             envs=getattr(args, "envs_dict", {}) or {},
             # N>1 workers = one jax.distributed world training ONE model
-            lockstep=num_workers > 1,
-            max_reforms=getattr(args, "relaunch_on_worker_failure", 3),
+            lockstep=lockstep,
+            max_reforms=max_reforms,
         )
 
     return Master(args, instance_manager_factory=im_factory)

@@ -42,6 +42,9 @@ class MasterServicer:
         self._version = 0
         # worker_id -> last heartbeat wall-clock
         self._heartbeats: dict[int, float] = {}
+        # externally-reported failures (pod events); cleared only by
+        # forget_worker so a racing in-flight heartbeat can't erase them
+        self._marked_dead: set[int] = set()
         self._cluster_version = 0
         self._quiesce = False
         # lockstep step-task stream: seq -> memoized TaskResponse.  Every
@@ -212,22 +215,33 @@ class MasterServicer:
 
     # ---- failure detection / mesh re-formation hooks ----------------------
 
+    def mark_worker_dead(self, worker_id: int):
+        """External failure signal (e.g. a k8s pod DELETED event): the
+        worker is reported by the next ``dead_workers`` call regardless
+        of heartbeat timing — events beat timeouts at detection speed.
+        One-shot: only ``forget_worker`` clears it (a racing in-flight
+        heartbeat must not erase the signal)."""
+        with self._lock:
+            self._marked_dead.add(worker_id)
+
     def dead_workers(self, timeout_secs: float) -> list[int]:
-        """Workers whose last heartbeat is older than the timeout;
-        ``timeout_secs <= 0`` disables detection."""
-        if timeout_secs <= 0:
-            return []
+        """Workers externally marked dead, plus (when ``timeout_secs >
+        0``) workers whose last heartbeat is older than the timeout."""
         now = time.monotonic()
         with self._lock:
-            return [
-                wid
-                for wid, at in self._heartbeats.items()
-                if now - at > timeout_secs
-            ]
+            dead = set(self._marked_dead)
+            if timeout_secs > 0:
+                dead.update(
+                    wid
+                    for wid, at in self._heartbeats.items()
+                    if now - at > timeout_secs
+                )
+            return sorted(dead)
 
     def forget_worker(self, worker_id: int):
         with self._lock:
             self._heartbeats.pop(worker_id, None)
+            self._marked_dead.discard(worker_id)
 
     def begin_quiesce(self):
         """Ask all workers to pause at the next task boundary (first phase

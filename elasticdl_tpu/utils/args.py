@@ -313,7 +313,44 @@ def _add_master_params(parser: argparse.ArgumentParser):
         ),
     )
     parser.add_argument("--namespace", default="default")
-    parser.add_argument("--docker_image", default="")
+    parser.add_argument(
+        "--docker_image",
+        default="",
+        help="Prebuilt job image; empty = build one (--docker_image_repository)",
+    )
+    parser.add_argument(
+        "--docker_image_repository",
+        default="",
+        help="Registry/repository the built job image is pushed to",
+    )
+    parser.add_argument(
+        "--docker_base_image",
+        default="",
+        help="Base image for the synthesized job Dockerfile",
+    )
+    parser.add_argument(
+        "--worker_resource_request", default="cpu=1,memory=4096Mi"
+    )
+    parser.add_argument("--worker_resource_limit", default="")
+    parser.add_argument("--worker_pod_priority", default="")
+    parser.add_argument(
+        "--master_resource_request", default="cpu=1,memory=4096Mi"
+    )
+    parser.add_argument("--master_resource_limit", default="")
+    parser.add_argument("--master_pod_priority", default="")
+    parser.add_argument(
+        "--volume",
+        default="",
+        help=(
+            "Pod volumes, e.g. 'host_path=/data,mount_path=/data;"
+            "claim_name=c1,mount_path=/ckpt'"
+        ),
+    )
+    parser.add_argument(
+        "--image_pull_policy",
+        default="Always",
+        choices=["Always", "IfNotPresent", "Never"],
+    )
     parser.add_argument(
         "--relaunch_on_worker_failure",
         type=non_neg_int,
@@ -447,6 +484,16 @@ _MASTER_ONLY_FLAGS = frozenset(
         "instance_backend",
         "namespace",
         "docker_image",
+        "docker_image_repository",
+        "docker_base_image",
+        "worker_resource_request",
+        "worker_resource_limit",
+        "worker_pod_priority",
+        "master_resource_request",
+        "master_resource_limit",
+        "master_pod_priority",
+        "volume",
+        "image_pull_policy",
         "relaunch_on_worker_failure",
         "heartbeat_timeout_secs",
         "task_timeout_secs",

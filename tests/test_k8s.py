@@ -1,0 +1,371 @@
+"""Kubernetes backend: parsers, manifests, instance-manager policy.
+
+Reference test pattern (k8s_instance_manager_test.py:16-46): drive pod
+lifecycle and event handling against the API; here the API is a fake
+(the kubernetes package isn't installed), so start/relaunch/OOM-blacklist
+/reform policy is exercised hermetically — manifests are plain dicts, so
+nothing else needs the SDK.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from elasticdl_tpu.k8s import resource as k8s_resource
+from elasticdl_tpu.k8s import volume as k8s_volume
+from elasticdl_tpu.k8s.client import COORDINATOR_PORT, Client
+from elasticdl_tpu.k8s.instance_manager import K8sInstanceManager
+from elasticdl_tpu.k8s.tensorboard_client import TensorBoardClient
+
+
+class FakeApi:
+    def __init__(self):
+        self.pods: dict[str, dict] = {}
+        self.services: dict[str, dict] = {}
+        self.deleted_pods: list[str] = []
+        self.patches: list[tuple[str, dict]] = []
+
+    def create_namespaced_pod(self, namespace, manifest):
+        self.pods[manifest["metadata"]["name"]] = manifest
+        return manifest
+
+    def create_namespaced_service(self, namespace, manifest):
+        self.services[manifest["metadata"]["name"]] = manifest
+        return manifest
+
+    def read_namespaced_pod(self, name, namespace):
+        if name not in self.pods:
+            raise KeyError(name)
+        return self.pods[name]
+
+    def read_namespaced_service(self, name, namespace):
+        if name not in self.services:
+            raise KeyError(name)
+        return self.services[name]
+
+    def delete_namespaced_pod(self, name, namespace):
+        self.deleted_pods.append(name)
+        self.pods.pop(name, None)
+
+    def delete_namespaced_service(self, name, namespace):
+        self.services.pop(name, None)
+
+    def patch_namespaced_pod(self, name, namespace, body):
+        self.patches.append((name, body))
+
+
+# ---- parsers ---------------------------------------------------------------
+
+
+def test_resource_parse_and_vendor_rename():
+    parsed = k8s_resource.parse("cpu=250m,memory=32Mi,gpu=1,tpu=4")
+    assert parsed == {
+        "cpu": "250m",
+        "memory": "32Mi",
+        "nvidia.com/gpu": "1",
+        "google.com/tpu": "4",
+    }
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "cpu=abc",
+        "memory=0Mi",
+        "memory=32Zi",
+        "gpu=0",
+        "cpu=1,cpu=2",
+        "flux=7",
+        "cpu:1",
+    ],
+)
+def test_resource_parse_rejects(bad):
+    with pytest.raises(ValueError):
+        k8s_resource.parse(bad)
+
+
+def test_volume_parse_and_manifests():
+    conf = "host_path=/data,mount_path=/data;claim_name=c1,mount_path=/ckpt"
+    volumes, mounts = k8s_volume.volumes_and_mounts(conf, "pod-x")
+    assert volumes[0]["hostPath"]["path"] == "/data"
+    assert volumes[1]["persistentVolumeClaim"]["claimName"] == "c1"
+    assert [m["mountPath"] for m in mounts] == ["/data", "/ckpt"]
+    assert {v["name"] for v in volumes} == {m["name"] for m in mounts}
+
+
+@pytest.mark.parametrize(
+    "bad",
+    ["mount_path=/x", "host_path=/a", "bogus=1,mount_path=/x"],
+)
+def test_volume_parse_rejects(bad):
+    with pytest.raises(ValueError):
+        k8s_volume.parse(bad)
+
+
+# ---- client manifests ------------------------------------------------------
+
+
+def _client(api=None, event_callback=None):
+    return Client(
+        image_name="img:1",
+        namespace="ns",
+        job_name="job",
+        event_callback=event_callback,
+        api=api or FakeApi(),
+        watch=False,
+    )
+
+
+def test_pod_manifest_labels_env_owner_volume():
+    client = _client()
+    owner = {"metadata": {"name": "elasticdl-job-master", "uid": "u-123"}}
+    manifest = client.build_pod_manifest(
+        pod_name="elasticdl-job-worker-0",
+        replica_type="worker",
+        replica_index=0,
+        command=["python", "-m"],
+        args=["elasticdl_tpu.worker.main", "--worker_id", "0"],
+        resource_requests="cpu=1,memory=64Mi",
+        volume="host_path=/data,mount_path=/data",
+        envs={"JAX_PLATFORMS": "tpu"},
+        owner_pod=owner,
+    )
+    labels = manifest["metadata"]["labels"]
+    assert labels["elasticdl-job-name"] == "job"
+    assert labels["elasticdl-replica-type"] == "worker"
+    assert labels["elasticdl-replica-index"] == "0"
+    assert manifest["metadata"]["ownerReferences"][0]["uid"] == "u-123"
+    container = manifest["spec"]["containers"][0]
+    env_names = [e["name"] for e in container["env"]]
+    assert "MY_POD_IP" in env_names and "JAX_PLATFORMS" in env_names
+    assert container["resources"]["requests"]["cpu"] == "1"
+    # limits default to requests (reference behavior)
+    assert container["resources"]["limits"]["memory"] == "64Mi"
+    assert container["volumeMounts"][0]["mountPath"] == "/data"
+
+
+# ---- instance manager ------------------------------------------------------
+
+
+def _argv(worker_id, master_addr, **world):
+    argv = [
+        "elasticdl_tpu.worker.main",
+        "--worker_id",
+        str(worker_id),
+        "--master_addr",
+        master_addr,
+    ]
+    for key, value in world.items():
+        argv.extend([f"--{key}", str(value)])
+    return argv
+
+
+def _manager(api, failures=None, lockstep=False, num_workers=2, reforms=2):
+    return K8sInstanceManager(
+        num_workers=num_workers,
+        build_argv=_argv,
+        master_addr="master.ns.svc:50001",
+        image_name="img:1",
+        namespace="ns",
+        job_name="job",
+        lockstep=lockstep,
+        max_reforms=reforms,
+        on_worker_failure=(failures.append if failures is not None else None),
+        api=api,
+        watch=False,
+    )
+
+
+def test_start_workers_creates_pods_and_services():
+    api = FakeApi()
+    im = _manager(api)
+    im.start_workers()
+    assert sorted(im.worker_ids()) == [0, 1]
+    assert set(api.pods) == {
+        "elasticdl-job-worker-0",
+        "elasticdl-job-worker-1",
+    }
+    assert set(api.services) == set(api.pods)
+    # each per-pod service selects on labels its pod actually carries
+    for name, svc in api.services.items():
+        assert (
+            svc["spec"]["selector"].items()
+            <= api.pods[name]["metadata"]["labels"].items()
+        )
+    args = api.pods["elasticdl-job-worker-1"]["spec"]["containers"][0]["args"]
+    assert args[args.index("--worker_id") + 1] == "1"
+    assert args[args.index("--master_addr") + 1] == "master.ns.svc:50001"
+
+
+def test_deleted_pod_event_notifies_master_and_restart_uses_new_id():
+    api = FakeApi()
+    failures: list[int] = []
+    im = _manager(api, failures=failures)
+    im.start_workers()
+    im._event_cb(
+        {
+            "type": "DELETED",
+            "object": {
+                "kind": "Pod",
+                "metadata": {"name": "elasticdl-job-worker-0"},
+                "status": {"phase": "Running"},
+            },
+        }
+    )
+    assert failures == [0]
+    im.restart_worker(0)
+    assert sorted(im.worker_ids()) == [1, 2]
+    assert "elasticdl-job-worker-2" in api.pods
+
+
+def test_oom_killed_pod_is_blacklisted_from_relaunch():
+    api = FakeApi()
+    failures: list[int] = []
+    im = _manager(api, failures=failures)
+    im.start_workers()
+    im._event_cb(
+        {
+            "type": "MODIFIED",
+            "object": {
+                "kind": "Pod",
+                "metadata": {"name": "elasticdl-job-worker-0"},
+                "status": {
+                    "phase": "Failed",
+                    "containerStatuses": [
+                        {"state": {"terminated": {"reason": "OOMKilled"}}}
+                    ],
+                },
+            },
+        }
+    )
+    assert failures == [0]
+    im.restart_worker(0)
+    # pod deleted, NOT relaunched (reference OOM blacklist :225-240)
+    assert sorted(im.worker_ids()) == [1]
+    assert "elasticdl-job-worker-2" not in api.pods
+
+
+def test_lockstep_world_coordinator_and_reform():
+    api = FakeApi()
+    im = _manager(api, lockstep=True, reforms=1)
+    im.start_workers()
+    coordinator = f"elasticdl-job-worker-0.ns.svc:{COORDINATOR_PORT}"
+    for worker_id in (0, 1):
+        args = api.pods[f"elasticdl-job-worker-{worker_id}"]["spec"][
+            "containers"
+        ][0]["args"]
+        assert args[args.index("--coordinator_addr") + 1] == coordinator
+        assert args[args.index("--process_id") + 1] == str(worker_id)
+        assert args[args.index("--num_processes") + 1] == "2"
+
+    im.reform_world(cluster_version=1)
+    # old pods deleted; new generation under new ids + new coordinator
+    assert "elasticdl-job-worker-0" in api.deleted_pods
+    assert sorted(im.worker_ids()) == [2, 3]
+    args = api.pods["elasticdl-job-worker-2"]["spec"]["containers"][0]["args"]
+    assert (
+        args[args.index("--coordinator_addr") + 1]
+        == f"elasticdl-job-worker-2.ns.svc:{COORDINATOR_PORT}"
+    )
+    assert args[args.index("--cluster_version") + 1] == "1"
+
+    # budget: second reform still tears down, then raises
+    with pytest.raises(RuntimeError):
+        im.reform_world(cluster_version=2)
+    assert im.worker_ids() == []
+
+
+def test_stop_workers_deletes_everything():
+    api = FakeApi()
+    im = _manager(api)
+    im.start_workers()
+    im.stop_workers()
+    assert api.pods == {} and api.services == {}
+    assert im.worker_ids() == []
+
+
+# ---- submission ------------------------------------------------------------
+
+
+def test_submit_master_pod_round_trips_args():
+    from elasticdl_tpu.k8s.submit import submit_master_pod
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    api = FakeApi()
+    args = parse_master_args(
+        [
+            "--model_def",
+            "mnist_functional_api.mnist_functional_api.custom_model",
+            "--training_data",
+            "/data/train",
+            "--docker_image",
+            "img:job",
+            "--job_name",
+            "sub",
+            "--namespace",
+            "ns",
+        ]
+    )
+    out = submit_master_pod(args, api=api)
+    assert out["master_pod"] == "elasticdl-sub-master"
+    pod = api.pods["elasticdl-sub-master"]
+    container = pod["spec"]["containers"][0]
+    assert container["args"][0] == "elasticdl_tpu.master.main"
+    assert "--model_def" in container["args"]
+    # the in-cluster master creates workers from the SAME resolved image
+    argv = container["args"]
+    assert argv[argv.index("--docker_image") + 1] == "img:job"
+    # master service selects on labels the master pod actually carries
+    svc = api.services["elasticdl-sub-master"]
+    selector = svc["spec"]["selector"]
+    assert selector.items() <= pod["metadata"]["labels"].items()
+
+
+def test_submit_rewrites_model_zoo_to_image_path(tmp_path):
+    from elasticdl_tpu.k8s.submit import submit_master_pod
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    api = FakeApi()
+    args = parse_master_args(
+        [
+            "--model_def",
+            "tiny.custom_model",
+            "--model_zoo",
+            str(tmp_path / "myzoo"),
+            "--training_data",
+            "/data/train",
+            "--docker_image",
+            "img:job",
+            "--job_name",
+            "sub2",
+        ]
+    )
+    submit_master_pod(args, api=api)
+    argv = api.pods["elasticdl-sub2-master"]["spec"]["containers"][0]["args"]
+    assert argv[argv.index("--model_zoo") + 1] == "/model_zoo/myzoo"
+
+
+def test_dockerfile_synthesis(tmp_path):
+    from elasticdl_tpu.image_builder import create_dockerfile
+
+    text = create_dockerfile(str(tmp_path / "zoo"), base_image="my/base:1")
+    assert "FROM my/base:1" in text
+    assert "COPY elasticdl_tpu /framework/elasticdl_tpu" in text
+    assert f"COPY zoo /model_zoo/zoo" in text
+    assert "import jax" in text
+
+    remote = create_dockerfile("https://example.com/zoo.git")
+    assert "git clone --recursive https://example.com/zoo.git" in remote
+
+
+def test_tensorboard_service_and_ingress():
+    api = FakeApi()
+    client = _client(api=api)
+    tb = TensorBoardClient(client)
+    manifest = tb.create_tensorboard_service()
+    assert manifest["spec"]["type"] == "LoadBalancer"
+    name = manifest["metadata"]["name"]
+    api.services[name]["status"] = {
+        "loadBalancer": {"ingress": [{"ip": "1.2.3.4"}]}
+    }
+    assert tb.get_tensorboard_external_ip(max_checks=1) == "1.2.3.4"
