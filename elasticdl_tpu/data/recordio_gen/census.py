@@ -1,0 +1,169 @@
+"""UCI Adult ("census income") → EDLIO shards.
+
+Reference: ``elasticdl/python/data/recordio_gen/census_recordio_gen.py``
+downloads ``adult.data`` and writes TF-Example RecordIO.  This build
+parses a LOCAL copy of the real file format instead (no egress):
+comma-separated with optional spaces, 14 feature fields + income label,
+``?`` for missing values, label ``>50K``/``<=50K``.
+
+Schema matches the census model variants
+(:mod:`elasticdl_tpu.models.census_dnn_model`):
+
+- numeric float32: age, capital-gain, capital-loss, hours-per-week
+- categorical int64: workclass, education, marital-status, occupation,
+  relationship, race, sex, native-country (string values are stored as
+  stable sha256 ids — the framework example codec carries tensors, not
+  strings; see :func:`encode_categorical`), education-num (already
+  integral)
+- label int64 (1 = income >50K)
+
+With no ``--source``, writes the learnable synthetic facsimile with the
+same schema (``synthetic.gen_census``).
+
+Usage::
+
+    python -m elasticdl_tpu.data.recordio_gen.census OUT_DIR \
+        [--source /path/to/adult.data] [--eval_fraction 0.2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+from elasticdl_tpu.data.recordio_gen import synthetic
+from elasticdl_tpu.data.recordio_gen._writers import write_train_test_split
+from elasticdl_tpu.utils.hash_utils import string_to_id
+
+# adult.data field order (UCI "adult" names file)
+FIELDS = [
+    "age",
+    "workclass",
+    "fnlwgt",
+    "education",
+    "education-num",
+    "marital-status",
+    "occupation",
+    "relationship",
+    "race",
+    "sex",
+    "capital-gain",
+    "capital-loss",
+    "hours-per-week",
+    "native-country",
+    "label",
+]
+
+NUMERIC = list(synthetic.CENSUS_NUMERIC)
+CATEGORICAL_STR = [
+    c for c in synthetic.CENSUS_CATEGORICAL if c != "education-num"
+]
+
+# String categoricals are stored as sha256 ids mod 2**32.  A downstream
+# hashed column with a power-of-two bucket count B <= 2**32 then lands
+# each value in the SAME bucket as hashing the raw string would
+# (sha256(v) mod 2**32 mod B == sha256(v) mod B when B divides 2**32);
+# the census columns use 64 buckets, so parity holds exactly.
+_STR_ID_SPACE = 2**32
+
+
+def encode_categorical(value: str) -> np.int64:
+    return np.int64(string_to_id(value, _STR_ID_SPACE))
+
+
+def parse_line(line: str) -> dict | None:
+    """One adult.data row -> example dict (None for blank/short rows).
+
+    Missing values (``?``): numeric -> 0, categorical -> hashed "?" id
+    (a consistent bucket of its own, which is how hashed columns treat
+    any unseen token anyway).
+    """
+    parts = [p.strip() for p in line.strip().rstrip(".").split(",")]
+    if len(parts) != len(FIELDS):
+        return None
+    row = dict(zip(FIELDS, parts))
+    ex: dict[str, np.ndarray] = {}
+    for k in NUMERIC:
+        try:
+            ex[k] = np.float32(row[k])
+        except ValueError:
+            ex[k] = np.float32(0.0)
+    for k in CATEGORICAL_STR:
+        ex[k] = encode_categorical(row[k])
+    try:
+        ex["education-num"] = np.int64(row["education-num"])
+    except ValueError:
+        ex["education-num"] = np.int64(0)
+    ex["label"] = np.int64(">50K" in row["label"])
+    return ex
+
+
+def read_source(path: str) -> list[dict]:
+    examples = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            ex = parse_line(line)
+            if ex is not None:
+                examples.append(ex)
+    if not examples:
+        raise ValueError(f"no parseable adult.data rows in {path}")
+    return examples
+
+
+def generate(
+    out_dir: str,
+    source: str | None = None,
+    eval_fraction: float = 0.2,
+    records_per_shard: int = 8192,
+    num_records: int = 8192,
+    seed: int = 0,
+) -> str:
+    if source:
+        return write_train_test_split(
+            out_dir,
+            read_source(source),
+            eval_fraction,
+            seed=seed,
+            records_per_shard=records_per_shard,
+        )
+    synthetic.gen_census(
+        os.path.join(out_dir, "train"), num_records=num_records, seed=seed
+    )
+    synthetic.gen_census(
+        os.path.join(out_dir, "test"),
+        num_records=max(256, num_records // 8),
+        num_shards=1,
+        seed=seed + 1,
+    )
+    return out_dir
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("dir", help="Output directory")
+    p.add_argument(
+        "--source",
+        default=None,
+        help="Local adult.data file (omit for the synthetic facsimile)",
+    )
+    p.add_argument("--eval_fraction", type=float, default=0.2)
+    p.add_argument("--records_per_shard", type=int, default=8192)
+    p.add_argument("--num_records", type=int, default=8192)
+    a = p.parse_args(argv)
+    print(
+        generate(
+            a.dir,
+            source=a.source,
+            eval_fraction=a.eval_fraction,
+            records_per_shard=a.records_per_shard,
+            num_records=a.num_records,
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,141 @@
+"""Frappe (libfm format) → EDLIO shards for the DeepFM models.
+
+Reference: ``elasticdl/python/data/recordio_gen/frappe_recordio_gen.py``
+downloads ``frappe.{train,validation,test}.libfm`` and writes RecordIO.
+This build parses LOCAL copies of the real libfm format instead (no
+egress): one example per line, ``label idx:val idx:val ...`` — raw
+feature indices are remapped to a dense contiguous id space built over
+ALL splits (the reference's feature map), and each row is padded with id
+0 to the corpus-wide max feature count.
+
+Schema matches the deepfm models: ``feature`` int64 [maxlen], ``label``
+int64 (the reference maps label -1/0 -> 0).
+
+With no ``--source``, writes the learnable synthetic facsimile
+(``synthetic.gen_frappe``: 10 ids per row, vocab 5383 — the real
+frappe's shape).
+
+Usage::
+
+    python -m elasticdl_tpu.data.recordio_gen.frappe OUT_DIR \
+        [--source /dir/with/frappe.train.libfm ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+from elasticdl_tpu.data.recordio_gen import synthetic
+from elasticdl_tpu.data.recordio_gen._writers import write_shards
+
+SPLITS = ("train", "validation", "test")
+
+
+def _split_file(source_dir: str, split: str) -> str | None:
+    for name in (f"frappe.{split}.libfm", f"{split}.libfm"):
+        path = os.path.join(source_dir, name)
+        if os.path.exists(path):
+            return path
+    return None
+
+
+def parse_libfm(path: str) -> tuple[list[int], list[list[int]]]:
+    """One libfm file -> (labels, raw-id rows)."""
+    labels, rows = [], []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            parts = line.split()
+            if not parts:
+                continue
+            labels.append(1 if float(parts[0]) > 0 else 0)
+            rows.append([int(tok.split(":")[0]) for tok in parts[1:]])
+    return labels, rows
+
+
+def build_feature_map(all_rows) -> dict[int, int]:
+    """Raw feature index -> dense id, 1-based (0 is the pad id) — the
+    reference builds the same corpus-wide remap before conversion."""
+    fmap: dict[int, int] = {}
+    for rows in all_rows:
+        for row in rows:
+            for raw in row:
+                if raw not in fmap:
+                    fmap[raw] = len(fmap) + 1
+    return fmap
+
+
+def _examples(labels, rows, fmap, maxlen):
+    for label, row in zip(labels, rows):
+        ids = np.zeros(maxlen, dtype=np.int64)
+        mapped = [fmap[r] for r in row]
+        ids[: len(mapped)] = mapped
+        yield {"feature": ids, "label": np.int64(label)}
+
+
+def generate(
+    out_dir: str,
+    source: str | None = None,
+    records_per_shard: int = 16 * 1024,
+    num_records: int = 8192,
+    seed: int = 0,
+) -> str:
+    if source:
+        parsed = {}
+        for split in SPLITS:
+            path = _split_file(source, split)
+            if path:
+                parsed[split] = parse_libfm(path)
+        if not parsed:
+            raise ValueError(f"no frappe libfm files under {source}")
+        fmap = build_feature_map(rows for _, rows in parsed.values())
+        maxlen = max(
+            len(row) for _, rows in parsed.values() for row in rows
+        )
+        for split, (labels, rows) in parsed.items():
+            write_shards(
+                os.path.join(out_dir, split),
+                _examples(labels, rows, fmap, maxlen),
+                records_per_shard,
+            )
+        return out_dir
+    synthetic.gen_frappe(
+        os.path.join(out_dir, "train"), num_records=num_records, seed=seed
+    )
+    synthetic.gen_frappe(
+        os.path.join(out_dir, "test"),
+        num_records=max(256, num_records // 8),
+        num_shards=1,
+        seed=seed + 1,
+    )
+    return out_dir
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("dir", help="Output directory")
+    p.add_argument(
+        "--source",
+        default=None,
+        help="Local dir with frappe.{train,validation,test}.libfm "
+        "(omit for the synthetic facsimile)",
+    )
+    p.add_argument("--records_per_shard", type=int, default=16 * 1024)
+    p.add_argument("--num_records", type=int, default=8192)
+    a = p.parse_args(argv)
+    print(
+        generate(
+            a.dir,
+            source=a.source,
+            records_per_shard=a.records_per_shard,
+            num_records=a.num_records,
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

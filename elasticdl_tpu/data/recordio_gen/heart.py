@@ -1,0 +1,115 @@
+"""Cleveland heart-disease CSV → EDLIO shards.
+
+Reference: ``elasticdl/python/data/recordio_gen/heart_recordio_gen.py``
+downloads ``heart.csv`` (header row; 13 features + ``target``; ``thal``
+is a string categorical) and writes TF-Example RecordIO.  This build
+parses a LOCAL copy of the same CSV instead (no egress).
+
+Schema matches :mod:`elasticdl_tpu.models.heart_functional_api`: all
+numeric columns float32, ``thal`` stored as a stable sha256 id (the
+example codec carries tensors, not strings — same encoding note as
+:mod:`.census`), ``target`` int64.
+
+With no ``--source``, writes the learnable synthetic facsimile
+(``synthetic.gen_heart``).
+
+Usage::
+
+    python -m elasticdl_tpu.data.recordio_gen.heart OUT_DIR \
+        [--source /path/to/heart.csv] [--eval_fraction 0.2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import sys
+
+import numpy as np
+
+from elasticdl_tpu.data.recordio_gen import synthetic
+from elasticdl_tpu.data.recordio_gen._writers import write_train_test_split
+from elasticdl_tpu.data.recordio_gen.census import encode_categorical
+
+LABEL_KEY = "target"
+# the one string-valued column; everything else is numeric, where any
+# unparsable token (the raw Cleveland data marks missing values '?') is
+# a missing value, NOT a category — it must become 0.0, never a hash id
+CATEGORICAL_KEYS = frozenset({"thal"})
+
+
+def parse_row(row: dict) -> dict:
+    ex: dict[str, np.ndarray] = {}
+    for key, value in row.items():
+        key = key.strip()
+        value = value.strip()
+        if key == LABEL_KEY:
+            ex[key] = np.int64(value)
+        elif key in CATEGORICAL_KEYS:
+            # kept int64 so the hashed column's mod-bucketing sees exact
+            # ids (thal: fixed/normal/reversible)
+            ex[key] = encode_categorical(value)
+        else:
+            try:
+                ex[key] = np.float32(value)
+            except ValueError:
+                ex[key] = np.float32(0.0)
+    return ex
+
+
+def read_source(path: str) -> list[dict]:
+    with open(path, "r", encoding="utf-8", newline="") as f:
+        rows = [parse_row(r) for r in csv.DictReader(f)]
+    if not rows:
+        raise ValueError(f"no csv rows in {path}")
+    return rows
+
+
+def generate(
+    out_dir: str,
+    source: str | None = None,
+    eval_fraction: float = 0.2,
+    num_records: int = 2048,
+    seed: int = 0,
+) -> str:
+    if source:
+        return write_train_test_split(
+            out_dir, read_source(source), eval_fraction, seed=seed
+        )
+    synthetic.gen_heart(
+        os.path.join(out_dir, "train"), num_records=num_records, seed=seed
+    )
+    synthetic.gen_heart(
+        os.path.join(out_dir, "test"),
+        num_records=max(256, num_records // 8),
+        num_shards=1,
+        seed=seed + 1,
+    )
+    return out_dir
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("dir", help="Output directory")
+    p.add_argument(
+        "--source",
+        default=None,
+        help="Local heart.csv (omit for the synthetic facsimile)",
+    )
+    p.add_argument("--eval_fraction", type=float, default=0.2)
+    p.add_argument("--num_records", type=int, default=2048)
+    a = p.parse_args(argv)
+    print(
+        generate(
+            a.dir,
+            source=a.source,
+            eval_fraction=a.eval_fraction,
+            num_records=a.num_records,
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

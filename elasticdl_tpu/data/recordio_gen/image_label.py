@@ -30,8 +30,7 @@ import sys
 
 import numpy as np
 
-from elasticdl_tpu.data import recordio
-from elasticdl_tpu.data.reader import encode_example
+from elasticdl_tpu.data.recordio_gen._writers import write_shards
 from elasticdl_tpu.utils.log_utils import default_logger as logger
 
 # canonical IDX file basenames per split (gz or raw)
@@ -121,38 +120,20 @@ def convert(
     (reference convert(), image_label.py:12-58)."""
     if len(x) != len(y):
         raise ValueError(f"images/labels length mismatch: {len(x)}/{len(y)}")
-    os.makedirs(out_dir, exist_ok=True)
     total = int(len(x) * fraction)
-    written = 0
-    shard = 0
-    writer = None
-    try:
-        for row in range(total):
-            if written % records_per_shard == 0:
-                if writer is not None:
-                    writer.close()
-                path = os.path.join(out_dir, f"data-{shard:05d}.edlio")
-                logger.info("Writing %s ...", path)
-                writer = recordio.Writer(path)
-                shard += 1
-            writer.write(
-                encode_example(
-                    {
-                        "image": np.asarray(x[row], dtype=np.uint8),
-                        "label": np.int64(np.asarray(y[row]).reshape(())),
-                    }
-                )
-            )
-            written += 1
-    finally:
-        if writer is not None:
-            writer.close()
-    logger.info(
-        "Wrote %d of %d records into %d shards under %s",
-        written,
-        len(x),
-        shard,
+    written = write_shards(
         out_dir,
+        (
+            {
+                "image": np.asarray(x[row], dtype=np.uint8),
+                "label": np.int64(np.asarray(y[row]).reshape(())),
+            }
+            for row in range(total)
+        ),
+        records_per_shard,
+    )
+    logger.info(
+        "Wrote %d of %d records under %s", written, len(x), out_dir
     )
     return written
 
