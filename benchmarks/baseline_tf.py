@@ -1,0 +1,185 @@
+"""Reproducible reference baseline: the reference's training-loop design
+(TF2 ``tf.function`` GradientTape step, the worker hot path of
+``elasticdl/python/worker/worker.py:656-669``) for the three benchmark
+models, measured on this host's CPU (the reference trains on CPU pods —
+its base image is ``tensorflow/tensorflow:2.0.0-py3``,
+``image_builder.py:206-208``).
+
+Writes per-model samples/sec to ``benchmarks/baseline.json``; ``bench.py``
+reads that file for its ``vs_baseline`` anchors.  Run::
+
+    python benchmarks/baseline_tf.py [--steps 20] [--out benchmarks/baseline.json]
+
+The Keras models mirror the reference model_zoo architectures
+(``model_zoo/mnist_functional_api``, ``model_zoo/resnet50_subclass`` at
+cifar10 shapes, ``model_zoo/deepfm_functional_api``) — same layer stacks
+and batch sizes as the JAX side of ``bench.py``, so the comparison is
+design-vs-design on identical math.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# The measurement is CPU-by-design (see module docstring); hide any
+# accelerator so TF cannot grab it (overriding, not defaulting — a
+# scheduler-exported CUDA_VISIBLE_DEVICES must not re-enable a GPU).
+os.environ["CUDA_VISIBLE_DEVICES"] = "-1"
+
+import tensorflow as tf  # noqa: E402
+
+# identical batch sizes to bench.py's JAX side (the vs_baseline ratios
+# must compare the same configuration)
+BATCHES = {"mnist": 256, "resnet50_cifar10": 256, "deepfm": 512}
+
+
+def mnist_model():
+    inputs = tf.keras.Input(shape=(28, 28), name="image")
+    x = tf.keras.layers.Reshape((28, 28, 1))(inputs)
+    x = tf.keras.layers.Conv2D(32, (3, 3), activation="relu")(x)
+    x = tf.keras.layers.Conv2D(64, (3, 3), activation="relu")(x)
+    x = tf.keras.layers.BatchNormalization()(x)
+    x = tf.keras.layers.MaxPooling2D((2, 2))(x)
+    x = tf.keras.layers.Dropout(0.25)(x)
+    x = tf.keras.layers.Flatten()(x)
+    outputs = tf.keras.layers.Dense(10)(x)
+    model = tf.keras.Model(inputs, outputs)
+    loss = lambda labels, logits: tf.reduce_mean(  # noqa: E731
+        tf.nn.sparse_softmax_cross_entropy_with_logits(
+            labels=labels, logits=logits
+        )
+    )
+    return model, loss
+
+
+def resnet50_model():
+    model = tf.keras.applications.ResNet50(
+        weights=None, input_shape=(32, 32, 3), classes=10
+    )
+    loss = lambda labels, probs: tf.reduce_mean(  # noqa: E731
+        tf.keras.losses.sparse_categorical_crossentropy(labels, probs)
+    )
+    return model, loss
+
+
+class DeepFMBaseline(tf.keras.Model):
+    """Subclassed (Keras-3-safe) DeepFM: embedding + bias tables, FM
+    second-order term, flatten->Dense(64)->Dense(1) deep tower."""
+
+    def __init__(self, input_dim=5383, embedding_dim=64):
+        super().__init__()
+        self.emb = tf.keras.layers.Embedding(input_dim, embedding_dim)
+        self.bias = tf.keras.layers.Embedding(input_dim, 1)
+        self.flatten = tf.keras.layers.Flatten()
+        self.fc = tf.keras.layers.Dense(64)
+        self.out = tf.keras.layers.Dense(1)
+
+    def call(self, ids, training=False):
+        # identical math to elasticdl_tpu/models/deepfm_functional_api.py:
+        # mask_zero on id 0, no activation on the deep tower
+        mask = tf.cast(tf.not_equal(ids, 0), tf.float32)
+        emb = self.emb(ids) * mask[..., None]
+        first = tf.reduce_sum(
+            tf.squeeze(self.bias(ids), -1) * mask, -1
+        )
+        sum_sq = tf.square(tf.reduce_sum(emb, 1))
+        sq_sum = tf.reduce_sum(tf.square(emb), 1)
+        fm = 0.5 * tf.reduce_sum(sum_sq - sq_sum, -1)
+        deep = tf.squeeze(self.out(self.fc(self.flatten(emb))), -1)
+        return first + fm + deep
+
+
+def deepfm_model():
+    loss = lambda labels, logits: tf.reduce_mean(  # noqa: E731
+        tf.nn.sigmoid_cross_entropy_with_logits(
+            labels=tf.cast(labels, tf.float32), logits=logits
+        )
+    )
+    return DeepFMBaseline(), loss
+
+
+def make_batch(name, rng):
+    b = BATCHES[name]
+    if name == "mnist":
+        return (
+            tf.constant(rng.rand(b, 28, 28).astype(np.float32)),
+            tf.constant(rng.randint(0, 10, b).astype(np.int32)),
+        )
+    if name == "resnet50_cifar10":
+        return (
+            tf.constant(rng.rand(b, 32, 32, 3).astype(np.float32)),
+            tf.constant(rng.randint(0, 10, b).astype(np.int32)),
+        )
+    return (
+        tf.constant(rng.randint(0, 5383, (b, 10)).astype(np.int32)),
+        tf.constant(rng.randint(0, 2, b).astype(np.int32)),
+    )
+
+
+MODELS = {
+    "mnist": mnist_model,
+    "resnet50_cifar10": resnet50_model,
+    "deepfm": deepfm_model,
+}
+
+
+def measure(name, steps, warmup=3):
+    model, loss_fn = MODELS[name]()
+    opt = tf.keras.optimizers.SGD(0.1)
+    features, labels = make_batch(name, np.random.RandomState(0))
+
+    @tf.function
+    def train_step(features, labels):
+        with tf.GradientTape() as tape:
+            outputs = model(features, training=True)
+            loss = loss_fn(labels, outputs)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        return loss
+
+    for _ in range(warmup):
+        train_step(features, labels)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = train_step(features, labels)
+    _ = float(loss)  # sync
+    dt = time.perf_counter() - t0
+    return steps * BATCHES[name] / dt
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument(
+        "--out",
+        default=os.path.join(os.path.dirname(__file__), "baseline.json"),
+    )
+    p.add_argument("--models", nargs="*", default=sorted(MODELS))
+    args = p.parse_args(argv)
+
+    results = {}
+    for name in args.models:
+        sps = measure(name, args.steps)
+        results[name] = round(sps, 1)
+        print(f"{name}: {sps:.1f} samples/sec", file=sys.stderr)
+    payload = {
+        "design": "tf2 tf.function GradientTape step, host CPU",
+        "tf_version": tf.__version__,
+        "batch_sizes": BATCHES,
+        "samples_per_sec": results,
+    }
+    with open(args.out, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(payload))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -223,8 +223,10 @@ def _add_train_params(parser: argparse.ArgumentParser):
         type=pos_int,
         default=1,
         help=(
-            "Apply gradients locally for N steps between global syncs "
-            "(local-SGD; reference worker.py:179-182)"
+            "Accepted for compatibility with the reference's local-SGD "
+            "mode (pull model from PS every N minibatches, reference "
+            "worker.py:179-182); the TPU build syncs every step — see "
+            "the deviation warning when set >1"
         ),
     )
     parser.add_argument(
@@ -441,6 +443,20 @@ def _finalize(args: argparse.Namespace) -> argparse.Namespace:
             "trains synchronously (gradient psum over ICI); async staleness "
             "semantics do not apply"
         )
+    if getattr(args, "get_model_steps", 1) > 1:
+        # Documented deviation: the reference's local-SGD exists to
+        # amortize PS pull/push round-trips over slow pod networks
+        # (worker.py:179-182,274-282); here gradient sync is the psum
+        # GSPMD derives from shardings, riding ICI — per-step sync is
+        # already cheaper than the divergent-replica bookkeeping
+        # local-SGD would need (params stacked over dp inside the step).
+        logger.warning(
+            "--get_model_steps=%d is accepted for compatibility but the "
+            "TPU build synchronizes gradients every step over ICI; "
+            "local-SGD does not apply (coerced to 1)",
+            args.get_model_steps,
+        )
+        args.get_model_steps = 1
     if args.model_params:
         args.model_params_dict = parse_params_dict(args.model_params)
     else:

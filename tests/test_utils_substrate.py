@@ -154,6 +154,14 @@ class TestArgs:
         )
         assert args.grads_to_wait == 1
 
+    def test_get_model_steps_coerced_to_sync(self):
+        """Documented deviation: local-SGD does not apply over ICI; the
+        flag is accepted (reference CLI parity) and coerced to 1."""
+        args = args_mod.parse_master_args(
+            self._master_argv(["--get_model_steps", "4"])
+        )
+        assert args.get_model_steps == 1
+
     def test_worker_argv_roundtrip(self):
         """Master argv -> worker argv -> reparse must preserve train flags
         (reference args.py:664-685)."""
