@@ -203,6 +203,10 @@ class TaskDispatcher:
 
     def get_eval_task(self, worker_id: int) -> tuple[int, Task | None]:
         with self._lock:
+            # reclaim here too, not only in get(): an EVALUATION_ONLY job
+            # has no training pulls, so this is the only place an expired
+            # eval lease can ever be re-queued
+            self._reclaim_expired_locked()
             if not self._pending_eval:
                 return -1, None
             task = self._pending_eval.pop()
