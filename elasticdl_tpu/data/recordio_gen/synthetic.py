@@ -209,8 +209,38 @@ def gen_iris(
     return _write_shards(out_dir, "iris", examples, num_shards)
 
 
+def gen_sequence(
+    out_dir: str,
+    num_records: int = 1024,
+    num_shards: int = 2,
+    seed: int = 0,
+    seq_len: int = 128,
+    vocab: int = 256,
+    noise: float = 0.05,
+):
+    """Token sequences for the long-context transformer: a fixed random
+    permutation Markov chain (next = perm[cur], flipped to a random token
+    with prob ``noise``), so next-token prediction is learnable to
+    ~(1 - noise) accuracy.  Records carry seq_len + 1 tokens; dataset_fn
+    shifts them into (input, target) pairs."""
+    perm = np.random.RandomState(1234).permutation(vocab)
+    rng = np.random.RandomState(seed)
+    examples = []
+    for _ in range(num_records):
+        tokens = np.empty(seq_len + 1, dtype=np.int64)
+        tokens[0] = rng.randint(vocab)
+        for t in range(1, seq_len + 1):
+            if rng.rand() < noise:
+                tokens[t] = rng.randint(vocab)
+            else:
+                tokens[t] = perm[tokens[t - 1]]
+        examples.append({"tokens": tokens})
+    return _write_shards(out_dir, "sequence", examples, num_shards)
+
+
 GENERATORS = {
     "mnist": gen_mnist,
+    "sequence": gen_sequence,
     "cifar10": gen_cifar10,
     "frappe": gen_frappe,
     "census": gen_census,

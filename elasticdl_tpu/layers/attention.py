@@ -1,0 +1,80 @@
+"""Flax attention layer over the framework kernels.
+
+``MultiHeadSelfAttention`` projects QKV and dispatches through
+:func:`elasticdl_tpu.ops.attention`: ring attention when the trainer's
+mesh has an ``sp`` axis > 1 (sequence sharded across devices), else the
+pallas flash kernel.  The layer itself is sharding-agnostic — GSPMD lays
+out the projections; only the attention inner product needs the explicit
+ring schedule.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+import elasticdl_tpu.ops.attention as attention_ops
+
+
+class MultiHeadSelfAttention(nn.Module):
+    num_heads: int
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        """x: (batch, seq, embed) -> (batch, seq, embed)."""
+        embed = x.shape[-1]
+        if embed % self.num_heads:
+            raise ValueError(
+                f"embed dim {embed} not divisible by {self.num_heads} heads"
+            )
+        head_dim = embed // self.num_heads
+
+        def _proj(name):
+            return nn.DenseGeneral(
+                features=(self.num_heads, head_dim), name=name
+            )(x)
+
+        q, k, v = _proj("query"), _proj("key"), _proj("value")
+        out = attention_ops.attention(q, k, v, causal=self.causal)
+        return nn.DenseGeneral(
+            features=embed, axis=(-2, -1), name="out"
+        )(out.astype(x.dtype))
+
+
+class TransformerBlock(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    causal: bool = False
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        y = nn.LayerNorm()(x)
+        y = MultiHeadSelfAttention(
+            num_heads=self.num_heads, causal=self.causal, name="attn"
+        )(y)
+        if self.dropout_rate:
+            y = nn.Dropout(self.dropout_rate, deterministic=not training)(y)
+        x = x + y
+        y = nn.LayerNorm()(x)
+        y = nn.Dense(x.shape[-1] * self.mlp_ratio)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(x.shape[-1])(y)
+        if self.dropout_rate:
+            y = nn.Dropout(self.dropout_rate, deterministic=not training)(y)
+        return x + y
+
+
+def sinusoidal_positions(seq_len: int, dim: int) -> jnp.ndarray:
+    """Fixed sinusoidal position encoding (seq, dim) — parameter-free, so
+    a sequence-sharded activation needs no position-table gather."""
+    pos = jnp.arange(seq_len)[:, None].astype(jnp.float32)
+    div = jnp.exp(
+        jnp.arange(0, dim, 2).astype(jnp.float32)
+        * (-jnp.log(10000.0) / dim)
+    )
+    enc = jnp.zeros((seq_len, dim), jnp.float32)
+    enc = enc.at[:, 0::2].set(jnp.sin(pos * div))
+    enc = enc.at[:, 1::2].set(jnp.cos(pos * div))
+    return enc

@@ -1,0 +1,93 @@
+"""Long-context causal transformer LM — the sequence-parallel flagship.
+
+No reference counterpart (the reference zoo is CNN/DNN/FM recommenders,
+SURVEY §2.10); this model exists because long-context training is a
+first-class capability of the TPU build: its attention dispatches to the
+pallas flash kernel on one device and to ring attention over the ``sp``
+mesh axis when the sequence is sharded (``--mesh_shape dp=2,sp=4``).
+
+Spec contract is the standard model-zoo surface (custom_model /
+dataset_fn / loss / optimizer / eval_metrics_fn), so the same CLI trains
+it: records are token sequences (``synthetic.gen_sequence``), the task
+is next-token prediction.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.data.reader import decode_example
+from elasticdl_tpu.layers.attention import (
+    TransformerBlock,
+    sinusoidal_positions,
+)
+from elasticdl_tpu.trainer.metrics import Accuracy
+from elasticdl_tpu.trainer.state import Modes
+
+VOCAB = 256
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int = VOCAB
+    embed_dim: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        tokens = (
+            features["tokens"] if isinstance(features, dict) else features
+        )
+        tokens = jnp.asarray(tokens).astype(jnp.int32)
+        x = nn.Embed(self.vocab_size, self.embed_dim, name="tok_embed")(
+            tokens
+        )
+        # parameter-free positions: a sequence-sharded activation adds its
+        # slice of the encoding without any table gather
+        x = x + sinusoidal_positions(tokens.shape[1], self.embed_dim)[
+            None, :, :
+        ].astype(x.dtype)
+        for layer in range(self.num_layers):
+            x = TransformerBlock(
+                num_heads=self.num_heads,
+                causal=True,
+                dropout_rate=self.dropout_rate,
+                name=f"block_{layer}",
+            )(x, training=training)
+        x = nn.LayerNorm()(x)
+        return nn.Dense(self.vocab_size, name="lm_head")(x)
+
+
+def custom_model(**kwargs):
+    return TransformerLM(**kwargs)
+
+
+def loss(labels, logits):
+    labels = jnp.asarray(labels).astype(jnp.int32)
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels
+    ).mean()
+
+
+def optimizer(lr=3e-3):
+    return optax.adam(lr)
+
+
+def dataset_fn(dataset, mode, metadata):
+    def _parse(record):
+        ex = decode_example(record)
+        tokens = ex["tokens"].astype(np.int32)
+        feats = {"tokens": tokens[:-1]}
+        if mode == Modes.PREDICTION:
+            return feats
+        return feats, tokens[1:]
+
+    return dataset.map(_parse)
+
+
+def eval_metrics_fn():
+    return {"accuracy": Accuracy()}

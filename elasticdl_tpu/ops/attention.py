@@ -1,0 +1,254 @@
+"""Attention kernels: pallas flash attention for the MXU + dispatch.
+
+The reference framework has no attention anywhere (its models are
+CNN/DNN/FM recommenders, SURVEY §2.10); long-context support is a
+first-class requirement of the TPU build, so this module provides the
+single-device half of it — a blockwise online-softmax (flash) kernel
+that never materializes the (S, S) score matrix in HBM — and
+:mod:`.ring_attention` provides the cross-device half over the ``sp``
+mesh axis.
+
+Layout convention everywhere: ``(batch, seq, heads, head_dim)`` — seq at
+dim 1 matches ``parallel.sharding.batch_sharding(sp_dim=1)`` so the same
+batch placement shards sequence over ``sp``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+# ---- mesh context (set by the trainer, read by layers) ---------------------
+
+# process-global, NOT thread-local: one mesh per worker process (the SPMD
+# model), and jit tracing may happen on a different thread than trainer
+# construction
+_mesh_context: list = [None, "sp"]
+
+
+def set_attention_mesh(mesh, sp_axis: str = "sp"):
+    """Register the mesh attention layers should use for sequence
+    parallelism.  A ``None`` mesh (or an ``sp`` axis of size 1) makes
+    :func:`attention` run the local kernel and lets GSPMD handle any
+    sharding.  SPMDTrainer scopes this around every step call via
+    :func:`attention_mesh_scope` — two trainers with different meshes in
+    one process (bench, dryrun) must not see each other's mesh at
+    (re)trace time."""
+    _mesh_context[0] = mesh
+    _mesh_context[1] = sp_axis
+
+
+def get_attention_mesh():
+    return _mesh_context[0], _mesh_context[1]
+
+
+@contextlib.contextmanager
+def attention_mesh_scope(mesh, sp_axis: str = "sp"):
+    """Set-and-restore the attention mesh: tracing inside the scope (jit
+    retraces on new shapes happen at call time) reads this mesh."""
+    prev = (_mesh_context[0], _mesh_context[1])
+    set_attention_mesh(mesh, sp_axis)
+    try:
+        yield
+    finally:
+        _mesh_context[0], _mesh_context[1] = prev
+
+
+# ---- reference (jnp) -------------------------------------------------------
+
+
+def mha_reference(q, k, v, causal: bool = False, sm_scale: float | None = None):
+    """Plain multi-head attention, (B, S, H, D) layout — the numerical
+    oracle for the kernels and the CPU fallback."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    )
+    scores = scores * sm_scale
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
+        scores = jnp.where(row >= col, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ---- pallas flash kernel ---------------------------------------------------
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_q, block_k
+):
+    """One (batch*head, q-block) program: stream K/V blocks through an
+    online softmax.  m/l/acc are loop carries (values, not scratch), so
+    the kernel needs no cross-program accumulation."""
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, D)
+    seq_k = k_ref.shape[1]
+    num_kb = seq_k // block_k
+    i = pl.program_id(1)
+
+    def body(j, carry):
+        acc, m, l = carry
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ()))
+        )  # (block_q, block_k)
+        if causal:
+            row = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            col = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(row >= col, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + p.sum(axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot(p, vb)
+        return acc_new, m_new, l_new
+
+    d = q_ref.shape[-1]
+    init = (
+        jnp.zeros((block_q, d), jnp.float32),
+        jnp.full((block_q,), _NEG_INF, jnp.float32),
+        jnp.zeros((block_q,), jnp.float32),
+    )
+    if causal:
+        # blocks strictly above the diagonal contribute nothing: stop at
+        # the last block that intersects this q-block's rows
+        num_kb_live = jnp.minimum(
+            num_kb, ((i + 1) * block_q + block_k - 1) // block_k
+        )
+    else:
+        num_kb_live = num_kb
+    acc, _m, l = jax.lax.fori_loop(0, num_kb_live, body, init)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _pick_block(size: int, preferred: int) -> int:
+    block = min(preferred, size)
+    while size % block:
+        block //= 2
+    return max(block, 1)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    sm_scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """Blockwise flash attention, (B, S, H, D) layout.
+
+    ``interpret=None`` auto-selects the pallas interpreter off-TPU (CPU
+    tests run the same kernel code path the TPU compiles).
+
+    Differentiable via custom_vjp: the forward runs the pallas kernel;
+    the backward recomputes attention in plain jnp and differentiates
+    that (O(S^2) memory in backward only).  Long-context TRAINING should
+    shard the sequence over ``sp`` — the ring path is blockwise in both
+    directions per device.
+    """
+    return _flash_forward(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret
+    )
+
+
+def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    q, k, v = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    batch, seq_q, heads, d = q.shape
+    seq_k = k.shape[1]
+    block_q = _pick_block(seq_q, block_q)
+    block_k = _pick_block(seq_k, block_k)
+
+    # (B, S, H, D) -> (B*H, S, D) for a 2-D grid over (bh, q-block)
+    def _fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(
+            batch * heads, x.shape[1], d
+        )
+
+    qf, kf, vf = _fold(q), _fold(k), _fold(v)
+    kernel = functools.partial(
+        _flash_kernel,
+        sm_scale=sm_scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(batch * heads, seq_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((batch * heads, seq_q, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(batch, heads, seq_q, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out = _flash_forward(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret
+    )
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: mha_reference(q, k, v, causal, sm_scale), q, k, v
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# ---- dispatch --------------------------------------------------------------
+
+
+def attention(q, k, v, causal: bool = False, sm_scale: float | None = None):
+    """Self-attention entry point for layers: ring attention when the
+    registered mesh has an ``sp`` axis > 1 (sequence sharded across
+    devices), else the local flash kernel."""
+    from elasticdl_tpu.ops.ring_attention import ring_attention
+
+    mesh, sp_axis = get_attention_mesh()
+    if (
+        mesh is not None
+        and sp_axis in mesh.axis_names
+        and mesh.shape[sp_axis] > 1
+    ):
+        return ring_attention(
+            q, k, v, mesh=mesh, axis_name=sp_axis, causal=causal,
+            sm_scale=sm_scale,
+        )
+    return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)

@@ -21,6 +21,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
+from elasticdl_tpu.ops.attention import attention_mesh_scope
 from elasticdl_tpu.parallel import elastic
 from elasticdl_tpu.parallel import sharding as sharding_lib
 from elasticdl_tpu.parallel.mesh import batch_divisor
@@ -84,7 +85,7 @@ class SPMDTrainer:
         self.state_shardings = sharding_lib.specs_to_shardings(
             self.state_specs, mesh
         )
-        with mesh:
+        with mesh, attention_mesh_scope(mesh):
             self.state = jax.jit(
                 create_state, out_shardings=self.state_shardings
             )()
@@ -113,8 +114,12 @@ class SPMDTrainer:
 
     def _batch_sharding(self, ndim: int) -> NamedSharding:
         if ndim not in self._batch_shardings_cache:
+            # a mesh with sp > 1 means the user chose sequence
+            # parallelism: dim 1 of every rank>=2 batch array is the
+            # sequence dim (the framework layout convention) and shards
+            # over sp; batch_sharding ignores sp_dim on sp=1 meshes
             self._batch_shardings_cache[ndim] = sharding_lib.batch_sharding(
-                self.mesh, ndim
+                self.mesh, ndim, sp_dim=1 if ndim >= 2 else None
             )
         return self._batch_shardings_cache[ndim]
 
@@ -166,18 +171,18 @@ class SPMDTrainer:
     # ---- steps ------------------------------------------------------------
 
     def train_step(self, features, labels):
-        with self.mesh:
+        with self.mesh, attention_mesh_scope(self.mesh):
             self.state, metrics = self._train_step(
                 self.state, features, labels
             )
         return metrics
 
     def eval_step(self, features, labels):
-        with self.mesh:
+        with self.mesh, attention_mesh_scope(self.mesh):
             return self._eval_step(self.state, features, labels)
 
     def predict_step(self, features):
-        with self.mesh:
+        with self.mesh, attention_mesh_scope(self.mesh):
             return self._predict_step(self.state, features)
 
     @property

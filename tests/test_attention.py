@@ -1,0 +1,193 @@
+"""Attention stack: pallas flash kernel, ring attention over sp, and the
+long-context transformer model (no reference counterpart — long-context
+sequence parallelism is a first-class TPU-build capability).
+
+All kernel tests compare against the jnp oracle ``mha_reference``; ring
+attention runs on the virtual 8-device mesh with the sequence sharded
+over sp (the pallas kernel runs in interpreter mode on CPU — same code
+path the TPU compiles).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from elasticdl_tpu.ops.attention import (
+    attention,
+    flash_attention,
+    mha_reference,
+    set_attention_mesh,
+)
+from elasticdl_tpu.ops.ring_attention import ring_attention
+from elasticdl_tpu.parallel.mesh import MeshConfig
+
+
+@pytest.fixture(autouse=True)
+def _reset_attention_mesh():
+    yield
+    set_attention_mesh(None)
+
+
+def _qkv(b=2, s=128, h=2, d=32, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: rng.randn(b, s, h, d).astype(np.float32)  # noqa: E731
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference(causal):
+    q, k, v = _qkv()
+    ref = mha_reference(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_flash_gradients_match_reference():
+    """custom_vjp: flash forward + reference-math backward must produce
+    the same gradients as differentiating the oracle directly."""
+    q, k, v = _qkv(b=1, s=64, h=2, d=16)
+
+    def loss_fl(q, k, v):
+        return (flash_attention(q, k, v, causal=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (mha_reference(q, k, v, causal=True) ** 2).sum()
+
+    g_fl = jax.grad(loss_fl, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fl, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
+        )
+
+
+def test_flash_handles_non_divisible_blocks():
+    # seq 96 with preferred block 128 -> _pick_block falls back to a divisor
+    q, k, v = _qkv(s=96)
+    out = flash_attention(q, k, v)
+    ref = mha_reference(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_reference_on_sp_mesh(causal):
+    q, k, v = _qkv()
+    mesh = MeshConfig.from_string("dp=2,sp=4").create()
+    ref = mha_reference(q, k, v, causal=causal)
+    out = ring_attention(q, k, v, mesh=mesh, axis_name="sp", causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_ring_with_sharded_inputs_under_jit():
+    """Ring attention composes with GSPMD: seq-sharded inputs go in, the
+    shard_map runs inside jit, and no all-gather of the full sequence is
+    needed for correctness."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    q, k, v = _qkv(b=4, s=256)
+    mesh = MeshConfig.from_string("dp=2,sp=4").create()
+    sh = NamedSharding(mesh, P("dp", "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+
+    @jax.jit
+    def run(q, k, v):
+        return ring_attention(q, k, v, mesh=mesh, causal=True)
+
+    out = run(qs, ks, vs)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_attention_dispatch_uses_ring_on_sp_mesh():
+    """attention() picks ring on an sp>1 mesh and flash otherwise; both
+    agree with the oracle, so dispatch is observable via the mesh rules
+    (ring requires seq % sp == 0 — exercised by construction)."""
+    q, k, v = _qkv()
+    ref = mha_reference(q, k, v, causal=True)
+
+    set_attention_mesh(None)
+    out_local = attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out_local), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+    mesh = MeshConfig.from_string("sp=8").create()
+    set_attention_mesh(mesh)
+    out_ring = attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out_ring), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_transformer_trains_on_sp_mesh(tmp_path):
+    """End-to-end: the transformer LM trains through SPMDTrainer on a
+    dp=2,sp=4 mesh — sequence-sharded batches, ring attention inside the
+    jitted step — and the loss drops."""
+    import optax
+
+    from elasticdl_tpu.data.dataset import Dataset
+    from elasticdl_tpu.data.recordio_gen import synthetic
+    from elasticdl_tpu.data.recordio_reader import RecordIODataReader
+    from elasticdl_tpu.models import long_seq_transformer as lm
+    from elasticdl_tpu.parallel.distributed import SPMDTrainer
+    from elasticdl_tpu.trainer.state import Modes
+
+    data_dir = synthetic.gen_sequence(
+        str(tmp_path / "seq"),
+        num_records=64,
+        num_shards=1,
+        seq_len=64,
+        seed=0,
+    )
+    reader = RecordIODataReader(data_dir=data_dir)
+    shards = reader.create_shards()
+    name, (start, count) = next(iter(shards.items()))
+    task = type(
+        "T", (), {"shard_name": name, "start": start, "end": start + count}
+    )
+    ds = lm.dataset_fn(
+        Dataset.from_generator(lambda: reader.read_records(task)),
+        Modes.TRAINING,
+        reader.metadata,
+    )
+    batches = list(ds.batch(16))
+
+    mesh = MeshConfig.from_string("dp=2,sp=4").create()
+    model = lm.custom_model(num_layers=1, embed_dim=64, num_heads=2)
+    trainer = SPMDTrainer(
+        mesh, model, lm.loss, optax.adam(3e-3), batches[0][0]
+    )
+    losses = []
+    for _ in range(3):
+        for feats, labels in batches:
+            m = trainer.train_step(
+                trainer.place_batch(feats), trainer.place_batch(labels)
+            )
+            losses.append(float(m["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+    # the sequence dim really is sharded over sp on device
+    placed = trainer.place_batch(batches[0][0])
+    spec = placed["tokens"].sharding.spec
+    assert spec[1] == "sp", spec
+
+
+def test_transformer_spec_contract():
+    """The model module satisfies the model-zoo spec surface."""
+    from elasticdl_tpu.utils.model_utils import get_model_spec
+
+    spec = get_model_spec(
+        "", "long_seq_transformer.long_seq_transformer.custom_model"
+    )
+    assert spec.build_model() is not None
+    assert spec.loss is not None and spec.dataset_fn is not None
+    assert spec.eval_metrics_fn is not None
