@@ -54,19 +54,25 @@ class ODPSDataReader(AbstractDataReader):
             self._project,
             endpoint=endpoint or os.environ.get("ODPS_ENDPOINT", ""),
         )
+        from elasticdl_tpu.data.odps_io import ODPSTableReader
+
+        # all table round trips go through the retrying chunk reader
+        self._io = ODPSTableReader(
+            self._client, self._table, partition=self._partition
+        )
 
     def _table_size(self) -> int:
-        t = self._client.get_table(self._table)
-        with t.open_reader(partition=self._partition) as reader:
-            return reader.count
+        return self._io.get_table_size()
+
+    # rows per ranged read: bounds memory and retry re-download for large
+    # tasks (a task range streams as a sequence of chunk reads, not one
+    # monolithic download)
+    _READ_CHUNK_ROWS = 4096
 
     def read_records(self, task) -> Iterator[list]:
-        t = self._client.get_table(self._table)
-        with t.open_reader(partition=self._partition) as reader:
-            for rec in reader.read(
-                start=task.start, count=task.end - task.start
-            ):
-                yield [rec[c] for c in (self._columns or rec.keys())]
+        for start in range(task.start, task.end, self._READ_CHUNK_ROWS):
+            end = min(start + self._READ_CHUNK_ROWS, task.end)
+            yield from self._io.read_batch(start, end, self._columns)
 
     def create_shards(self) -> dict[str, tuple[int, int]]:
         total = self._table_size()
