@@ -46,10 +46,13 @@ def _peak_flops(device) -> float | None:
     return None
 
 
-def _configs():
+def _configs(n_chips: int = 1):
     import numpy as np
 
     rng = np.random.RandomState(0)
+    # sequences per step: divisible by any dp size (plain device_put has
+    # no padding fallback), small enough for one chip
+    seq_batch = max(8, n_chips)
     return {
         "mnist": dict(
             model_def="mnist_functional_api.mnist_functional_api.custom_model",
@@ -70,6 +73,21 @@ def _configs():
             },
             labels=rng.randint(0, 2, 512).astype(np.int32),
             batch=512,
+        ),
+        # long-context transformer (pallas flash attention); the
+        # reference has no transformer, so no baseline anchor exists —
+        # the per-chip rate is the metric (samples = sequences; x seq_len
+        # for tokens/sec)
+        "transformer_seq2048": dict(
+            model_def="long_seq_transformer.long_seq_transformer.custom_model",
+            features={
+                "tokens": rng.randint(0, 256, (seq_batch, 2048)).astype(
+                    np.int32
+                )
+            },
+            labels=rng.randint(0, 256, (seq_batch, 2048)).astype(np.int32),
+            batch=seq_batch,
+            tokens_per_sample=2048,
         ),
     }
 
@@ -115,6 +133,10 @@ def _measure(name, cfg, mesh):
         ),
         "batch": cfg["batch"],
     }
+    if "tokens_per_sample" in cfg:
+        result["tokens_per_sec_per_chip"] = round(
+            STEPS * cfg["batch"] * cfg["tokens_per_sample"] / dt / n_chips
+        )
     try:
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):  # older jax returns [dict]
@@ -158,7 +180,7 @@ def main():
             baselines = json.load(f).get("samples_per_sec", {})
 
     models = {}
-    for name, cfg in _configs().items():
+    for name, cfg in _configs(max(1, mesh.devices.size)).items():
         models[name] = _measure(name, cfg, mesh)
         base = baselines.get(name)
         if base:

@@ -66,6 +66,25 @@ def custom_model(**kwargs):
     return TransformerLM(**kwargs)
 
 
+def sharding_rules(mesh):
+    """Megatron-style tensor parallelism over ``tp``: QKV projections
+    shard by head, the attention output and MLP shard so each pair needs
+    exactly one psum (GSPMD inserts it); everything else falls through to
+    the default fsdp/replicated policy."""
+    from jax.sharding import PartitionSpec as P
+
+    from elasticdl_tpu.parallel.sharding import Rule
+
+    if mesh.shape.get("tp", 1) <= 1:
+        return ()
+    return (
+        Rule(r"block_\d+/attn/(query|key|value)/kernel", P(None, "tp", None)),
+        Rule(r"block_\d+/attn/out/kernel", P("tp", None, None)),
+        Rule(r"block_\d+/Dense_0/kernel", P(None, "tp")),
+        Rule(r"block_\d+/Dense_1/kernel", P("tp", None)),
+    )
+
+
 def loss(labels, logits):
     labels = jnp.asarray(labels).astype(jnp.int32)
     return optax.softmax_cross_entropy_with_integer_labels(

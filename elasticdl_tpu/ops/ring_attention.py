@@ -122,10 +122,16 @@ def ring_attention(
     # replicated batch is the only valid layout
     dp_axes = data_parallel_axes(mesh)
     dp_size = int(np.prod([mesh.shape[a] for a in dp_axes])) if dp_axes else 1
-    if dp_axes and q.shape[0] % dp_size == 0:
-        spec = P(dp_axes, axis_name, None, None)
-    else:
-        spec = P(None, axis_name, None, None)
+    batch_axes = dp_axes if dp_axes and q.shape[0] % dp_size == 0 else None
+    # heads are embarrassingly parallel through the whole ring: keep them
+    # sharded over tp (megatron-style attention) when they divide
+    tp = "tp" if "tp" in mesh.axis_names else None
+    head_axis = (
+        tp
+        if tp and mesh.shape[tp] > 1 and q.shape[2] % mesh.shape[tp] == 0
+        else None
+    )
+    spec = P(batch_axes, axis_name, head_axis, None)
     body = functools.partial(
         _ring_attention_local,
         axis_name=axis_name,
