@@ -36,7 +36,7 @@ import tensorflow as tf  # noqa: E402
 
 # identical batch sizes to bench.py's JAX side (the vs_baseline ratios
 # must compare the same configuration)
-BATCHES = {"mnist": 256, "resnet50_cifar10": 256, "deepfm": 512}
+BATCHES = {"mnist": 256, "resnet50_cifar10": 512, "deepfm": 512}
 
 
 def mnist_model():
@@ -163,7 +163,13 @@ def main(argv=None) -> int:
     p.add_argument("--models", nargs="*", default=sorted(MODELS))
     args = p.parse_args(argv)
 
+    # merge into an existing baseline file so a partial --models rerun
+    # (e.g. after changing one model's batch size) keeps the other
+    # anchors — every value in the file is script-produced either way
     results = {}
+    if os.path.exists(args.out):
+        with open(args.out) as f:
+            results = json.load(f).get("samples_per_sec", {})
     for name in args.models:
         sps = measure(name, args.steps)
         results[name] = round(sps, 1)

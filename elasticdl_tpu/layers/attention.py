@@ -58,9 +58,10 @@ class TransformerBlock(nn.Module):
             y = nn.Dropout(self.dropout_rate, deterministic=not training)(y)
         x = x + y
         y = nn.LayerNorm()(x)
-        y = nn.Dense(x.shape[-1] * self.mlp_ratio)(y)
+        # named for the shared megatron tp rules (sharding.default_tp_rules)
+        y = nn.Dense(x.shape[-1] * self.mlp_ratio, name="mlp_up")(y)
         y = nn.gelu(y)
-        y = nn.Dense(x.shape[-1])(y)
+        y = nn.Dense(x.shape[-1], name="mlp_down")(y)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate, deterministic=not training)(y)
         return x + y

@@ -67,22 +67,15 @@ def custom_model(**kwargs):
 
 
 def sharding_rules(mesh):
-    """Megatron-style tensor parallelism over ``tp``: QKV projections
-    shard by head, the attention output and MLP shard so each pair needs
-    exactly one psum (GSPMD inserts it); everything else falls through to
-    the default fsdp/replicated policy."""
-    from jax.sharding import PartitionSpec as P
-
-    from elasticdl_tpu.parallel.sharding import Rule
+    """Megatron-style tensor parallelism over ``tp``: the shared default
+    rule set (QKV sharded by head, attn-out/MLP paired so each block
+    needs exactly one psum — GSPMD inserts it); everything unmatched
+    falls through to the default fsdp/replicated policy."""
+    from elasticdl_tpu.parallel.sharding import default_tp_rules
 
     if mesh.shape.get("tp", 1) <= 1:
         return ()
-    return (
-        Rule(r"block_\d+/attn/(query|key|value)/kernel", P(None, "tp", None)),
-        Rule(r"block_\d+/attn/out/kernel", P("tp", None, None)),
-        Rule(r"block_\d+/Dense_0/kernel", P(None, "tp")),
-        Rule(r"block_\d+/Dense_1/kernel", P("tp", None)),
-    )
+    return tuple(default_tp_rules())
 
 
 def loss(labels, logits):
