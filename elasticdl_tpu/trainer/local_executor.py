@@ -110,6 +110,12 @@ class LocalExecutor:
         self._timing = Timing(
             enabled=args.log_level == "DEBUG", logger=logger
         )
+        from elasticdl_tpu.utils.profiling import StepProfiler
+
+        self._profiler = StepProfiler(
+            getattr(args, "profile_dir", ""),
+            num_steps=getattr(args, "profile_steps", 5),
+        )
 
     # ---- plumbing ---------------------------------------------------------
 
@@ -172,6 +178,7 @@ class LocalExecutor:
         for batch in self._task_dataset(self._train_reader, task, Modes.TRAINING):
             features, labels = batch
             self._ensure_state(features)
+            self._profiler.on_step(self._version)
             with self._timing.record("batch_process"):
                 self._state, step_metrics = self._train_step(
                     self._state, features, labels
@@ -264,13 +271,18 @@ class LocalExecutor:
             shuffle_seed=getattr(self._args, "shuffle_seed", None),
         )
         total = 0
-        while True:
-            tid, task = dispatcher.get(0)
-            if task is None:
-                break
-            with self._timing.record("task_process"):
-                total += self._train_task(task)
-            dispatcher.report(tid, True)
+        try:
+            while True:
+                tid, task = dispatcher.get(0)
+                if task is None:
+                    break
+                with self._timing.record("task_process"):
+                    total += self._train_task(task)
+                dispatcher.report(tid, True)
+        finally:
+            # flush (or diagnose) the trace even on a mid-training error —
+            # a leaked active trace poisons later start_trace calls
+            self._profiler.stop()
         logger.info(
             "Training complete: %d records, %d steps", total, self._version
         )

@@ -219,6 +219,20 @@ def _add_train_params(parser: argparse.ArgumentParser):
     )
     parser.add_argument("--tensorboard_log_dir", default="")
     parser.add_argument(
+        "--profile_dir",
+        default="",
+        help=(
+            "Capture an XLA profiler trace of a few training steps into "
+            "this directory (TensorBoard 'profile' plugin format)"
+        ),
+    )
+    parser.add_argument(
+        "--profile_steps",
+        type=pos_int,
+        default=5,
+        help="How many steps the profiler window covers",
+    )
+    parser.add_argument(
         "--get_model_steps",
         type=pos_int,
         default=1,

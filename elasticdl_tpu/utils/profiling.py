@@ -1,0 +1,77 @@
+"""XLA profiler window for training runs.
+
+The reference's only tracing is wall-clock buckets at DEBUG level
+(``common/timing_utils.py``, kept as ``utils.timing_utils``); on TPU the
+tool that actually explains a slow step is the XLA profiler (op-level
+device timeline, HLO attribution, TensorBoard ``profile`` plugin).  This
+wires it as a step-window capture: ``--profile_dir d --profile_steps N``
+traces steps [start, start + N) into ``d`` — viewable with
+``tensorboard --logdir d``.
+"""
+
+from __future__ import annotations
+
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+
+class StepProfiler:
+    """Capture one window of training steps with ``jax.profiler``.
+
+    ``on_step()`` is called once per step by the training loop and counts
+    calls SINCE PROCESS START (not the model version — a checkpoint-
+    resumed run at version 10000 still warms up before its window); the
+    trace starts at call ``start_step`` (past compile + warmup) and stops
+    ``num_steps`` later.  Inactive (no output dir) it is one attribute
+    lookup per step.
+    """
+
+    def __init__(
+        self,
+        out_dir: str | None,
+        start_step: int = 5,
+        num_steps: int = 5,
+    ):
+        self._out_dir = out_dir or ""
+        self._start = start_step
+        self._stop = start_step + num_steps
+        self._seen = 0
+        self._tracing = False
+        self._done = not self._out_dir
+
+    def on_step(self, _step=None):
+        """Count one training step (the argument is accepted and ignored
+        for call-site readability)."""
+        if self._done:
+            return
+        self._seen += 1
+        if not self._tracing and self._seen > self._start:
+            import jax
+
+            jax.profiler.start_trace(self._out_dir)
+            self._tracing = True
+            logger.info(
+                "XLA profiler: tracing %d steps into %s",
+                self._stop - self._start,
+                self._out_dir,
+            )
+        elif self._tracing and self._seen > self._stop:
+            self.stop()
+
+    def stop(self):
+        """Idempotent; also called at loop exit so a short run still
+        flushes a partial window."""
+        if self._tracing:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._tracing = False
+            logger.info("XLA profiler: trace written to %s", self._out_dir)
+        elif not self._done and self._out_dir:
+            logger.warning(
+                "XLA profiler: window never opened — the run had %d steps "
+                "but tracing starts after step %d (--profile_steps only "
+                "sets the window length)",
+                self._seen,
+                self._start,
+            )
+        self._done = True

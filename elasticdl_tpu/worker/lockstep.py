@@ -108,6 +108,16 @@ class LockstepWorker:
             process_id=self._process_id,
             num_parts=self._num_processes,
         )
+        from elasticdl_tpu.utils.profiling import StepProfiler
+
+        # per-process trace subdir: each host profiles its own devices
+        profile_dir = getattr(args, "profile_dir", "") or ""
+        self._profiler = StepProfiler(
+            os.path.join(profile_dir, f"process_{self._process_id}")
+            if profile_dir
+            else "",
+            num_steps=getattr(args, "profile_steps", 5),
+        )
 
     # ---- process-0-only master reporting -----------------------------------
 
@@ -192,6 +202,7 @@ class LockstepWorker:
         with self._crash_on_error(task):
             for features, labels in self._task_batches(task, Modes.TRAINING):
                 self._ensure_trainer(features)
+                self._profiler.on_step(self._trainer.step)
                 with self._timing.record("batch_process"):
                     self._trainer.train_step(
                         self._place(features), self._place(labels)
@@ -371,6 +382,7 @@ class LockstepWorker:
                     )
             self._dump_state_if_requested()
         finally:
+            self._profiler.stop()
             self._stopped = True
 
     def _dump_state_if_requested(self):

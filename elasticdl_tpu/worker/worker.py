@@ -69,6 +69,12 @@ class Worker:
             enabled=getattr(args, "log_level", "INFO") == "DEBUG",
             logger=logger,
         )
+        from elasticdl_tpu.utils.profiling import StepProfiler
+
+        self._profiler = StepProfiler(
+            getattr(args, "profile_dir", "") or "",
+            num_steps=getattr(args, "profile_steps", 5),
+        )
 
         self._spec = get_model_spec(
             getattr(args, "model_zoo", "") or "",
@@ -207,6 +213,7 @@ class Worker:
             try:
                 if task_type == int(TaskType.TRAINING):
                     self._ensure_trainer(features)
+                    self._profiler.on_step()
                     self._timing.start_record_time("batch_process")
                     self._trainer.train_step(
                         self._place(features), self._place(labels)
@@ -419,6 +426,7 @@ class Worker:
             else:
                 self._train_and_evaluate()
         finally:
+            self._profiler.stop()
             self._stopped = True
 
 
