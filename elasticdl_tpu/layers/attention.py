@@ -47,6 +47,9 @@ class TransformerBlock(nn.Module):
     mlp_ratio: int = 4
     causal: bool = False
     dropout_rate: float = 0.0
+    # > 0 replaces the dense MLP with a routed expert MLP (layers.moe);
+    # shard experts over ep via moe_sharding_rules
+    num_experts: int = 0
 
     @nn.compact
     def __call__(self, x, training: bool = False):
@@ -58,10 +61,19 @@ class TransformerBlock(nn.Module):
             y = nn.Dropout(self.dropout_rate, deterministic=not training)(y)
         x = x + y
         y = nn.LayerNorm()(x)
-        # named for the shared megatron tp rules (sharding.default_tp_rules)
-        y = nn.Dense(x.shape[-1] * self.mlp_ratio, name="mlp_up")(y)
-        y = nn.gelu(y)
-        y = nn.Dense(x.shape[-1], name="mlp_down")(y)
+        if self.num_experts > 0:
+            from elasticdl_tpu.layers.moe import MoEMLP
+
+            y = MoEMLP(
+                num_experts=self.num_experts,
+                hidden_mult=self.mlp_ratio,
+                name="moe",
+            )(y, training=training)
+        else:
+            # named for the shared megatron tp rules (default_tp_rules)
+            y = nn.Dense(x.shape[-1] * self.mlp_ratio, name="mlp_up")(y)
+            y = nn.gelu(y)
+            y = nn.Dense(x.shape[-1], name="mlp_down")(y)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate, deterministic=not training)(y)
         return x + y

@@ -36,6 +36,7 @@ class TransformerLM(nn.Module):
     num_heads: int = 4
     num_layers: int = 2
     dropout_rate: float = 0.0
+    num_experts: int = 0  # > 0: MoE MLP, experts sharded over ep
 
     @nn.compact
     def __call__(self, features, training: bool = False):
@@ -56,6 +57,7 @@ class TransformerLM(nn.Module):
                 num_heads=self.num_heads,
                 causal=True,
                 dropout_rate=self.dropout_rate,
+                num_experts=self.num_experts,
                 name=f"block_{layer}",
             )(x, training=training)
         x = nn.LayerNorm()(x)
@@ -71,11 +73,15 @@ def sharding_rules(mesh):
     rule set (QKV sharded by head, attn-out/MLP paired so each block
     needs exactly one psum — GSPMD inserts it); everything unmatched
     falls through to the default fsdp/replicated policy."""
+    from elasticdl_tpu.layers.moe import moe_sharding_rules
     from elasticdl_tpu.parallel.sharding import default_tp_rules
 
-    if mesh.shape.get("tp", 1) <= 1:
-        return ()
-    return tuple(default_tp_rules())
+    rules = []
+    if mesh.shape.get("ep", 1) > 1:
+        rules += moe_sharding_rules()
+    if mesh.shape.get("tp", 1) > 1:
+        rules += default_tp_rules()
+    return tuple(rules)
 
 
 def loss(labels, logits):

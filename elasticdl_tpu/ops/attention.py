@@ -251,4 +251,12 @@ def attention(q, k, v, causal: bool = False, sm_scale: float | None = None):
             q, k, v, mesh=mesh, axis_name=sp_axis, causal=causal,
             sm_scale=sm_scale,
         )
-    return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    # interpret must follow the mesh's platform, NOT the process default:
+    # a CPU mesh on a TPU-default machine (virtual-device dryrun) compiles
+    # for CPU, where pallas only runs interpreted
+    interpret = None
+    if mesh is not None:
+        interpret = mesh.devices.flat[0].platform != "tpu"
+    return flash_attention(
+        q, k, v, causal=causal, sm_scale=sm_scale, interpret=interpret
+    )

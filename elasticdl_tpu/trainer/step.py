@@ -88,6 +88,14 @@ def build_train_step(
         features = _cast_floats(features, compute_dtype)
         outputs, new_model_state = _apply(state, params, features, True)
         loss = loss_fn(labels, outputs)
+        # layer-contributed losses (MoE load balancing, regularizers):
+        # any value sown into the "losses" collection joins the training
+        # loss — the reference adds Keras model reg losses the same way
+        # (worker.py:656-669)
+        for leaf in jax.tree_util.tree_leaves(
+            new_model_state.get("losses", {})
+        ):
+            loss = loss + jnp.sum(leaf)
         return loss.astype(jnp.float32), (outputs, new_model_state)
 
     if remat:
