@@ -48,20 +48,107 @@ def parse_mesh_shape(mesh_shape: str) -> dict[str, int]:
     return out
 
 
+def detect_num_slices(devices) -> int:
+    """Distinct TPU slices among ``devices`` (1 when the backend exposes
+    no ``slice_index`` — CPU, single slice, or older runtimes)."""
+    slices = {getattr(d, "slice_index", None) for d in devices}
+    if None in slices or not slices:
+        return 1
+    return len(slices)
+
+
+def plan_dcn_axes(
+    sizes: dict[str, int], n_slices: int, dcn_axes: dict[str, int] | None
+) -> dict[str, int]:
+    """Which part of each mesh axis spans slices (rides DCN).
+
+    Defaults to putting ALL of the slice dimension on ``dp`` — gradient
+    all-reduce is the lowest-rate collective, so it is the one that can
+    afford DCN; everything else stays intra-slice on ICI (the
+    scaling-book layout).  An explicit ``dcn_axes`` (from
+    ``--dcn_mesh_shape``) overrides, e.g. ``fsdp=2`` for cross-slice
+    parameter sharding.
+    """
+    if n_slices <= 1:
+        return {}
+    if dcn_axes:
+        prod = int(np.prod(list(dcn_axes.values())))
+        if prod != n_slices:
+            raise ValueError(
+                f"dcn_mesh_shape product {prod} != number of slices "
+                f"{n_slices}"
+            )
+        for axis, deg in dcn_axes.items():
+            if sizes.get(axis, 1) % deg:
+                raise ValueError(
+                    f"dcn axis {axis}={deg} does not divide mesh "
+                    f"{axis}={sizes.get(axis, 1)}"
+                )
+        return dict(dcn_axes)
+    if sizes.get(MeshAxis.DP, 1) % n_slices:
+        raise ValueError(
+            f"dp={sizes.get(MeshAxis.DP, 1)} not divisible by "
+            f"{n_slices} slices; pass --dcn_mesh_shape explicitly"
+        )
+    return {MeshAxis.DP: n_slices}
+
+
+def order_devices_hybrid(
+    devices, sizes: dict[str, int], dcn: dict[str, int]
+) -> np.ndarray:
+    """Fallback hybrid ordering: group devices by slice, lay each slice
+    out row-major over the intra-slice (ICI) shape, and concatenate
+    slices along the DCN axes — so the outer (slice) stride of a DCN axis
+    crosses slices and everything else stays inside one.
+
+    (``mesh_utils.create_hybrid_device_mesh`` does this with
+    topology-aware intra-slice orders; this fallback keeps the same
+    slice/axis assignment when that API is unavailable.)
+    """
+    by_slice: dict = {}
+    for d in devices:
+        by_slice.setdefault(getattr(d, "slice_index", 0), []).append(d)
+    slice_ids = sorted(by_slice)
+    if len({len(v) for v in by_slice.values()}) != 1:
+        raise ValueError(f"unequal devices per slice: {sorted(by_slice)}")
+    live = [a for a, deg in dcn.items() if deg > 1]
+    if len(live) != 1:
+        raise ValueError(
+            "fallback hybrid ordering supports exactly one DCN axis; "
+            f"got {dcn} (use a jax version with create_hybrid_device_mesh "
+            "for multi-axis DCN layouts)"
+        )
+    ici_shape = tuple(sizes[a] // dcn.get(a, 1) for a in sizes)
+    arrays = [
+        np.asarray(by_slice[s], dtype=object).reshape(ici_shape)
+        for s in slice_ids
+    ]
+    # slice-major concatenation along the DCN axis: positions that differ
+    # only in their intra-slice coordinate stay within one slice
+    return np.concatenate(arrays, axis=list(sizes).index(live[0]))
+
+
 @dataclass
 class MeshConfig:
     """Axis sizes for the logical mesh; unspecified axes default to 1.
 
     When ``dp`` is omitted it is *inferred* as "all remaining devices"
     (num_devices / product of the given axes), so a bare job scales to
-    whatever slice it lands on.
+    whatever slice it lands on.  ``dcn_axes`` declares which part of
+    which axis spans TPU slices (multi-slice jobs; collectives on those
+    axis strides ride DCN, everything else ICI).
     """
 
     axes: dict[str, int] = field(default_factory=dict)
+    dcn_axes: dict[str, int] = field(default_factory=dict)
 
     @classmethod
-    def from_string(cls, mesh_shape: str) -> "MeshConfig":
-        return cls(parse_mesh_shape(mesh_shape))
+    def from_string(
+        cls, mesh_shape: str, dcn_mesh_shape: str = ""
+    ) -> "MeshConfig":
+        return cls(
+            parse_mesh_shape(mesh_shape), parse_mesh_shape(dcn_mesh_shape)
+        )
 
     def resolved_axes(self, num_devices: int) -> dict[str, int]:
         sizes = {name: self.axes.get(name, 1) for name in MeshAxis.ALL}
@@ -90,20 +177,49 @@ class MeshConfig:
         devices = list(devices)[:total]
         axis_names = tuple(sizes)
         shape = tuple(sizes[a] for a in axis_names)
-        try:
-            from jax.experimental import mesh_utils
-
-            device_array = mesh_utils.create_device_mesh(
-                shape, devices=devices
+        n_slices = detect_num_slices(devices)
+        if n_slices > 1:
+            dcn = plan_dcn_axes(sizes, n_slices, self.dcn_axes or None)
+            ici_shape = tuple(
+                sizes[a] // dcn.get(a, 1) for a in axis_names
             )
-        except Exception:
-            # fallback (e.g. host-platform CPU devices): row-major reshape
-            device_array = np.asarray(devices).reshape(shape)
+            dcn_shape = tuple(dcn.get(a, 1) for a in axis_names)
+            try:
+                from jax.experimental import mesh_utils
+
+                device_array = mesh_utils.create_hybrid_device_mesh(
+                    ici_shape, dcn_shape, devices=devices
+                )
+            except Exception:
+                device_array = order_devices_hybrid(devices, sizes, dcn)
+            topology = f"{n_slices} slices (DCN axes {dcn})"
+        else:
+            if self.dcn_axes:
+                # not silently: the user declared a multi-slice layout the
+                # backend doesn't expose — collectives may cross DCN in
+                # whatever order the flat mesh happens to pick
+                logger.warning(
+                    "--dcn_mesh_shape %s given but the backend exposes "
+                    "a single slice (no device slice_index); building a "
+                    "flat mesh",
+                    self.dcn_axes,
+                )
+            try:
+                from jax.experimental import mesh_utils
+
+                device_array = mesh_utils.create_device_mesh(
+                    shape, devices=devices
+                )
+            except Exception:
+                # fallback (e.g. host-platform CPU devices): row-major
+                device_array = np.asarray(devices).reshape(shape)
+            topology = "1 slice"
         mesh = Mesh(device_array, axis_names)
         logger.info(
-            "Created mesh %s over %d devices",
+            "Created mesh %s over %d devices, %s",
             {a: s for a, s in sizes.items() if s > 1} or {"dp": 1},
             len(devices),
+            topology,
         )
         return mesh
 

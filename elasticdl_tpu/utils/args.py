@@ -287,6 +287,15 @@ def _add_mesh_params(parser: argparse.ArgumentParser):
         ),
     )
     parser.add_argument(
+        "--dcn_mesh_shape",
+        default="",
+        help=(
+            "Which part of which axis spans TPU slices on a multi-slice "
+            "job (collectives there ride DCN), e.g. 'dp=2'; empty = "
+            "auto (all slices on dp)"
+        ),
+    )
+    parser.add_argument(
         "--compute_dtype",
         default="bfloat16",
         choices=["bfloat16", "float32"],

@@ -98,7 +98,10 @@ class LockstepWorker:
         )
 
         mesh_shape = getattr(args, "mesh_shape", "") or ""
-        self._mesh = MeshConfig.from_string(mesh_shape).create(devices)
+        dcn_shape = getattr(args, "dcn_mesh_shape", "") or ""
+        self._mesh = MeshConfig.from_string(mesh_shape, dcn_shape).create(
+            devices
+        )
         self._trainer: SPMDTrainer | None = None
         self._stopped = False
         self._checkpointer = PeriodicCheckpointer(

@@ -104,7 +104,10 @@ class Worker:
         )
 
         mesh_shape = getattr(args, "mesh_shape", "") or ""
-        self._mesh = MeshConfig.from_string(mesh_shape).create(devices)
+        dcn_shape = getattr(args, "dcn_mesh_shape", "") or ""
+        self._mesh = MeshConfig.from_string(mesh_shape, dcn_shape).create(
+            devices
+        )
         self._trainer: SPMDTrainer | None = None
         self._eval_metrics = None
         # periodic checkpointing (reference ps/servicer.py:216-231 — the
