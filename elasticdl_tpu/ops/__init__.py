@@ -14,4 +14,8 @@ from elasticdl_tpu.ops.attention import (  # noqa: F401
     mha_reference,
     set_attention_mesh,
 )
+from elasticdl_tpu.ops.pipeline import (  # noqa: F401
+    pipeline_apply,
+    pipeline_sharding_rules,
+)
 from elasticdl_tpu.ops.ring_attention import ring_attention  # noqa: F401

@@ -8,7 +8,8 @@ enumerate devices into named logical axes:
 - ``fsdp`` fully-sharded data parallel (parameter sharding)
 - ``tp``   tensor parallel
 - ``sp``   sequence/context parallel (ring attention)
-- ``ep``   expert/embedding parallel (sharded embedding tables)
+- ``ep``   expert/embedding parallel (sharded embedding tables, MoE)
+- ``pp``   pipeline parallel (GPipe stage schedule, ops/pipeline.py)
 
 ``--mesh_shape dp=4,tp=2`` on the CLI maps to ``MeshConfig``.  Axes of
 size 1 are kept in the mesh (they cost nothing and keep PartitionSpecs
@@ -178,6 +179,21 @@ class MeshConfig:
         axis_names = tuple(sizes)
         shape = tuple(sizes[a] for a in axis_names)
         n_slices = detect_num_slices(devices)
+        if n_slices > 1:
+            per_slice: dict = {}
+            for d in devices:
+                key = getattr(d, "slice_index", 0)
+                per_slice[key] = per_slice.get(key, 0) + 1
+            if len(set(per_slice.values())) != 1:
+                # a sub-mesh that doesn't tile the slices evenly (e.g. an
+                # explicit smaller mesh truncated mid-slice) cannot be
+                # laid out hybrid; a flat mesh is still correct
+                logger.warning(
+                    "Device subset spans slices unevenly (%s); building "
+                    "a flat mesh instead of a hybrid one",
+                    per_slice,
+                )
+                n_slices = 1
         if n_slices > 1:
             dcn = plan_dcn_axes(sizes, n_slices, self.dcn_axes or None)
             ici_shape = tuple(

@@ -95,6 +95,7 @@ class MeshAxis:
     tp: tensor parallel (feature-dim sharding of weights/activations)
     sp: sequence/context parallel (ring attention / Ulysses all-to-all)
     ep: expert / embedding parallel (sharded embedding tables, MoE experts)
+    pp: pipeline parallel (layer stages; activations ppermute stage-to-stage)
     """
 
     DP = "dp"
@@ -102,8 +103,9 @@ class MeshAxis:
     TP = "tp"
     SP = "sp"
     EP = "ep"
+    PP = "pp"
 
-    ALL = (DP, FSDP, TP, SP, EP)
+    ALL = (DP, FSDP, TP, SP, EP, PP)
 
 
 class WorkerEnv:

@@ -39,13 +39,13 @@ class TestMultiSlice:
 
         devs = [_FakeDev(i, i // 4) for i in range(8)]
         assert detect_num_slices(devs) == 2
-        sizes = {"dp": 4, "fsdp": 1, "tp": 2, "sp": 1, "ep": 1}
+        sizes = {"dp": 4, "fsdp": 1, "tp": 2, "sp": 1, "ep": 1, "pp": 1}
         assert plan_dcn_axes(sizes, 2, None) == {"dp": 2}
 
     def test_plan_rejects_bad_shapes(self):
         from elasticdl_tpu.parallel.mesh import plan_dcn_axes
 
-        sizes = {"dp": 3, "fsdp": 1, "tp": 1, "sp": 1, "ep": 1}
+        sizes = {"dp": 3, "fsdp": 1, "tp": 1, "sp": 1, "ep": 1, "pp": 1}
         with pytest.raises(ValueError):
             plan_dcn_axes(sizes, 2, None)  # dp=3 not divisible by 2 slices
         with pytest.raises(ValueError):
@@ -54,28 +54,28 @@ class TestMultiSlice:
     def test_explicit_dcn_axes(self):
         from elasticdl_tpu.parallel.mesh import plan_dcn_axes
 
-        sizes = {"dp": 2, "fsdp": 4, "tp": 1, "sp": 1, "ep": 1}
+        sizes = {"dp": 2, "fsdp": 4, "tp": 1, "sp": 1, "ep": 1, "pp": 1}
         assert plan_dcn_axes(sizes, 4, {"fsdp": 4}) == {"fsdp": 4}
 
     def test_fallback_ordering_keeps_ici_axes_intra_slice(self):
         from elasticdl_tpu.parallel.mesh import order_devices_hybrid
 
         devs = [_FakeDev(i, i // 4) for i in range(8)]
-        sizes = {"dp": 4, "fsdp": 1, "tp": 2, "sp": 1, "ep": 1}
+        sizes = {"dp": 4, "fsdp": 1, "tp": 2, "sp": 1, "ep": 1, "pp": 1}
         arr = order_devices_hybrid(devs, sizes, {"dp": 2})
-        assert arr.shape == (4, 1, 2, 1, 1)
+        assert arr.shape == (4, 1, 2, 1, 1, 1)
         # tp neighbors (last varying axis) never cross a slice
         for i in range(4):
-            pair = arr[i, 0, :, 0, 0]
+            pair = arr[i, 0, :, 0, 0, 0]
             assert pair[0].slice_index == pair[1].slice_index
         # the dp axis crosses slices exactly at its halfway stride
-        dp_slices = [arr[i, 0, 0, 0, 0].slice_index for i in range(4)]
+        dp_slices = [arr[i, 0, 0, 0, 0, 0].slice_index for i in range(4)]
         assert dp_slices == [0, 0, 1, 1]
 
     def test_single_slice_create_unchanged(self):
         mesh = MeshConfig.from_string("dp=4,tp=2").create()
         assert dict(mesh.shape) == {
-            "dp": 4, "fsdp": 1, "tp": 2, "sp": 1, "ep": 1
+            "dp": 4, "fsdp": 1, "tp": 2, "sp": 1, "ep": 1, "pp": 1
         }
 
 
