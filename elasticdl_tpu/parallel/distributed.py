@@ -138,13 +138,23 @@ class SPMDTrainer:
             sh = self._batch_sharding(x.ndim)
             if not self._multiprocess:
                 return jax.device_put(x, sh)
-            ranges = self._local_range_cache.get(x.shape)
-            if ranges is None:
-                ranges = elastic.local_batch_ranges(
-                    sh, x.shape, self._process_index
+            cached = self._local_range_cache.get(x.shape)
+            if cached is None:
+                if elastic.dim0_split_only(sh, x.shape):
+                    cached = elastic.local_batch_ranges(
+                        sh, x.shape, self._process_index
+                    )
+                else:
+                    cached = ()  # e.g. sp spans processes: split on dim 1+
+                self._local_range_cache[x.shape] = cached
+            if not cached:
+                # universal path: every process holds the full host batch
+                # (lockstep reads whole tasks), each device slices its
+                # block — correct for ANY sharding layout
+                return jax.make_array_from_callback(
+                    x.shape, sh, lambda idx: x[idx]
                 )
-                self._local_range_cache[x.shape] = ranges
-            local = np.concatenate([x[lo:hi] for lo, hi in ranges], axis=0)
+            local = np.concatenate([x[lo:hi] for lo, hi in cached], axis=0)
             return jax.make_array_from_process_local_data(
                 sh, local, global_shape=x.shape
             )

@@ -255,16 +255,21 @@ def _flat_arrays(tree) -> dict:
     }
 
 
-def _dim0_sharded_only(arr) -> bool:
-    """Sharded along dim 0 with dim 1 unsharded."""
-    for idx in arr.sharding.devices_indices_map(arr.shape).values():
-        sl = idx[1]
-        if not (
-            sl.start in (None, 0)
-            and sl.stop in (None, arr.shape[1])
-        ):
-            return False
+def dim0_split_only(sharding, shape) -> bool:
+    """The layout splits only dim 0: every trailing dim is a full slice
+    on every device.  Shared predicate for part-based checkpointing
+    (2-D tables) and batch placement (dp/fsdp-only batch layouts)."""
+    for idx in sharding.devices_indices_map(shape).values():
+        for dim, sl in enumerate(idx[1:], start=1):
+            if not (
+                sl.start in (None, 0) and sl.stop in (None, shape[dim])
+            ):
+                return False
     return True
+
+
+def _dim0_sharded_only(arr) -> bool:
+    return dim0_split_only(arr.sharding, arr.shape)
 
 
 def replicate_to_hosts(tree, mesh):

@@ -73,6 +73,17 @@ def _run_master(args):
     return master_main(build_arguments_from_parsed_result(args))
 
 
+def _load_identical_final_states(dump_dir):
+    """Both processes' dumps must be bitwise-identical (replicated state
+    after identical collectives: exact); returns process 0's dump."""
+    p0 = np.load(os.path.join(dump_dir, "final_state_p0.npz"))
+    p1 = np.load(os.path.join(dump_dir, "final_state_p1.npz"))
+    assert set(p0.files) == set(p1.files) and p0.files
+    for key in p0.files:
+        assert np.array_equal(p0[key], p1[key]), key
+    return p0
+
+
 @pytest.mark.slow
 def test_two_process_lockstep_matches_single_process(tmp_path, monkeypatch):
     train = synthetic.gen_mnist(
@@ -86,12 +97,7 @@ def test_two_process_lockstep_matches_single_process(tmp_path, monkeypatch):
     )
     assert _run_master(args) == 0
 
-    p0 = np.load(os.path.join(dump_dir, "final_state_p0.npz"))
-    p1 = np.load(os.path.join(dump_dir, "final_state_p1.npz"))
-    assert set(p0.files) == set(p1.files) and p0.files
-    for key in p0.files:
-        # replicated state after identical collectives: exact
-        assert np.array_equal(p0[key], p1[key]), key
+    p0 = _load_identical_final_states(dump_dir)
 
     # single-process comparison on the SAME data and task order
     monkeypatch.delenv("ELASTICDL_TPU_DUMP_STATE")
@@ -185,6 +191,37 @@ def test_lockstep_sharded_table_checkpoint_and_resume(tmp_path):
     )
     # resumed step counter keeps counting up from run 1's final version
     assert versions2[-1] > int(versions[-1].split("-", 1)[1])
+
+
+@pytest.mark.slow
+def test_lockstep_ring_attention_across_processes(tmp_path, monkeypatch):
+    """Multi-HOST long context: 2 worker processes, mesh dp=1,sp=2 — the
+    sequence dimension spans the PROCESS boundary, so ring attention's
+    ppermute hops ride the cross-process collective transport (gloo here,
+    ICI/DCN on pods).  Both processes must finish with bitwise-identical
+    replicated parameters."""
+    train = synthetic.gen_sequence(
+        str(tmp_path / "t"), num_records=64, num_shards=1, seq_len=32, seed=6
+    )
+    dump_dir = str(tmp_path / "dump")
+    monkeypatch.setenv("ELASTICDL_TPU_DUMP_STATE", dump_dir)
+    args = _master_args(
+        train,
+        [
+            "--num_workers",
+            "2",
+            "--records_per_task",
+            "32",
+            "--mesh_shape",
+            "dp=1,sp=2",
+        ],
+        model_def="long_seq_transformer.long_seq_transformer.custom_model",
+    )
+    assert _run_master(args) == 0
+
+    p0 = _load_identical_final_states(dump_dir)
+    for key in p0.files:
+        assert np.isfinite(p0[key]).all(), key
 
 
 @pytest.mark.slow
