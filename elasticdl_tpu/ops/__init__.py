@@ -19,3 +19,4 @@ from elasticdl_tpu.ops.pipeline import (  # noqa: F401
     pipeline_sharding_rules,
 )
 from elasticdl_tpu.ops.ring_attention import ring_attention  # noqa: F401
+from elasticdl_tpu.ops.ulysses import ulysses_attention  # noqa: F401

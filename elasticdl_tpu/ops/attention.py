@@ -31,35 +31,51 @@ _NEG_INF = -1e30
 # process-global, NOT thread-local: one mesh per worker process (the SPMD
 # model), and jit tracing may happen on a different thread than trainer
 # construction
-_mesh_context: list = [None, "sp"]
+_mesh_context: list = [None, "sp", "ring"]
 
 
-def set_attention_mesh(mesh, sp_axis: str = "sp"):
+_SP_IMPLS = ("ring", "ulysses")
+
+
+def set_attention_mesh(mesh, sp_axis: str = "sp", sp_impl: str = "ring"):
     """Register the mesh attention layers should use for sequence
     parallelism.  A ``None`` mesh (or an ``sp`` axis of size 1) makes
     :func:`attention` run the local kernel and lets GSPMD handle any
-    sharding.  SPMDTrainer scopes this around every step call via
-    :func:`attention_mesh_scope` — two trainers with different meshes in
-    one process (bench, dryrun) must not see each other's mesh at
-    (re)trace time."""
+    sharding.  ``sp_impl`` picks the sequence-parallel algorithm:
+    ``"ring"`` (K/V rotation; any head count) or ``"ulysses"``
+    (head/sequence all-to-all; needs heads % sp == 0).  SPMDTrainer
+    scopes this around every step call via :func:`attention_mesh_scope`
+    — two trainers with different meshes in one process (bench, dryrun)
+    must not see each other's mesh at (re)trace time."""
+    if sp_impl not in _SP_IMPLS:
+        # a typo must not silently fall back to ring
+        raise ValueError(
+            f"unknown sp_impl {sp_impl!r}; valid: {_SP_IMPLS}"
+        )
     _mesh_context[0] = mesh
     _mesh_context[1] = sp_axis
+    _mesh_context[2] = sp_impl
 
 
 def get_attention_mesh():
-    return _mesh_context[0], _mesh_context[1]
+    return _mesh_context[0], _mesh_context[1], _mesh_context[2]
 
 
 @contextlib.contextmanager
-def attention_mesh_scope(mesh, sp_axis: str = "sp"):
+def attention_mesh_scope(mesh, sp_axis: str = "sp", sp_impl: str | None = None):
     """Set-and-restore the attention mesh: tracing inside the scope (jit
-    retraces on new shapes happen at call time) reads this mesh."""
-    prev = (_mesh_context[0], _mesh_context[1])
-    set_attention_mesh(mesh, sp_axis)
+    retraces on new shapes happen at call time) reads this mesh.
+    ``sp_impl=None`` preserves the currently selected implementation —
+    SPMDTrainer's step scopes must not clobber a global
+    ``set_attention_mesh(..., sp_impl="ulysses")`` choice."""
+    prev = tuple(_mesh_context)
+    set_attention_mesh(
+        mesh, sp_axis, _mesh_context[2] if sp_impl is None else sp_impl
+    )
     try:
         yield
     finally:
-        _mesh_context[0], _mesh_context[1] = prev
+        _mesh_context[:] = prev
 
 
 # ---- reference (jnp) -------------------------------------------------------
@@ -236,18 +252,22 @@ flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def attention(q, k, v, causal: bool = False, sm_scale: float | None = None):
-    """Self-attention entry point for layers: ring attention when the
-    registered mesh has an ``sp`` axis > 1 (sequence sharded across
-    devices), else the local flash kernel."""
+    """Self-attention entry point for layers: sequence-parallel attention
+    (ring by default, ulysses when configured) when the registered mesh
+    has an ``sp`` axis > 1, else the local flash kernel."""
     from elasticdl_tpu.ops.ring_attention import ring_attention
+    from elasticdl_tpu.ops.ulysses import ulysses_attention
 
-    mesh, sp_axis = get_attention_mesh()
+    mesh, sp_axis, sp_impl = get_attention_mesh()
     if (
         mesh is not None
         and sp_axis in mesh.axis_names
         and mesh.shape[sp_axis] > 1
     ):
-        return ring_attention(
+        impl = (
+            ulysses_attention if sp_impl == "ulysses" else ring_attention
+        )
+        return impl(
             q, k, v, mesh=mesh, axis_name=sp_axis, causal=causal,
             sm_scale=sm_scale,
         )

@@ -83,6 +83,30 @@ def _ring_attention_local(
     return out.transpose(0, 2, 1, 3).astype(q.dtype)  # (B, Sq, H, D)
 
 
+def sequence_shard_spec(
+    mesh, axis_name: str, batch: int, heads: int, head_divisor: int = 1
+) -> P:
+    """The (B, S, H, D) PartitionSpec both sp implementations share:
+    batch on its data-parallel axes when divisible (replicated-batch
+    fallback covers the 1-example init trace), sequence on ``axis_name``,
+    heads on ``tp`` when it divides ``heads`` (and the per-device head
+    group stays divisible by ``head_divisor`` — ulysses' all_to_all
+    constraint; ring passes 1)."""
+    from elasticdl_tpu.parallel.mesh import data_parallel_axes
+
+    dp_axes = data_parallel_axes(mesh)
+    dp_size = (
+        int(np.prod([mesh.shape[a] for a in dp_axes])) if dp_axes else 1
+    )
+    batch_axes = dp_axes if dp_axes and batch % dp_size == 0 else None
+    tp = "tp" if "tp" in mesh.axis_names else None
+    head_axis = None
+    if tp and mesh.shape[tp] > 1 and heads % mesh.shape[tp] == 0:
+        if (heads // mesh.shape[tp]) % head_divisor == 0:
+            head_axis = tp
+    return P(batch_axes, axis_name, head_axis, None)
+
+
 def ring_attention(
     q,
     k,
@@ -116,22 +140,9 @@ def ring_attention(
             f"ring attention needs seq ({q.shape[1]}) divisible by "
             f"{axis_name}={axis_size}"
         )
-    # batch stays on its data-parallel axes (None there would make GSPMD
-    # all-gather the batch just to enter the shard_map) — unless the
-    # batch doesn't divide them (e.g. the 1-example init trace), where a
-    # replicated batch is the only valid layout
-    dp_axes = data_parallel_axes(mesh)
-    dp_size = int(np.prod([mesh.shape[a] for a in dp_axes])) if dp_axes else 1
-    batch_axes = dp_axes if dp_axes and q.shape[0] % dp_size == 0 else None
-    # heads are embarrassingly parallel through the whole ring: keep them
-    # sharded over tp (megatron-style attention) when they divide
-    tp = "tp" if "tp" in mesh.axis_names else None
-    head_axis = (
-        tp
-        if tp and mesh.shape[tp] > 1 and q.shape[2] % mesh.shape[tp] == 0
-        else None
-    )
-    spec = P(batch_axes, axis_name, head_axis, None)
+    # batch on dp when divisible; heads stay tp-sharded through the ring
+    # (embarrassingly parallel over heads)
+    spec = sequence_shard_spec(mesh, axis_name, q.shape[0], q.shape[2])
     body = functools.partial(
         _ring_attention_local,
         axis_name=axis_name,

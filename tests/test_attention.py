@@ -106,6 +106,96 @@ def test_ring_with_sharded_inputs_under_jit():
     )
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_reference(causal):
+    """The all-to-all sequence-parallel alternative: heads reshard over
+    sp, full-sequence flash per head group, reshard back."""
+    from elasticdl_tpu.ops.ulysses import ulysses_attention
+
+    q, k, v = _qkv(h=4)  # heads must divide sp
+    mesh = MeshConfig.from_string("dp=2,sp=4").create()
+    ref = mha_reference(q, k, v, causal=causal)
+    out = ulysses_attention(q, k, v, mesh=mesh, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from elasticdl_tpu.ops.ulysses import ulysses_attention
+
+    q, k, v = _qkv(h=2)
+    mesh = MeshConfig.from_string("sp=4").create()
+    with pytest.raises(ValueError):
+        ulysses_attention(q, k, v, mesh=mesh)
+
+
+def test_attention_dispatch_honors_sp_impl():
+    """set_attention_mesh(..., sp_impl='ulysses') routes dispatch through
+    the all-to-all implementation; both agree with the oracle."""
+    q, k, v = _qkv(h=4)
+    ref = mha_reference(q, k, v, causal=True)
+    mesh = MeshConfig.from_string("dp=2,sp=4").create()
+    set_attention_mesh(mesh, sp_impl="ulysses")
+    out = attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_sp_impl_validation_and_scope_preservation():
+    """A typo'd sp_impl raises; the trainer's step scopes (sp_impl=None)
+    preserve a globally selected implementation instead of resetting it
+    to ring."""
+    from elasticdl_tpu.ops.attention import (
+        attention_mesh_scope,
+        get_attention_mesh,
+    )
+
+    mesh = MeshConfig.from_string("sp=4").create()
+    with pytest.raises(ValueError):
+        set_attention_mesh(mesh, sp_impl="ulyses")  # typo
+
+    set_attention_mesh(mesh, sp_impl="ulysses")
+    with attention_mesh_scope(mesh):  # what SPMDTrainer does per step
+        assert get_attention_mesh()[2] == "ulysses"
+    assert get_attention_mesh()[2] == "ulysses"
+
+
+def test_transformer_trains_with_ulysses(tmp_path):
+    """End-to-end: global ulysses selection survives SPMDTrainer's
+    scoping and the jitted step trains."""
+    import optax
+
+    from elasticdl_tpu.models import long_seq_transformer as lm
+    from elasticdl_tpu.parallel.distributed import SPMDTrainer
+
+    rng = np.random.RandomState(0)
+    feats = {"tokens": rng.randint(0, 64, (4, 32)).astype(np.int32)}
+    labels = rng.randint(0, 64, (4, 32)).astype(np.int32)
+    mesh = MeshConfig.from_string("dp=2,sp=4").create()
+    set_attention_mesh(mesh, sp_impl="ulysses")
+    trainer = SPMDTrainer(
+        mesh,
+        lm.custom_model(
+            vocab_size=64, num_layers=1, embed_dim=32, num_heads=4
+        ),
+        lm.loss,
+        optax.adam(3e-3),
+        feats,
+    )
+    losses = [
+        float(
+            trainer.train_step(
+                trainer.place_batch(feats), trainer.place_batch(labels)
+            )["loss"]
+        )
+        for _ in range(4)
+    ]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], losses
+
+
 def test_attention_dispatch_uses_ring_on_sp_mesh():
     """attention() picks ring on an sp>1 mesh and flash otherwise; both
     agree with the oracle, so dispatch is observable via the mesh rules
