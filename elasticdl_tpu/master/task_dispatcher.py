@@ -51,6 +51,9 @@ class Task:
 class JobCounters:
     total_records: int = 0
     failed_records: int = 0
+    # any other worker-reported per-task counters, summed (e.g. the
+    # time_<bucket>_ms wall-clock buckets from utils.timing_utils)
+    exec_metrics: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -232,6 +235,11 @@ class TaskDispatcher:
             counters = self._counters.setdefault(task.type, JobCounters())
             if exec_counters:
                 counters.failed_records += exec_counters.get(FAIL_COUNT, 0)
+                for key, value in exec_counters.items():
+                    if key != FAIL_COUNT:
+                        counters.exec_metrics[key] = (
+                            counters.exec_metrics.get(key, 0) + value
+                        )
             if not success:
                 if task.type == TaskType.EVALUATION:
                     self._pending_eval.append(task)

@@ -50,6 +50,19 @@ class Timing:
             for name, total in sorted(self._totals.items())
         }
 
+    def exec_counters(self) -> dict[str, int]:
+        """Bucket totals as task-report counters (``time_<bucket>_ms``) —
+        attached to report_task_result so the master aggregates per-job
+        worker timing (reference reports per task at DEBUG only)."""
+        if not self._enabled:
+            return {}
+        return {
+            # round, don't floor: per-task resets would otherwise bias
+            # sub-millisecond buckets to an aggregate of exactly 0
+            f"time_{name}_ms": round(total * 1000)
+            for name, total in self._totals.items()
+        }
+
     def report_timing(self, reset: bool = False):
         if self._enabled and self._logger is not None:
             for name, stats in self.summary().items():

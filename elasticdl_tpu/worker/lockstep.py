@@ -131,11 +131,13 @@ class LockstepWorker:
     def _report_task_result(self, task_id, err_msg="", fail_count=0):
         if not self._is_chief:
             return
+        counters = {FAIL_COUNT: fail_count} if fail_count else {}
+        counters.update(self._timing.exec_counters())  # chief's buckets
         self._master.report_task_result(
             msg.ReportTaskResultRequest(
                 task_id=task_id,
                 err_message=err_msg,
-                exec_counters={FAIL_COUNT: fail_count} if fail_count else {},
+                exec_counters=counters,
             )
         )
 

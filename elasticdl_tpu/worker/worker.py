@@ -126,11 +126,17 @@ class Worker:
         )
 
     def report_task_result(self, task_id, err_msg="", exec_counters=None):
+        counters = dict(exec_counters or {})
+        # per-task wall-clock buckets ride the report (DEBUG runs only —
+        # Timing is disabled otherwise and contributes nothing); the
+        # per-task reset stays with report_timing(reset=True) in the
+        # task loop so the DEBUG log still prints
+        counters.update(self._timing.exec_counters())
         self._master.report_task_result(
             msg.ReportTaskResultRequest(
                 task_id=task_id,
                 err_message=err_msg,
-                exec_counters=exec_counters or {},
+                exec_counters=counters,
             )
         )
 

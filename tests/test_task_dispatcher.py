@@ -87,6 +87,22 @@ class TestTaskDispatcher:
         d.report(tid, success=True, exec_counters={FAIL_COUNT: 3})
         assert d.counters(TaskType.TRAINING).failed_records == 3
 
+    def test_exec_metrics_aggregate_across_tasks(self):
+        """Worker-reported timing buckets sum per job (VERDICT r1 #10:
+        per-task timing rides the task reports)."""
+        d = make_dispatcher(training_shards={"f": (0, 10)}, records_per_task=5)
+        t1, _ = d.get(0)
+        d.report(t1, success=True, exec_counters={"time_batch_process_ms": 40})
+        t2, _ = d.get(0)
+        d.report(
+            t2,
+            success=True,
+            exec_counters={"time_batch_process_ms": 25, FAIL_COUNT: 1},
+        )
+        counters = d.counters(TaskType.TRAINING)
+        assert counters.exec_metrics == {"time_batch_process_ms": 65}
+        assert counters.failed_records == 1
+
     def test_eval_tasks_separate_queue(self):
         d = TaskDispatcher(
             training_shards={"t": (0, 10)},
