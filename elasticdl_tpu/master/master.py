@@ -276,6 +276,12 @@ class Master:
                     "total_records": c.total_records,
                     "failed_records": c.failed_records,
                 }
+                if c.exec_metrics:
+                    # worker-reported per-job aggregates (DEBUG timing
+                    # buckets, utils.timing_utils.exec_counters)
+                    out[tt.name.lower()]["exec_metrics"] = dict(
+                        c.exec_metrics
+                    )
         summary = getattr(self.evaluation_service, "latest_summary", None)
         if summary:
             out["evaluation_metrics"] = summary

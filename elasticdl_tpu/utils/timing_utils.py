@@ -26,6 +26,7 @@ class Timing:
         self._totals: dict[str, float] = defaultdict(float)
         self._counts: dict[str, int] = defaultdict(int)
         self._starts: dict[str, float] = {}
+        self._reported_ms: dict[str, int] = defaultdict(int)
 
     def start_record_time(self, name: str):
         if self._enabled:
@@ -51,17 +52,22 @@ class Timing:
         }
 
     def exec_counters(self) -> dict[str, int]:
-        """Bucket totals as task-report counters (``time_<bucket>_ms``) —
-        attached to report_task_result so the master aggregates per-job
-        worker timing (reference reports per task at DEBUG only)."""
+        """Bucket time accrued SINCE THE LAST CALL, as task-report
+        counters (``time_<bucket>_ms``) — delta semantics so a batch that
+        completes several tasks attributes its time once, not once per
+        report, and the master's per-job sum stays exact.  Zero deltas
+        are omitted; the cumulative-ms bookkeeping keeps rounding from
+        drifting across reports."""
         if not self._enabled:
             return {}
-        return {
-            # round, don't floor: per-task resets would otherwise bias
-            # sub-millisecond buckets to an aggregate of exactly 0
-            f"time_{name}_ms": round(total * 1000)
-            for name, total in self._totals.items()
-        }
+        out = {}
+        for name, total in self._totals.items():
+            cum_ms = round(total * 1000)
+            delta = cum_ms - self._reported_ms[name]
+            if delta:
+                out[f"time_{name}_ms"] = delta
+                self._reported_ms[name] = cum_ms
+        return out
 
     def report_timing(self, reset: bool = False):
         if self._enabled and self._logger is not None:

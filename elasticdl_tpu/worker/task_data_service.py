@@ -106,7 +106,7 @@ class TaskDataService:
             else {}
         )
         self._worker.report_task_result(
-            task.task_id, err_msg, exec_counters=counters
+            task.task_id, err_msg, exec_counters=counters, include_timing=True
         )
 
     # ---- dataset construction ---------------------------------------------

@@ -125,13 +125,16 @@ class Worker:
             msg.GetTaskRequest(worker_id=self._worker_id, task_type=task_type)
         )
 
-    def report_task_result(self, task_id, err_msg="", exec_counters=None):
+    def report_task_result(
+        self, task_id, err_msg="", exec_counters=None, include_timing=False
+    ):
         counters = dict(exec_counters or {})
-        # per-task wall-clock buckets ride the report (DEBUG runs only —
-        # Timing is disabled otherwise and contributes nothing); the
-        # per-task reset stays with report_timing(reset=True) in the
-        # task loop so the DEBUG log still prints
-        counters.update(self._timing.exec_counters())
+        if include_timing:
+            # wall-clock accrued since the last report (DEBUG runs only —
+            # Timing is disabled otherwise); only the training task
+            # stream opts in, so eval/save reports never absorb leftover
+            # training buckets
+            counters.update(self._timing.exec_counters())
         self._master.report_task_result(
             msg.ReportTaskResultRequest(
                 task_id=task_id,

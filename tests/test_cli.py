@@ -140,7 +140,11 @@ def test_cli_distributed_train(tmp_path):
             "--distribution_strategy",
             "AllreduceStrategy",
             "--num_workers",
-            "1",
+            "2",  # REAL multi-process: 2 workers, one lockstep world
+            "--jax_platform",
+            "cpu",
+            "--envs",
+            "JAX_PLATFORMS=cpu,XLA_FLAGS= ",
             "--port",
             "0",
             "--output",

@@ -29,7 +29,9 @@ class _ScriptedWorker:
             return self._tasks.pop(0)
         return msg.TaskResponse()  # job complete
 
-    def report_task_result(self, task_id, err_msg="", exec_counters=None):
+    def report_task_result(
+        self, task_id, err_msg="", exec_counters=None, include_timing=False
+    ):
         self.reported.append((task_id, err_msg, exec_counters or {}))
 
 
