@@ -128,11 +128,16 @@ class LockstepWorker:
     def _is_chief(self) -> bool:
         return self._process_id == 0
 
-    def _report_task_result(self, task_id, err_msg="", fail_count=0):
+    def _report_task_result(
+        self, task_id, err_msg="", fail_count=0, include_timing=False
+    ):
         if not self._is_chief:
             return
         counters = {FAIL_COUNT: fail_count} if fail_count else {}
-        counters.update(self._timing.exec_counters())  # chief's buckets
+        if include_timing:
+            # chief's buckets; training reports only (same gating as the
+            # task-stream Worker so eval/save never absorb train time)
+            counters.update(self._timing.exec_counters())
         self._master.report_task_result(
             msg.ReportTaskResultRequest(
                 task_id=task_id,
@@ -212,7 +217,7 @@ class LockstepWorker:
                     self._trainer.train_step(
                         self._place(features), self._place(labels)
                     )
-        self._report_task_result(task.task_id)
+        self._report_task_result(task.task_id, include_timing=True)
         self._timing.report_timing(reset=True)
         self._report_version()
         self._maybe_checkpoint()

@@ -105,9 +105,10 @@ class TestTaskDispatcher:
         with timing.record("batch_process"):
             time_mod.sleep(0.02)
         second = timing.exec_counters()
-        assert 0 < second["time_batch_process_ms"] < 2 * first[
-            "time_batch_process_ms"
-        ] + 50
+        # only the delta, never the cumulative total again (no upper
+        # wall-clock bound — shared CI hosts stall unpredictably)
+        assert second["time_batch_process_ms"] > 0
+        assert timing.exec_counters() == {}
 
     def test_exec_metrics_aggregate_across_tasks(self):
         """Worker-reported timing buckets sum per job (VERDICT r1 #10:
