@@ -19,6 +19,9 @@ import elasticdl_tpu.ops.attention as attention_ops
 class MultiHeadSelfAttention(nn.Module):
     num_heads: int
     causal: bool = False
+    # grouped-query attention: fewer K/V heads than Q heads (0 = equal);
+    # shrinks the KV projection + cache by num_heads/num_kv_heads
+    num_kv_heads: int = 0
 
     @nn.compact
     def __call__(self, x):
@@ -29,13 +32,16 @@ class MultiHeadSelfAttention(nn.Module):
                 f"embed dim {embed} not divisible by {self.num_heads} heads"
             )
         head_dim = embed // self.num_heads
+        kv_heads = self.num_kv_heads or self.num_heads
 
-        def _proj(name):
+        def _proj(name, heads):
             return nn.DenseGeneral(
-                features=(self.num_heads, head_dim), name=name
+                features=(heads, head_dim), name=name
             )(x)
 
-        q, k, v = _proj("query"), _proj("key"), _proj("value")
+        q = _proj("query", self.num_heads)
+        k = _proj("key", kv_heads)
+        v = _proj("value", kv_heads)
         out = attention_ops.attention(q, k, v, causal=self.causal)
         return nn.DenseGeneral(
             features=embed, axis=(-2, -1), name="out"
@@ -50,12 +56,16 @@ class TransformerBlock(nn.Module):
     # > 0 replaces the dense MLP with a routed expert MLP (layers.moe);
     # shard experts over ep via moe_sharding_rules
     num_experts: int = 0
+    num_kv_heads: int = 0  # > 0: grouped-query attention
 
     @nn.compact
     def __call__(self, x, training: bool = False):
         y = nn.LayerNorm()(x)
         y = MultiHeadSelfAttention(
-            num_heads=self.num_heads, causal=self.causal, name="attn"
+            num_heads=self.num_heads,
+            causal=self.causal,
+            num_kv_heads=self.num_kv_heads,
+            name="attn",
         )(y)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate, deterministic=not training)(y)

@@ -37,6 +37,7 @@ class TransformerLM(nn.Module):
     num_layers: int = 2
     dropout_rate: float = 0.0
     num_experts: int = 0  # > 0: MoE MLP, experts sharded over ep
+    num_kv_heads: int = 0  # > 0: grouped-query attention
 
     @nn.compact
     def __call__(self, features, training: bool = False):
@@ -58,6 +59,7 @@ class TransformerLM(nn.Module):
                 causal=True,
                 dropout_rate=self.dropout_rate,
                 num_experts=self.num_experts,
+                num_kv_heads=self.num_kv_heads,
                 name=f"block_{layer}",
             )(x, training=training)
         x = nn.LayerNorm()(x)

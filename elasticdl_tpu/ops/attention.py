@@ -81,9 +81,30 @@ def attention_mesh_scope(mesh, sp_axis: str = "sp", sp_impl: str | None = None):
 # ---- reference (jnp) -------------------------------------------------------
 
 
+def repeat_kv_heads(q, k, v):
+    """Grouped-query attention support: when K/V carry fewer heads than
+    Q (``q_heads % kv_heads == 0``), repeat each KV head over its query
+    group so every implementation can treat heads uniformly."""
+    q_heads, kv_heads = q.shape[2], k.shape[2]
+    if kv_heads == q_heads:
+        return k, v
+    if kv_heads <= 0 or q_heads % kv_heads:
+        raise ValueError(
+            f"GQA needs q heads ({q_heads}) divisible by kv heads "
+            f"({kv_heads})"
+        )
+    group = q_heads // kv_heads
+    return (
+        jnp.repeat(k, group, axis=2),
+        jnp.repeat(v, group, axis=2),
+    )
+
+
 def mha_reference(q, k, v, causal: bool = False, sm_scale: float | None = None):
-    """Plain multi-head attention, (B, S, H, D) layout — the numerical
-    oracle for the kernels and the CPU fallback."""
+    """Plain multi-head attention, (B, S, H, D) layout (K/V may carry
+    fewer heads — GQA) — the numerical oracle for the kernels and the
+    CPU fallback."""
+    k, v = repeat_kv_heads(q, k, v)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     scores = jnp.einsum(
@@ -197,6 +218,13 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     batch, seq_q, heads, d = q.shape
+    kv_heads = k.shape[2]
+    if kv_heads <= 0 or heads % kv_heads:
+        raise ValueError(
+            f"GQA needs q heads ({heads}) divisible by kv heads "
+            f"({kv_heads})"
+        )
+    group = heads // kv_heads
     seq_k = k.shape[1]
     block_q = _pick_block(seq_q, block_q)
     block_k = _pick_block(seq_k, block_k)
@@ -204,8 +232,13 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     # (B, S, H, D) -> (B*H, S, D) for a 2-D grid over (bh, q-block)
     def _fold(x):
         return x.transpose(0, 2, 1, 3).reshape(
-            batch * heads, x.shape[1], d
+            batch * x.shape[2], x.shape[1], d
         )
+
+    def _kv_index(b, i):
+        # GQA without materializing repeated K/V: the q-head program bh
+        # reads its group's single kv head
+        return ((b // heads) * kv_heads + (b % heads) // group, 0, 0)
 
     qf, kf, vf = _fold(q), _fold(k), _fold(v)
     kernel = functools.partial(
@@ -220,8 +253,8 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         grid=(batch * heads, seq_q // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), _kv_index),
+            pl.BlockSpec((1, seq_k, d), _kv_index),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((batch * heads, seq_q, d), q.dtype),

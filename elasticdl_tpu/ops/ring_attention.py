@@ -51,19 +51,25 @@ def _ring_attention_local(
     batch, _, heads, d = q.shape
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
+    group = q.shape[2] // k.shape[2]  # GQA: rotate the SMALL kv tensors
+
     def step(s, carry):
         acc, m, l, k_cur, v_cur = carry
         # the chunk we hold at step s started on device (my_idx - s)
         src = (my_idx - s) % axis_size
+        # expand grouped kv heads locally, AFTER the rotation — ppermute
+        # traffic stays at kv_heads size
+        k_exp = jnp.repeat(k_cur, group, axis=2) if group > 1 else k_cur
+        v_exp = jnp.repeat(v_cur, group, axis=2) if group > 1 else v_cur
         scores = _chunk_scores(
-            q, k_cur, sm_scale, causal, my_idx * chunk_q, src * chunk_k
+            q, k_exp, sm_scale, causal, my_idx * chunk_q, src * chunk_k
         )  # (B, H, Sq, Sk)
         m_new = jnp.maximum(m, scores.max(axis=-1))
         p = jnp.exp(scores - m_new[..., None])
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + p.sum(axis=-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32)
+            "bhqk,bkhd->bhqd", p, v_exp.astype(jnp.float32)
         )
         # rotate AFTER using the chunk; the final rotation restores the
         # original K/V residency (and XLA overlaps it with compute)
@@ -123,6 +129,12 @@ def ring_attention(
     sharded however the surrounding program shards it (specs below only
     constrain the sequence dim).
     """
+    q_heads, kv_heads = q.shape[2], k.shape[2]
+    if kv_heads <= 0 or q_heads % kv_heads:
+        raise ValueError(
+            f"GQA needs q heads ({q_heads}) divisible by kv heads "
+            f"({kv_heads})"
+        )
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     axis_size = mesh.shape[axis_name]
@@ -133,16 +145,18 @@ def ring_attention(
 
     from jax.experimental.shard_map import shard_map
 
-    from elasticdl_tpu.parallel.mesh import data_parallel_axes
-
     if q.shape[1] % axis_size:
         raise ValueError(
             f"ring attention needs seq ({q.shape[1]}) divisible by "
             f"{axis_name}={axis_size}"
         )
     # batch on dp when divisible; heads stay tp-sharded through the ring
-    # (embarrassingly parallel over heads)
-    spec = sequence_shard_spec(mesh, axis_name, q.shape[0], q.shape[2])
+    # (embarrassingly parallel over heads).  Under GQA the small kv
+    # tensors rotate un-repeated (expansion is chunk-local in the body)
+    # and head sharding is disabled to keep query groups aligned.
+    spec = sequence_shard_spec(mesh, axis_name, q.shape[0], q_heads)
+    if kv_heads != q_heads and spec[2] is not None:
+        spec = P(spec[0], axis_name, None, None)
     body = functools.partial(
         _ring_attention_local,
         axis_name=axis_name,

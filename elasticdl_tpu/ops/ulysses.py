@@ -60,6 +60,9 @@ def ulysses_attention(
 
     Requires ``heads % sp == 0`` and ``seq % sp == 0``.
     """
+    from elasticdl_tpu.ops.attention import repeat_kv_heads
+
+    k, v = repeat_kv_heads(q, k, v)  # GQA: uniform heads for all_to_all
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     sp = mesh.shape[axis_name]
