@@ -79,6 +79,15 @@ def _configs(n_chips: int = 1):
             labels=rng.randint(0, 2, 512).astype(np.int32),
             batch=512,
         ),
+        # ImageNet-shape ResNet-50 (BASELINE.md config 3, single chip)
+        "imagenet_resnet50": dict(
+            model_def="imagenet_resnet50.imagenet_resnet50.custom_model",
+            features={
+                "image": rng.rand(64, 224, 224, 3).astype(np.float32)
+            },
+            labels=rng.randint(0, 1000, 64).astype(np.int32),
+            batch=64,
+        ),
         # long-context transformer (pallas flash attention); the
         # reference has no transformer, so no baseline anchor exists —
         # the per-chip rate is the metric (samples = sequences; x seq_len
@@ -188,19 +197,28 @@ def main():
 
     models = {}
     for name, cfg in _configs(max(1, mesh.devices.size)).items():
-        models[name] = _measure(name, cfg, mesh)
+        try:
+            models[name] = _measure(name, cfg, mesh)
+        except Exception as ex:  # noqa: BLE001 — one config must not
+            # take down the headline metric (e.g. a flaky remote-compile
+            # tunnel on large HLO payloads)
+            print(f"bench config {name} failed: {ex}", file=sys.stderr)
+            models[name] = {"error": str(ex)[:200]}
+            continue
         base = baselines.get(name)
         if base:
             models[name]["vs_baseline"] = round(
                 models[name]["samples_per_sec_per_chip"] / base, 2
             )
 
-    head = models["resnet50_cifar10"]
+    # the headline must survive its own config failing (the whole point
+    # of the per-config isolation above)
+    head = models.get("resnet50_cifar10") or {}
     print(
         json.dumps(
             {
                 "metric": "resnet50_cifar10_train_samples_per_sec_per_chip",
-                "value": head["samples_per_sec_per_chip"],
+                "value": head.get("samples_per_sec_per_chip"),
                 "unit": "samples/sec/chip",
                 # null (not 0.0) when no anchor exists — a consumer must
                 # not read "baseline missing" as "infinitely regressed"

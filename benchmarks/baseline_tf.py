@@ -36,7 +36,12 @@ import tensorflow as tf  # noqa: E402
 
 # identical batch sizes to bench.py's JAX side (the vs_baseline ratios
 # must compare the same configuration)
-BATCHES = {"mnist": 256, "resnet50_cifar10": 512, "deepfm": 512}
+BATCHES = {
+    "mnist": 256,
+    "resnet50_cifar10": 512,
+    "imagenet_resnet50": 64,
+    "deepfm": 512,
+}
 
 
 def mnist_model():
@@ -61,6 +66,16 @@ def mnist_model():
 def resnet50_model():
     model = tf.keras.applications.ResNet50(
         weights=None, input_shape=(32, 32, 3), classes=10
+    )
+    loss = lambda labels, probs: tf.reduce_mean(  # noqa: E731
+        tf.keras.losses.sparse_categorical_crossentropy(labels, probs)
+    )
+    return model, loss
+
+
+def imagenet_resnet50_model():
+    model = tf.keras.applications.ResNet50(
+        weights=None, input_shape=(224, 224, 3), classes=1000
     )
     loss = lambda labels, probs: tf.reduce_mean(  # noqa: E731
         tf.keras.losses.sparse_categorical_crossentropy(labels, probs)
@@ -116,6 +131,11 @@ def make_batch(name, rng):
             tf.constant(rng.rand(b, 32, 32, 3).astype(np.float32)),
             tf.constant(rng.randint(0, 10, b).astype(np.int32)),
         )
+    if name == "imagenet_resnet50":
+        return (
+            tf.constant(rng.rand(b, 224, 224, 3).astype(np.float32)),
+            tf.constant(rng.randint(0, 1000, b).astype(np.int32)),
+        )
     return (
         tf.constant(rng.randint(0, 5383, (b, 10)).astype(np.int32)),
         tf.constant(rng.randint(0, 2, b).astype(np.int32)),
@@ -125,6 +145,7 @@ def make_batch(name, rng):
 MODELS = {
     "mnist": mnist_model,
     "resnet50_cifar10": resnet50_model,
+    "imagenet_resnet50": imagenet_resnet50_model,
     "deepfm": deepfm_model,
 }
 

@@ -81,19 +81,30 @@ def attention_mesh_scope(mesh, sp_axis: str = "sp", sp_impl: str | None = None):
 # ---- reference (jnp) -------------------------------------------------------
 
 
-def repeat_kv_heads(q, k, v):
-    """Grouped-query attention support: when K/V carry fewer heads than
-    Q (``q_heads % kv_heads == 0``), repeat each KV head over its query
-    group so every implementation can treat heads uniformly."""
+def validate_gqa_heads(q, k, v) -> int:
+    """The ONE place the grouped-query head constraint lives: K and V
+    must agree, and q heads must be a multiple of kv heads.  Returns the
+    group factor (1 = plain MHA)."""
     q_heads, kv_heads = q.shape[2], k.shape[2]
-    if kv_heads == q_heads:
-        return k, v
+    if v.shape[2] != kv_heads:
+        raise ValueError(
+            f"k and v head counts differ: {kv_heads} vs {v.shape[2]}"
+        )
     if kv_heads <= 0 or q_heads % kv_heads:
         raise ValueError(
             f"GQA needs q heads ({q_heads}) divisible by kv heads "
             f"({kv_heads})"
         )
-    group = q_heads // kv_heads
+    return q_heads // kv_heads
+
+
+def repeat_kv_heads(q, k, v):
+    """Grouped-query attention support: when K/V carry fewer heads than
+    Q, repeat each KV head over its query group so the caller can treat
+    heads uniformly."""
+    group = validate_gqa_heads(q, k, v)
+    if group == 1:
+        return k, v
     return (
         jnp.repeat(k, group, axis=2),
         jnp.repeat(v, group, axis=2),
@@ -218,13 +229,8 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     batch, seq_q, heads, d = q.shape
+    group = validate_gqa_heads(q, k, v)
     kv_heads = k.shape[2]
-    if kv_heads <= 0 or heads % kv_heads:
-        raise ValueError(
-            f"GQA needs q heads ({heads}) divisible by kv heads "
-            f"({kv_heads})"
-        )
-    group = heads // kv_heads
     seq_k = k.shape[1]
     block_q = _pick_block(seq_q, block_q)
     block_k = _pick_block(seq_k, block_k)

@@ -129,12 +129,10 @@ def ring_attention(
     sharded however the surrounding program shards it (specs below only
     constrain the sequence dim).
     """
+    from elasticdl_tpu.ops.attention import validate_gqa_heads
+
+    validate_gqa_heads(q, k, v)
     q_heads, kv_heads = q.shape[2], k.shape[2]
-    if kv_heads <= 0 or q_heads % kv_heads:
-        raise ValueError(
-            f"GQA needs q heads ({q_heads}) divisible by kv heads "
-            f"({kv_heads})"
-        )
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     axis_size = mesh.shape[axis_name]

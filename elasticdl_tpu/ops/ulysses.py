@@ -21,9 +21,13 @@ import math
 import jax
 
 
-def _ulysses_local(q, k, v, *, axis_name, causal, sm_scale, interpret):
+def _ulysses_local(
+    q, k, v, *, axis_name, causal, sm_scale, interpret, group, sp
+):
     """Per-shard body (under shard_map): inputs are (B, S/n, H, D);
-    all_to_all to (B, S, H/n, D), flash attention, and back."""
+    all_to_all to (B, S, H/n, D), flash attention (GQA-aware: kv may
+    still carry fewer heads after the reshard), and back."""
+    import jax.numpy as jnp
 
     def seq_to_heads(x):
         # concat_dimension=1 gathers the sequence; split_dimension=2
@@ -39,6 +43,12 @@ def _ulysses_local(q, k, v, *, axis_name, causal, sm_scale, interpret):
 
     from elasticdl_tpu.ops.attention import flash_attention
 
+    if group > 1 and k.shape[2] % sp != 0:
+        # kv heads don't split over sp: expand BEFORE the reshard (the
+        # divisible case moves the SMALL kv through the all_to_all and
+        # lets flash's GQA indexing handle the reduced head count)
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     out = flash_attention(
         qh, kh, vh, causal=causal, sm_scale=sm_scale, interpret=interpret
@@ -60,9 +70,9 @@ def ulysses_attention(
 
     Requires ``heads % sp == 0`` and ``seq % sp == 0``.
     """
-    from elasticdl_tpu.ops.attention import repeat_kv_heads
+    from elasticdl_tpu.ops.attention import validate_gqa_heads
 
-    k, v = repeat_kv_heads(q, k, v)  # GQA: uniform heads for all_to_all
+    group = validate_gqa_heads(q, k, v)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     sp = mesh.shape[axis_name]
@@ -80,12 +90,16 @@ def ulysses_attention(
 
     from elasticdl_tpu.ops.ring_attention import sequence_shard_spec
 
-    # shared layout with ring (batch on dp, heads tp-sharded when they
-    # fit); head_divisor=sp because the inner all_to_all further splits
-    # each device's head group sp ways
+    # shared layout with ring (batch on dp; head sharding over tp is
+    # disabled under GQA — query groups must stay aligned); head_divisor
+    # = sp because the inner all_to_all splits the head dim sp ways
     spec = sequence_shard_spec(
         mesh, axis_name, q.shape[0], q.shape[2], head_divisor=sp
     )
+    if group > 1 and spec[2] is not None:
+        from jax.sharding import PartitionSpec as P
+
+        spec = P(spec[0], axis_name, None, None)
     local_heads = q.shape[2] // (
         mesh.shape["tp"] if spec[2] == "tp" else 1
     )
@@ -101,6 +115,8 @@ def ulysses_attention(
         causal=causal,
         sm_scale=sm_scale,
         interpret=interpret,
+        group=group,
+        sp=sp,
     )
     return shard_map(
         body,

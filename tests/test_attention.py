@@ -98,6 +98,16 @@ def test_gqa_all_implementations_agree():
         np.asarray(uly), np.asarray(ref), atol=2e-5, rtol=2e-5
     )
 
+    # ulysses' small-kv path: kv heads divide sp, so the un-repeated kv
+    # rides the all_to_all and flash's GQA indexing runs per shard
+    k4 = rng.randn(2, 64, 4, 16).astype(np.float32)
+    v4 = rng.randn(2, 64, 4, 16).astype(np.float32)
+    ref4 = mha_reference(q, k4, v4, causal=True)
+    uly4 = ulysses_attention(q, k4, v4, mesh=mesh, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(uly4), np.asarray(ref4), atol=2e-5, rtol=2e-5
+    )
+
 
 def test_gqa_gradients_and_transformer_on_sp_mesh():
     """GQA flash gradients match differentiating the oracle, and a GQA
