@@ -11,6 +11,7 @@ ring schedule.
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 import elasticdl_tpu.ops.attention as attention_ops
@@ -22,10 +23,19 @@ class MultiHeadSelfAttention(nn.Module):
     # grouped-query attention: fewer K/V heads than Q heads (0 = equal);
     # shrinks the KV projection + cache by num_heads/num_kv_heads
     num_kv_heads: int = 0
+    # autoregressive decoding: keep a KV cache in the "cache" variable
+    # collection (apply with mutable=["cache"]); each call appends one
+    # step's K/V and attends over the filled prefix
+    decode: bool = False
+    max_decode_len: int = 0
 
     @nn.compact
-    def __call__(self, x):
-        """x: (batch, seq, embed) -> (batch, seq, embed)."""
+    def __call__(self, x, decode_pos=None):
+        """x: (batch, seq, embed) -> (batch, seq, embed).
+
+        ``decode_pos``: the model's single decode cursor (traced scalar),
+        required in decode mode — there is ONE position source of truth,
+        not one per layer."""
         embed = x.shape[-1]
         if embed % self.num_heads:
             raise ValueError(
@@ -42,10 +52,66 @@ class MultiHeadSelfAttention(nn.Module):
         q = _proj("query", self.num_heads)
         k = _proj("key", kv_heads)
         v = _proj("value", kv_heads)
-        out = attention_ops.attention(q, k, v, causal=self.causal)
+        if self.decode:
+            if decode_pos is None:
+                raise ValueError("decode mode needs decode_pos")
+            out = self._decode_attend(q, k, v, decode_pos)
+        else:
+            out = attention_ops.attention(q, k, v, causal=self.causal)
         return nn.DenseGeneral(
             features=embed, axis=(-2, -1), name="out"
         )(out.astype(x.dtype))
+
+    def _decode_attend(self, q, k, v, pos):
+        """One decode step: append this step's K/V to the cache at
+        ``pos``, attend the single query over the filled prefix
+        (positions beyond the cursor are masked)."""
+        if not self.max_decode_len:
+            raise ValueError("decode=True needs max_decode_len")
+        if q.shape[1] != 1:
+            raise ValueError(
+                f"decode mode consumes one token per call, got seq "
+                f"{q.shape[1]}"
+            )
+        batch, _, kv_heads, head_dim = k.shape
+        cache_shape = (batch, self.max_decode_len, kv_heads, head_dim)
+        ck = self.variable(
+            "cache", "k", lambda: jnp.zeros(cache_shape, k.dtype)
+        )
+        cv = self.variable(
+            "cache", "v", lambda: jnp.zeros(cache_shape, v.dtype)
+        )
+        if not self.is_initializing():
+            # init() runs this call once to create the variables; it must
+            # NOT consume cache slot 0
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k, (0, pos, 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v, (0, pos, 0, 0)
+            )
+
+        kf, vf = attention_ops.repeat_kv_heads(q, ck.value, cv.value)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+        scores = (
+            jnp.einsum(
+                "bqhd,bkhd->bhqk",
+                q.astype(jnp.float32),
+                kf.astype(jnp.float32),
+            )
+            * scale
+        )
+        valid = (
+            jnp.arange(self.max_decode_len) <= pos
+        )  # filled prefix incl. this step
+        scores = jnp.where(
+            valid[None, None, None, :], scores, attention_ops._NEG_INF
+        )
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum(
+            "bhqk,bkhd->bqhd", probs, vf.astype(jnp.float32)
+        )
+        return out.astype(q.dtype)
 
 
 class TransformerBlock(nn.Module):
@@ -57,16 +123,20 @@ class TransformerBlock(nn.Module):
     # shard experts over ep via moe_sharding_rules
     num_experts: int = 0
     num_kv_heads: int = 0  # > 0: grouped-query attention
+    decode: bool = False  # autoregressive decoding with a KV cache
+    max_decode_len: int = 0
 
     @nn.compact
-    def __call__(self, x, training: bool = False):
+    def __call__(self, x, training: bool = False, decode_pos=None):
         y = nn.LayerNorm()(x)
         y = MultiHeadSelfAttention(
             num_heads=self.num_heads,
             causal=self.causal,
             num_kv_heads=self.num_kv_heads,
+            decode=self.decode,
+            max_decode_len=self.max_decode_len,
             name="attn",
-        )(y)
+        )(y, decode_pos=decode_pos)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate, deterministic=not training)(y)
         x = x + y

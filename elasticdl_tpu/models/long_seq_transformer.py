@@ -15,6 +15,7 @@ is next-token prediction.
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -38,6 +39,8 @@ class TransformerLM(nn.Module):
     dropout_rate: float = 0.0
     num_experts: int = 0  # > 0: MoE MLP, experts sharded over ep
     num_kv_heads: int = 0  # > 0: grouped-query attention
+    decode: bool = False  # one-token-per-call decoding with KV caches
+    max_decode_len: int = 0
 
     @nn.compact
     def __call__(self, features, training: bool = False):
@@ -50,9 +53,26 @@ class TransformerLM(nn.Module):
         )
         # parameter-free positions: a sequence-sharded activation adds its
         # slice of the encoding without any table gather
-        x = x + sinusoidal_positions(tokens.shape[1], self.embed_dim)[
-            None, :, :
-        ].astype(x.dtype)
+        decode_pos = None
+        if self.decode:
+            # the ONE decode cursor: position encoding and every layer's
+            # KV-cache write derive from it
+            pos_var = self.variable(
+                "cache", "pos", lambda: jnp.zeros((), jnp.int32)
+            )
+            decode_pos = pos_var.value
+            enc = sinusoidal_positions(
+                self.max_decode_len, self.embed_dim
+            )
+            x = x + jax.lax.dynamic_slice_in_dim(
+                enc, decode_pos, 1
+            )[None, :, :].astype(x.dtype)
+            if not self.is_initializing():  # init must not advance
+                pos_var.value = decode_pos + 1
+        else:
+            x = x + sinusoidal_positions(tokens.shape[1], self.embed_dim)[
+                None, :, :
+            ].astype(x.dtype)
         for layer in range(self.num_layers):
             x = TransformerBlock(
                 num_heads=self.num_heads,
@@ -60,8 +80,10 @@ class TransformerLM(nn.Module):
                 dropout_rate=self.dropout_rate,
                 num_experts=self.num_experts,
                 num_kv_heads=self.num_kv_heads,
+                decode=self.decode,
+                max_decode_len=self.max_decode_len,
                 name=f"block_{layer}",
-            )(x, training=training)
+            )(x, training=training, decode_pos=decode_pos)
         x = nn.LayerNorm()(x)
         return nn.Dense(self.vocab_size, name="lm_head")(x)
 
@@ -111,3 +133,63 @@ def dataset_fn(dataset, mode, metadata):
 
 def eval_metrics_fn():
     return {"accuracy": Accuracy()}
+
+
+def generate(
+    params,
+    prompt,
+    num_steps: int,
+    model: TransformerLM | None = None,
+    **model_kwargs,
+):
+    """Greedy autoregressive generation with KV caches.
+
+    params: trained parameters (from any of the training runtimes — the
+    decode model shares the exact parameter structure).
+    prompt: (batch, prompt_len) int tokens.
+    Returns (batch, prompt_len + num_steps) tokens.
+
+    Each step feeds ONE token: the per-layer KV caches make a step
+    O(seq) instead of O(seq^2) — this is the inference-side payoff of
+    ``num_kv_heads`` (the cache shrinks by the GQA group factor).
+    """
+    if model is not None and model_kwargs:
+        raise ValueError(
+            "pass either a model or model_kwargs, not both "
+            f"(got model + {sorted(model_kwargs)})"
+        )
+    prompt = jnp.asarray(prompt, jnp.int32)
+    batch, prompt_len = prompt.shape
+    max_len = prompt_len + num_steps
+    base = model or TransformerLM(**model_kwargs)
+    decode_model = base.clone(decode=True, max_decode_len=max_len)
+
+    # empty caches from shapes only — no throwaway parameter init
+    cache_shapes = jax.eval_shape(
+        lambda: decode_model.init(
+            jax.random.PRNGKey(0),
+            {"tokens": jnp.zeros((batch, 1), jnp.int32)},
+        )["cache"]
+    )
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+    )
+
+    @jax.jit
+    def step(params, cache, token):
+        logits, mutated = decode_model.apply(
+            {"params": params, "cache": cache},
+            {"tokens": token},
+            mutable=["cache"],
+        )
+        return mutated["cache"], jnp.argmax(logits[:, -1], axis=-1)
+
+    next_token = None
+    for i in range(prompt_len):  # prefill one token at a time
+        cache, next_token = step(params, cache, prompt[:, i : i + 1])
+    out = [prompt[:, i] for i in range(prompt_len)]
+    for i in range(num_steps):
+        out.append(next_token)
+        if i < num_steps - 1:  # the final step's forward would be unused
+            cache, next_token = step(params, cache, next_token[:, None])
+    return jnp.stack(out, axis=1)
