@@ -140,13 +140,20 @@ def generate(
     prompt,
     num_steps: int,
     model: TransformerLM | None = None,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    rng=None,
     **model_kwargs,
 ):
-    """Greedy autoregressive generation with KV caches.
+    """Autoregressive generation with KV caches.
 
     params: trained parameters (from any of the training runtimes — the
     decode model shares the exact parameter structure).
     prompt: (batch, prompt_len) int tokens.
+    temperature: <= 0 decodes greedily; > 0 samples from
+        softmax(logits / temperature), optionally truncated to the
+        ``top_k`` most likely tokens (0 = no truncation).  Sampling
+        needs ``rng`` (a jax PRNG key).
     Returns (batch, prompt_len + num_steps) tokens.
 
     Each step feeds ONE token: the per-layer KV caches make a step
@@ -158,6 +165,10 @@ def generate(
             "pass either a model or model_kwargs, not both "
             f"(got model + {sorted(model_kwargs)})"
         )
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng key")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
     prompt = jnp.asarray(prompt, jnp.int32)
     batch, prompt_len = prompt.shape
     max_len = prompt_len + num_steps
@@ -175,21 +186,45 @@ def generate(
         lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
     )
 
+    def _select(logits, key):
+        if temperature <= 0:
+            return jnp.argmax(logits, axis=-1)
+        scaled = logits.astype(jnp.float32) / temperature
+        if top_k:
+            # clamp to the vocab; lax.top_k is O(V) vs a full sort
+            kth = jax.lax.top_k(
+                scaled, min(top_k, scaled.shape[-1])
+            )[0][:, -1:]
+            scaled = jnp.where(scaled >= kth, scaled, -1e30)
+        return jax.random.categorical(key, scaled, axis=-1)
+
     @jax.jit
-    def step(params, cache, token):
+    def step(params, cache, token, key):
         logits, mutated = decode_model.apply(
             {"params": params, "cache": cache},
             {"tokens": token},
             mutable=["cache"],
         )
-        return mutated["cache"], jnp.argmax(logits[:, -1], axis=-1)
+        return mutated["cache"], _select(logits[:, -1], key)
 
+    n_keys = prompt_len + num_steps
+    keys = (
+        jax.random.split(rng, n_keys)
+        if rng is not None
+        # greedy never consults the key; any constant keeps step's
+        # signature uniform
+        else [jax.random.PRNGKey(0)] * n_keys
+    )
     next_token = None
     for i in range(prompt_len):  # prefill one token at a time
-        cache, next_token = step(params, cache, prompt[:, i : i + 1])
+        cache, next_token = step(
+            params, cache, prompt[:, i : i + 1], keys[i]
+        )
     out = [prompt[:, i] for i in range(prompt_len)]
     for i in range(num_steps):
         out.append(next_token)
         if i < num_steps - 1:  # the final step's forward would be unused
-            cache, next_token = step(params, cache, next_token[:, None])
+            cache, next_token = step(
+                params, cache, next_token[:, None], keys[prompt_len + i]
+            )
     return jnp.stack(out, axis=1)

@@ -57,6 +57,52 @@ def test_cached_generation_matches_full_forward_gqa():
     np.testing.assert_array_equal(np.asarray(cached), np.asarray(oracle))
 
 
+def test_sampling_modes():
+    """temperature=0 is greedy; near-zero temperature sampling matches
+    greedy (the distribution collapses onto the argmax); same seed is
+    reproducible and sampling needs a key."""
+    import pytest
+
+    kwargs = dict(vocab_size=32, num_layers=1, embed_dim=32, num_heads=2)
+    model = lm.custom_model(**kwargs)
+    params = _init_params(model)
+    prompt = jnp.asarray([[1, 2]], jnp.int32)
+
+    greedy = lm.generate(params, prompt, num_steps=5, **kwargs)
+    cold = lm.generate(
+        params,
+        prompt,
+        num_steps=5,
+        temperature=1e-4,
+        rng=jax.random.PRNGKey(7),
+        **kwargs,
+    )
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(cold))
+
+    hot_a = lm.generate(
+        params,
+        prompt,
+        num_steps=5,
+        temperature=5.0,
+        top_k=8,
+        rng=jax.random.PRNGKey(1),
+        **kwargs,
+    )
+    hot_b = lm.generate(
+        params,
+        prompt,
+        num_steps=5,
+        temperature=5.0,
+        top_k=8,
+        rng=jax.random.PRNGKey(1),
+        **kwargs,
+    )
+    np.testing.assert_array_equal(np.asarray(hot_a), np.asarray(hot_b))
+
+    with pytest.raises(ValueError):
+        lm.generate(params, prompt, num_steps=2, temperature=1.0, **kwargs)
+
+
 def test_trained_model_generates_the_markov_chain(tmp_path):
     """Train briefly on gen_sequence's permutation chain, then generate:
     most continuations should follow next = perm[cur] (noise rate 5%)."""
