@@ -30,13 +30,14 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
-def pipeline_sharding_rules(prefix: str = "stages"):
-    """Rule sharding the leading stage dimension of stacked parameters
-    over ``pp`` (pair with ``scan``-stacked or manually stacked layer
-    weights whose path contains ``prefix``)."""
+def pipeline_sharding_rules(pattern: str = r"(^|/)stages[_/]"):
+    """The canonical 'stage-stacked params shard dim 0 over pp' rule:
+    matches a path SEGMENT named/prefixed ``stages`` (nested ``stages/x``
+    or flat ``stages_x``), anchored so e.g. ``extra_stages_bias`` does
+    not shard accidentally."""
     from elasticdl_tpu.parallel.sharding import Rule
 
-    return [Rule(rf"{prefix}/", P("pp"))]
+    return [Rule(pattern, P("pp"))]
 
 
 def _pipeline_local(params, x_mb, *, stage_fn, axis_name, num_stages):

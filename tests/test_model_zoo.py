@@ -50,10 +50,17 @@ ZOO = [
     ("census_dnn_model.census_subclass.custom_model", "census", 64, 16),
     ("heart_functional_api.heart_functional_api.custom_model", "heart", 64, 16),
     ("odps_iris_dnn_model.odps_iris_dnn_model.custom_model", "iris", 64, 16),
-    # TPU-build addition (no reference counterpart): long-context
-    # transformer, flash attention on the single-device path
+    # TPU-build additions (no reference counterpart): long-context
+    # transformer (flash attention on the single-device path) and the
+    # pipeline-parallel transformer (sequential-scan path here)
     (
         "long_seq_transformer.long_seq_transformer.custom_model",
+        "sequence",
+        32,
+        8,
+    ),
+    (
+        "pipelined_transformer.pipelined_transformer.custom_model",
         "sequence",
         32,
         8,
