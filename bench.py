@@ -178,6 +178,37 @@ def _measure(name, cfg, mesh):
     return result
 
 
+def _measure_reform():
+    """Elastic re-formation latency (BASELINE.md config 5), in a CPU
+    subprocess so the kill-and-relaunch job never touches the chip the
+    throughput configs are timing."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks",
+        "reform_bench.py",
+    )
+    proc = subprocess.run(
+        [sys.executable, script],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(
+        f"no JSON from reform bench (rc={proc.returncode}): "
+        f"{proc.stderr[-300:]}"
+    )
+
+
 def main():
     import jax  # noqa: F401 — device init before timing
 
@@ -210,6 +241,12 @@ def main():
             models[name]["vs_baseline"] = round(
                 models[name]["samples_per_sec_per_chip"] / base, 2
             )
+
+    try:
+        models["elastic_reform"] = _measure_reform()
+    except Exception as ex:  # noqa: BLE001 — same isolation as above
+        print(f"bench config elastic_reform failed: {ex}", file=sys.stderr)
+        models["elastic_reform"] = {"error": str(ex)[:200]}
 
     # the headline must survive its own config failing (the whole point
     # of the per-config isolation above)

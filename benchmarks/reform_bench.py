@@ -1,0 +1,154 @@
+"""Elastic re-formation latency benchmark (BASELINE.md config 5).
+
+Runs a real 2-process lockstep job on the host CPU backend, SIGKILLs one
+worker mid-epoch, and measures the mesh re-formation the master performs
+(reference behavior: pod kill -> task re-queue -> relaunch,
+``elasticdl/python/master/k8s_instance_manager.py:241-275``; here the
+whole ``jax.distributed`` world is fenced, re-queued, and relaunched —
+``master/master.py:_handle_dead_workers``).
+
+Prints ONE JSON line:
+  {"reform_latency_secs": R, "kill_to_step_secs": T,
+   "detect_secs": D, "records_ok": true}
+
+- ``reform_latency_secs`` — detection -> first step-task pull of the new
+  world (the re-form cost the framework controls).
+- ``kill_to_step_secs``  — SIGKILL -> first post-re-form step pull (adds
+  the heartbeat detection window, like the reference's k8s watch delay).
+
+Run standalone: ``python benchmarks/reform_bench.py``.  ``bench.py``
+invokes it in a subprocess with ``JAX_PLATFORMS=cpu`` so the measurement
+never touches the TPU chip the throughput configs are using.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "")
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+HEARTBEAT_TIMEOUT_SECS = 3
+
+
+def measure(workdir: str) -> dict:
+    from elasticdl_tpu.data.recordio_gen import synthetic
+    from elasticdl_tpu.master.main import build_master
+    from elasticdl_tpu.utils.args import parse_master_args
+    from elasticdl_tpu.utils.constants import TaskType
+
+    train = synthetic.gen_mnist(
+        os.path.join(workdir, "train"), num_records=512, num_shards=2, seed=3
+    )
+    ckpt = os.path.join(workdir, "ckpt")
+    args = parse_master_args(
+        [
+            "--model_def",
+            "mnist_functional_api.mnist_functional_api.custom_model",
+            "--training_data",
+            train,
+            "--minibatch_size",
+            "32",
+            "--records_per_task",
+            "64",
+            "--num_epochs",
+            "2",
+            "--compute_dtype",
+            "float32",
+            "--shuffle_seed",
+            "5",
+            "--jax_platform",
+            "cpu",
+            "--envs",
+            "JAX_PLATFORMS=cpu,XLA_FLAGS= ",
+            "--port",
+            "0",
+            "--distribution_strategy",
+            "AllreduceStrategy",
+            "--num_workers",
+            "2",
+            "--checkpoint_dir",
+            ckpt,
+            "--checkpoint_steps",
+            "2",
+            "--heartbeat_timeout_secs",
+            str(HEARTBEAT_TIMEOUT_SECS),
+        ]
+    )
+    master = build_master(args)
+    master.prepare()
+    rc: list[int] = []
+    runner = threading.Thread(target=lambda: rc.append(master.run()))
+    runner.start()
+    killed_at = None
+    try:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if os.path.isdir(ckpt) and any(
+                name.startswith("version-") for name in os.listdir(ckpt)
+            ):
+                break
+            time.sleep(0.25)
+        else:
+            raise RuntimeError("job never reached the first checkpoint")
+
+        victims = master.instance_manager.worker_ids()
+        victim = master.instance_manager._procs[victims[-1]]
+        killed_at = time.monotonic()
+        os.kill(victim.pid, signal.SIGKILL)
+
+        runner.join(timeout=600)
+        if runner.is_alive():
+            raise RuntimeError("master never finished after the kill")
+    finally:
+        master.request_stop()
+        runner.join(timeout=30)
+
+    counters = master.task_d.counters(TaskType.TRAINING)
+    event = master.reform_events[0] if master.reform_events else {}
+    pull_at = master.servicer.first_stream_pull_at()
+    out = {
+        "reform_latency_secs": round(event.get("latency_secs", -1.0), 3),
+        "detect_secs": (
+            round(event["detected_at"] - killed_at, 3)
+            if event and killed_at is not None
+            else None
+        ),
+        "kill_to_step_secs": (
+            round(pull_at - killed_at, 3)
+            if pull_at is not None and killed_at is not None
+            else None
+        ),
+        "records_ok": (
+            rc == [0]
+            and master.task_d.finished()
+            and counters.total_records == 2 * 512
+        ),
+        "heartbeat_timeout_secs": HEARTBEAT_TIMEOUT_SECS,
+        # >0 proves the re-formed world came from the hot-standby pool
+        # (the cold-start path would dominate reform_latency_secs)
+        "standby_activated": master.instance_manager.standby_activations,
+    }
+    if not out["records_ok"]:
+        out["rc"] = rc
+        out["total_records"] = counters.total_records
+    return out
+
+
+def main():
+    with tempfile.TemporaryDirectory() as workdir:
+        print(json.dumps(measure(workdir)))
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,12 @@ def build_master(args) -> Master:
 
             from elasticdl_tpu.k8s.instance_manager import K8sInstanceManager
 
+            if getattr(args, "standby_workers", -1) > 0:
+                logger.warning(
+                    "--standby_workers is not implemented for the k8s "
+                    "backend; pods cold-start on re-formation"
+                )
+
             return K8sInstanceManager(
                 num_workers=num_workers,
                 build_argv=build_argv,
@@ -78,6 +84,7 @@ def build_master(args) -> Master:
             # N>1 workers = one jax.distributed world training ONE model
             lockstep=lockstep,
             max_reforms=max_reforms,
+            standby_workers=getattr(args, "standby_workers", -1),
         )
 
     return Master(args, instance_manager_factory=im_factory)

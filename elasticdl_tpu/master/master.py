@@ -16,6 +16,7 @@ SAVE_MODEL deferred callback (:122-129), and polls ``task_d.finished()``
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
@@ -162,6 +163,16 @@ class Master:
                     break
                 if self._stop_requested:
                     break
+                if self.instance_manager is not None:
+                    # local process-exit events (the subprocess analogue
+                    # of the k8s pod watch): an abnormal exit is detected
+                    # in one poll tick instead of a heartbeat timeout
+                    poll_failed = getattr(
+                        self.instance_manager, "poll_failed_workers", None
+                    )
+                    if poll_failed is not None:
+                        for worker_id in poll_failed():
+                            self.servicer.mark_worker_dead(worker_id)
                 dead = self.servicer.dead_workers(
                     getattr(self._args, "heartbeat_timeout_secs", 0) or 0
                 )
@@ -320,6 +331,7 @@ class LocalInstanceManager:
         envs: dict[str, str] | None = None,
         lockstep: bool = False,
         max_reforms: int = 3,
+        standby_workers: int = -1,
     ):
         self._master = master
         self._num_workers = num_workers
@@ -332,6 +344,21 @@ class LocalInstanceManager:
         self._procs: dict[int, object] = {}
         self._next_worker_id = 0
         self._lock = threading.Lock()
+        # hot-standby pool: processes spawned warm (imports done, blocked
+        # on stdin) so reform_world skips the worker cold start — the
+        # dominant term of re-formation latency.  Only a lockstep world
+        # re-forms wholesale, so the pool exists only there.
+        if standby_workers < 0:
+            standby_workers = num_workers if self.lockstep else 0
+        if standby_workers > 0 and not self.lockstep:
+            logger.warning(
+                "--standby_workers applies only to lockstep jobs "
+                "(num_workers > 1); ignoring"
+            )
+        self._standby_target = standby_workers if self.lockstep else 0
+        self._standbys: list = []
+        self._draining = False
+        self.standby_activations = 0
 
     def worker_ids(self) -> list[int]:
         with self._lock:
@@ -340,6 +367,7 @@ class LocalInstanceManager:
     def start_workers(self):
         if self.lockstep:
             self._start_world(cluster_version=0)
+            self._replenish_standbys()
         else:
             for _ in range(self._num_workers):
                 self._start(self._claim_worker_id())
@@ -356,15 +384,17 @@ class LocalInstanceManager:
         n = num_processes if num_processes is not None else self._num_workers
         coordinator = f"localhost:{elastic.pick_coordinator_port()}"
         for process_id in range(n):
-            self._start(
-                self._claim_worker_id(),
+            world = dict(
                 coordinator_addr=coordinator,
                 num_processes=n,
                 process_id=process_id,
                 cluster_version=cluster_version,
             )
+            worker_id = self._claim_worker_id()
+            if not self._activate_standby(worker_id, world):
+                self._start(worker_id, **world)
 
-    def _start(self, worker_id: int, **world_kwargs):
+    def _spawn(self, worker_id: int, stdin_pipe: bool = False, **world_kwargs):
         argv = self._build_argv(
             worker_id, f"localhost:{self._master.port}", **world_kwargs
         )
@@ -377,10 +407,103 @@ class LocalInstanceManager:
         env["PYTHONPATH"] = os.pathsep.join(
             p for p in (pkg_root, env.get("PYTHONPATH", "")) if p
         )
-        proc = subprocess.Popen([sys.executable, "-m", *argv], env=env)
+        return subprocess.Popen(
+            [sys.executable, "-m", *argv],
+            env=env,
+            stdin=subprocess.PIPE if stdin_pipe else None,
+        )
+
+    def _start(self, worker_id: int, **world_kwargs):
+        proc = self._spawn(worker_id, **world_kwargs)
         with self._lock:
             self._procs[worker_id] = proc
         logger.info("Started worker %d (pid %d)", worker_id, proc.pid)
+
+    # ---- hot-standby pool -------------------------------------------------
+
+    def _replenish_standbys(self):
+        with self._lock:
+            if self._draining:
+                return
+            # prune corpses (a standby that died while waiting) so the
+            # pool list cannot grow unboundedly across re-formations
+            self._standbys = [p for p in self._standbys if p.poll() is None]
+            missing = self._standby_target - len(self._standbys)
+        for _ in range(max(0, missing)):
+            proc = self._spawn(0, stdin_pipe=True, standby=1)
+            with self._lock:
+                accepted = not self._draining
+                if accepted:
+                    self._standbys.append(proc)
+            if not accepted:
+                # stop_workers ran while we were spawning: this standby
+                # would never be drained — reap it now
+                try:
+                    proc.stdin.close()
+                except OSError:
+                    pass
+                proc.kill()
+                return
+            logger.info("Spawned standby worker (pid %d)", proc.pid)
+
+    def _activate_standby(self, worker_id: int, world: dict) -> bool:
+        """Hand a warm standby its world assignment; False = none usable
+        (caller cold-starts instead)."""
+        while True:
+            with self._lock:
+                if not self._standbys:
+                    return False
+                proc = self._standbys.pop(0)
+            if proc.poll() is not None:
+                continue  # died while waiting; try the next one
+            try:
+                line = json.dumps({"worker_id": worker_id, **world}) + "\n"
+                proc.stdin.write(line.encode("utf-8"))
+                proc.stdin.flush()
+            except (OSError, ValueError):
+                proc.kill()
+                continue
+            with self._lock:
+                self._procs[worker_id] = proc
+                self.standby_activations += 1
+            logger.info(
+                "Activated standby pid %d as worker %d (process %d/%d)",
+                proc.pid,
+                worker_id,
+                world["process_id"],
+                world["num_processes"],
+            )
+            return True
+
+    def _drain_standbys(self):
+        with self._lock:
+            self._draining = True  # fence concurrent _replenish_standbys
+            standbys = list(self._standbys)
+            self._standbys.clear()
+        for proc in standbys:
+            if proc.poll() is None:
+                try:  # EOF on stdin is the clean shutdown signal
+                    proc.stdin.close()
+                except OSError:
+                    pass
+                try:
+                    proc.wait(timeout=5)
+                except Exception:  # noqa: BLE001
+                    proc.kill()
+
+    def poll_failed_workers(self) -> list[int]:
+        """Worker ids whose subprocess exited abnormally (nonzero rc or
+        signal) — the local analogue of the reference's k8s pod watch
+        (k8s_client.py:84-98): events beat heartbeat timeouts at
+        detection speed.  Normal exits (rc 0) are NOT failures: workers
+        exit 0 at stream end, racing the master's own finished() check;
+        a premature rc-0 exit is still caught by the heartbeat timeout."""
+        with self._lock:
+            return [
+                wid
+                for wid, proc in self._procs.items()
+                if proc.poll() not in (None, 0)
+            ]
 
     def restart_worker(self, worker_id: int):
         """Relaunch with a NEW worker id (reference
@@ -417,8 +540,14 @@ class LocalInstanceManager:
                 f"(--relaunch_on_worker_failure limit); giving up"
             )
         self._start_world(cluster_version=cluster_version)
+        # refill the pool AFTER the new world is up, off the recovery
+        # path (the spawns are exactly what re-formation must not wait on)
+        threading.Thread(
+            target=self._replenish_standbys, daemon=True
+        ).start()
 
     def stop_workers(self):
+        self._drain_standbys()
         with self._lock:
             procs = list(self._procs.values())
             self._procs.clear()

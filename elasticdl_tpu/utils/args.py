@@ -396,6 +396,17 @@ def _add_master_params(parser: argparse.ArgumentParser):
         default=0.0,
         help="Re-queue a task held longer than this (0 = never)",
     )
+    parser.add_argument(
+        "--standby_workers",
+        type=int,
+        default=-1,
+        help=(
+            "Hot-standby processes kept warm (imports done, blocked on a "
+            "world assignment) so re-formation skips the cold start; "
+            "-1 = num_workers, 0 disables. Lockstep jobs on the local "
+            "instance backend only (k8s pods cold-start on re-formation)"
+        ),
+    )
 
 
 def _add_worker_params(parser: argparse.ArgumentParser):
@@ -428,6 +439,16 @@ def _add_worker_params(parser: argparse.ArgumentParser):
         help=(
             "World generation assigned by the master; fences stale "
             "workers after a mesh re-formation"
+        ),
+    )
+    parser.add_argument(
+        "--standby",
+        type=non_neg_int,
+        default=0,
+        help=(
+            "1 = hot-standby mode: warm every import, then block until "
+            "the master writes a world assignment (JSON line) on stdin; "
+            "re-formation then skips the cold start"
         ),
     )
 
@@ -536,6 +557,7 @@ _MASTER_ONLY_FLAGS = frozenset(
         "relaunch_on_worker_failure",
         "heartbeat_timeout_secs",
         "task_timeout_secs",
+        "standby_workers",
     }
 )
 

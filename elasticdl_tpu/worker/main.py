@@ -14,6 +14,7 @@ Two runtimes, selected by the master via the argv round-trip:
 
 from __future__ import annotations
 
+import json
 import sys
 
 from elasticdl_tpu.rpc.service import MasterClient
@@ -21,8 +22,44 @@ from elasticdl_tpu.utils.args import parse_worker_args
 from elasticdl_tpu.utils.log_utils import default_logger as logger
 
 
+def _standby_wait(args) -> bool:
+    """Hot-standby mode: pay the cold-start cost NOW (imports dominate
+    worker spawn latency), then block until the master writes a world
+    assignment as one JSON line on stdin.  Returns False on EOF (master
+    shut the pool down without using this process)."""
+    from elasticdl_tpu.parallel import elastic
+
+    # pin the platform BEFORE any import can initialize a backend: a
+    # model-zoo module doing jnp work at import time would otherwise
+    # initialize the default (possibly plugin) backend, making the
+    # activation-time configure_platform ineffective (elastic.py:29-38)
+    elastic.configure_platform(getattr(args, "jax_platform", "") or None)
+
+    from elasticdl_tpu.utils.model_utils import get_model_spec
+    from elasticdl_tpu.worker import lockstep  # noqa: F401 — warm the chain
+
+    try:  # model-zoo import is part of the cold start too
+        get_model_spec(
+            getattr(args, "model_zoo", "") or "", args.model_def
+        )
+    except Exception:  # noqa: BLE001 — the live run will surface it
+        pass
+    logger.info("Standby worker warmed; waiting for a world assignment")
+    line = sys.stdin.readline()
+    if not line.strip():
+        return False
+    assignment = json.loads(line)
+    for key, value in assignment.items():
+        setattr(args, key, value)
+    args.standby = 0
+    return True
+
+
 def main(argv=None) -> int:
     args = parse_worker_args(argv)
+    if getattr(args, "standby", 0):
+        if not _standby_wait(args):
+            return 0
     logger.info(
         "Worker %d connecting to master at %s",
         args.worker_id,

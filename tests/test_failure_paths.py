@@ -133,6 +133,8 @@ def test_stalled_lockstep_worker_triggers_reform(tmp_path):
     )
     assert master.reform_events, "stall never triggered a re-formation"
     assert master.reform_events[0]["latency_secs"] > 0
+    # the new world must come from the hot-standby pool, not a cold start
+    assert master.instance_manager.standby_activations == 2
 
 
 @pytest.mark.slow
@@ -144,6 +146,57 @@ def test_stalled_taskstream_worker_restarted_with_new_id(tmp_path):
     assert not master.reform_events  # no world to re-form
     # the replacement got a fresh id: worker 0 stalled, worker 1 finished
     assert master.instance_manager._next_worker_id >= 2
+
+
+def test_standby_activation_skips_dead_processes():
+    """_activate_standby must skip standbys that died while waiting and
+    report False on an empty pool (caller then cold-starts)."""
+    from elasticdl_tpu.master.master import LocalInstanceManager
+
+    class _FakeProc:
+        def __init__(self, alive=True, broken_pipe=False):
+            self._alive = alive
+            self._broken = broken_pipe
+            self.killed = False
+            self.stdin = self
+            self.written = b""
+            self.pid = 999
+
+        def poll(self):
+            return None if self._alive else 1
+
+        def write(self, data):
+            if self._broken:
+                raise OSError("broken pipe")
+            self.written += data
+
+        def flush(self):
+            pass
+
+        def kill(self):
+            self.killed = True
+
+    im = LocalInstanceManager.__new__(LocalInstanceManager)
+    im._lock = threading.Lock()
+    im._procs = {}
+    im.standby_activations = 0
+    dead = _FakeProc(alive=False)
+    broken = _FakeProc(broken_pipe=True)
+    good = _FakeProc()
+    im._standbys = [dead, broken, good]
+
+    world = dict(
+        coordinator_addr="localhost:1", num_processes=2,
+        process_id=0, cluster_version=1,
+    )
+    assert im._activate_standby(7, world)
+    assert im._procs == {7: good}
+    assert broken.killed  # unwritable standby is reaped, not leaked
+    assert im.standby_activations == 1
+    assert b'"worker_id": 7' in good.written
+
+    # pool exhausted -> False (caller cold-starts)
+    assert not im._activate_standby(8, world)
 
 
 def test_eval_lease_reclaim_over_grpc(tmp_path):
