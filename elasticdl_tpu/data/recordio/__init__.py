@@ -63,12 +63,40 @@ def _load_native():
     lib.edlio_scanner_close.restype = None
     lib.edlio_scanner_close.argtypes = [ctypes.c_void_p]
     lib.edlio_last_error.restype = ctypes.c_char_p
+    try:
+        decode = lib.edl_decode_batch
+    except AttributeError:  # stale .so built before the batch decoder
+        decode = None
+    if decode is not None:
+        _register_decode(decode)
     _lib = lib
     return _lib
 
 
+def _register_decode(decode):
+    decode.restype = ctypes.c_int64
+    decode.argtypes = [
+        ctypes.c_char_p,                    # concatenated payloads
+        ctypes.POINTER(ctypes.c_uint64),    # n+1 offsets
+        ctypes.c_int64,                     # n_records
+        ctypes.c_int32,                     # n_features
+        ctypes.POINTER(ctypes.c_char_p),    # names
+        ctypes.POINTER(ctypes.c_char_p),    # dtypes
+        ctypes.POINTER(ctypes.c_int64),     # flattened shapes
+        ctypes.POINTER(ctypes.c_int32),     # ndims
+        ctypes.POINTER(ctypes.c_uint64),    # row_bytes
+        ctypes.POINTER(ctypes.c_void_p),    # out base pointers
+    ]
+
+
 def native_available() -> bool:
     return _load_native() is not None
+
+
+def native_lib():
+    """The loaded C library (or None) — shared by the example batch
+    decoder (``data/reader.py``), which lives in the same .so."""
+    return _load_native()
 
 
 def _native_error(lib) -> str:

@@ -250,3 +250,151 @@ void edlio_scanner_close(void* handle) {
 }
 
 }  // extern "C"
+
+// ---- fused batch decode of example payloads --------------------------------
+//
+// The vectorized half of the data loader (the role tf.data's C++ runtime
+// plays for the reference, SURVEY §2.9): decode N example payloads — each a
+// tensor-frame collection produced by utils/tensor.py serialize_tensors —
+// straight into caller-allocated (N, ...) batch arrays, one memcpy per
+// (record, feature), no per-record Python objects.
+//
+// Payload layout (utils/tensor.py): [u32 nframes] ([u32 flen] frame)*
+//   frame = [u32 hdr_len] header_json data [indices?]
+//   header_json (canonical json.dumps order, space separators):
+//     {"name": "...", "dtype": "...", "shape": [a, b], "sparse": false}
+//
+// The parser accepts exactly the canonical layout; anything else (sparse
+// tensors, escaped names, re-ordered keys from a foreign writer) returns a
+// negative code and the Python caller falls back to the per-record path —
+// correctness never depends on this fast path.
+
+namespace {
+
+struct HdrCursor {
+  const uint8_t* p;
+  const uint8_t* end;
+};
+
+bool expect(HdrCursor* c, const char* lit) {
+  size_t n = std::strlen(lit);
+  if ((size_t)(c->end - c->p) < n || std::memcmp(c->p, lit, n) != 0) {
+    return false;
+  }
+  c->p += n;
+  return true;
+}
+
+// Parse a JSON string value with no escapes; returns false on escape/EOF.
+bool parse_plain_string(HdrCursor* c, const uint8_t** out, size_t* out_len) {
+  const uint8_t* start = c->p;
+  while (c->p < c->end && *c->p != '"') {
+    if (*c->p == '\\') return false;
+    ++c->p;
+  }
+  if (c->p >= c->end) return false;
+  *out = start;
+  *out_len = (size_t)(c->p - start);
+  ++c->p;  // closing quote
+  return true;
+}
+
+bool parse_int(HdrCursor* c, int64_t* out) {
+  if (c->p >= c->end || *c->p < '0' || *c->p > '9') return false;
+  int64_t v = 0;
+  while (c->p < c->end && *c->p >= '0' && *c->p <= '9') {
+    v = v * 10 + (*c->p - '0');
+    ++c->p;
+  }
+  *out = v;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode n_records payloads (concatenated in buf, record i spanning
+// [offsets[i], offsets[i+1])) into n_features batch arrays.  Feature k of
+// record i lands at outs[k] + i * row_bytes[k].  Every record must carry
+// exactly the expected features (any order), each matching the expected
+// dtype / shape / byte count.  Returns 0 on success, negative on any
+// mismatch (caller falls back to the per-record Python decoder).
+int64_t edl_decode_batch(const uint8_t* buf, const uint64_t* offsets,
+                         int64_t n_records, int32_t n_features,
+                         const char** names, const char** dtypes,
+                         const int64_t* shapes, const int32_t* ndims,
+                         const uint64_t* row_bytes, uint8_t** outs) {
+  if (n_features <= 0 || n_features > 64) return -1;  // seen-mask is u64
+  // per-feature offset into the flattened expected-shape array
+  std::vector<int32_t> shape_off(n_features);
+  int32_t off = 0;
+  for (int32_t k = 0; k < n_features; ++k) {
+    shape_off[k] = off;
+    off += ndims[k];
+  }
+  for (int64_t i = 0; i < n_records; ++i) {
+    const uint8_t* p = buf + offsets[i];
+    const uint8_t* rec_end = buf + offsets[i + 1];
+    if (rec_end - p < 4) return -2;
+    uint32_t nframes = load_u32(p);
+    p += 4;
+    if ((int64_t)nframes != n_features) return -3;
+    uint64_t seen = 0;
+    for (uint32_t f = 0; f < nframes; ++f) {
+      if (rec_end - p < 8) return -4;
+      uint32_t flen = load_u32(p);
+      uint32_t hdr_len = load_u32(p + 4);
+      p += 8;
+      if ((uint64_t)(rec_end - p) + 4 < (uint64_t)flen ||
+          (uint64_t)hdr_len + 4 > (uint64_t)flen) {
+        return -5;
+      }
+      const uint8_t* frame_end = p + (flen - 4);
+      HdrCursor c{p, p + hdr_len};
+      p += hdr_len;
+      // canonical header walk
+      const uint8_t* name;
+      size_t name_len;
+      const uint8_t* dtype;
+      size_t dtype_len;
+      if (!expect(&c, "{\"name\": \"") ||
+          !parse_plain_string(&c, &name, &name_len) ||
+          !expect(&c, ", \"dtype\": \"") ||
+          !parse_plain_string(&c, &dtype, &dtype_len) ||
+          !expect(&c, ", \"shape\": [")) {
+        return -6;
+      }
+      // match the feature by name
+      int32_t k = -1;
+      for (int32_t j = 0; j < n_features; ++j) {
+        if (std::strlen(names[j]) == name_len &&
+            std::memcmp(names[j], name, name_len) == 0) {
+          k = j;
+          break;
+        }
+      }
+      if (k < 0 || (seen >> k) & 1) return -7;
+      if (std::strlen(dtypes[k]) != dtype_len ||
+          std::memcmp(dtypes[k], dtype, dtype_len) != 0) {
+        return -8;
+      }
+      // shape must equal the expected per-record shape exactly
+      for (int32_t d = 0; d < ndims[k]; ++d) {
+        if (d > 0 && !expect(&c, ", ")) return -9;
+        int64_t v;
+        if (!parse_int(&c, &v) || v != shapes[shape_off[k] + d]) return -9;
+      }
+      if (!expect(&c, "]") || !expect(&c, ", \"sparse\": false}")) {
+        return -10;  // sparse or trailing keys: not batchable here
+      }
+      if ((uint64_t)(frame_end - p) != row_bytes[k]) return -11;
+      std::memcpy(outs[k] + (uint64_t)i * row_bytes[k], p, row_bytes[k]);
+      p = frame_end;
+      seen |= (uint64_t)1 << k;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

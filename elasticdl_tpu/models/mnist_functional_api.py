@@ -65,5 +65,16 @@ def dataset_fn(dataset, mode, metadata):
     return dataset
 
 
+def batch_parse(example_batch, mode):
+    """Vectorized ``dataset_fn`` equivalent: one call per minibatch on
+    natively-decoded ``(B, ...)`` arrays (the runtimes prefer this over
+    the per-record path when defined — data/dataset.py
+    batched_model_pipeline)."""
+    image = example_batch["image"].astype(np.float32) / 255.0
+    if mode == Modes.PREDICTION:
+        return {"image": image}
+    return {"image": image}, example_batch["label"].astype(np.int32)
+
+
 def eval_metrics_fn():
     return {"accuracy": Accuracy()}

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from elasticdl_tpu.data.dataset import Dataset
+from elasticdl_tpu.data.dataset import Dataset, batched_model_pipeline
 from elasticdl_tpu.data.factory import create_data_reader
 from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
 from elasticdl_tpu.trainer import metrics as metrics_lib
@@ -121,9 +121,15 @@ class LocalExecutor:
 
     def _task_dataset(self, reader, task, mode: Modes) -> Dataset:
         ds = Dataset.from_generator(lambda: reader.read_records(task))
-        if self._spec.dataset_fn is not None:
-            ds = self._spec.dataset_fn(ds, mode, reader.metadata)
-        return ds.batch(self._args.minibatch_size).prefetch(2)
+        return batched_model_pipeline(
+            ds,
+            self._spec,
+            mode,
+            reader.metadata,
+            self._args.minibatch_size,
+            shuffle_records=mode == Modes.TRAINING,
+            prefetch=2,
+        )
 
     def _ensure_state(self, sample_features):
         if self._state is not None:

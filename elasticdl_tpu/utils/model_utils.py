@@ -86,6 +86,12 @@ class ModelSpec:
     loss: Callable
     optimizer: Callable
     dataset_fn: Callable | None = None
+    # optional vectorized alternative to dataset_fn:
+    # ``batch_parse(example_batch: dict[str, ndarray], mode)`` receives a
+    # WHOLE decoded minibatch (native fused decode+batch path,
+    # data/dataset.py batched_model_pipeline) and returns the same
+    # element dataset_fn's mapped elements would after batching
+    batch_parse: Callable | None = None
     eval_metrics_fn: Callable | None = None
     learning_rate_scheduler: Any | None = None
     prediction_outputs_processor: Any | None = None
@@ -143,6 +149,12 @@ def resolve_model_spec(
         loss=_get(loss, required=True),
         optimizer=_get(optimizer, required=True),
         dataset_fn=_get(dataset_fn),
+        # the vectorized fast path pairs with the DEFAULT dataset_fn; a
+        # user-renamed --dataset_fn selects a different parse, which
+        # batch_parse must not silently bypass
+        batch_parse=(
+            _get("batch_parse") if dataset_fn == "dataset_fn" else None
+        ),
         eval_metrics_fn=_get(eval_metrics_fn),
         learning_rate_scheduler=_get("learning_rate_scheduler"),
         prediction_outputs_processor=processor,

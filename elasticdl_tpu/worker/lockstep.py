@@ -35,7 +35,7 @@ import traceback
 import jax
 import numpy as np
 
-from elasticdl_tpu.data.dataset import Dataset
+from elasticdl_tpu.data.dataset import Dataset, batched_model_pipeline
 from elasticdl_tpu.data.factory import create_data_reader
 from elasticdl_tpu.master.task_dispatcher import FAIL_COUNT
 from elasticdl_tpu.parallel import elastic
@@ -198,9 +198,16 @@ class LockstepWorker:
         ds = Dataset.from_generator(
             lambda: iter(self._reader.read_records(task))
         )
-        if self._spec.dataset_fn is not None:
-            ds = self._spec.dataset_fn(ds, mode, self._reader.metadata)
-        return ds.batch(self._minibatch_size)
+        # per-task dataset + seeded shuffle: deterministic on every
+        # process, so the lockstep schedule agreement is preserved
+        return batched_model_pipeline(
+            ds,
+            self._spec,
+            mode,
+            self._reader.metadata,
+            self._minibatch_size,
+            shuffle_records=mode == Modes.TRAINING,
+        )
 
     def _place(self, tree):
         padded, _ = self._trainer.pad_batch(tree)

@@ -28,6 +28,7 @@ import traceback
 import jax
 import numpy as np
 
+from elasticdl_tpu.data.dataset import batched_model_pipeline
 from elasticdl_tpu.parallel.distributed import SPMDTrainer
 from elasticdl_tpu.parallel.mesh import MeshConfig
 from elasticdl_tpu.rpc import messages as msg
@@ -271,10 +272,15 @@ class Worker:
                 # (reference worker.py:969-971)
                 self._process_save_model_task_if_needed()
                 break
-            dataset = self._spec.dataset_fn(
-                dataset, Modes.TRAINING, self._task_data_service.data_reader.metadata
+            dataset = batched_model_pipeline(
+                dataset,
+                self._spec,
+                Modes.TRAINING,
+                self._task_data_service.data_reader.metadata,
+                self._minibatch_size,
+                shuffle_records=True,
+                prefetch=2,
             )
-            dataset = dataset.batch(self._minibatch_size).prefetch(2)
             saw_batch = False
             for features, labels in dataset:
                 saw_batch = True
@@ -330,10 +336,16 @@ class Worker:
         from elasticdl_tpu.data.dataset import Dataset
 
         ds = Dataset.from_generator(lambda: iter(reader.read_records(task)))
-        ds = self._spec.dataset_fn(ds, Modes.EVALUATION, reader.metadata)
+        ds = batched_model_pipeline(
+            ds,
+            self._spec,
+            Modes.EVALUATION,
+            reader.metadata,
+            self._minibatch_size,
+        )
         err = ""
         all_outputs, all_labels = [], []
-        for features, labels in ds.batch(self._minibatch_size):
+        for features, labels in ds:
             for _ in range(MAX_MINIBATCH_RETRY_NUM):
                 try:
                     self._ensure_trainer(features)
@@ -365,12 +377,14 @@ class Worker:
             dataset = self._task_data_service.get_dataset()
             if dataset is None:
                 break
-            dataset = self._spec.dataset_fn(
+            dataset = batched_model_pipeline(
                 dataset,
+                self._spec,
                 Modes.PREDICTION,
                 self._task_data_service.data_reader.metadata,
+                self._minibatch_size,
+                prefetch=2,
             )
-            dataset = dataset.batch(self._minibatch_size).prefetch(2)
             for features in dataset:
                 task = self._task_data_service.get_current_task()
                 err = self._process_minibatch(

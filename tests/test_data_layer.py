@@ -281,3 +281,172 @@ class TestGenerators:
         # both classes present, neither vanishingly rare
         pos = sum(labels)
         assert 64 < pos < 448
+
+
+class TestBatchDecode:
+    """Fused decode+batch fast path (native edl_decode_batch) vs the
+    per-record decoder: identical outputs, graceful fallbacks."""
+
+    def _records(self, n=64):
+        rng = np.random.RandomState(3)
+        return [
+            encode_example(
+                {
+                    "image": rng.randint(0, 255, (8, 8)).astype(np.uint8),
+                    "dense": rng.randn(5).astype(np.float32),
+                    "label": np.int64(i % 7),  # scalar feature
+                }
+            )
+            for i in range(n)
+        ]
+
+    def test_matches_per_record_decode(self):
+        from elasticdl_tpu.data.reader import decode_example_batch
+
+        recs = self._records()
+        out = decode_example_batch(recs)
+        ref = [decode_example(r) for r in recs]
+        assert set(out) == {"image", "dense", "label"}
+        assert out["image"].shape == (64, 8, 8)
+        assert out["label"].shape == (64,)
+        for key in out:
+            np.testing.assert_array_equal(
+                out[key], np.stack([d[key] for d in ref])
+            )
+
+    def test_native_path_taken(self):
+        """On this build the native codec exists, and the C call must
+        succeed for uniform dense records (no silent fallback)."""
+        from elasticdl_tpu.data import reader
+
+        if not recordio.native_available():
+            pytest.skip("native codec not built")
+        recs = self._records(8)
+        first = decode_example(recs[0])
+        assert reader._native_decode_batch(recs, first) is not None
+
+    def test_python_fallback_matches(self, monkeypatch):
+        from elasticdl_tpu.data import reader
+
+        recs = self._records(16)
+        native = reader.decode_example_batch(recs)
+        monkeypatch.setattr(
+            reader, "_native_decode_batch", lambda *a: None
+        )
+        fallback = reader.decode_example_batch(recs)
+        for key in native:
+            np.testing.assert_array_equal(native[key], fallback[key])
+
+    def test_bfloat16_feature(self):
+        import ml_dtypes
+
+        from elasticdl_tpu.data.reader import decode_example_batch
+
+        bf16 = ml_dtypes.bfloat16
+        recs = [
+            encode_example({"x": np.arange(4, dtype=np.float32).astype(bf16)})
+            for _ in range(4)
+        ]
+        out = decode_example_batch(recs)
+        assert out["x"].dtype == bf16
+        assert out["x"].shape == (4, 4)
+
+    def test_single_and_empty(self):
+        from elasticdl_tpu.data.reader import decode_example_batch
+
+        assert decode_example_batch([]) == {}
+        one = decode_example_batch(self._records(1))
+        assert one["image"].shape == (1, 8, 8)
+
+    def test_batch_list(self):
+        ds = Dataset.from_records(list(range(7))).batch_list(3)
+        assert list(ds) == [[0, 1, 2], [3, 4, 5], [6]]
+
+
+class TestBatchedModelPipeline:
+    def test_batch_parse_equals_dataset_fn(self, tmp_path):
+        """The vectorized fast path must produce byte-identical batches
+        to the per-record dataset_fn path (same shuffle stream)."""
+        from elasticdl_tpu.data.dataset import batched_model_pipeline
+        from elasticdl_tpu.trainer.state import Modes
+        from elasticdl_tpu.utils.model_utils import get_model_spec
+
+        out = synthetic.gen_mnist(
+            str(tmp_path / "m"), num_records=70, num_shards=1, seed=5
+        )
+        reader = RecordIODataReader(data_dir=out)
+        path = next(iter(reader.create_shards()))
+        records = list(
+            reader.read_records(Task(path, 0, 70, TaskType.TRAINING))
+        )
+        spec = get_model_spec(
+            "", "mnist_functional_api.mnist_functional_api.custom_model"
+        )
+        assert spec.batch_parse is not None
+
+        fast = list(
+            batched_model_pipeline(
+                Dataset.from_records(records),
+                spec,
+                Modes.TRAINING,
+                reader.metadata,
+                batch_size=32,
+                shuffle_records=True,
+            )
+        )
+        spec.batch_parse = None  # force the classic per-record path
+        classic = list(
+            batched_model_pipeline(
+                Dataset.from_records(records),
+                spec,
+                Modes.TRAINING,
+                reader.metadata,
+                batch_size=32,
+            )
+        )
+        assert len(fast) == len(classic) == 3
+        for (ff, fl), (cf, cl) in zip(fast, classic):
+            np.testing.assert_array_equal(ff["image"], cf["image"])
+            np.testing.assert_array_equal(fl, cl)
+
+    def test_prediction_mode_features_only(self, tmp_path):
+        from elasticdl_tpu.data.dataset import batched_model_pipeline
+        from elasticdl_tpu.trainer.state import Modes
+        from elasticdl_tpu.utils.model_utils import get_model_spec
+
+        out = synthetic.gen_mnist(
+            str(tmp_path / "p"), num_records=8, num_shards=1, seed=6
+        )
+        reader = RecordIODataReader(data_dir=out)
+        path = next(iter(reader.create_shards()))
+        records = list(
+            reader.read_records(Task(path, 0, 8, TaskType.PREDICTION))
+        )
+        spec = get_model_spec(
+            "", "mnist_functional_api.mnist_functional_api.custom_model"
+        )
+        batches = list(
+            batched_model_pipeline(
+                Dataset.from_records(records),
+                spec,
+                Modes.PREDICTION,
+                reader.metadata,
+                batch_size=8,
+            )
+        )
+        assert len(batches) == 1
+        assert set(batches[0]) == {"image"}
+        assert batches[0]["image"].dtype == np.float32
+
+    def test_renamed_dataset_fn_disables_fast_path(self):
+        """--dataset_fn selects a different parse; batch_parse must not
+        silently bypass it (it pairs with the DEFAULT dataset_fn only)."""
+        from elasticdl_tpu.utils.model_utils import get_model_spec
+
+        spec = get_model_spec(
+            "",
+            "mnist_functional_api.mnist_functional_api.custom_model",
+            dataset_fn="batch_parse",  # any non-default name
+        )
+        assert spec.batch_parse is None
+        assert spec.dataset_fn is not None
