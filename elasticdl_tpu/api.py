@@ -27,6 +27,12 @@ from elasticdl_tpu.utils.log_utils import default_logger as logger
 def _run_local(args) -> dict:
     from elasticdl_tpu.trainer.local_executor import LocalExecutor
 
+    if getattr(args, "compilation_cache_dir", ""):
+        from elasticdl_tpu.parallel.elastic import (
+            configure_compilation_cache,
+        )
+
+        configure_compilation_cache(args.compilation_cache_dir)
     return LocalExecutor(args).run()
 
 

@@ -302,7 +302,9 @@ bool parse_plain_string(HdrCursor* c, const uint8_t** out, size_t* out_len) {
 bool parse_int(HdrCursor* c, int64_t* out) {
   if (c->p >= c->end || *c->p < '0' || *c->p > '9') return false;
   int64_t v = 0;
+  int digits = 0;
   while (c->p < c->end && *c->p >= '0' && *c->p <= '9') {
+    if (++digits > 18) return false;  // corrupt header: would overflow i64
     v = v * 10 + (*c->p - '0');
     ++c->p;
   }

@@ -41,6 +41,19 @@ def configure_platform(platform: str | None):
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 
+def configure_compilation_cache(cache_dir: str | None):
+    """Enable the persistent XLA compilation cache.  On TPU a re-formed
+    world (or a re-run of the same job) then loads its executables from
+    disk instead of recompiling — compile time is a real term in both
+    re-formation latency and job startup."""
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache every executable: the default thresholds skip exactly the
+        # small programs a test-size job re-forms over
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+
 def initialize_world(
     coordinator_addr: str,
     num_processes: int,

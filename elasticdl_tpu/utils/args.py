@@ -321,6 +321,15 @@ def _add_mesh_params(parser: argparse.ArgumentParser):
             "'tpu'); empty = JAX default.  Forwarded to workers."
         ),
     )
+    parser.add_argument(
+        "--compilation_cache_dir",
+        default="",
+        help=(
+            "Persistent XLA compilation cache directory (forwarded to "
+            "workers): repeated jobs and re-formed worlds reuse compiled "
+            "executables instead of recompiling; empty disables"
+        ),
+    )
 
 
 def _add_master_params(parser: argparse.ArgumentParser):

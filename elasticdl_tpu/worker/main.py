@@ -57,6 +57,10 @@ def _standby_wait(args) -> bool:
 
 def main(argv=None) -> int:
     args = parse_worker_args(argv)
+    if getattr(args, "compilation_cache_dir", ""):
+        from elasticdl_tpu.parallel.elastic import configure_compilation_cache
+
+        configure_compilation_cache(args.compilation_cache_dir)
     if getattr(args, "standby", 0):
         if not _standby_wait(args):
             return 0
