@@ -450,3 +450,41 @@ class TestBatchedModelPipeline:
         )
         assert spec.batch_parse is None
         assert spec.dataset_fn is not None
+
+    def test_corrupt_payload_fuzz_never_crashes(self):
+        """Bit-flipped / truncated / garbage payloads must either decode
+        via the fallback or raise a clean Python error — the native
+        parser returns a negative code rather than reading out of
+        bounds (incl. the u32-overflow case hdr_len ~ 0xFFFFFFFC)."""
+        from elasticdl_tpu.data import reader
+
+        rng = np.random.RandomState(11)
+        good = TestBatchDecode()._records(8)
+        first = decode_example(good[0])
+
+        def mutate(payload, kind):
+            b = bytearray(payload)
+            if kind == 0 and len(b) > 8:  # bit flip
+                b[rng.randint(4, len(b))] ^= 1 << rng.randint(8)
+            elif kind == 1:  # truncate
+                del b[rng.randint(1, len(b)):]
+            elif kind == 2:  # garbage tail
+                b.extend(rng.bytes(17))
+            elif kind == 3:  # u32-overflow header length
+                b[8:12] = (0xFFFFFFFC).to_bytes(4, "little")
+            else:  # pure garbage
+                b = bytearray(rng.bytes(max(9, len(b) // 2)))
+            return bytes(b)
+
+        for trial in range(200):
+            recs = list(good)
+            recs[rng.randint(1, len(recs))] = mutate(
+                good[rng.randint(0, len(good))], trial % 5
+            )
+            try:
+                out = reader._native_decode_batch(recs, dict(first))
+            except Exception:
+                continue  # clean Python-level error is acceptable
+            if out is not None:
+                # accepted: the mutation must not have clobbered shapes
+                assert out["image"].shape == (8, 8, 8)
