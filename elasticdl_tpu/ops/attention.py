@@ -136,11 +136,13 @@ def mha_reference(q, k, v, causal: bool = False, sm_scale: float | None = None):
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_q, block_k
+    q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_q, block_k
 ):
     """One (batch*head, q-block) program: stream K/V blocks through an
     online softmax.  m/l/acc are loop carries (values, not scratch), so
-    the kernel needs no cross-program accumulation."""
+    the kernel needs no cross-program accumulation.  Also emits the
+    per-row logsumexp (of the SCALED scores) — the backward kernels
+    rebuild softmax probabilities from it without a second pass."""
     q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, D)
     seq_k = k_ref.shape[1]
     num_kb = seq_k // block_k
@@ -182,8 +184,138 @@ def _flash_kernel(
         )
     else:
         num_kb_live = num_kb
-    acc, _m, l = jax.lax.fori_loop(0, num_kb_live, body, init)
+    acc, m, l = jax.lax.fori_loop(0, num_kb_live, body, init)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    # (block_q, 1) trailing unit dim: TPU block shapes must tile the
+    # last two dims, and a 2-D (1, block_q) block would not
+    lse_ref[0] = (m + jnp.log(l))[:, None]
+
+
+def _flash_dq_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dq_ref,
+    *,
+    sm_scale,
+    causal,
+    block_q,
+    block_k,
+):
+    """dQ program per (batch*head, q-block): stream K/V blocks, rebuild
+    p from the saved logsumexp, accumulate dq = sm_scale * ds @ K."""
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+    do = do_ref[0].astype(jnp.float32)  # (block_q, D)
+    lse = lse_ref[0][:, 0]  # (block_q,)
+    delta = delta_ref[0][:, 0]  # (block_q,)
+    seq_k = k_ref.shape[1]
+    num_kb = seq_k // block_k
+    i = pl.program_id(1)
+
+    def body(j, dq_acc):
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())))
+        if causal:
+            row = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            col = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(row >= col, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # (block_q, block_k)
+        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())))
+        ds = p * (dp - delta[:, None])
+        return dq_acc + jax.lax.dot(ds, kb)
+
+    if causal:
+        num_kb_live = jnp.minimum(
+            num_kb, ((i + 1) * block_q + block_k - 1) // block_k
+        )
+    else:
+        num_kb_live = num_kb
+    dq = jax.lax.fori_loop(
+        0,
+        num_kb_live,
+        body,
+        jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32),
+    )
+    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dk_ref,
+    dv_ref,
+    *,
+    sm_scale,
+    causal,
+    block_q,
+    block_k,
+):
+    """dK/dV program per (batch*head, k-block): stream q blocks,
+    dv += p^T @ dO and dk += ds^T @ (sm_scale * q)."""
+    kb = k_ref[0].astype(jnp.float32)  # (block_k, D)
+    vb = v_ref[0].astype(jnp.float32)
+    seq_q = q_ref.shape[1]
+    num_qb = seq_q // block_q
+    j = pl.program_id(1)
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        qi = (
+            q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+            * sm_scale
+        )
+        doi = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), 0]
+        s = jax.lax.dot_general(qi, kb, (((1,), (1,)), ((), ())))
+        if causal:
+            row = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            col = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(row >= col, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # (block_q, block_k)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, doi, (((0,), (0,)), ((), ()))
+        )
+        dp = jax.lax.dot_general(doi, vb, (((1,), (1,)), ((), ())))
+        ds = p * (dp - delta[:, None])
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, qi, (((0,), (0,)), ((), ()))
+        )
+        return dk_acc, dv_acc
+
+    if causal:
+        # q blocks strictly above this k block's diagonal see nothing
+        i0 = (j * block_k) // block_q
+    else:
+        i0 = 0
+    d = q_ref.shape[-1]
+    dk, dv = jax.lax.fori_loop(
+        i0,
+        num_qb,
+        body,
+        (
+            jnp.zeros((block_k, d), jnp.float32),
+            jnp.zeros((block_k, d), jnp.float32),
+        ),
+    )
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _pick_block(size: int, preferred: int) -> int:
@@ -211,42 +343,61 @@ def flash_attention(
     ``interpret=None`` auto-selects the pallas interpreter off-TPU (CPU
     tests run the same kernel code path the TPU compiles).
 
-    Differentiable via custom_vjp: the forward runs the pallas kernel;
-    the backward recomputes attention in plain jnp and differentiates
-    that (O(S^2) memory in backward only).  Long-context TRAINING should
-    shard the sequence over ``sp`` — the ring path is blockwise in both
-    directions per device.
+    Differentiable via custom_vjp with pallas kernels in BOTH directions
+    (FlashAttention-2 structure): the forward saves (q, k, v, out, lse);
+    the backward reconstructs probabilities blockwise from the saved
+    logsumexp — one kernel for dQ, one for dK/dV — so neither direction
+    ever materializes an (S, S) score matrix in HBM.
     """
-    return _flash_forward(
+    out, _lse = _flash_forward(
         q, k, v, causal, sm_scale, block_q, block_k, interpret
     )
+    return out
 
 
-def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    q, k, v = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+def _flash_geometry(q, k, sm_scale, block_q, block_k, interpret):
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
+    block_q = _pick_block(q.shape[1], block_q)
+    block_k = _pick_block(k.shape[1], block_k)
+    return sm_scale, block_q, block_k, interpret
+
+
+def _fold_heads(x):
+    """(B, S, H, D) -> (B*H, S, D)."""
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _kv_head(bh, heads, kv_heads, group):
+    """Folded-KV row for folded-Q row ``bh``: GQA without materializing
+    repeated K/V — the q-head program reads its group's single kv head.
+    THE one definition of the grouping used by every kernel spec (the
+    subtlest index math in these kernels must not be copy-pasted)."""
+    return (bh // heads) * kv_heads + (bh % heads) // group
+
+
+def _unfold_heads(x, batch, heads):
+    bh, s, d = x.shape
+    return x.reshape(batch, heads, s, d).transpose(0, 2, 1, 3)
+
+
+def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    q, k, v = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+    sm_scale, block_q, block_k, interpret = _flash_geometry(
+        q, k, sm_scale, block_q, block_k, interpret
+    )
     batch, seq_q, heads, d = q.shape
     group = validate_gqa_heads(q, k, v)
     kv_heads = k.shape[2]
     seq_k = k.shape[1]
-    block_q = _pick_block(seq_q, block_q)
-    block_k = _pick_block(seq_k, block_k)
-
-    # (B, S, H, D) -> (B*H, S, D) for a 2-D grid over (bh, q-block)
-    def _fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(
-            batch * x.shape[2], x.shape[1], d
-        )
 
     def _kv_index(b, i):
-        # GQA without materializing repeated K/V: the q-head program bh
-        # reads its group's single kv head
-        return ((b // heads) * kv_heads + (b % heads) // group, 0, 0)
+        return (_kv_head(b, heads, kv_heads, group), 0, 0)
 
-    qf, kf, vf = _fold(q), _fold(k), _fold(v)
+    qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
     kernel = functools.partial(
         _flash_kernel,
         sm_scale=sm_scale,
@@ -254,7 +405,7 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         block_q=block_q,
         block_k=block_k,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(batch * heads, seq_q // block_q),
         in_specs=[
@@ -262,26 +413,116 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
             pl.BlockSpec((1, seq_k, d), _kv_index),
             pl.BlockSpec((1, seq_k, d), _kv_index),
         ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch * heads, seq_q, d), q.dtype),
+            jax.ShapeDtypeStruct((batch * heads, seq_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return _unfold_heads(out, batch, heads), lse
+
+
+def _flash_backward(
+    q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret
+):
+    q, k, v = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+    out, lse, g = jnp.asarray(out), jnp.asarray(lse), jnp.asarray(g)
+    sm_scale, block_q, block_k, interpret = _flash_geometry(
+        q, k, sm_scale, block_q, block_k, interpret
+    )
+    batch, seq_q, heads, d = q.shape
+    group = validate_gqa_heads(q, k, v)
+    kv_heads = k.shape[2]
+    seq_k = k.shape[1]
+
+    def _kv_index(b, i):
+        return (_kv_head(b, heads, kv_heads, group), 0, 0)
+
+    qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
+    dof = _fold_heads(g)
+    # delta_r = rowsum(dO * O): the softmax-jacobian correction term;
+    # trailing unit dim matches the lse layout (TPU block tiling)
+    delta = jnp.sum(
+        dof.astype(jnp.float32)
+        * _fold_heads(out).astype(jnp.float32),
+        axis=-1,
+        keepdims=True,
+    )  # (B*H, S_q, 1)
+
+    common = dict(
+        sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k
+    )
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, **common),
+        grid=(batch * heads, seq_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, d), _kv_index),
+            pl.BlockSpec((1, seq_k, d), _kv_index),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((batch * heads, seq_q, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(batch, heads, seq_q, d).transpose(0, 2, 1, 3)
+    )(qf, kf, vf, dof, lse, delta)
+
+    # dK/dV are computed per q-head (the kernel never materializes
+    # repeated K/V either); a GQA group then sums its q-heads' parts —
+    # one (B, H, S_k, D) pass, the gradient analogue of the repeat
+    dk_per_q, dv_per_q = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, **common),
+        grid=(batch * heads, seq_k // block_k),
+        in_specs=[
+            pl.BlockSpec((1, seq_q, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (
+                _kv_head(b, heads, kv_heads, group), j, 0
+            )),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (
+                _kv_head(b, heads, kv_heads, group), j, 0
+            )),
+            pl.BlockSpec((1, seq_q, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, seq_q, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, seq_q, 1), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch * heads, seq_k, d), k.dtype),
+            jax.ShapeDtypeStruct((batch * heads, seq_k, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    dq = _unfold_heads(dq, batch, heads)
+    dk = _unfold_heads(dk_per_q, batch, heads)
+    dv = _unfold_heads(dv_per_q, batch, heads)
+    if group > 1:
+        # sum each kv head's query group: (B, S, H, D) -> (B, S, KVH, D)
+        dk = dk.reshape(batch, seq_k, kv_heads, group, d).sum(axis=3)
+        dv = dv.reshape(batch, seq_k, kv_heads, group, d).sum(axis=3)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out = _flash_forward(
+    out, lse = _flash_forward(
         q, k, v, causal, sm_scale, block_q, block_k, interpret
     )
-    return out, (q, k, v)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: mha_reference(q, k, v, causal, sm_scale), q, k, v
+    q, k, v, out, lse = res
+    return _flash_backward(
+        q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret
     )
-    return vjp(g)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
