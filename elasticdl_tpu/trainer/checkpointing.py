@@ -18,6 +18,8 @@ different PS count (``save_utils.py:208-261``).  TPU equivalents:
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from elasticdl_tpu.parallel import elastic
@@ -37,6 +39,7 @@ class PeriodicCheckpointer:
         keep_checkpoint_max: int = 3,
         process_id: int = 0,
         num_parts: int = 1,
+        async_write: bool = True,
     ):
         self._saver = (
             save_utils.CheckpointSaver(checkpoint_dir, keep_checkpoint_max)
@@ -47,6 +50,15 @@ class PeriodicCheckpointer:
         self._process_id = process_id
         self._num_parts = max(1, num_parts)
         self._last_milestone = 0
+        # async: the device->host snapshot (and any gather collective)
+        # stays on the training thread; only the disk write moves to a
+        # background thread, so the step stream never waits on IO.  One
+        # write in flight at most — the next save (or flush) joins the
+        # previous one first, which bounds host memory and surfaces
+        # write errors on the training thread.
+        self._async = async_write
+        self._writer: threading.Thread | None = None
+        self._write_error: BaseException | None = None
 
     @property
     def enabled(self) -> bool:
@@ -80,6 +92,36 @@ class PeriodicCheckpointer:
             trainer.state, mesh, materialize_dense=self.is_chief
         )
         version = trainer.step
+        if not self._async:
+            self._write(version, dense, parts)
+            return
+        self.flush()  # at most one write in flight (backpressure)
+        self._writer = threading.Thread(
+            target=self._write_guarded,
+            args=(version, dense, parts),
+            name=f"ckpt-writer-{version}",
+            daemon=True,
+        )
+        self._writer.start()
+
+    def flush(self):
+        """Join the in-flight write (if any) and re-raise its error on
+        the caller's thread.  Call before process exit / state restore
+        so a job never 'completes' with an unwritten checkpoint."""
+        writer, self._writer = self._writer, None
+        if writer is not None:
+            writer.join()
+        error, self._write_error = self._write_error, None
+        if error is not None:
+            raise error
+
+    def _write_guarded(self, version, dense, parts):
+        try:
+            self._write(version, dense, parts)
+        except BaseException as e:  # noqa: BLE001 — re-raised in flush()
+            self._write_error = e
+
+    def _write(self, version, dense, parts):
         self._saver.save(
             version,
             dense=dense,

@@ -399,8 +399,15 @@ class LockstepWorker:
                     )
             self._dump_state_if_requested()
         finally:
-            self._profiler.stop()
-            self._stopped = True
+            try:
+                # a job must not report complete with an unwritten
+                # (async) checkpoint still in flight
+                self._checkpointer.flush()
+            finally:
+                # ...but a failed write must not leave the heartbeat
+                # thread running (it polls self._stopped)
+                self._profiler.stop()
+                self._stopped = True
 
     def _dump_state_if_requested(self):
         out_dir = os.environ.get(_DUMP_STATE_ENV, "")

@@ -452,8 +452,15 @@ class Worker:
             else:
                 self._train_and_evaluate()
         finally:
-            self._profiler.stop()
-            self._stopped = True
+            try:
+                # a job must not report complete with an unwritten
+                # (async) checkpoint still in flight
+                self._checkpointer.flush()
+            finally:
+                # ...but a failed write must not leave the heartbeat
+                # thread running (it polls self._stopped)
+                self._profiler.stop()
+                self._stopped = True
 
 
 def _batch_len(tree) -> int:

@@ -95,6 +95,7 @@ def test_reshard_restore_ep4_to_ep2(tmp_path):
 
     ckpt = PeriodicCheckpointer(str(tmp_path / "ckpt"), checkpoint_steps=1)
     ckpt.save_now(trainer, mesh)
+    ckpt.flush()  # async by default: join the write before restoring
 
     trainer2, _ = _trainer("dp=4,ep=2")
     assert not np.allclose(_table(trainer2), want_table)
@@ -175,3 +176,48 @@ def test_assemble_rejects_incomplete_parts():
         save_utils.assemble_embedding_tables(
             {"t": (np.array([0, 2]), np.zeros((2, 3)))}
         )
+
+
+def test_async_save_flush_and_error_surfacing(tmp_path):
+    """Async checkpointing: the write happens off-thread, flush() joins
+    it, and a write failure is re-raised on the caller's thread at the
+    next flush (never swallowed)."""
+    trainer, mesh = _trainer("dp=2,ep=4")
+    feats, labels = _feats(seed=3)
+    trainer.train_step(
+        trainer.place_batch(feats), trainer.place_batch(labels)
+    )
+
+    ckpt = PeriodicCheckpointer(str(tmp_path / "ok"), checkpoint_steps=1)
+    assert ckpt.maybe_save(trainer, mesh)
+    ckpt.flush()
+    assert save_utils.latest_version(str(tmp_path / "ok")) == 1
+    # milestone already passed: no duplicate save
+    assert not ckpt.maybe_save(trainer, mesh)
+    ckpt.flush()  # idempotent with nothing in flight
+
+    # failure path: break the saver underneath the async writer
+    bad = PeriodicCheckpointer(str(tmp_path / "bad"), checkpoint_steps=1)
+
+    def _boom(*a, **k):
+        raise IOError("disk full")
+
+    bad._saver.save = _boom
+    bad.save_now(trainer, mesh)
+    with pytest.raises(IOError, match="disk full"):
+        bad.flush()
+    bad.flush()  # error is delivered once, then cleared
+
+
+def test_sync_mode_writes_inline(tmp_path):
+    trainer, mesh = _trainer("dp=2,ep=4")
+    feats, labels = _feats(seed=4)
+    trainer.train_step(
+        trainer.place_batch(feats), trainer.place_batch(labels)
+    )
+    ckpt = PeriodicCheckpointer(
+        str(tmp_path / "sync"), checkpoint_steps=1, async_write=False
+    )
+    ckpt.save_now(trainer, mesh)
+    # no flush needed: the write completed inline
+    assert save_utils.latest_version(str(tmp_path / "sync")) == 1
