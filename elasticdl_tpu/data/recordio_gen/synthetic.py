@@ -130,11 +130,15 @@ CENSUS_VOCAB = 100
 
 
 def gen_census(
-    out_dir: str, num_records: int = 4096, num_shards: int = 4, seed: int = 0
+    out_dir: str,
+    num_records: int = 4096,
+    num_shards: int = 4,
+    seed: int = 0,
+    vocab_size: int = CENSUS_VOCAB,
 ):
     rng_w = np.random.RandomState(1234)
     cat_weights = {
-        c: rng_w.normal(0, 1.0, size=CENSUS_VOCAB) for c in CENSUS_CATEGORICAL
+        c: rng_w.normal(0, 1.0, size=vocab_size) for c in CENSUS_CATEGORICAL
     }
     num_weights = rng_w.normal(0, 1.0, size=len(CENSUS_NUMERIC))
     rng = np.random.RandomState(seed)
@@ -142,7 +146,7 @@ def gen_census(
     for _ in range(num_records):
         numeric = rng.normal(0, 1.0, size=len(CENSUS_NUMERIC))
         cats = {
-            c: np.int64(rng.randint(CENSUS_VOCAB))
+            c: np.int64(rng.randint(vocab_size))
             for c in CENSUS_CATEGORICAL
         }
         score = float(numeric @ num_weights) + sum(

@@ -42,6 +42,8 @@ class K8sInstanceManager:
         on_worker_failure=None,
         api=None,
         watch: bool | None = None,
+        standby_workers: int = -1,
+        post_assignment=None,
     ):
         self._num_workers = num_workers
         self._build_argv = build_argv
@@ -61,12 +63,33 @@ class K8sInstanceManager:
         self._next_worker_id = 0
         # worker_id -> pod name, and the reverse, for event routing
         self._pods: dict[int, str] = {}
+        # worker_id -> service name (a standby-activated worker's service
+        # is named by worker id, not by its pod, and must not leak)
+        self._services: dict[int, str] = {}
         self._pod_to_worker: dict[str, int] = {}
         # pod name -> last seen phase
         self._phases: dict[str, str] = {}
         # OOMKilled pods: never relaunched (reference :225-240)
         self._oom_workers: set[int] = set()
         self._stopping = False
+        # hot-standby pods: pre-warmed (imports done), polling the
+        # master's assignment mailbox (servicer.get_world_assignment) —
+        # pods cannot receive the stdin line the local backend uses.
+        # reform_world assigns them into the new world instead of
+        # cold-starting pods.
+        if standby_workers < 0:
+            standby_workers = num_workers if self.lockstep else 0
+        self._standby_target = standby_workers if self.lockstep else 0
+        self._post_assignment = post_assignment
+        if self._standby_target and post_assignment is None:
+            logger.warning(
+                "standby_workers set but no post_assignment mailbox; "
+                "disabling the k8s standby pool"
+            )
+            self._standby_target = 0
+        self._standbys: list[tuple[str, int]] = []  # (pod, index) FIFO
+        self._next_standby = 0
+        self.standby_activations = 0
 
         self._client = Client(
             image_name=image_name,
@@ -87,6 +110,7 @@ class K8sInstanceManager:
     def start_workers(self):
         if self.lockstep:
             self._start_world(cluster_version=0)
+            self._replenish_standbys()
         else:
             for _ in range(self._num_workers):
                 self._start(self._claim_worker_id())
@@ -96,12 +120,14 @@ class K8sInstanceManager:
         worker died of OOM (relaunching an OOM loop helps nobody)."""
         with self._lock:
             pod_name = self._pods.pop(worker_id, None)
+            service = self._services.pop(worker_id, None)
             if pod_name:
                 self._pod_to_worker.pop(pod_name, None)
             blacklisted = worker_id in self._oom_workers
         if pod_name:
             self._client.delete_pod(pod_name)
-            self._client.delete_service(pod_name)
+        if service:
+            self._client.delete_service(service)
         if blacklisted:
             logger.warning(
                 "Worker %d was OOMKilled; not relaunching", worker_id
@@ -116,11 +142,14 @@ class K8sInstanceManager:
         crash loops)."""
         with self._lock:
             pods = dict(self._pods)
+            services = dict(self._services)
             self._pods.clear()
+            self._services.clear()
             self._pod_to_worker.clear()
         for pod_name in pods.values():
             self._client.delete_pod(pod_name)
-            self._client.delete_service(pod_name)
+        for service in services.values():
+            self._client.delete_service(service)
         self._reforms += 1
         if self._reforms > self._max_reforms:
             raise RuntimeError(
@@ -128,17 +157,28 @@ class K8sInstanceManager:
                 f"(--relaunch_on_worker_failure limit); giving up"
             )
         self._start_world(cluster_version=cluster_version)
+        # refill the pool AFTER the new world is up, off the recovery path
+        threading.Thread(
+            target=self._replenish_standbys, daemon=True
+        ).start()
 
     def stop_workers(self):
         with self._lock:
             self._stopping = True
             pods = dict(self._pods)
+            services = dict(self._services)
             self._pods.clear()
+            self._services.clear()
             self._pod_to_worker.clear()
+            standbys = list(self._standbys)
+            self._standbys.clear()
         self._client.stop_watching()
         for pod_name in pods.values():
             self._client.delete_pod(pod_name)
-            self._client.delete_service(pod_name)
+        for service in services.values():
+            self._client.delete_service(service)
+        for pod_name, _index in standbys:
+            self._client.delete_pod(pod_name)
 
     # ---- pod lifecycle -----------------------------------------------------
 
@@ -151,12 +191,15 @@ class K8sInstanceManager:
     def _start_world(self, cluster_version: int, num_processes=None):
         n = num_processes if num_processes is not None else self._num_workers
         worker_ids = [self._claim_worker_id() for _ in range(n)]
-        # the coordinator is process 0's per-pod DNS name
+        # the coordinator is process 0's per-worker-id DNS name; the
+        # service is (re)pointed at whichever pod plays process 0, so the
+        # address is stable whether that pod is fresh or a standby
         coordinator = (
             self._client.worker_service_address(worker_ids[0])
             if n > 1
             else ""
         )
+        standbys = self._take_live_standbys(n)
         for process_id, worker_id in enumerate(worker_ids):
             kwargs = {}
             if coordinator:
@@ -166,7 +209,116 @@ class K8sInstanceManager:
                     process_id=process_id,
                     cluster_version=cluster_version,
                 )
-            self._start(worker_id, **kwargs)
+            if standbys:
+                self._activate_standby_pod(
+                    *standbys.pop(0), worker_id, kwargs
+                )
+            else:
+                self._start(worker_id, **kwargs)
+
+    # ---- hot-standby pod pool ----------------------------------------------
+
+    def _replenish_standbys(self):
+        with self._lock:
+            if self._stopping:
+                return
+            missing = self._standby_target - len(self._standbys)
+        master_addr = (
+            self._master_addr()
+            if callable(self._master_addr)
+            else self._master_addr
+        )
+        for _ in range(max(0, missing)):
+            with self._lock:
+                if self._stopping:
+                    return
+                index = self._next_standby
+                self._next_standby += 1
+            pod_name = f"elasticdl-{self._client.job_name}-standby-{index}"
+            argv = self._build_argv(0, master_addr, standby=1)
+            manifest = self._client.build_pod_manifest(
+                pod_name=pod_name,
+                replica_type="worker-standby",
+                replica_index=index,
+                command=["python", "-m"],
+                args=list(argv),
+                resource_requests=self._resource_request,
+                resource_limits=self._resource_limit,
+                pod_priority=self._pod_priority,
+                volume=self._volume,
+                image_pull_policy=self._image_pull_policy,
+                # the identity it polls the assignment mailbox with
+                envs={**self._envs, "EDL_STANDBY_ID": pod_name},
+                owner_pod=self._owner_pod,
+            )
+            self._client.create_pod(manifest)
+            with self._lock:
+                accepted = not self._stopping
+                if accepted:
+                    self._standbys.append((pod_name, index))
+            if not accepted:
+                # stop_workers drained the pool while we were creating
+                # this pod: nobody will ever delete it but us
+                self._client.delete_pod(pod_name)
+                return
+            logger.info("Started standby pod %s", pod_name)
+
+    def _take_live_standbys(self, n: int) -> list:
+        """Pop up to n standbys whose pods still exist (one that died
+        while waiting is silently dropped — it was never part of any
+        world, so nothing needs recovering)."""
+        taken: list = []
+        while len(taken) < n:
+            with self._lock:
+                if not self._standbys:
+                    break
+                entry = self._standbys.pop(0)
+            pod = self._client.read_pod(entry[0])
+            phase = ""
+            if pod is not None:
+                _meta, status = _pod_fields(pod)
+                phase = (status or {}).get("phase", "")
+            if pod is None or phase in ("Failed", "Succeeded"):
+                # a crashed pod object persists in phase Failed
+                # (restartPolicy Never) — it will never poll the mailbox
+                logger.warning(
+                    "Standby pod %s is gone/dead (%s); skipping",
+                    entry[0],
+                    phase or "deleted",
+                )
+                if pod is not None:
+                    self._client.delete_pod(entry[0])
+                continue
+            taken.append(entry)
+        return taken
+
+    def _activate_standby_pod(
+        self, pod_name: str, standby_index: int, worker_id: int, world: dict
+    ):
+        """Assign a warm standby pod its place in the new world: create
+        the worker-id service pointing at it (so it can serve as the
+        coordinator), register it for event routing, and post the
+        assignment to the master's mailbox."""
+        self._client.create_service(
+            self._client.build_service_manifest(
+                self._client.get_worker_pod_name(worker_id),
+                self._client.replica_selector(
+                    "worker-standby", standby_index
+                ),
+                COORDINATOR_PORT,
+            )
+        )
+        with self._lock:
+            self._pods[worker_id] = pod_name
+            self._services[worker_id] = self._client.get_worker_pod_name(
+                worker_id
+            )
+            self._pod_to_worker[pod_name] = worker_id
+            self.standby_activations += 1
+        self._post_assignment(pod_name, {"worker_id": worker_id, **world})
+        logger.info(
+            "Activated standby pod %s as worker %d", pod_name, worker_id
+        )
 
     def _start(self, worker_id: int, **world_kwargs):
         pod_name = self._client.get_worker_pod_name(worker_id)
@@ -194,6 +346,7 @@ class K8sInstanceManager:
         )
         with self._lock:
             self._pods[worker_id] = pod_name
+            self._services[worker_id] = pod_name
             self._pod_to_worker[pod_name] = worker_id
         self._client.create_pod(manifest)
         self._client.create_service(

@@ -41,12 +41,6 @@ def build_master(args) -> Master:
 
             from elasticdl_tpu.k8s.instance_manager import K8sInstanceManager
 
-            if getattr(args, "standby_workers", -1) > 0:
-                logger.warning(
-                    "--standby_workers is not implemented for the k8s "
-                    "backend; pods cold-start on re-formation"
-                )
-
             return K8sInstanceManager(
                 num_workers=num_workers,
                 build_argv=build_argv,
@@ -75,6 +69,9 @@ def build_master(args) -> Master:
                     args, "image_pull_policy", "Always"
                 ),
                 on_worker_failure=master.servicer.mark_worker_dead,
+                standby_workers=getattr(args, "standby_workers", -1),
+                # standby pods poll this mailbox for world assignments
+                post_assignment=master.servicer.post_world_assignment,
             )
         return LocalInstanceManager(
             master,

@@ -264,6 +264,8 @@ class Master:
     def stop(self):
         if self.evaluation_service is not None:
             self.evaluation_service.stop()
+        # any RPC-polling standby must learn the job is over
+        self.servicer.drain_standbys()
         if self.instance_manager is not None:
             self.instance_manager.stop_workers()
         if self._server is not None:

@@ -54,6 +54,11 @@ class MasterServicer:
         self._step_stream: dict[int, msg.TaskResponse] = {}
         self._stream_lock = threading.Lock()
         self._first_stream_pull_at: float | None = None
+        # hot-standby world assignments addressed by standby id (the
+        # RPC-transported analogue of the local backend's stdin line:
+        # pods cannot receive stdin, so k8s standbys poll for these)
+        self._world_assignments: dict[str, dict] = {}
+        self._standby_drain = False
         if evaluation_service is not None:
             evaluation_service.set_master_servicer(self)
 
@@ -212,6 +217,36 @@ class MasterServicer:
             should_quiesce=self._quiesce,
             cluster_version=self._cluster_version,
         )
+
+    # ---- hot-standby world assignments ------------------------------------
+
+    def post_world_assignment(self, standby_id: str, assignment: dict):
+        """Instance manager -> standby mailbox: ``assignment`` carries the
+        same keys the local backend writes on stdin (worker_id,
+        coordinator_addr, num_processes, process_id, cluster_version)."""
+        with self._lock:
+            self._world_assignments[standby_id] = dict(assignment)
+
+    def get_world_assignment(
+        self, request: msg.GetWorldAssignmentRequest
+    ) -> msg.WorldAssignmentResponse:
+        """Standby poll.  Deliberately NOT a liveness signal: a waiting
+        standby is invisible to failure detection until activated."""
+        with self._lock:
+            assignment = self._world_assignments.pop(
+                request.standby_id, None
+            )
+            if assignment is None:
+                return msg.WorldAssignmentResponse(
+                    shutdown=self._standby_drain
+                )
+        return msg.WorldAssignmentResponse(has=True, **assignment)
+
+    def drain_standbys(self):
+        """Job shutdown: polling standbys are told to exit."""
+        with self._lock:
+            self._standby_drain = True
+            self._world_assignments.clear()
 
     # ---- failure detection / mesh re-formation hooks ----------------------
 

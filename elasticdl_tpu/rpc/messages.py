@@ -118,6 +118,28 @@ class HeartbeatResponse:
     cluster_version: int = 0
 
 
+@dataclass
+class GetWorldAssignmentRequest:
+    """Hot-standby poll: a pre-warmed worker (pod) asks whether it has
+    been assigned a place in a (re-)formed world.  ``standby_id`` is the
+    identity the instance manager addressed the assignment to (the pod
+    name on k8s)."""
+
+    standby_id: str
+
+
+@dataclass
+class WorldAssignmentResponse:
+    has: bool = False
+    # True once the job is shutting down: the standby exits cleanly
+    shutdown: bool = False
+    worker_id: int = 0
+    coordinator_addr: str = ""
+    num_processes: int = 1
+    process_id: int = 0
+    cluster_version: int = 0
+
+
 _SIMPLE_TYPES = {
     "GetTaskRequest": GetTaskRequest,
     "GetStepTaskRequest": GetStepTaskRequest,
@@ -126,6 +148,8 @@ _SIMPLE_TYPES = {
     "ReportVersionRequest": ReportVersionRequest,
     "HeartbeatRequest": HeartbeatRequest,
     "HeartbeatResponse": HeartbeatResponse,
+    "GetWorldAssignmentRequest": GetWorldAssignmentRequest,
+    "WorldAssignmentResponse": WorldAssignmentResponse,
 }
 
 

@@ -33,6 +33,7 @@ _METHODS = (
     "report_version",
     "report_evaluation_metrics",
     "heartbeat",
+    "get_world_assignment",
 )
 
 _CHANNEL_OPTIONS = [
@@ -113,6 +114,11 @@ class MasterClient:
         self, request: msg.ReportEvaluationMetricsRequest
     ):
         return self._call("report_evaluation_metrics", request)
+
+    def get_world_assignment(
+        self, request: msg.GetWorldAssignmentRequest
+    ) -> msg.WorldAssignmentResponse:
+        return self._call("get_world_assignment", request)
 
     def heartbeat(self, request: msg.HeartbeatRequest) -> msg.HeartbeatResponse:
         return self._call("heartbeat", request)

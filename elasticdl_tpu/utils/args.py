@@ -410,10 +410,11 @@ def _add_master_params(parser: argparse.ArgumentParser):
         type=int,
         default=-1,
         help=(
-            "Hot-standby processes kept warm (imports done, blocked on a "
+            "Hot-standby workers kept warm (imports done, waiting on a "
             "world assignment) so re-formation skips the cold start; "
-            "-1 = num_workers, 0 disables. Lockstep jobs on the local "
-            "instance backend only (k8s pods cold-start on re-formation)"
+            "-1 = num_workers, 0 disables. Lockstep jobs only; local "
+            "standbys wait on stdin, k8s standby pods poll the master's "
+            "assignment mailbox"
         ),
     )
 

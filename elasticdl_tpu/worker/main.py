@@ -15,7 +15,9 @@ Two runtimes, selected by the master via the argv round-trip:
 from __future__ import annotations
 
 import json
+import os
 import sys
+import time
 
 from elasticdl_tpu.rpc.service import MasterClient
 from elasticdl_tpu.utils.args import parse_worker_args
@@ -44,15 +46,66 @@ def _standby_wait(args) -> bool:
         )
     except Exception:  # noqa: BLE001 — the live run will surface it
         pass
-    logger.info("Standby worker warmed; waiting for a world assignment")
-    line = sys.stdin.readline()
-    if not line.strip():
+    standby_id = os.environ.get("EDL_STANDBY_ID", "")
+    logger.info(
+        "Standby worker warmed; waiting for a world assignment (%s)",
+        f"RPC as {standby_id!r}" if standby_id else "stdin",
+    )
+    if standby_id:
+        assignment = _poll_world_assignment(args, standby_id)
+    else:
+        # local backend: the instance manager writes one JSON line
+        line = sys.stdin.readline()
+        assignment = json.loads(line) if line.strip() else None
+    if assignment is None:
         return False
-    assignment = json.loads(line)
     for key, value in assignment.items():
         setattr(args, key, value)
     args.standby = 0
     return True
+
+
+def _poll_world_assignment(
+    args, standby_id: str, poll_secs: float = 0.5
+) -> dict | None:
+    """k8s standbys cannot receive stdin: poll the master's assignment
+    mailbox instead (same payload keys as the stdin line)."""
+    from elasticdl_tpu.rpc import messages as msg
+
+    client = MasterClient(args.master_addr)
+    failures = 0
+    try:
+        while True:
+            try:
+                resp = client.get_world_assignment(
+                    msg.GetWorldAssignmentRequest(standby_id=standby_id)
+                )
+                failures = 0
+            except Exception as ex:  # noqa: BLE001 — a standby must
+                # survive transient master unavailability (pod reschedule,
+                # network blip): crashing here silently shrinks the pool
+                failures += 1
+                if failures % 60 == 1:
+                    logger.warning(
+                        "Standby %s cannot reach the master (%s); retrying",
+                        standby_id,
+                        ex,
+                    )
+                time.sleep(poll_secs)
+                continue
+            if resp.has:
+                return {
+                    "worker_id": resp.worker_id,
+                    "coordinator_addr": resp.coordinator_addr,
+                    "num_processes": resp.num_processes,
+                    "process_id": resp.process_id,
+                    "cluster_version": resp.cluster_version,
+                }
+            if resp.shutdown:
+                return None
+            time.sleep(poll_secs)
+    finally:
+        client.close()
 
 
 def main(argv=None) -> int:

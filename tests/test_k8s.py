@@ -369,3 +369,127 @@ def test_tensorboard_service_and_ingress():
         "loadBalancer": {"ingress": [{"ip": "1.2.3.4"}]}
     }
     assert tb.get_tensorboard_external_ip(max_checks=1) == "1.2.3.4"
+
+
+def test_k8s_standby_pool_reform_activates_without_cold_start():
+    """Re-formation assigns pre-warmed standby pods into the new world
+    through the assignment mailbox instead of cold-starting pods; the
+    worker-id service is re-pointed at the standby so it can coordinate."""
+    import time as _time
+
+    api = FakeApi()
+    mailbox: dict = {}
+    im = K8sInstanceManager(
+        num_workers=2,
+        build_argv=_argv,
+        master_addr="master.ns.svc:50001",
+        image_name="img:1",
+        namespace="ns",
+        job_name="job",
+        lockstep=True,
+        max_reforms=2,
+        api=api,
+        watch=False,
+        standby_workers=2,
+        post_assignment=lambda sid, a: mailbox.__setitem__(sid, a),
+    )
+    im.start_workers()
+    # 2 worker pods + 2 warm standby pods carrying their mailbox identity
+    assert "elasticdl-job-standby-0" in api.pods
+    assert "elasticdl-job-standby-1" in api.pods
+    spec = api.pods["elasticdl-job-standby-0"]["spec"]["containers"][0]
+    env = {e["name"]: e.get("value") for e in spec["env"]}
+    assert env["EDL_STANDBY_ID"] == "elasticdl-job-standby-0"
+    assert "--standby" in spec["args"]
+
+    im.reform_world(cluster_version=1)
+    assert im.standby_activations == 2
+    assert sorted(im.worker_ids()) == [2, 3]
+    # assignments posted, process 0 on the first standby, with the
+    # coordinator at the NEW worker id's stable DNS name
+    a0 = mailbox["elasticdl-job-standby-0"]
+    assert a0["worker_id"] == 2 and a0["process_id"] == 0
+    assert a0["cluster_version"] == 1 and a0["num_processes"] == 2
+    assert (
+        a0["coordinator_addr"]
+        == f"elasticdl-job-worker-2.ns.svc:{COORDINATOR_PORT}"
+    )
+    # the worker-2 service selects the standby pod's labels
+    selector = api.services["elasticdl-job-worker-2"]["spec"]["selector"]
+    assert selector["elasticdl-replica-type"] == "worker-standby"
+    assert selector["elasticdl-replica-index"] == "0"
+    # no cold worker pods were created for the new generation
+    assert "elasticdl-job-worker-2" not in api.pods
+
+    # the pool refills off the recovery path (background thread)
+    deadline = _time.monotonic() + 10
+    while _time.monotonic() < deadline:
+        with im._lock:
+            if len(im._standbys) == 2:
+                break
+        _time.sleep(0.05)
+    with im._lock:
+        assert [name for name, _ in im._standbys] == [
+            "elasticdl-job-standby-2",
+            "elasticdl-job-standby-3",
+        ]
+
+    # a standby that CRASHED while waiting (pod object persists in phase
+    # Failed) is skipped and reaped, not assigned
+    api.pods["elasticdl-job-standby-2"]["status"] = {"phase": "Failed"}
+    im.reform_world(cluster_version=2)
+    assert im.standby_activations == 3  # only the live one activated
+    assert "elasticdl-job-standby-3" in mailbox
+    assert "elasticdl-job-standby-2" in api.deleted_pods
+    # the standby-activated worker-2's service was deleted with its world
+    assert "elasticdl-job-worker-2" not in api.services
+
+
+def test_rpc_standby_wait_round_trip(tmp_path):
+    """A standby polls the REAL wire for its assignment; drain tells a
+    late standby to exit."""
+    import threading
+
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.rpc.service import create_server
+    from elasticdl_tpu.worker.main import _poll_world_assignment
+
+    servicer = MasterServicer(
+        16, TaskDispatcher({"s": (0, 16)}, records_per_task=16)
+    )
+    server = create_server(servicer, port=0)
+    server.start()
+
+    class _Args:
+        master_addr = f"localhost:{server._edl_bound_port}"
+
+    try:
+        results: list = []
+        t = threading.Thread(
+            target=lambda: results.append(
+                _poll_world_assignment(_Args, "pod-a", poll_secs=0.05)
+            )
+        )
+        t.start()
+        servicer.post_world_assignment(
+            "pod-a",
+            {
+                "worker_id": 7,
+                "coordinator_addr": "c:1",
+                "num_processes": 2,
+                "process_id": 1,
+                "cluster_version": 3,
+            },
+        )
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert results[0]["worker_id"] == 7
+        assert results[0]["coordinator_addr"] == "c:1"
+        assert results[0]["cluster_version"] == 3
+
+        # drained mailbox -> a polling standby exits with None
+        servicer.drain_standbys()
+        assert _poll_world_assignment(_Args, "pod-b", poll_secs=0.05) is None
+    finally:
+        server.stop(grace=None)

@@ -287,3 +287,83 @@ def test_deepfm_sharded_embedding_trains_past_85pct(tmp_path):
     results = metric_tree_results(tree)
     assert results["accuracy_logits"] > 0.85, results
     assert results["auc_probs"] > 0.9, results
+
+
+def test_census_feature_columns_train_past_80pct(tmp_path):
+    """BASELINE.md config-4, census half: the feature-column DNN (numeric
+    + embedding_column categoricals) trains on EDLIO census-shape shards
+    past the reference's >0.8 quality bar
+    (worker_ps_interaction_test.py)."""
+    import jax
+    import optax
+
+    from elasticdl_tpu.data.dataset import Dataset, batched_model_pipeline
+    from elasticdl_tpu.data.recordio_reader import RecordIODataReader
+    from elasticdl_tpu.data.recordio_gen import synthetic
+    from elasticdl_tpu.trainer.metrics import (
+        metric_tree_results,
+        update_metric_tree,
+    )
+    from elasticdl_tpu.trainer.state import Modes, TrainState, init_model
+    from elasticdl_tpu.trainer.step import build_eval_step, build_train_step
+    from elasticdl_tpu.utils.model_utils import get_model_spec
+
+    train_dir = synthetic.gen_census(
+        str(tmp_path / "train"),
+        num_records=8192,
+        num_shards=1,
+        seed=2,
+        # vocab 30 keeps per-value observation counts high enough for
+        # the embedding_column weights to generalize within test-size data
+        vocab_size=30,
+    )
+    test_dir = synthetic.gen_census(
+        str(tmp_path / "test"), num_records=512, num_shards=1, seed=77,
+        vocab_size=30,
+    )
+    spec = get_model_spec(
+        "", "census_dnn_model.census_functional_api.custom_model"
+    )
+
+    def batches(data_dir, mode):
+        reader = RecordIODataReader(data_dir=data_dir)
+
+        def gen():
+            for name, (start, count) in reader.create_shards().items():
+                task = type(
+                    "T",
+                    (),
+                    {"shard_name": name, "start": start, "end": start + count},
+                )
+                yield from reader.read_records(task)
+
+        return list(
+            batched_model_pipeline(
+                Dataset.from_generator(gen),
+                spec,
+                mode,
+                reader.metadata,
+                128,
+                shuffle_records=mode == Modes.TRAINING,
+            )
+        )
+
+    train_batches = batches(train_dir, Modes.TRAINING)
+    features, _ = train_batches[0]
+    model = spec.build_model()
+    params, model_state = init_model(model, features)
+    state = TrainState.create(
+        model.apply, params, optax.adam(2e-3), model_state
+    )
+    train_step = build_train_step(spec.loss, compute_dtype=None)
+    for _ in range(20):
+        for feats, labs in train_batches:
+            state, _m = train_step(state, feats, labs)
+
+    eval_step = build_eval_step(spec.loss)
+    tree = spec.eval_metrics_fn()
+    for feats, labs in batches(test_dir, Modes.EVALUATION):
+        outputs, _l = eval_step(state, feats, labs)
+        update_metric_tree(tree, np.asarray(labs), jax.device_get(outputs))
+    results = metric_tree_results(tree)
+    assert results["accuracy"] > 0.8, results
