@@ -60,7 +60,7 @@ def _wait_for_checkpoint(ckpt_dir, timeout=300):
     return False
 
 
-def _run_stall_recovery(tmp_path, extra, num_workers):
+def _run_stall_recovery(tmp_path, extra, num_workers, victim_index=-1):
     """Start a master, SIGSTOP one worker after real progress, assert the
     job completes with every record accounted; returns the master."""
     from elasticdl_tpu.master.main import build_master
@@ -97,7 +97,9 @@ def _run_stall_recovery(tmp_path, extra, num_workers):
         assert _wait_for_checkpoint(ckpt), "job never progressed"
         victims = master.instance_manager.worker_ids()
         assert len(victims) == num_workers
-        victim_proc = master.instance_manager._procs[victims[-1]]
+        victim_proc = master.instance_manager._procs[
+            sorted(victims)[victim_index]
+        ]
         stalled_pid = victim_proc.pid
         # STALL, don't kill: the process stays alive but its heartbeat
         # thread freezes with it — the failure k8s cannot see but a
@@ -134,6 +136,21 @@ def test_stalled_lockstep_worker_triggers_reform(tmp_path):
     assert master.reform_events, "stall never triggered a re-formation"
     assert master.reform_events[0]["latency_secs"] > 0
     # the new world must come from the hot-standby pool, not a cold start
+    assert master.instance_manager.standby_activations == 2
+
+
+@pytest.mark.slow
+def test_stalled_coordinator_process_triggers_reform(tmp_path):
+    """Process 0 hosts the jax.distributed coordination service: losing
+    IT is the worst lockstep failure (survivors lose both their peer and
+    the coordinator).  The world must still re-form and finish."""
+    master = _run_stall_recovery(
+        tmp_path,
+        ["--distribution_strategy", "AllreduceStrategy"],
+        num_workers=2,
+        victim_index=0,  # worker 0 == process 0 == coordinator host
+    )
+    assert master.reform_events, "coordinator stall never triggered reform"
     assert master.instance_manager.standby_activations == 2
 
 
