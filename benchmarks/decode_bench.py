@@ -1,0 +1,75 @@
+"""Host input-pipeline benchmark: fused native decode+batch vs the
+per-record Python decoder (the data-plane half of the framework; the
+device half is ``bench.py``).
+
+Prints ONE JSON line:
+  {"native_records_per_sec": N, "python_records_per_sec": N,
+   "speedup": N, "batch": B, "record_bytes": R}
+
+Run: ``python benchmarks/decode_bench.py``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np  # noqa: E402
+
+from elasticdl_tpu.data import reader  # noqa: E402
+from elasticdl_tpu.data import recordio  # noqa: E402
+
+BATCH = 256
+REPS = 50
+
+
+def main():
+    rng = np.random.RandomState(0)
+    payloads = [
+        reader.encode_example(
+            {
+                "image": rng.randint(0, 255, (28, 28)).astype(np.uint8),
+                "label": np.int64(i % 10),
+            }
+        )
+        for i in range(BATCH)
+    ]
+
+    def timeit(fn):
+        fn()  # warm
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            fn()
+        return (time.perf_counter() - t0) / REPS
+
+    t_native = timeit(lambda: reader.decode_example_batch(payloads))
+
+    orig = reader._native_decode_batch
+    reader._native_decode_batch = lambda *a: None  # force the fallback
+    try:
+        t_python = timeit(lambda: reader.decode_example_batch(payloads))
+    finally:
+        reader._native_decode_batch = orig
+
+    print(
+        json.dumps(
+            {
+                "native_records_per_sec": round(BATCH / t_native),
+                "python_records_per_sec": round(BATCH / t_python),
+                "speedup": round(t_python / t_native, 1),
+                "batch": BATCH,
+                "record_bytes": len(payloads[0]),
+                "native_codec_loaded": recordio.native_available(),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

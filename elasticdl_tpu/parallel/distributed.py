@@ -178,6 +178,12 @@ class SPMDTrainer:
 
         return jax.tree_util.tree_map(_pad, tree), div
 
+    def place_padded(self, tree):
+        """pad_batch + place_batch — THE way runtimes feed host batches
+        whose leading dim may not divide the data axes."""
+        padded, _ = self.pad_batch(tree)
+        return self.place_batch(padded)
+
     # ---- steps ------------------------------------------------------------
 
     def train_step(self, features, labels):
@@ -205,3 +211,9 @@ def _host_slice_for_init(sample_features):
     return jax.tree_util.tree_map(
         lambda x: np.asarray(x)[:1], sample_features
     )
+
+
+def trim_pad(outputs, n: int):
+    """Drop the rows :meth:`SPMDTrainer.pad_batch` added for shard
+    divisibility (device arrays come back as host numpy)."""
+    return jax.tree_util.tree_map(lambda x: np.asarray(x)[:n], outputs)

@@ -5,33 +5,30 @@ strategy executor: no master process, no RPC, but the same task-based data
 traversal.  Deviations: where the reference mocks tasks with a namedtuple
 (``_MockedTask``), we drive a real in-process :class:`TaskDispatcher`, so
 the exact task lifecycle (epochs, SAVE_MODEL callback, counters) is
-exercised even in local runs; and the train step is a jitted JAX program
-on the local chip instead of an eager GradientTape.
+exercised even in local runs; and the compute plane is the same
+:class:`SPMDTrainer` the distributed workers run — a jitted SPMD step over
+a mesh of ALL local devices (a Local job on a v5e-8 host trains
+data-parallel across its 8 chips), with the same sharding rules,
+re-shardable periodic checkpoints, and async writes.
 """
 
 from __future__ import annotations
 
+import jax
 import numpy as np
 
 from elasticdl_tpu.data.dataset import Dataset, batched_model_pipeline
 from elasticdl_tpu.data.factory import create_data_reader
 from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.parallel.distributed import SPMDTrainer, trim_pad
+from elasticdl_tpu.parallel.mesh import MeshConfig
 from elasticdl_tpu.trainer import metrics as metrics_lib
-from elasticdl_tpu.trainer.state import (
-    Modes,
-    TrainState,
-    checkpoint_to_state,
-    init_model,
-    state_to_checkpoint,
+from elasticdl_tpu.trainer.checkpointing import (
+    PeriodicCheckpointer,
+    restore_trainer_state,
 )
-from elasticdl_tpu.trainer.step import (
-    build_eval_step,
-    build_predict_step,
-    build_train_step,
-    resolve_optimizer,
-)
-from elasticdl_tpu.utils import save_utils, tree_utils
-from elasticdl_tpu.utils.constants import TaskType
+from elasticdl_tpu.trainer.state import Modes, TrainState
+from elasticdl_tpu.trainer.step import resolve_optimizer
 from elasticdl_tpu.utils.log_utils import default_logger as logger
 from elasticdl_tpu.utils.model_utils import get_model_spec
 from elasticdl_tpu.utils.timing_utils import Timing
@@ -96,16 +93,19 @@ class LocalExecutor:
             if args.prediction_data
             else None
         )
-        self._state: TrainState | None = None
-        self._train_step = None
-        self._eval_step = None
-        self._predict_step = None
-        self._saver = (
-            save_utils.CheckpointSaver(
-                args.checkpoint_dir, args.keep_checkpoint_max
-            )
-            if args.checkpoint_dir
-            else None
+        if getattr(args, "jax_platform", ""):
+            from elasticdl_tpu.parallel.elastic import configure_platform
+
+            configure_platform(args.jax_platform)
+        # all local devices; --mesh_shape picks the layout ('' = all on dp)
+        self._mesh = MeshConfig.from_string(
+            getattr(args, "mesh_shape", "") or ""
+        ).create()
+        self._trainer: SPMDTrainer | None = None
+        self._checkpointer = PeriodicCheckpointer(
+            getattr(args, "checkpoint_dir", "") or "",
+            getattr(args, "checkpoint_steps", 0) or 0,
+            keep_checkpoint_max=getattr(args, "keep_checkpoint_max", 3),
         )
         self._timing = Timing(
             enabled=args.log_level == "DEBUG", logger=logger
@@ -131,63 +131,49 @@ class LocalExecutor:
             prefetch=2,
         )
 
-    def _ensure_state(self, sample_features):
-        if self._state is not None:
+    def _ensure_trainer(self, sample_features):
+        if self._trainer is not None:
             return
-        params, model_state = init_model(self._model, sample_features)
-        self._state = TrainState.create(
-            self._model.apply, params, self._tx, model_state
-        )
-        if self._args.checkpoint_dir_for_init:
-            dense, embeddings, extra = save_utils.restore_checkpoint(
-                self._args.checkpoint_dir_for_init
-            )
-            # worker-written checkpoints carry sharded tables as parts
-            dense.update(save_utils.assemble_embedding_tables(embeddings))
-            self._state = checkpoint_to_state(self._state, dense)
-            logger.info(
-                "Initialized parameters from checkpoint %s (version %s)",
-                self._args.checkpoint_dir_for_init,
-                extra.get("model_version", "?"),
-            )
-        self._train_step = build_train_step(
+        rules = ()
+        if self._spec.sharding_rules is not None:
+            rules = tuple(self._spec.sharding_rules(self._mesh))
+        compute_dtype = getattr(self._args, "compute_dtype", "float32")
+        self._trainer = SPMDTrainer(
+            self._mesh,
+            self._model,
             self._spec.loss,
+            self._tx,
+            sample_features,
+            rules=rules,
             compute_dtype=None
-            if self._args.compute_dtype == "float32"
-            else self._args.compute_dtype,
-            remat=self._args.remat,
-            donate=self._args.donate_state,
+            if compute_dtype == "float32"
+            else compute_dtype,
+            remat=bool(getattr(self._args, "remat", False)),
+            donate=bool(getattr(self._args, "donate_state", True)),
         )
-        self._eval_step = build_eval_step(self._spec.loss)
-        self._predict_step = build_predict_step()
+        version = restore_trainer_state(self._trainer, self._args)
+        if version is not None:
+            self._checkpointer.note_restored_version(version)
 
-    def _maybe_checkpoint(self):
-        if (
-            self._saver is not None
-            and self._args.checkpoint_steps
-            and self._version % self._args.checkpoint_steps == 0
-        ):
-            self._saver.save(
-                self._version,
-                dense=state_to_checkpoint(self._state),
-                extra={"model_version": self._version},
-            )
+    def _place(self, tree):
+        return self._trainer.place_padded(tree)
 
     @property
     def _version(self) -> int:
-        return int(self._state.step) if self._state is not None else 0
+        return self._trainer.step if self._trainer is not None else 0
 
     # ---- phases -----------------------------------------------------------
 
     def _train_task(self, task) -> int:
         processed = 0
-        for batch in self._task_dataset(self._train_reader, task, Modes.TRAINING):
-            features, labels = batch
-            self._ensure_state(features)
+        for features, labels in self._task_dataset(
+            self._train_reader, task, Modes.TRAINING
+        ):
+            self._ensure_trainer(features)
             self._profiler.on_step(self._version)
             with self._timing.record("batch_process"):
-                self._state, step_metrics = self._train_step(
-                    self._state, features, labels
+                self._trainer.train_step(
+                    self._place(features), self._place(labels)
                 )
             processed += _batch_size(labels)
             if (
@@ -195,11 +181,11 @@ class LocalExecutor:
                 and self._version % self._args.evaluation_steps == 0
             ):
                 self.evaluate(tag=f"step {self._version}")
-            self._maybe_checkpoint()
+            self._checkpointer.maybe_save(self._trainer, self._mesh)
         return processed
 
     def evaluate(self, tag: str = "final") -> dict:
-        if self._eval_reader is None or self._state is None:
+        if self._eval_reader is None or self._trainer is None:
             return {}
         eval_metrics = (
             self._spec.eval_metrics_fn()
@@ -220,11 +206,22 @@ class LocalExecutor:
             for features, labels in self._task_dataset(
                 self._eval_reader, task, Modes.EVALUATION
             ):
-                outputs, loss = self._eval_step(self._state, features, labels)
-                metrics_lib.update_metric_tree(
-                    eval_metrics, np.asarray(labels), _to_numpy(outputs)
+                n = _batch_size(labels)
+                outputs, _padded_loss = self._trainer.eval_step(
+                    self._place(features), self._place(labels)
                 )
-                loss_mean.update_value(loss, _batch_size(labels))
+                outputs = trim_pad(jax.device_get(outputs), n)
+                metrics_lib.update_metric_tree(
+                    eval_metrics, np.asarray(labels), outputs
+                )
+                # exact loss over the REAL rows (the in-step loss would
+                # count the rows pad_batch added for shard divisibility)
+                loss_mean.update_value(
+                    float(
+                        np.asarray(self._spec.loss(labels, outputs))
+                    ),
+                    n,
+                )
             dispatcher.report(tid, True)
         results = metrics_lib.metric_tree_results(eval_metrics)
         results["loss"] = loss_mean.result()
@@ -248,9 +245,10 @@ class LocalExecutor:
             for features in self._task_dataset(
                 self._predict_reader, task, Modes.PREDICTION
             ):
-                self._ensure_state(features)
-                outputs = self._predict_step(self._state, features)
-                processed = _to_numpy(outputs)
+                self._ensure_trainer(features)
+                n = _batch_size(features)
+                outputs = self._trainer.predict_step(self._place(features))
+                processed = trim_pad(jax.device_get(outputs), n)
                 if self._spec.prediction_outputs_processor is not None:
                     self._spec.prediction_outputs_processor.process(
                         processed, worker_id=0
@@ -286,25 +284,30 @@ class LocalExecutor:
                     total += self._train_task(task)
                 dispatcher.report(tid, True)
         finally:
-            # flush (or diagnose) the trace even on a mid-training error —
-            # a leaked active trace poisons later start_trace calls
-            self._profiler.stop()
+            try:
+                # an in-flight async checkpoint (or a parked write error)
+                # must not be abandoned by a mid-training exception
+                self._checkpointer.flush()
+            finally:
+                # flush (or diagnose) the trace even on error — a leaked
+                # active trace poisons later start_trace calls
+                self._profiler.stop()
         logger.info(
             "Training complete: %d records, %d steps", total, self._version
         )
         self._timing.report_timing(reset=True)
-        if self._saver is not None:
-            self._saver.save(
-                self._version,
-                dense=state_to_checkpoint(self._state),
-                extra={"model_version": self._version},
-            )
+        if self._checkpointer.enabled and self._trainer is not None:
+            self._checkpointer.save_now(self._trainer, self._mesh)
+            self._checkpointer.flush()
         results = self.evaluate()
-        if self._args.output and self._state is not None:
+        if self._args.output and self._trainer is not None:
             from elasticdl_tpu.utils.export_utils import export_model
 
             export_model(
-                self._args.output, self._state, self._spec, self._args
+                self._args.output,
+                self._trainer.state,
+                self._spec,
+                self._args,
             )
         return results
 
@@ -321,21 +324,21 @@ class LocalExecutor:
         for features, _ in self._task_dataset(
             self._eval_reader, task, Modes.EVALUATION
         ):
-            self._ensure_state(features)
+            self._ensure_trainer(features)
             break
 
     @property
     def state(self) -> TrainState | None:
-        return self._state
+        return self._trainer.state if self._trainer is not None else None
+
+    @property
+    def trainer(self) -> SPMDTrainer | None:
+        return self._trainer
 
 
-def _batch_size(labels) -> int:
-    if isinstance(labels, dict):
-        labels = next(iter(labels.values()))
-    return int(np.shape(labels)[0]) if np.ndim(labels) else 1
+def _batch_size(tree) -> int:
+    if isinstance(tree, dict):
+        tree = next(iter(tree.values()))
+    return int(np.shape(tree)[0]) if np.ndim(tree) else 1
 
 
-def _to_numpy(outputs):
-    if isinstance(outputs, dict):
-        return {k: np.asarray(v) for k, v in outputs.items()}
-    return np.asarray(outputs)

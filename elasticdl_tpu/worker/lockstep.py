@@ -39,7 +39,7 @@ from elasticdl_tpu.data.dataset import Dataset, batched_model_pipeline
 from elasticdl_tpu.data.factory import create_data_reader
 from elasticdl_tpu.master.task_dispatcher import FAIL_COUNT
 from elasticdl_tpu.parallel import elastic
-from elasticdl_tpu.parallel.distributed import SPMDTrainer
+from elasticdl_tpu.parallel.distributed import SPMDTrainer, trim_pad
 from elasticdl_tpu.parallel.mesh import MeshConfig
 from elasticdl_tpu.rpc import messages as msg
 from elasticdl_tpu.trainer.checkpointing import (
@@ -210,8 +210,7 @@ class LockstepWorker:
         )
 
     def _place(self, tree):
-        padded, _ = self._trainer.pad_batch(tree)
-        return self._trainer.place_batch(padded)
+        return self._trainer.place_padded(tree)
 
     # ---- task execution ----------------------------------------------------
 
@@ -267,7 +266,7 @@ class LockstepWorker:
                 # collective gather so the chief holds full outputs, in
                 # global batch order (matches the labels read host-side)
                 host = elastic.replicate_to_hosts(outputs, self._mesh)
-                all_outputs.append(_trim(host, n))
+                all_outputs.append(trim_pad(host, n))
                 all_labels.append(np.asarray(labels))
         if all_outputs and self._is_chief:
             outputs = jax.tree_util.tree_map(
@@ -305,7 +304,7 @@ class LockstepWorker:
                 self._ensure_trainer(features)
                 n = _batch_len(features)
                 outputs = self._trainer.predict_step(self._place(features))
-                host = _trim(
+                host = trim_pad(
                     elastic.replicate_to_hosts(outputs, self._mesh), n
                 )
                 if (
@@ -434,5 +433,3 @@ def _batch_len(tree) -> int:
     return int(np.shape(leaves[0])[0]) if leaves else 0
 
 
-def _trim(outputs, n: int):
-    return jax.tree_util.tree_map(lambda x: np.asarray(x)[:n], outputs)

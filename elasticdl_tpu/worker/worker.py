@@ -29,7 +29,7 @@ import jax
 import numpy as np
 
 from elasticdl_tpu.data.dataset import batched_model_pipeline
-from elasticdl_tpu.parallel.distributed import SPMDTrainer
+from elasticdl_tpu.parallel.distributed import SPMDTrainer, trim_pad
 from elasticdl_tpu.parallel.mesh import MeshConfig
 from elasticdl_tpu.rpc import messages as msg
 from elasticdl_tpu.trainer.checkpointing import (
@@ -214,8 +214,7 @@ class Worker:
     # ---- minibatch processing ----------------------------------------------
 
     def _place(self, tree):
-        padded, _ = self._trainer.pad_batch(tree)
-        return self._trainer.place_batch(padded)
+        return self._trainer.place_padded(tree)
 
     def _process_minibatch(self, task_type, features, labels):
         """One minibatch with retry (reference worker.py:800-840; retries
@@ -248,7 +247,7 @@ class Worker:
         outputs = jax.device_get(
             self._trainer.predict_step(self._place(features))
         )
-        outputs = _trim(outputs, n)
+        outputs = trim_pad(outputs, n)
         if self._spec.prediction_outputs_processor is not None:
             self._spec.prediction_outputs_processor.process(
                 outputs, self._worker_id
@@ -353,7 +352,7 @@ class Worker:
                     outputs, _ = self._trainer.eval_step(
                         self._place(features), self._place(labels)
                     )
-                    all_outputs.append(_trim(jax.device_get(outputs), n))
+                    all_outputs.append(trim_pad(jax.device_get(outputs), n))
                     all_labels.append(np.asarray(labels))
                     err = ""
                     break
@@ -468,6 +467,3 @@ def _batch_len(tree) -> int:
     return int(np.shape(leaves[0])[0]) if leaves else 0
 
 
-def _trim(outputs, n: int):
-    """Drop pad rows added for SPMD batch divisibility."""
-    return jax.tree_util.tree_map(lambda x: np.asarray(x)[:n], outputs)

@@ -124,15 +124,16 @@ def test_two_process_lockstep_matches_single_process(tmp_path, monkeypatch):
     executor.run()
     local = state_to_checkpoint(executor.state)
     for key in p0.files:
-        # tolerance covers 1-device vs 2-device reduction-order noise
-        # amplified through BatchNorm over 6 steps; a data-partitioning
-        # bug (each worker training on half the data) shows up as O(1e-1)
-        # divergence and still fails loudly
+        # tolerance covers 8-device (LocalExecutor SPMD over the virtual
+        # mesh) vs 2-device reduction-order noise amplified through
+        # BatchNorm over 6 steps; a data-partitioning bug (each worker
+        # training on half the data) shows up as O(1e-1) divergence and
+        # still fails loudly
         np.testing.assert_allclose(
             np.asarray(local[key], dtype=np.float64),
             np.asarray(p0[key], dtype=np.float64),
             rtol=5e-3,
-            atol=2e-2,
+            atol=3e-2,
             err_msg=key,
         )
 
