@@ -78,13 +78,15 @@ def _configs(n_chips: int = 1):
             labels=rng.randint(0, 10, 512).astype(np.int32),
             batch=512,
         ),
+        # CTR-realistic batch (4096): at small batches the per-step
+        # dispatch floor, not the embedding+FM math, dominates both sides
         "deepfm": dict(
             model_def="deepfm_edl_embedding.deepfm_edl_embedding.custom_model",
             features={
-                "feature": rng.randint(0, 5383, (512, 10)).astype(np.int64)
+                "feature": rng.randint(0, 5383, (4096, 10)).astype(np.int64)
             },
-            labels=rng.randint(0, 2, 512).astype(np.int32),
-            batch=512,
+            labels=rng.randint(0, 2, 4096).astype(np.int32),
+            batch=4096,
         ),
         # ImageNet-shape ResNet-50 (BASELINE.md config 3, single chip);
         # batch 128 measured best on v5e (1442 samples/s vs 1258 @64)
@@ -295,9 +297,12 @@ def main():
         "baseline.json",
     )
     baselines = {}
+    baseline_batches = {}
     if os.path.exists(baseline_path):
         with open(baseline_path) as f:
-            baselines = json.load(f).get("samples_per_sec", {})
+            payload = json.load(f)
+        baselines = payload.get("samples_per_sec", {})
+        baseline_batches = payload.get("batch_sizes", {})
 
     models = {}
     for name, cfg in _configs(max(1, mesh.devices.size)).items():
@@ -310,6 +315,17 @@ def main():
             models[name] = {"error": str(ex)[:200]}
             continue
         base = baselines.get(name)
+        # a stale anchor measured at a different batch is apples-to-
+        # oranges: drop it loudly rather than report a skewed ratio
+        base_batch = baseline_batches.get(name, cfg["batch"])
+        if base and base_batch != cfg["batch"]:
+            print(
+                f"baseline for {name} measured at batch {base_batch}, "
+                f"bench runs {cfg['batch']}; re-run benchmarks/"
+                f"baseline_tf.py — dropping the vs_baseline anchor",
+                file=sys.stderr,
+            )
+            base = None
         if base:
             models[name]["vs_baseline"] = round(
                 models[name]["samples_per_sec_per_chip"] / base, 2

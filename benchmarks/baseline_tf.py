@@ -39,8 +39,10 @@ import tensorflow as tf  # noqa: E402
 BATCHES = {
     "mnist": 256,
     "resnet50_cifar10": 512,
-    "imagenet_resnet50": 64,
-    "deepfm": 512,
+    "imagenet_resnet50": 128,
+    # CTR-realistic batch; small batches measure per-step overhead, not
+    # the embedding+FM math (same batch as bench.py's JAX side)
+    "deepfm": 4096,
 }
 
 
