@@ -48,6 +48,11 @@ def _run_distributed(args) -> dict:
 
 
 def _submit_k8s(args) -> dict:
+    if getattr(args, "yaml", ""):
+        # a manifest dump never touches the cluster: no SDK needed
+        from elasticdl_tpu.k8s.submit import submit_master_pod
+
+        return submit_master_pod(args)
     try:
         import kubernetes  # noqa: F401
     except ImportError as e:
@@ -67,8 +72,10 @@ def _dispatch(args) -> dict:
     )
     if strategy == DistributionStrategy.LOCAL:
         return _run_local(args)
-    if getattr(args, "docker_image", "") or getattr(
-        args, "docker_image_repository", ""
+    if (
+        getattr(args, "docker_image", "")
+        or getattr(args, "docker_image_repository", "")
+        or getattr(args, "yaml", "")
     ):
         # a prebuilt image OR a repository to build+push into means a
         # cluster submission (reference api.py:24-33); otherwise the job

@@ -34,6 +34,7 @@ def create_dockerfile(
     model_zoo: str,
     base_image: str = "",
     extra_pypi_index: str = "",
+    cluster_spec: str = "",
 ) -> str:
     """Synthesize the job Dockerfile (reference :137-212).
 
@@ -52,6 +53,13 @@ def create_dockerfile(
         "COPY elasticdl_tpu /framework/elasticdl_tpu",
         f"RUN pip install 'jax[tpu]' flax optax msgpack grpcio numpy{index}",
     ]
+    if cluster_spec:
+        # the master applies cluster hooks in-cluster, so the spec module
+        # rides in the image at a fixed path (reference api.py:42-43)
+        lines.append(
+            f"COPY {os.path.basename(cluster_spec)} /cluster_spec/"
+            f"{os.path.basename(cluster_spec)}"
+        )
     if model_zoo:
         parsed = urlparse(model_zoo)
         if not parsed.path:
@@ -82,6 +90,7 @@ def build_and_push_docker_image(
     docker_tlscert: str = "",
     docker_tlskey: str = "",
     client=None,
+    cluster_spec: str = "",
 ) -> str:
     """Assemble the context, build, and (when a repository is given) push.
     Returns the full image name (reference :12-79)."""
@@ -96,9 +105,18 @@ def build_and_push_docker_image(
                 shutil.copytree(
                     zoo, os.path.join(ctx_dir, os.path.basename(zoo))
                 )
+        if cluster_spec:
+            shutil.copy(
+                os.path.abspath(cluster_spec),
+                os.path.join(ctx_dir, os.path.basename(cluster_spec)),
+            )
         dockerfile = os.path.join(ctx_dir, "Dockerfile")
         with open(dockerfile, "w") as f:
-            f.write(create_dockerfile(model_zoo, base_image, extra_pypi))
+            f.write(
+                create_dockerfile(
+                    model_zoo, base_image, extra_pypi, cluster_spec
+                )
+            )
 
         client = client or _docker_client(
             docker_base_url, docker_tlscert, docker_tlskey

@@ -64,14 +64,29 @@ class Client:
         event_callback=None,
         api=None,
         watch: bool | None = None,
+        cluster_spec: str = "",
     ):
         """``watch=False`` disables the stream thread (tests drive the
-        event callback directly through a fake API)."""
-        self._api = api if api is not None else _default_api()
+        event callback directly through a fake API).  ``api=False``
+        selects apiless manifest-only mode (--yaml dump: never touches a
+        cluster; CRUD raises).  ``cluster_spec`` names a Python module
+        exporting ``cluster`` with ``with_pod(pod)`` /
+        ``with_service(service)`` hooks applied to every manifest
+        (reference k8s_client.py:79-82,271-272,468-469 —
+        cluster-specific tolerations, labels, annotations)."""
+        if api is False:
+            self._api = None
+        else:
+            self._api = api if api is not None else _default_api()
         self.namespace = namespace
         self.job_name = job_name
         self.image_name = image_name
         self._event_cb = event_callback
+        self.cluster = None
+        if cluster_spec:
+            from elasticdl_tpu.utils.model_utils import load_module_from_path
+
+            self.cluster = load_module_from_path(cluster_spec).cluster
         self._watching = (
             event_callback is not None if watch is None else watch
         )
@@ -219,6 +234,8 @@ class Client:
             },
             "spec": spec,
         }
+        if self.cluster is not None:
+            manifest = self.cluster.with_pod(manifest)
         return manifest
 
     def build_service_manifest(
@@ -227,7 +244,7 @@ class Client:
         """Headless single-pod service: a stable DNS name (the coordinator
         address must survive pod IP churn).  ``selector`` must match the
         labels the target pod actually carries (``replica_selector``)."""
-        return {
+        manifest = {
             "apiVersion": "v1",
             "kind": "Service",
             "metadata": {
@@ -241,6 +258,9 @@ class Client:
                 "ports": [{"port": port, "targetPort": port}],
             },
         }
+        if self.cluster is not None:
+            manifest = self.cluster.with_service(manifest)
+        return manifest
 
     def replica_selector(self, replica_type: str, replica_index=None) -> dict:
         """Selector matching exactly the labels ``build_pod_manifest``
@@ -249,11 +269,23 @@ class Client:
 
     # ---- CRUD --------------------------------------------------------------
 
+    def _require_api(self):
+        if self._api is None:
+            raise RuntimeError(
+                "k8s Client was constructed apiless (manifest-only / "
+                "--yaml dump mode); cluster CRUD is unavailable"
+            )
+        return self._api
+
     def create_pod(self, manifest: dict):
-        return self._api.create_namespaced_pod(self.namespace, manifest)
+        return self._require_api().create_namespaced_pod(
+            self.namespace, manifest
+        )
 
     def create_service(self, manifest: dict):
-        return self._api.create_namespaced_service(self.namespace, manifest)
+        return self._require_api().create_namespaced_service(
+            self.namespace, manifest
+        )
 
     def read_pod(self, pod_name: str):
         try:

@@ -44,6 +44,7 @@ class K8sInstanceManager:
         watch: bool | None = None,
         standby_workers: int = -1,
         post_assignment=None,
+        cluster_spec: str = "",
     ):
         self._num_workers = num_workers
         self._build_argv = build_argv
@@ -98,6 +99,7 @@ class K8sInstanceManager:
             event_callback=self._event_cb,
             api=api,
             watch=watch,
+            cluster_spec=cluster_spec,
         )
         self._owner_pod = self._client.get_master_pod()
 

@@ -15,9 +15,18 @@ from elasticdl_tpu.utils.log_utils import default_logger as logger
 
 def submit_master_pod(args, api=None) -> dict:
     """Build (and optionally push) the job image, then create the master
-    pod.  Returns a summary dict for the CLI."""
+    pod (or, with ``--yaml FILE``, dump the manifests there instead of
+    submitting — reference api.py:147-161).  Returns a summary dict for
+    the CLI."""
+    yaml_path = getattr(args, "yaml", "") or ""
     image_name = getattr(args, "docker_image", "") or ""
+    prebuilt = bool(image_name)
     repository = getattr(args, "docker_image_repository", "") or ""
+    if not image_name and yaml_path:
+        # a manifest dump must not require docker; a real build tags
+        # repository:elasticdl-tpu-<uuid>, unknowable here — emit an
+        # explicit placeholder the user must replace before applying
+        image_name = f"{repository or 'elasticdl_tpu'}:TO_BUILD"
     if not image_name:
         from elasticdl_tpu.image_builder import build_and_push_docker_image
 
@@ -25,27 +34,44 @@ def submit_master_pod(args, api=None) -> dict:
             model_zoo=getattr(args, "model_zoo", "") or "",
             docker_image_repository=repository,
             base_image=getattr(args, "docker_base_image", "") or "",
+            cluster_spec=getattr(args, "cluster_spec", "") or "",
         )
 
     client = Client(
         image_name=image_name,
         namespace=args.namespace,
         job_name=args.job_name,
-        api=api,
+        # --yaml never touches the cluster: apiless manifest-only mode
+        api=api if api is not None else (False if yaml_path else None),
+        cluster_spec=getattr(args, "cluster_spec", "") or "",
     )
     master_argv = build_arguments_from_parsed_result(
-        args, filter_args=frozenset({"docker_image", "model_zoo"})
+        args,
+        filter_args=frozenset({"docker_image", "model_zoo", "cluster_spec", "yaml"}),
     )
     # the in-cluster master creates worker pods from THIS image, and the
     # model zoo lives at its in-image location, not the submitter's path
     master_argv.extend(["--docker_image", image_name])
+    import os
+
     model_zoo = getattr(args, "model_zoo", "") or ""
     if model_zoo:
-        import os
-
         master_argv.extend(
             ["--model_zoo", f"/model_zoo/{os.path.basename(os.path.abspath(model_zoo))}"]
         )
+    cluster_spec = getattr(args, "cluster_spec", "") or ""
+    if cluster_spec:
+        if prebuilt:
+            # a prebuilt image was NOT built by this submission, so the
+            # /cluster_spec COPY never happened: pass the path through
+            # (it must exist inside the image or on a mounted volume)
+            master_argv.extend(["--cluster_spec", cluster_spec])
+        else:
+            # the in-image location the builder COPYed it to
+            master_argv.extend(
+                ["--cluster_spec",
+                 f"/cluster_spec/{os.path.basename(cluster_spec)}"]
+            )
     manifest = client.build_pod_manifest(
         pod_name=client.get_master_pod_name(),
         replica_type="master",
@@ -60,15 +86,42 @@ def submit_master_pod(args, api=None) -> dict:
         image_pull_policy=getattr(args, "image_pull_policy", "Always"),
         envs=getattr(args, "envs_dict", {}) or {},
     )
+    service = client.build_service_manifest(
+        client.get_master_pod_name(),
+        client.replica_selector("master"),
+        MASTER_PORT,
+    )
+    if yaml_path:
+        try:
+            import yaml as yaml_lib
+
+            with open(yaml_path, "w") as f:
+                yaml_lib.safe_dump_all(
+                    [manifest, service], f, sort_keys=False
+                )
+        except ImportError:
+            # manifests are JSON-compatible and kubectl accepts a v1 List
+            import json
+
+            with open(yaml_path, "w") as f:
+                json.dump(
+                    {
+                        "apiVersion": "v1",
+                        "kind": "List",
+                        "items": [manifest, service],
+                    },
+                    f,
+                    indent=1,
+                )
+        logger.info("Dumped master manifests to %s (not submitted)", yaml_path)
+        return {
+            "master_pod": client.get_master_pod_name(),
+            "image": image_name,
+            "yaml": yaml_path,
+        }
     client.create_pod(manifest)
     # the control-plane service workers dial (stable DNS for MASTER_PORT)
-    client.create_service(
-        client.build_service_manifest(
-            client.get_master_pod_name(),
-            client.replica_selector("master"),
-            MASTER_PORT,
-        )
-    )
+    client.create_service(service)
     logger.info(
         "Submitted master pod %s (image %s) to namespace %s",
         client.get_master_pod_name(),

@@ -72,6 +72,7 @@ def build_master(args) -> Master:
                 standby_workers=getattr(args, "standby_workers", -1),
                 # standby pods poll this mailbox for world assignments
                 post_assignment=master.servicer.post_world_assignment,
+                cluster_spec=getattr(args, "cluster_spec", "") or "",
             )
         return LocalInstanceManager(
             master,

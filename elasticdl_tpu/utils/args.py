@@ -406,6 +406,23 @@ def _add_master_params(parser: argparse.ArgumentParser):
         help="Re-queue a task held longer than this (0 = never)",
     )
     parser.add_argument(
+        "--cluster_spec",
+        default="",
+        help=(
+            "Python module exporting `cluster` with with_pod/with_service "
+            "hooks applied to every pod/service manifest (cluster-specific "
+            "tolerations, labels); copied into the job image on submit"
+        ),
+    )
+    parser.add_argument(
+        "--yaml",
+        default="",
+        help=(
+            "Dump the master pod+service manifests to this file instead "
+            "of submitting the job (k8s backend only)"
+        ),
+    )
+    parser.add_argument(
         "--standby_workers",
         type=int,
         default=-1,
@@ -568,6 +585,8 @@ _MASTER_ONLY_FLAGS = frozenset(
         "heartbeat_timeout_secs",
         "task_timeout_secs",
         "standby_workers",
+        "yaml",
+        "cluster_spec",
     }
 )
 

@@ -493,3 +493,89 @@ def test_rpc_standby_wait_round_trip(tmp_path):
         assert _poll_world_assignment(_Args, "pod-b", poll_secs=0.05) is None
     finally:
         server.stop(grace=None)
+
+
+def test_cluster_spec_hooks_applied_to_manifests(tmp_path):
+    """--cluster_spec module's with_pod/with_service hooks customize
+    every manifest (reference k8s_client.py:271-272,468-469)."""
+    spec_file = tmp_path / "my_cluster.py"
+    spec_file.write_text(
+        "class _Cluster:\n"
+        "    def with_pod(self, pod):\n"
+        "        pod['spec']['tolerations'] = [{'key': 'tpu'}]\n"
+        "        return pod\n"
+        "    def with_service(self, service):\n"
+        "        service['metadata'].setdefault('annotations', {})[\n"
+        "            'cloud'] = 'internal'\n"
+        "        return service\n"
+        "cluster = _Cluster()\n"
+    )
+    client = Client(
+        image_name="img:1",
+        namespace="ns",
+        job_name="job",
+        api=FakeApi(),
+        watch=False,
+        cluster_spec=str(spec_file),
+    )
+    pod = client.build_pod_manifest(
+        pod_name="p", replica_type="worker", replica_index=0
+    )
+    assert pod["spec"]["tolerations"] == [{"key": "tpu"}]
+    svc = client.build_service_manifest(
+        "s", client.replica_selector("worker", 0), 1234
+    )
+    assert svc["metadata"]["annotations"]["cloud"] == "internal"
+
+
+def test_submit_yaml_dumps_without_cluster(tmp_path):
+    """--yaml writes the master pod+service manifests and submits
+    NOTHING (reference api.py:147-161); no kubernetes SDK, no docker."""
+    import yaml as yaml_lib
+
+    from elasticdl_tpu.api import _dispatch
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    spec_file = tmp_path / "my_cluster.py"
+    spec_file.write_text(
+        "class _Cluster:\n"
+        "    def with_pod(self, pod):\n"
+        "        pod['spec']['tolerations'] = [{'key': 'tpu'}]\n"
+        "        return pod\n"
+        "    def with_service(self, service):\n"
+        "        return service\n"
+        "cluster = _Cluster()\n"
+    )
+    out = tmp_path / "job.yaml"
+    args = parse_master_args(
+        [
+            "--model_def",
+            "mnist_functional_api.mnist_functional_api.custom_model",
+            "--training_data",
+            "/data/train",
+            "--distribution_strategy",
+            "AllreduceStrategy",
+            "--num_workers",
+            "2",
+            "--docker_image",
+            "img:7",
+            "--yaml",
+            str(out),
+            "--cluster_spec",
+            str(spec_file),
+        ]
+    )
+    result = _dispatch(args)
+    assert result["yaml"] == str(out)
+    docs = list(yaml_lib.safe_load_all(out.read_text()))
+    assert [d["kind"] for d in docs] == ["Pod", "Service"]
+    # the cluster hook customized the dumped master pod too
+    assert docs[0]["spec"]["tolerations"] == [{"key": "tpu"}]
+    pod_args = docs[0]["spec"]["containers"][0]["args"]
+    assert pod_args[0] == "elasticdl_tpu.master.main"
+    # with a PREBUILT image no /cluster_spec COPY ever ran: the path is
+    # passed through (it must exist inside the image or on a volume);
+    # only a built-by-this-submission image gets the rewrite
+    idx = pod_args.index("--cluster_spec")
+    assert pod_args[idx + 1] == str(spec_file)
+    assert "--yaml" not in pod_args  # the in-cluster master must submit
