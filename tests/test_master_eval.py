@@ -264,3 +264,46 @@ def test_master_run_completes(tmp_path):
 
     model, flat, _ = load_exported_model(str(tmp_path / "out"))
     assert flat
+
+
+def test_concurrent_report_version_queues_each_milestone_once(tmp_path):
+    """Every worker's report_version lands on the 64-thread gRPC pool
+    concurrently; the milestone check-and-set is lock-guarded so one
+    milestone must queue exactly one eval job's tasks (the race fixed
+    after round 1 — duplicate milestones double-count eval)."""
+    import threading
+
+    train_dir = synthetic.gen_mnist(
+        str(tmp_path / "t"), num_records=64, num_shards=1, seed=0
+    )
+    eval_dir = synthetic.gen_mnist(
+        str(tmp_path / "e"), num_records=32, num_shards=1, seed=1
+    )
+    args = _master_args(train_dir, eval_dir, ["--evaluation_steps", "2"])
+    master = Master(args)
+
+    from elasticdl_tpu.rpc import messages as msg
+
+    barrier = threading.Barrier(16)
+
+    def ping(worker_id):
+        barrier.wait()
+        for version in (2, 3, 4):  # milestones 1, 1, 2
+            master.servicer.report_version(
+                msg.ReportVersionRequest(
+                    model_version=version, worker_id=worker_id
+                )
+            )
+
+    threads = [
+        threading.Thread(target=ping, args=(i,)) for i in range(16)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert all(not t.is_alive() for t in threads)
+    # 2 milestones crossed (versions 2 and 4); the 32-record eval set at
+    # records_per_task=32 is 1 task per milestone — exactly 2 eval tasks
+    # queued across 48 concurrent pings
+    assert len(master.task_d._pending_eval) == 2
