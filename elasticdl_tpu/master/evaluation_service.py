@@ -126,6 +126,7 @@ class EvaluationService:
         self._eval_throttle_secs = throttle_secs
         self._eval_start_delay_secs = start_delay_secs
         self._eval_checkpoint_versions: list[int] = []
+        self._latest_published_job = 0
         # highest milestone index (model_version // evaluation_steps)
         # already queued by the step-based trigger
         self._last_eval_milestone = 0
@@ -265,7 +266,11 @@ class EvaluationService:
                 return None
             job, self._eval_job = self._eval_job, None
 
-        # job done: publish results (reference :271-293)
+        # job done: publish results (reference :271-293).  The published
+        # summary carries BOTH versions: the milestone the eval was
+        # scheduled at and the step the workers actually evaluated with —
+        # deviation D5 (no checkpoint restore at the milestone), so the
+        # two can legitimately differ and the user must be able to see it.
         summary = job.get_evaluation_summary()
         logger.info(
             "Evaluation @version %d (evaluated with step-%d state): %s",
@@ -277,10 +282,21 @@ class EvaluationService:
             self._tensorboard_service.write_dict_to_summary(
                 summary, version=max(job.model_version, 0)
             )
+        summary = dict(summary)
+        if job.model_version >= 0:
+            summary["model_version"] = job.model_version
+        if job.evaluated_version >= 0:
+            summary["evaluated_version"] = job.evaluated_version
         if self._eval_exporter is not None:
             self._eval_exporter(job.model_version, summary)
         if self._eval_only:
             self.trigger.set()
-        self.latest_summary = summary
+        with self._lock:
+            # this publish section runs unlocked, so a slow thread holding
+            # an OLD finished job could otherwise overwrite a newer job's
+            # summary; job ids are monotonic, so publish only forward
+            if job.job_id >= self._latest_published_job:
+                self._latest_published_job = job.job_id
+                self.latest_summary = summary
         self._try_start_next()  # queued milestones run back-to-back
         return summary

@@ -268,9 +268,17 @@ def test_master_run_completes(tmp_path):
 
 def test_concurrent_report_version_queues_each_milestone_once(tmp_path):
     """Every worker's report_version lands on the 64-thread gRPC pool
-    concurrently; the milestone check-and-set is lock-guarded so one
-    milestone must queue exactly one eval job's tasks (the race fixed
-    after round 1 — duplicate milestones double-count eval)."""
+    concurrently; the milestone check-and-set is lock-guarded so each
+    milestone is queued exactly once (the race fixed after round 1 —
+    duplicate milestones double-count eval).
+
+    Eval jobs are *serialized* — same as the reference, whose
+    try_to_create_new_job only materializes tasks when no eval job is
+    running and drains the version queue on completion
+    (evaluation_service.py:221-243, 267-292).  So after the pings: one
+    eval job's tasks pending (milestone 1), one version queued
+    (milestone 2), and completing the first job creates the second's
+    tasks — nothing dropped, nothing duplicated."""
     import threading
 
     train_dir = synthetic.gen_mnist(
@@ -303,7 +311,72 @@ def test_concurrent_report_version_queues_each_milestone_once(tmp_path):
     for t in threads:
         t.join(timeout=60)
     assert all(not t.is_alive() for t in threads)
-    # 2 milestones crossed (versions 2 and 4); the 32-record eval set at
-    # records_per_task=32 is 1 task per milestone — exactly 2 eval tasks
-    # queued across 48 concurrent pings
-    assert len(master.task_d._pending_eval) == 2
+    # 2 milestones crossed (versions 2 and 4) across 48 concurrent pings.
+    # The 32-record eval set at records_per_task=32 is 1 task per job:
+    # milestone 1's job is running, milestone 2 waits in the queue.
+    eval_service = master.evaluation_service
+    assert len(master.task_d._pending_eval) == 1
+    assert eval_service._eval_checkpoint_versions == [4]
+    assert eval_service._eval_job.model_version == 2
+
+    # Drain the first eval job: its completion must materialize the
+    # queued milestone's tasks (the serialized hand-off — reference
+    # complete_task -> try_to_create_new_job).
+    task_id, task = master.task_d.get_eval_task(worker_id=0)
+    assert task is not None and task.model_version == 2
+    master.task_d.report(task_id, success=True)
+    assert eval_service._eval_checkpoint_versions == []
+    assert eval_service._eval_job.model_version == 4
+    assert len(master.task_d._pending_eval) == 1
+    task_id, task = master.task_d.get_eval_task(worker_id=0)
+    assert task is not None and task.model_version == 4
+    master.task_d.report(task_id, success=True)
+    assert eval_service._eval_job is None
+    assert len(master.task_d._pending_eval) == 0
+
+
+def test_summary_carries_evaluated_version_when_it_differs(tmp_path):
+    """Deviation D5 pinned: workers evaluate with whatever state they hold
+    (no checkpoint restore at the milestone), so the published summary
+    must surface BOTH the milestone model_version and the step actually
+    evaluated with when they differ."""
+    train_dir = synthetic.gen_mnist(
+        str(tmp_path / "t"), num_records=64, num_shards=1, seed=0
+    )
+    eval_dir = synthetic.gen_mnist(
+        str(tmp_path / "e"), num_records=32, num_shards=1, seed=1
+    )
+    args = _master_args(train_dir, eval_dir, ["--evaluation_steps", "2"])
+    master = Master(args)
+
+    from elasticdl_tpu.rpc import messages as msg
+
+    # milestone crossing at version 4 queues one eval job
+    master.servicer.report_version(
+        msg.ReportVersionRequest(model_version=4, worker_id=0)
+    )
+    task_id, task = master.task_d.get_eval_task(worker_id=0)
+    assert task is not None and task.model_version == 4
+
+    # the worker's state has advanced to step 7 by the time it evaluates
+    outputs = {
+        "output": ndarray_to_tensor("output", np.eye(10, dtype=np.float32))
+    }
+    labels = ndarray_to_tensor("labels", np.arange(10))
+    master.servicer.report_evaluation_metrics(
+        msg.ReportEvaluationMetricsRequest(
+            model_outputs=outputs,
+            labels=labels,
+            model_version=4,
+            task_id=task_id,
+            evaluated_version=7,
+        )
+    )
+    master.task_d.report(task_id, success=True)
+
+    summary = master.evaluation_service.latest_summary
+    assert summary["model_version"] == 4
+    assert summary["evaluated_version"] == 7
+    assert summary["accuracy"] == 1.0
+    # and the job-level summary the CLI prints carries the same dict
+    assert master.job_summary()["evaluation_metrics"] is summary
