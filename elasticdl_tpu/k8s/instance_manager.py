@@ -89,6 +89,8 @@ class K8sInstanceManager:
             )
             self._standby_target = 0
         self._standbys: list[tuple[str, int]] = []  # (pod, index) FIFO
+        # pod name -> consecutive reforms seen Pending (eviction aging)
+        self._pending_skips: dict[str, int] = {}
         self._next_standby = 0
         self.standby_activations = 0
 
@@ -112,7 +114,7 @@ class K8sInstanceManager:
     def start_workers(self):
         if self.lockstep:
             self._start_world(cluster_version=0)
-            self._replenish_standbys()
+            self._replenish_standbys(raise_errors=True)
         else:
             for _ in range(self._num_workers):
                 self._start(self._claim_worker_id())
@@ -220,7 +222,12 @@ class K8sInstanceManager:
 
     # ---- hot-standby pod pool ----------------------------------------------
 
-    def _replenish_standbys(self):
+    def _replenish_standbys(self, raise_errors: bool = False):
+        """``raise_errors=True`` on the synchronous startup call: a
+        deterministic config error (bad --cluster_spec hook, malformed
+        resources) must fail the job with a traceback, not silently
+        start it standby-less.  Background refills (after reform) keep
+        going past transient API failures instead."""
         with self._lock:
             if self._stopping:
                 return
@@ -237,23 +244,36 @@ class K8sInstanceManager:
                 index = self._next_standby
                 self._next_standby += 1
             pod_name = f"elasticdl-{self._client.job_name}-standby-{index}"
-            argv = self._build_argv(0, master_addr, standby=1)
-            manifest = self._client.build_pod_manifest(
-                pod_name=pod_name,
-                replica_type="worker-standby",
-                replica_index=index,
-                command=["python", "-m"],
-                args=list(argv),
-                resource_requests=self._resource_request,
-                resource_limits=self._resource_limit,
-                pod_priority=self._pod_priority,
-                volume=self._volume,
-                image_pull_policy=self._image_pull_policy,
-                # the identity it polls the assignment mailbox with
-                envs={**self._envs, "EDL_STANDBY_ID": pod_name},
-                owner_pod=self._owner_pod,
-            )
-            self._client.create_pod(manifest)
+            try:
+                argv = self._build_argv(0, master_addr, standby=1)
+                manifest = self._client.build_pod_manifest(
+                    pod_name=pod_name,
+                    replica_type="worker-standby",
+                    replica_index=index,
+                    command=["python", "-m"],
+                    args=list(argv),
+                    resource_requests=self._resource_request,
+                    resource_limits=self._resource_limit,
+                    pod_priority=self._pod_priority,
+                    volume=self._volume,
+                    image_pull_policy=self._image_pull_policy,
+                    # the identity it polls the assignment mailbox with
+                    envs={**self._envs, "EDL_STANDBY_ID": pod_name},
+                    owner_pod=self._owner_pod,
+                )
+                self._client.create_pod(manifest)
+            except Exception:
+                if raise_errors:
+                    raise
+                # this runs on an unguarded daemon thread after
+                # reform_world: one transient API failure must not abort
+                # the whole refill and leave the pool empty until the
+                # next reform
+                logger.exception(
+                    "Failed to create standby pod %s; continuing refill",
+                    pod_name,
+                )
+                continue
             with self._lock:
                 accepted = not self._stopping
                 if accepted:
@@ -265,11 +285,22 @@ class K8sInstanceManager:
                 return
             logger.info("Started standby pod %s", pod_name)
 
+    # reforms a standby may sit Pending before it is presumed
+    # unschedulable (quota / taints) and evicted from the pool
+    _MAX_PENDING_SKIPS = 3
+
     def _take_live_standbys(self, n: int) -> list:
-        """Pop up to n standbys whose pods still exist (one that died
+        """Pop up to n standbys whose pods are Running (one that died
         while waiting is silently dropped — it was never part of any
-        world, so nothing needs recovering)."""
+        world, so nothing needs recovering).  A Pending standby (still
+        scheduling / pulling the image) is NOT live: it isn't polling the
+        mailbox yet, so activating it would silently revert to cold-start
+        latency — leave it in the pool to warm up for the next reform.
+        One stuck Pending across ``_MAX_PENDING_SKIPS`` reforms is
+        presumed unschedulable and evicted so it cannot wedge a pool
+        slot forever (the refill then creates a fresh pod)."""
         taken: list = []
+        not_ready: list = []
         while len(taken) < n:
             with self._lock:
                 if not self._standbys:
@@ -290,8 +321,35 @@ class K8sInstanceManager:
                 )
                 if pod is not None:
                     self._client.delete_pod(entry[0])
+                self._pending_skips.pop(entry[0], None)
                 continue
+            if phase == "Pending":
+                skips = self._pending_skips.get(entry[0], 0) + 1
+                if skips >= self._MAX_PENDING_SKIPS:
+                    logger.warning(
+                        "Standby pod %s still Pending after %d reforms; "
+                        "presuming unschedulable and evicting",
+                        entry[0],
+                        skips,
+                    )
+                    self._client.delete_pod(entry[0])
+                    self._pending_skips.pop(entry[0], None)
+                else:
+                    self._pending_skips[entry[0]] = skips
+                    not_ready.append(entry)
+                continue
+            self._pending_skips.pop(entry[0], None)
             taken.append(entry)
+        if not_ready:
+            with self._lock:
+                stopping = self._stopping
+                if not stopping:
+                    self._standbys[:0] = not_ready
+            if stopping:
+                # stop_workers drained the pool concurrently: these pods
+                # would never be deleted by anyone but us
+                for entry in not_ready:
+                    self._client.delete_pod(entry[0])
         return taken
 
     def _activate_standby_pod(

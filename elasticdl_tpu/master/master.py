@@ -432,7 +432,16 @@ class LocalInstanceManager:
             self._standbys = [p for p in self._standbys if p.poll() is None]
             missing = self._standby_target - len(self._standbys)
         for _ in range(max(0, missing)):
-            proc = self._spawn(0, stdin_pipe=True, standby=1)
+            try:
+                proc = self._spawn(0, stdin_pipe=True, standby=1)
+            except OSError:
+                # refill runs on an unguarded daemon thread: one Popen
+                # failure (fd exhaustion, fork limits) must not abort the
+                # rest of the refill and leave the pool empty
+                logger.exception(
+                    "Failed to spawn standby process; continuing refill"
+                )
+                continue
             with self._lock:
                 accepted = not self._draining
                 if accepted:

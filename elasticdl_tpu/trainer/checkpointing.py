@@ -115,6 +115,24 @@ class PeriodicCheckpointer:
         if error is not None:
             raise error
 
+    def flush_on_unwind(self, clean_exit: bool):
+        """``flush()`` for ``finally`` blocks: when the body raised
+        (``clean_exit=False``), a failed write is logged instead of raised
+        so it cannot replace the root cause in the worker's log; on a
+        clean exit it raises exactly like ``flush()``.  The caller passes
+        the flag explicitly (an ``ok`` variable set as the body's last
+        statement) — sniffing ``sys.exc_info()`` here would also trip
+        when ``run()`` is invoked inside some unrelated active handler."""
+        try:
+            self.flush()
+        except Exception:
+            if clean_exit:
+                raise
+            logger.exception(
+                "Async checkpoint write failed during error unwind "
+                "(original exception follows)"
+            )
+
     def _write_guarded(self, version, dense, parts):
         try:
             self._write(version, dense, parts)

@@ -275,6 +275,7 @@ class LocalExecutor:
             shuffle_seed=getattr(self._args, "shuffle_seed", None),
         )
         total = 0
+        ok = False
         try:
             while True:
                 tid, task = dispatcher.get(0)
@@ -283,11 +284,13 @@ class LocalExecutor:
                 with self._timing.record("task_process"):
                     total += self._train_task(task)
                 dispatcher.report(tid, True)
+            ok = True
         finally:
             try:
                 # an in-flight async checkpoint (or a parked write error)
-                # must not be abandoned by a mid-training exception
-                self._checkpointer.flush()
+                # must not be abandoned by a mid-training exception — nor
+                # may a failed flush replace that exception
+                self._checkpointer.flush_on_unwind(clean_exit=ok)
             finally:
                 # flush (or diagnose) the trace even on error — a leaked
                 # active trace poisons later start_trace calls

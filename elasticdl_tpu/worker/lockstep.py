@@ -363,6 +363,7 @@ class LockstepWorker:
         self._stopped = False
         if hasattr(self._master, "heartbeat"):
             self._start_heartbeats()
+        ok = False
         try:
             seq = 0
             while True:
@@ -397,13 +398,15 @@ class LockstepWorker:
                         task.task_id, f"unknown task type {task.type}"
                     )
             self._dump_state_if_requested()
+            ok = True
         finally:
             try:
-                # a job must not report complete with an unwritten
-                # (async) checkpoint still in flight
-                self._checkpointer.flush()
+                # a job must not report complete with an unwritten (async)
+                # checkpoint in flight — but a failed flush must not
+                # REPLACE an exception already propagating from the body
+                self._checkpointer.flush_on_unwind(clean_exit=ok)
             finally:
-                # ...but a failed write must not leave the heartbeat
+                # ...and neither outcome may leave the heartbeat
                 # thread running (it polls self._stopped)
                 self._profiler.stop()
                 self._stopped = True

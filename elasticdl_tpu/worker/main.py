@@ -66,14 +66,22 @@ def _standby_wait(args) -> bool:
 
 
 def _poll_world_assignment(
-    args, standby_id: str, poll_secs: float = 0.5
+    args, standby_id: str, poll_secs: float = 0.5,
+    max_unreachable_secs: float = 900.0,
 ) -> dict | None:
     """k8s standbys cannot receive stdin: poll the master's assignment
-    mailbox instead (same payload keys as the stdin line)."""
+    mailbox instead (same payload keys as the stdin line).
+
+    ``max_unreachable_secs``: if the master stays CONTINUOUSLY
+    unreachable this long (crashed without posting shutdown, and the pod
+    not GC'd via owner references), the standby exits cleanly rather
+    than polling forever as an orphan; any successful poll resets the
+    clock."""
     from elasticdl_tpu.rpc import messages as msg
 
     client = MasterClient(args.master_addr)
     failures = 0
+    unreachable_since = None
     try:
         while True:
             try:
@@ -81,10 +89,25 @@ def _poll_world_assignment(
                     msg.GetWorldAssignmentRequest(standby_id=standby_id)
                 )
                 failures = 0
+                unreachable_since = None
             except Exception as ex:  # noqa: BLE001 — a standby must
                 # survive transient master unavailability (pod reschedule,
                 # network blip): crashing here silently shrinks the pool
                 failures += 1
+                now = time.monotonic()
+                if unreachable_since is None:
+                    unreachable_since = now
+                elif (
+                    max_unreachable_secs > 0
+                    and now - unreachable_since > max_unreachable_secs
+                ):
+                    logger.error(
+                        "Standby %s: master unreachable for %.0fs; "
+                        "assuming the job is gone and exiting",
+                        standby_id,
+                        now - unreachable_since,
+                    )
+                    return None
                 if failures % 60 == 1:
                     logger.warning(
                         "Standby %s cannot reach the master (%s); retrying",

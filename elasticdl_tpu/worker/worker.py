@@ -443,6 +443,7 @@ class Worker:
         self._stopped = False
         if hasattr(self._master, "heartbeat"):
             self._start_heartbeats()
+        ok = False
         try:
             if self._job_type == JobType.PREDICTION_ONLY:
                 self._predict_only()
@@ -450,13 +451,15 @@ class Worker:
                 self._evaluate_only(wait=True)
             else:
                 self._train_and_evaluate()
+            ok = True
         finally:
             try:
-                # a job must not report complete with an unwritten
-                # (async) checkpoint still in flight
-                self._checkpointer.flush()
+                # a job must not report complete with an unwritten (async)
+                # checkpoint in flight — but a failed flush must not
+                # REPLACE an exception already propagating from the body
+                self._checkpointer.flush_on_unwind(clean_exit=ok)
             finally:
-                # ...but a failed write must not leave the heartbeat
+                # ...and neither outcome may leave the heartbeat
                 # thread running (it polls self._stopped)
                 self._profiler.stop()
                 self._stopped = True

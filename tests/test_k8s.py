@@ -445,6 +445,42 @@ def test_k8s_standby_pool_reform_activates_without_cold_start():
     assert "elasticdl-job-worker-2" not in api.services
 
 
+def test_pending_standby_left_pooled_not_activated():
+    """A standby still Pending (scheduling / image pull) is not polling
+    the mailbox yet: activating it would silently revert to cold-start
+    latency. It must stay in the pool and the reform cold-start instead."""
+    api = FakeApi()
+    mailbox: dict = {}
+    im = K8sInstanceManager(
+        num_workers=2,
+        build_argv=_argv,
+        master_addr="master.ns.svc:50001",
+        image_name="img:1",
+        namespace="ns",
+        job_name="job",
+        lockstep=True,
+        max_reforms=2,
+        api=api,
+        watch=False,
+        standby_workers=1,
+        post_assignment=lambda sid, a: mailbox.__setitem__(sid, a),
+    )
+    im.start_workers()
+    api.pods["elasticdl-job-standby-0"]["status"] = {"phase": "Pending"}
+    im.reform_world(cluster_version=1)
+    assert im.standby_activations == 0
+    assert "elasticdl-job-standby-0" not in mailbox
+    # cold-start pod for the new generation instead
+    assert any(
+        name.startswith("elasticdl-job-worker-")
+        for name, pod in api.pods.items()
+        if name != "elasticdl-job-worker-0"
+    )
+    # still pooled for the next reform (refill saw a full pool)
+    with im._lock:
+        assert ("elasticdl-job-standby-0", 0) in im._standbys
+
+
 def test_rpc_standby_wait_round_trip(tmp_path):
     """A standby polls the REAL wire for its assignment; drain tells a
     late standby to exit."""
