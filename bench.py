@@ -57,6 +57,19 @@ def _peak_flops(device) -> float | None:
     return None
 
 
+def _causal_attn_flops(layers: int, batch: int, seq: int, d_model: int):
+    """Analytic train-step FLOPs of causal flash attention.
+
+    XLA's cost analysis cannot see inside a pallas custom call, so the
+    attention matmuls would otherwise be missing from MFU entirely
+    (verified empirically: the gpt2s lowered flops count matches the
+    non-attention matmuls alone, ~664 MFLOPs/token).  Per layer, causal:
+    forward QK^T + PV = 2*B*T^2*d; backward recompute + dQ/dK/dV ~= 2x
+    forward.  Total 6*L*B*T^2*d — slightly conservative (the flash
+    backward recomputes the score matrix, ~7x/6 of this)."""
+    return 6 * layers * batch * seq * seq * d_model
+
+
 def _configs(n_chips: int = 1):
     import numpy as np
 
@@ -73,10 +86,13 @@ def _configs(n_chips: int = 1):
         ),
         "resnet50_cifar10": dict(
             model_def="resnet50_subclass.resnet50_subclass.custom_model",
-            # 512 keeps the tiny 32x32 convs wide enough to tile the MXU
-            features={"image": rng.rand(512, 32, 32, 3).astype(np.float32)},
-            labels=rng.randint(0, 10, 512).astype(np.int32),
-            batch=512,
+            # bf16 compute (f32 params/BN stats); 2048 saturates the tiny
+            # 32x32 convs — throughput plateaus there (26% MFU is the
+            # roofline for this shape: early stages are bandwidth-bound)
+            model_params=dict(dtype="bfloat16"),
+            features={"image": rng.rand(2048, 32, 32, 3).astype(np.float32)},
+            labels=rng.randint(0, 10, 2048).astype(np.int32),
+            batch=2048,
         ),
         # CTR-realistic batch (4096): at small batches the per-step
         # dispatch floor, not the embedding+FM math, dominates both sides
@@ -89,29 +105,45 @@ def _configs(n_chips: int = 1):
             batch=4096,
         ),
         # ImageNet-shape ResNet-50 (BASELINE.md config 3, single chip);
-        # batch 128 measured best on v5e (1442 samples/s vs 1258 @64)
+        # batch 128 measured best on v5e (2678 samples/s vs 2609 @256,
+        # 2524 @512, all bf16 — r02's 1435 @128 was f32 compute: input
+        # casting alone left every conv in f32 via dtype promotion)
         "imagenet_resnet50": dict(
             model_def="imagenet_resnet50.imagenet_resnet50.custom_model",
+            model_params=dict(dtype="bfloat16"),
             features={
                 "image": rng.rand(128, 224, 224, 3).astype(np.float32)
             },
             labels=rng.randint(0, 1000, 128).astype(np.int32),
             batch=128,
         ),
-        # long-context transformer (pallas flash attention); the
-        # reference has no transformer, so no baseline anchor exists —
-        # the per-chip rate is the metric (samples = sequences; x seq_len
-        # for tokens/sec)
-        "transformer_seq2048": dict(
+        # long-context showcase: seq 8192 sized so attention DOMINATES
+        # the FLOPs (per token/layer: attn 6*T*d = 25.2 MFLOPs vs dense
+        # 6*12*d^2 = 18.9 MFLOPs at d=512) — this measures the flash
+        # kernel, not the dispatch floor (r02's 1-layer/64-dim seq2048
+        # config measured nothing and was dropped per VERDICT #5)
+        "transformer_seq8192": dict(
             model_def="long_seq_transformer.long_seq_transformer.custom_model",
+            model_params=dict(
+                vocab_size=32768,
+                embed_dim=512,
+                num_heads=8,
+                num_layers=6,
+                dtype="bfloat16",
+            ),
             features={
-                "tokens": rng.randint(0, 256, (seq_batch, 2048)).astype(
-                    np.int32
-                )
+                "tokens": rng.randint(
+                    0, 32768, (4 * n_chips, 8192)
+                ).astype(np.int32)
             },
-            labels=rng.randint(0, 256, (seq_batch, 2048)).astype(np.int32),
-            batch=seq_batch,
-            tokens_per_sample=2048,
+            labels=rng.randint(0, 32768, (4 * n_chips, 8192)).astype(
+                np.int32
+            ),
+            batch=4 * n_chips,
+            tokens_per_sample=8192,
+            attn_flops_per_step=_causal_attn_flops(
+                layers=6, batch=4 * n_chips, seq=8192, d_model=512
+            ),
         ),
         # GPT-2-small-shape LM (124M params): the honest large-model MFU
         # witness — 12 layers x 768 dim, 32k vocab, seq 2048, pallas
@@ -123,6 +155,7 @@ def _configs(n_chips: int = 1):
                 embed_dim=768,
                 num_heads=12,
                 num_layers=12,
+                dtype="bfloat16",
             ),
             features={
                 "tokens": rng.randint(0, 32768, (seq_batch, 2048)).astype(
@@ -132,6 +165,9 @@ def _configs(n_chips: int = 1):
             labels=rng.randint(0, 32768, (seq_batch, 2048)).astype(np.int32),
             batch=seq_batch,
             tokens_per_sample=2048,
+            attn_flops_per_step=_causal_attn_flops(
+                layers=12, batch=seq_batch, seq=2048, d_model=768
+            ),
         ),
     }
 
@@ -228,12 +264,31 @@ def _measure(name, cfg, mesh):
         if cost is None:
             cost = lowered.compile().cost_analysis()
             per_chip_divisor = 1
+        elif n_chips > 1:
+            # the global-vs-per-device convention of the lowered analysis
+            # is jax-version-dependent: sanity-check against the compiled
+            # (always per-device) module rather than trusting it blind —
+            # a wrong divisor skews multi-chip MFU by n_chips exactly
+            compiled_cost = lowered.compile().cost_analysis()
+            if isinstance(compiled_cost, (list, tuple)):
+                compiled_cost = compiled_cost[0] if compiled_cost else {}
+            ratio = float((cost or {}).get("flops", 0.0)) / max(
+                float((compiled_cost or {}).get("flops", 0.0)), 1.0
+            )
+            if ratio < 1.5:  # lowered already reports per-device flops
+                per_chip_divisor = 1
         if isinstance(cost, (list, tuple)):  # older jax returns [dict]
             cost = cost[0] if cost else {}
         flops = (
             float((cost or {}).get("flops", 0.0))
             * STEPS
             / per_chip_divisor
+        )
+        # pallas kernels are opaque custom calls with no flops in the
+        # cost analysis: add the config's analytic attention flops
+        # (global, so they shard evenly over the chips)
+        flops += (
+            cfg.get("attn_flops_per_step", 0.0) * STEPS / n_chips
         )
     except Exception:  # noqa: BLE001 — cost analysis is best-effort
         flops = 0.0
@@ -251,6 +306,194 @@ def _measure(name, cfg, mesh):
             if mfu <= 1.0:
                 result["mfu"] = round(mfu, 4)
     return result
+
+
+def _measure_e2e(
+    gen_name,
+    model_def,
+    batch,
+    num_records,
+    records_per_task,
+    extra_argv=(),
+    num_shards=8,
+):
+    """End-to-end throughput through the REAL training path: EDLIO shard
+    files on disk -> reader -> dataset_fn decode -> batching -> host
+    placement -> jitted SPMD step, driven by LocalExecutor exactly as
+    ``elasticdl train --distribution_strategy=Local`` runs it
+    (BASELINE.md's metric; the step-only configs above exclude the whole
+    data plane).
+
+    Steady state = every task after the first (the first carries jit
+    compilation); per-task boundaries come from the real TaskDispatcher.
+    """
+    import tempfile
+
+    import jax
+
+    from elasticdl_tpu.data.recordio_gen import synthetic
+    from elasticdl_tpu.trainer.local_executor import LocalExecutor
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    marks = []
+
+    class _TimedExecutor(LocalExecutor):
+        def _train_task(self, task):
+            n = super()._train_task(task)
+            marks.append((time.perf_counter(), n))
+            return n
+
+    with tempfile.TemporaryDirectory() as td:
+        data_dir = getattr(synthetic, gen_name)(
+            os.path.join(td, "data"),
+            num_records=num_records,
+            num_shards=num_shards,
+            seed=0,
+        )
+        argv = [
+            "--model_def",
+            model_def,
+            "--training_data",
+            data_dir,
+            "--minibatch_size",
+            str(batch),
+            "--records_per_task",
+            str(records_per_task),
+            "--num_epochs",
+            "1",
+        ] + list(extra_argv)
+        _TimedExecutor(parse_master_args(argv)).run()
+
+    if len(marks) < 3:
+        raise RuntimeError(
+            f"e2e needs >= 3 tasks for a steady-state window, got "
+            f"{len(marks)}"
+        )
+    steady_records = sum(n for _, n in marks[1:])
+    dt = marks[-1][0] - marks[0][0]
+    n_chips = max(1, len(jax.devices()))
+    return {
+        "e2e_samples_per_sec_per_chip": round(
+            steady_records / dt / n_chips, 1
+        ),
+        "batch": batch,
+        "records_measured": steady_records,
+        "tasks_measured": len(marks) - 1,
+    }
+
+
+E2E_CONFIGS = {
+    # --steps_per_dispatch: one scanned dispatch per k minibatches —
+    # per-dispatch overhead on the tunneled dev link (~130ms for any
+    # call with fresh input buffers) would otherwise dominate the
+    # measurement and hide the data plane entirely
+    "mnist_e2e": dict(
+        gen_name="gen_mnist",
+        model_def="mnist_functional_api.mnist_functional_api.custom_model",
+        batch=256,
+        num_records=163840,
+        records_per_task=8192,
+        # k=16 measured best on the tunneled dev chip: 12.8MB stacked
+        # transfers stay under the link's fast-path size cliff (k=32's
+        # 25MB transfers fell to 1/6th the rate)
+        extra_argv=("--steps_per_dispatch", "16"),
+    ),
+    "deepfm_e2e": dict(
+        gen_name="gen_frappe",
+        model_def="deepfm_edl_embedding.deepfm_edl_embedding.custom_model",
+        batch=4096,
+        num_records=655360,
+        records_per_task=65536,
+        extra_argv=("--steps_per_dispatch", "16"),
+    ),
+}
+
+
+def _measure_accuracy():
+    """Opt-in (``--accuracy``): train mnist and deepfm-frappe ON THE CHIP
+    for roughly the reference's step budget and report final eval
+    accuracy (BASELINE.md acceptance; the reference bar is mnist > 0.8
+    after ~937 steps, worker_ps_interaction_test.py — our synthetic
+    datasets are easier, so the same thresholds are conservative)."""
+    import tempfile
+
+    from elasticdl_tpu.data.recordio_gen import synthetic
+    from elasticdl_tpu.trainer.local_executor import LocalExecutor
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    out = {}
+    configs = {
+        # 937 steps x batch 64 = the reference's budget
+        "mnist": dict(
+            gen_name="gen_mnist",
+            model_def=(
+                "mnist_functional_api.mnist_functional_api.custom_model"
+            ),
+            train_records=59968,
+            eval_records=4096,
+            batch=64,
+            threshold=0.8,
+        ),
+        # vocab 512 (data + model): per-id observation counts high enough
+        # for the factorization to generalize — same recipe as the
+        # config-4 acceptance test (test_recordio_gen_real.py)
+        "deepfm_frappe": dict(
+            gen_name="gen_frappe",
+            model_def=(
+                "deepfm_edl_embedding.deepfm_edl_embedding.custom_model"
+            ),
+            train_records=131072,
+            eval_records=8192,
+            batch=512,
+            threshold=0.8,
+            gen_kwargs=dict(vocab_size=512),
+            extra_argv=("--model_params", "input_dim=512"),
+        ),
+    }
+    for name, cfg in configs.items():
+        with tempfile.TemporaryDirectory() as td:
+            gen = getattr(synthetic, cfg["gen_name"])
+            gen_kwargs = cfg.get("gen_kwargs", {})
+            train_dir = gen(
+                os.path.join(td, "t"),
+                num_records=cfg["train_records"],
+                num_shards=8,
+                seed=0,
+                **gen_kwargs,
+            )
+            eval_dir = gen(
+                os.path.join(td, "e"),
+                num_records=cfg["eval_records"],
+                num_shards=1,
+                seed=1,
+                **gen_kwargs,
+            )
+            args = parse_master_args(
+                [
+                    "--model_def",
+                    cfg["model_def"],
+                    "--training_data",
+                    train_dir,
+                    "--validation_data",
+                    eval_dir,
+                    "--minibatch_size",
+                    str(cfg["batch"]),
+                    "--records_per_task",
+                    str(cfg["batch"] * 16),
+                    "--steps_per_dispatch",
+                    "16",
+                ]
+                + list(cfg.get("extra_argv", ()))
+            )
+            results = LocalExecutor(args).run()
+        acc = float(results.get("accuracy", results.get("accuracy_logits", 0.0)))
+        out[name] = {
+            "accuracy": round(acc, 4),
+            "steps": cfg["train_records"] // cfg["batch"],
+            "pass": acc >= cfg["threshold"],
+            "threshold": cfg["threshold"],
+        }
+    return out
 
 
 def _measure_reform():
@@ -289,6 +532,7 @@ def main():
 
     from elasticdl_tpu.parallel.mesh import MeshConfig
 
+    accuracy_mode = "--accuracy" in sys.argv[1:]
     mesh = MeshConfig.from_string("").create()  # all local devices on dp
 
     baseline_path = os.path.join(
@@ -330,6 +574,27 @@ def main():
             models[name]["vs_baseline"] = round(
                 models[name]["samples_per_sec_per_chip"] / base, 2
             )
+
+    for name, cfg in E2E_CONFIGS.items():
+        try:
+            models[name] = _measure_e2e(**cfg)
+        except Exception as ex:  # noqa: BLE001 — same isolation as above
+            print(f"bench config {name} failed: {ex}", file=sys.stderr)
+            models[name] = {"error": str(ex)[:200]}
+    # the data plane keeps the chip fed when e2e holds ~80%+ of the
+    # device-resident step rate at the same batch
+    for e2e, step in (("mnist_e2e", "mnist"), ("deepfm_e2e", "deepfm")):
+        rate = models.get(e2e, {}).get("e2e_samples_per_sec_per_chip")
+        step_rate = models.get(step, {}).get("samples_per_sec_per_chip")
+        if rate and step_rate:
+            models[e2e]["vs_step_only"] = round(rate / step_rate, 3)
+
+    if accuracy_mode:
+        try:
+            models["accuracy"] = _measure_accuracy()
+        except Exception as ex:  # noqa: BLE001 — same isolation as above
+            print(f"bench accuracy mode failed: {ex}", file=sys.stderr)
+            models["accuracy"] = {"error": str(ex)[:200]}
 
     try:
         models["elastic_reform"] = _measure_reform()

@@ -38,7 +38,7 @@ import tensorflow as tf  # noqa: E402
 # must compare the same configuration)
 BATCHES = {
     "mnist": 256,
-    "resnet50_cifar10": 512,
+    "resnet50_cifar10": 2048,
     "imagenet_resnet50": 128,
     # CTR-realistic batch; small batches measure per-step overhead, not
     # the embedding+FM math (same batch as bench.py's JAX side)

@@ -1,0 +1,142 @@
+"""Spark job converting a TAR of raw files into EDLIO shards.
+
+Reference: ``elasticdl/python/data/recordio_gen/sample_pyspark_recordio_gen/
+spark_gen_recordio.py`` — partitions the tar's file list over Spark
+workers; each partition calls the model module's
+``prepare_data_for_a_single_file(file_object, filename) -> bytes`` and
+writes its records into per-partition shard files.
+
+The partition body (:func:`convert_tar_partition`) is a plain function —
+fully testable without Spark; :func:`main` only adds the SparkContext
+fan-out, and pyspark is imported lazily so the module loads (and tests
+run) on images without it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import tarfile
+
+from elasticdl_tpu.data import recordio
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+from elasticdl_tpu.utils.model_utils import load_module_from_path
+
+
+def convert_tar_partition(
+    tar_path: str,
+    filenames,
+    prepare_fn,
+    output_dir: str,
+    partition_id: int,
+    records_per_file: int,
+) -> int:
+    """Convert this partition's files from the tar into EDLIO shards
+    named ``data-<partition>-<counter>.edlio`` (reference
+    ``process_data`` :21-64).  Pre-existing shards of the same partition
+    are removed first (reruns must not mix generations).  Returns the
+    record count written."""
+    for stale in glob.glob(
+        os.path.join(output_dir, f"data-{partition_id}-*")
+    ):
+        os.remove(stale)
+
+    filename_set = set(filenames)
+    written = 0
+    counter = 0
+    payloads: list[bytes] = []
+
+    def _flush():
+        nonlocal counter
+        if not payloads:
+            return
+        path = os.path.join(
+            output_dir, f"data-{partition_id}-{counter:04d}.edlio"
+        )
+        logger.info("Writing %d records to %s", len(payloads), path)
+        with recordio.Writer(path) as w:
+            for payload in payloads:
+                w.write(payload)
+        counter += 1
+        payloads.clear()
+
+    with tarfile.open(tar_path) as tar:
+        for tar_info in tar.getmembers():
+            if tar_info.name not in filename_set:
+                continue
+            fileobj = tar.extractfile(tar_info)
+            if fileobj is None:
+                continue
+            payloads.append(prepare_fn(fileobj, tar_info.name))
+            written += 1
+            if len(payloads) == records_per_file:
+                _flush()
+    _flush()
+    return written
+
+
+def list_tar_data_files(tar_path: str) -> list:
+    """Data file names in the tar, skipping dotfiles (reference
+    main :96-102)."""
+    with tarfile.open(tar_path) as tar:
+        return [
+            info.name
+            for info in tar.getmembers()
+            if tar.extractfile(info) is not None
+            and not info.name.split("/")[-1].startswith(".")
+        ]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Spark job to convert training data to EDLIO format"
+    )
+    parser.add_argument("--training_data_tar_file", required=True)
+    parser.add_argument("--output_dir", required=True)
+    parser.add_argument(
+        "--model_file",
+        required=True,
+        help="Module exporting prepare_data_for_a_single_file",
+    )
+    parser.add_argument("--records_per_file", default=1024, type=int)
+    parser.add_argument("--num_workers", default=2, type=int)
+    args = parser.parse_args(argv)
+
+    try:
+        from pyspark import SparkContext, TaskContext
+    except ImportError as e:
+        raise ImportError(
+            "spark_gen_recordio needs pyspark; for single-machine "
+            "conversion call convert_tar_partition directly"
+        ) from e
+
+    filename_list = list_tar_data_files(args.training_data_tar_file)
+    model_module = load_module_from_path(args.model_file)
+    os.makedirs(args.output_dir, exist_ok=True)
+
+    tar_path = args.training_data_tar_file
+    output_dir = args.output_dir
+    records_per_file = args.records_per_file
+    prepare_fn = model_module.prepare_data_for_a_single_file
+
+    def _partition(filenames):
+        convert_tar_partition(
+            tar_path,
+            list(filenames),
+            prepare_fn,
+            output_dir,
+            TaskContext().partitionId(),
+            records_per_file,
+        )
+        return filenames
+
+    sc = SparkContext()
+    sc.parallelize(filename_list, args.num_workers).mapPartitions(
+        _partition
+    ).collect()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

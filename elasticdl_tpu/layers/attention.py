@@ -10,6 +10,8 @@ ring schedule.
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -28,6 +30,9 @@ class MultiHeadSelfAttention(nn.Module):
     # step's K/V and attends over the filled prefix
     decode: bool = False
     max_decode_len: int = 0
+    # compute dtype (e.g. bf16): projections and the attention kernel run
+    # in it; parameters stay in param_dtype (f32) — mixed precision
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, decode_pos=None):
@@ -46,7 +51,7 @@ class MultiHeadSelfAttention(nn.Module):
 
         def _proj(name, heads):
             return nn.DenseGeneral(
-                features=(heads, head_dim), name=name
+                features=(heads, head_dim), dtype=self.dtype, name=name
             )(x)
 
         q = _proj("query", self.num_heads)
@@ -59,7 +64,7 @@ class MultiHeadSelfAttention(nn.Module):
         else:
             out = attention_ops.attention(q, k, v, causal=self.causal)
         return nn.DenseGeneral(
-            features=embed, axis=(-2, -1), name="out"
+            features=embed, axis=(-2, -1), dtype=self.dtype, name="out"
         )(out.astype(x.dtype))
 
     def _decode_attend(self, q, k, v, pos):
@@ -125,22 +130,24 @@ class TransformerBlock(nn.Module):
     num_kv_heads: int = 0  # > 0: grouped-query attention
     decode: bool = False  # autoregressive decoding with a KV cache
     max_decode_len: int = 0
+    dtype: Any = None  # compute dtype; params stay f32
 
     @nn.compact
     def __call__(self, x, training: bool = False, decode_pos=None):
-        y = nn.LayerNorm()(x)
+        y = nn.LayerNorm(dtype=self.dtype)(x)
         y = MultiHeadSelfAttention(
             num_heads=self.num_heads,
             causal=self.causal,
             num_kv_heads=self.num_kv_heads,
             decode=self.decode,
             max_decode_len=self.max_decode_len,
+            dtype=self.dtype,
             name="attn",
         )(y, decode_pos=decode_pos)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate, deterministic=not training)(y)
         x = x + y
-        y = nn.LayerNorm()(x)
+        y = nn.LayerNorm(dtype=self.dtype)(x)
         if self.num_experts > 0:
             from elasticdl_tpu.layers.moe import MoEMLP
 
@@ -151,9 +158,11 @@ class TransformerBlock(nn.Module):
             )(y, training=training)
         else:
             # named for the shared megatron tp rules (default_tp_rules)
-            y = nn.Dense(x.shape[-1] * self.mlp_ratio, name="mlp_up")(y)
+            y = nn.Dense(x.shape[-1] * self.mlp_ratio, dtype=self.dtype,
+                         name="mlp_up")(y)
             y = nn.gelu(y)
-            y = nn.Dense(x.shape[-1], name="mlp_down")(y)
+            y = nn.Dense(x.shape[-1], dtype=self.dtype,
+                         name="mlp_down")(y)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate, deterministic=not training)(y)
         return x + y

@@ -16,6 +16,7 @@ from jax.sharding import PartitionSpec as P
 
 from elasticdl_tpu.models.deepfm_functional_api import (  # noqa: F401
     DeepFM,
+    batch_parse,
     custom_data_reader,
     custom_model,
     dataset_fn,

@@ -92,6 +92,17 @@ def dataset_fn(dataset, mode, metadata):
     return dataset
 
 
+def batch_parse(example_batch, mode):
+    """Vectorized ``dataset_fn`` equivalent: one call per minibatch over
+    the natively batch-decoded arrays (data/dataset.py fast path) — the
+    per-record map caps the e2e pipeline at ~30k records/s while the
+    DeepFM step consumes hundreds of thousands."""
+    feature = example_batch["feature"].astype(np.int32)
+    if mode == Modes.PREDICTION:
+        return {"feature": feature}
+    return {"feature": feature}, example_batch["label"].astype(np.int32)
+
+
 def eval_metrics_fn():
     # metric-name-outer nesting (metrics.update_metric_tree); reference
     # nests output-name-outer — same pairs either way

@@ -14,6 +14,8 @@ is next-token prediction.
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -41,6 +43,9 @@ class TransformerLM(nn.Module):
     num_kv_heads: int = 0  # > 0: grouped-query attention
     decode: bool = False  # one-token-per-call decoding with KV caches
     max_decode_len: int = 0
+    # compute dtype (e.g. "bfloat16"): activations and matmuls run in it,
+    # parameters stay f32; the loss casts logits back up
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, features, training: bool = False):
@@ -48,9 +53,10 @@ class TransformerLM(nn.Module):
             features["tokens"] if isinstance(features, dict) else features
         )
         tokens = jnp.asarray(tokens).astype(jnp.int32)
-        x = nn.Embed(self.vocab_size, self.embed_dim, name="tok_embed")(
-            tokens
-        )
+        x = nn.Embed(
+            self.vocab_size, self.embed_dim, dtype=self.dtype,
+            name="tok_embed",
+        )(tokens)
         # parameter-free positions: a sequence-sharded activation adds its
         # slice of the encoding without any table gather
         decode_pos = None
@@ -82,10 +88,11 @@ class TransformerLM(nn.Module):
                 num_kv_heads=self.num_kv_heads,
                 decode=self.decode,
                 max_decode_len=self.max_decode_len,
+                dtype=self.dtype,
                 name=f"block_{layer}",
             )(x, training=training, decode_pos=decode_pos)
-        x = nn.LayerNorm()(x)
-        return nn.Dense(self.vocab_size, name="lm_head")(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.vocab_size, dtype=self.dtype, name="lm_head")(x)
 
 
 def custom_model(**kwargs):

@@ -10,7 +10,7 @@ plain SGD the two are the same gradient-descent update.
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Any, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -22,11 +22,16 @@ BATCH_NORM_EPSILON = 1e-5
 _conv_init = nn.initializers.he_normal()
 
 
-def _bn(training: bool, name: str):
+def _bn(training: bool, name: str, dtype=None):
+    # dtype=bf16 keeps the normalize/scale math on the fast path while
+    # flax computes the batch statistics in float32 internally
+    # (_compute_stats upcasts half precision) — the canonical TPU mixed
+    # precision for BN
     return nn.BatchNorm(
         use_running_average=not training,
         momentum=BATCH_NORM_DECAY,
         epsilon=BATCH_NORM_EPSILON,
+        dtype=dtype,
         name=name,
     )
 
@@ -37,23 +42,25 @@ class IdentityBlock(nn.Module):
 
     kernel_size: int
     filters: Sequence[int]
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, training: bool = False):
         f1, f2, f3 = self.filters
         k = self.kernel_size
+        dt = self.dtype
         shortcut = x
         x = nn.Conv(f1, (1, 1), use_bias=False, kernel_init=_conv_init,
-                    name="conv_a")(x)
-        x = _bn(training, "bn_a")(x)
+                    dtype=dt, name="conv_a")(x)
+        x = _bn(training, "bn_a", dt)(x)
         x = nn.relu(x)
         x = nn.Conv(f2, (k, k), padding="SAME", use_bias=False,
-                    kernel_init=_conv_init, name="conv_b")(x)
-        x = _bn(training, "bn_b")(x)
+                    kernel_init=_conv_init, dtype=dt, name="conv_b")(x)
+        x = _bn(training, "bn_b", dt)(x)
         x = nn.relu(x)
         x = nn.Conv(f3, (1, 1), use_bias=False, kernel_init=_conv_init,
-                    name="conv_c")(x)
-        x = _bn(training, "bn_c")(x)
+                    dtype=dt, name="conv_c")(x)
+        x = _bn(training, "bn_c", dt)(x)
         return nn.relu(x + shortcut)
 
 
@@ -64,27 +71,29 @@ class ConvBlock(nn.Module):
     kernel_size: int
     filters: Sequence[int]
     strides: tuple = (2, 2)
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, training: bool = False):
         f1, f2, f3 = self.filters
         k = self.kernel_size
+        dt = self.dtype
         shortcut = nn.Conv(
             f3, (1, 1), strides=self.strides, use_bias=False,
-            kernel_init=_conv_init, name="conv_shortcut",
+            kernel_init=_conv_init, dtype=dt, name="conv_shortcut",
         )(x)
-        shortcut = _bn(training, "bn_shortcut")(shortcut)
+        shortcut = _bn(training, "bn_shortcut", dt)(shortcut)
         x = nn.Conv(f1, (1, 1), strides=self.strides, use_bias=False,
-                    kernel_init=_conv_init, name="conv_a")(x)
-        x = _bn(training, "bn_a")(x)
+                    kernel_init=_conv_init, dtype=dt, name="conv_a")(x)
+        x = _bn(training, "bn_a", dt)(x)
         x = nn.relu(x)
         x = nn.Conv(f2, (k, k), padding="SAME", use_bias=False,
-                    kernel_init=_conv_init, name="conv_b")(x)
-        x = _bn(training, "bn_b")(x)
+                    kernel_init=_conv_init, dtype=dt, name="conv_b")(x)
+        x = _bn(training, "bn_b", dt)(x)
         x = nn.relu(x)
         x = nn.Conv(f3, (1, 1), use_bias=False, kernel_init=_conv_init,
-                    name="conv_c")(x)
-        x = _bn(training, "bn_c")(x)
+                    dtype=dt, name="conv_c")(x)
+        x = _bn(training, "bn_c", dt)(x)
         return nn.relu(x + shortcut)
 
 
@@ -104,27 +113,34 @@ class ResNet50(nn.Module):
     probabilities)."""
 
     num_classes: int = 10
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, features, training: bool = False):
         x = features["image"] if isinstance(features, dict) else features
+        dt = self.dtype
+        if dt is not None:
+            x = x.astype(dt)
         x = jnp.pad(x, ((0, 0), (3, 3), (3, 3), (0, 0)))
         x = nn.Conv(64, (7, 7), strides=(2, 2), padding="VALID",
-                    use_bias=False, kernel_init=_conv_init, name="conv1")(x)
-        x = _bn(training, "bn_conv1")(x)
+                    use_bias=False, kernel_init=_conv_init, dtype=dt,
+                    name="conv1")(x)
+        x = _bn(training, "bn_conv1", dt)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, (filters, blocks, strides) in enumerate(
             RESNET50_STAGES, start=2
         ):
             x = ConvBlock(
-                3, filters, strides=strides, name=f"conv_block_{stage}"
+                3, filters, strides=strides, dtype=dt,
+                name=f"conv_block_{stage}"
             )(x, training)
             for b in range(1, blocks):
                 x = IdentityBlock(
-                    3, filters, name=f"identity_block_{stage}_{b}"
+                    3, filters, dtype=dt,
+                    name=f"identity_block_{stage}_{b}"
                 )(x, training)
         x = x.mean(axis=(1, 2))
-        x = nn.Dense(self.num_classes, name="fc")(x)
+        x = nn.Dense(self.num_classes, dtype=dt, name="fc")(x)
         # cast up before softmax so bf16 compute keeps a stable loss
         return nn.softmax(x.astype(jnp.float32))

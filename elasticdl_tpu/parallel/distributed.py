@@ -90,6 +90,7 @@ class SPMDTrainer:
                 create_state, out_shardings=self.state_shardings
             )()
         self._batch_shardings_cache: dict = {}
+        self._stacked_scan_cache: dict = {}
         # mesh topology is immutable for this trainer's lifetime: resolve
         # the multi-process layout once, not per minibatch
         self._multiprocess = elastic.is_multiprocess_mesh(mesh)
@@ -186,12 +187,73 @@ class SPMDTrainer:
 
     # ---- steps ------------------------------------------------------------
 
+    @property
+    def state(self):
+        return self._state
+
+    @state.setter
+    def state(self, value):
+        # external assignment (checkpoint restore, re-formation): the
+        # host step mirror is unknown until read
+        self._state = value
+        self._step_cache = None
+
     def train_step(self, features, labels):
         with self.mesh, attention_mesh_scope(self.mesh):
-            self.state, metrics = self._train_step(
-                self.state, features, labels
+            self._state, metrics = self._train_step(
+                self._state, features, labels
             )
+        if self._step_cache is not None:
+            self._step_cache += 1
         return metrics
+
+    def train_steps_stacked(self, stacked_features, stacked_labels):
+        """K optimizer steps in ONE dispatch: a jitted ``lax.scan`` over
+        batches stacked on a leading axis (semantically identical to K
+        sequential ``train_step`` calls).  Amortizes per-dispatch
+        overhead — decisive on high-latency links (tunneled dev TPUs,
+        remote hosts), a free ~2x even on local hosts.  Returns the last
+        step's metrics."""
+        num_steps = jax.tree_util.tree_leaves(stacked_features)[0].shape[0]
+        scan_fn = self._stacked_scan_cache.get(num_steps)
+        if scan_fn is None:
+            step_fn = self._train_step
+
+            def scan_steps(state, feats, labels):
+                def body(s, xs):
+                    s2, metrics = step_fn(s, xs[0], xs[1])
+                    return s2, metrics
+
+                return jax.lax.scan(body, state, (feats, labels))
+
+            scan_fn = jax.jit(scan_steps, donate_argnums=(0,))
+            self._stacked_scan_cache[num_steps] = scan_fn
+        with self.mesh, attention_mesh_scope(self.mesh):
+            self._state, metrics = scan_fn(
+                self._state, stacked_features, stacked_labels
+            )
+        if self._step_cache is not None:
+            self._step_cache += int(num_steps)
+        return jax.tree_util.tree_map(lambda m: m[-1], metrics)
+
+    def place_stacked(self, tree):
+        """Place a (K, batch, ...) stacked tree: same layout as
+        :meth:`place_batch` per step with a replicated leading K axis."""
+        from jax.sharding import PartitionSpec as P
+
+        def _place(x):
+            x = np.asarray(x)
+            per_step = self._batch_sharding(x.ndim - 1)
+            sh = NamedSharding(
+                self.mesh, P(None, *per_step.spec)
+            )
+            if not self._multiprocess:
+                return jax.device_put(x, sh)
+            return jax.make_array_from_callback(
+                x.shape, sh, lambda idx: x[idx]
+            )
+
+        return jax.tree_util.tree_map(_place, tree)
 
     def eval_step(self, features, labels):
         with self.mesh, attention_mesh_scope(self.mesh):
@@ -203,7 +265,13 @@ class SPMDTrainer:
 
     @property
     def step(self) -> int:
-        return int(self.state.step)
+        """Model version — served from a host mirror so per-batch version
+        checks never force a device readback (a full sync + roundtrip,
+        ~100ms on tunneled dev links); one readback re-seeds the mirror
+        after any external state assignment."""
+        if self._step_cache is None:
+            self._step_cache = int(jax.device_get(self._state.step))
+        return self._step_cache
 
 
 def _host_slice_for_init(sample_features):

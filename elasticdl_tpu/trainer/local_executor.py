@@ -110,6 +110,7 @@ class LocalExecutor:
         self._timing = Timing(
             enabled=args.log_level == "DEBUG", logger=logger
         )
+        self._last_eval_milestone = 0
         from elasticdl_tpu.utils.profiling import StepProfiler
 
         self._profiler = StepProfiler(
@@ -154,6 +155,13 @@ class LocalExecutor:
         version = restore_trainer_state(self._trainer, self._args)
         if version is not None:
             self._checkpointer.note_restored_version(version)
+            if self._args.evaluation_steps:
+                # milestones evaluated before the restore point must not
+                # re-fire on the first post-restore step (mirrors
+                # note_restored_version for checkpoints)
+                self._last_eval_milestone = (
+                    version // self._args.evaluation_steps
+                )
 
     def _place(self, tree):
         return self._trainer.place_padded(tree)
@@ -165,6 +173,9 @@ class LocalExecutor:
     # ---- phases -----------------------------------------------------------
 
     def _train_task(self, task) -> int:
+        k = getattr(self._args, "steps_per_dispatch", 1) or 1
+        if k > 1:
+            return self._train_task_stacked(task, k)
         processed = 0
         for features, labels in self._task_dataset(
             self._train_reader, task, Modes.TRAINING
@@ -176,13 +187,86 @@ class LocalExecutor:
                     self._place(features), self._place(labels)
                 )
             processed += _batch_size(labels)
-            if (
-                self._args.evaluation_steps
-                and self._version % self._args.evaluation_steps == 0
-            ):
-                self.evaluate(tag=f"step {self._version}")
-            self._checkpointer.maybe_save(self._trainer, self._mesh)
+            self._post_step_hooks()
         return processed
+
+    def _train_task_stacked(self, task, k: int) -> int:
+        """``--steps_per_dispatch k``: group k equal-shape minibatches,
+        stack them on a leading axis and run ONE jitted scan of k
+        optimizer steps (``SPMDTrainer.train_steps_stacked``) — the same
+        updates in 1/k the dispatches.  Ragged tails (a task's final
+        short batch, or fewer than k batches left) fall back to the
+        per-step path.  Eval/checkpoint hooks run per GROUP, so
+        step-based triggers fire at dispatch granularity."""
+        processed = 0
+        group: list = []
+
+        def _flush():
+            nonlocal processed
+            if not group:
+                return
+            if len(group) == 1:
+                features, labels = group[0]
+                self._trainer.train_step(
+                    self._place(features), self._place(labels)
+                )
+                processed += _batch_size(labels)
+            else:
+                # pad each batch the way the per-step path does
+                # (place_padded): XLA needs the per-step leading dim to
+                # divide the data axes on multi-device meshes
+                padded = [
+                    (
+                        self._trainer.pad_batch(g[0])[0],
+                        self._trainer.pad_batch(g[1])[0],
+                    )
+                    for g in group
+                ]
+                stacked_f = jax.tree_util.tree_map(
+                    lambda *xs: np.stack(xs), *[p[0] for p in padded]
+                )
+                stacked_l = jax.tree_util.tree_map(
+                    lambda *xs: np.stack(xs), *[p[1] for p in padded]
+                )
+                self._trainer.train_steps_stacked(
+                    self._trainer.place_stacked(stacked_f),
+                    self._trainer.place_stacked(stacked_l),
+                )
+                processed += sum(_batch_size(g[1]) for g in group)
+            group.clear()
+            self._post_step_hooks()
+
+        first_shape = None
+        for features, labels in self._task_dataset(
+            self._train_reader, task, Modes.TRAINING
+        ):
+            self._ensure_trainer(features)
+            self._profiler.on_step(self._version)
+            shape = jax.tree_util.tree_leaves(features)[0].shape
+            if first_shape is None:
+                first_shape = shape
+            if shape != first_shape:
+                # ragged tail batch: flush the group, run it alone
+                _flush()
+                first_shape = shape
+            group.append((features, labels))
+            if len(group) == k:
+                _flush()
+                first_shape = None
+        _flush()
+        return processed
+
+    def _post_step_hooks(self):
+        # milestone-CROSSING, not exact-multiple: with steps_per_dispatch
+        # the version advances k at a time, so an exact modulo check
+        # would silently skip milestones (same rationale as the eval
+        # service's add_evaluation_task_if_needed)
+        if self._args.evaluation_steps:
+            milestone = self._version // self._args.evaluation_steps
+            if milestone > self._last_eval_milestone:
+                self._last_eval_milestone = milestone
+                self.evaluate(tag=f"step {self._version}")
+        self._checkpointer.maybe_save(self._trainer, self._mesh)
 
     def evaluate(self, tag: str = "final") -> dict:
         if self._eval_reader is None or self._trainer is None:

@@ -1,0 +1,99 @@
+#!/usr/bin/env bash
+# Real-cluster smoke test: drive the actual `elasticdl` CLI against a
+# kind/minikube cluster and assert the job reaches success.
+#
+# Port of /root/reference/scripts/client_test.sh:1-119 to the TPU build:
+# worker-only topology (no PS pods), JAX_PLATFORMS=cpu workers so the
+# smoke test runs on any CPU cluster, synthetic EDLIO data baked by
+# data/recordio_gen/synthetic.py.
+#
+# Usage:
+#   scripts/client_test.sh <train|evaluate|predict|local> [num_workers]
+#
+# Requirements (skipped with rc 0 + message when absent, so CI without a
+# cluster can still call this):
+#   - kubectl with a reachable cluster (e.g. `kind create cluster`)
+#   - an image containing this repo + its deps, loaded into the cluster
+#     and named via $EDL_TEST_IMAGE (e.g. built from the repo Dockerfile
+#     and `kind load docker-image ...`)
+set -euo pipefail
+
+JOB_TYPE=${1:?usage: client_test.sh <train|evaluate|predict|local> [workers]}
+WORKER_NUM=${2:-2}
+JOB_NAME="smoke-${JOB_TYPE}"
+DATA_DIR=${EDL_TEST_DATA:-/tmp/edl-smoke-data}
+cd "$(dirname "$0")/.."
+
+if [[ "$JOB_TYPE" != "local" ]]; then
+    if ! kubectl cluster-info >/dev/null 2>&1; then
+        echo "SKIP: no reachable kubernetes cluster (kubectl cluster-info failed)"
+        exit 0
+    fi
+    if [[ -z "${EDL_TEST_IMAGE:-}" ]]; then
+        echo "SKIP: EDL_TEST_IMAGE not set (load an image into the cluster first)"
+        exit 0
+    fi
+fi
+
+# synthetic EDLIO shards (mnist for train/evaluate/predict smoke)
+python - <<PYEOF
+from elasticdl_tpu.data.recordio_gen import synthetic
+synthetic.gen_mnist("${DATA_DIR}/train", num_records=512, num_shards=2, seed=0)
+synthetic.gen_mnist("${DATA_DIR}/test", num_records=128, num_shards=1, seed=1)
+PYEOF
+
+COMMON_ARGS=(
+    --model_def=mnist_functional_api.mnist_functional_api.custom_model
+    --minibatch_size=64
+    --num_minibatches_per_task=2
+    --job_name="${JOB_NAME}"
+    --log_level=INFO
+)
+
+K8S_ARGS=(
+    --distribution_strategy=AllreduceStrategy
+    --docker_image="${EDL_TEST_IMAGE:-}"
+    --image_pull_policy=Never
+    --num_workers="${WORKER_NUM}"
+    --master_resource_request="cpu=0.2,memory=1024Mi"
+    --worker_resource_request="cpu=0.4,memory=2048Mi"
+    --envs="JAX_PLATFORMS=cpu"
+    --volume="host_path=${DATA_DIR},mount_path=${DATA_DIR}"
+)
+
+case "$JOB_TYPE" in
+train)
+    python -m elasticdl_tpu.client train \
+        "${COMMON_ARGS[@]}" "${K8S_ARGS[@]}" \
+        --training_data="${DATA_DIR}/train" \
+        --validation_data="${DATA_DIR}/test" \
+        --evaluation_steps=4 \
+        --num_epochs=1
+    ;;
+evaluate)
+    python -m elasticdl_tpu.client evaluate \
+        "${COMMON_ARGS[@]}" "${K8S_ARGS[@]}" \
+        --validation_data="${DATA_DIR}/test"
+    ;;
+predict)
+    python -m elasticdl_tpu.client predict \
+        "${COMMON_ARGS[@]}" "${K8S_ARGS[@]}" \
+        --prediction_data="${DATA_DIR}/test"
+    ;;
+local)
+    JAX_PLATFORMS=cpu python -m elasticdl_tpu.client train \
+        "${COMMON_ARGS[@]}" \
+        --distribution_strategy=Local \
+        --training_data="${DATA_DIR}/train" \
+        --validation_data="${DATA_DIR}/test" \
+        --num_epochs=1
+    echo "Local smoke test succeeded."
+    exit 0
+    ;;
+*)
+    echo "Unsupported job type: $JOB_TYPE" >&2
+    exit 1
+    ;;
+esac
+
+python scripts/validate_job_status.py --job_name="${JOB_NAME}"

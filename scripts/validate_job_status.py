@@ -1,0 +1,96 @@
+"""Poll a submitted job's pods until the job succeeds or fails.
+
+Port of ``/root/reference/scripts/validate_job_status.py:1-120`` to the
+TPU build's pod topology: master + worker pods only (no PS pods), pods
+discovered by the ``elasticdl-job-name`` label rather than fixed names
+(elastic relaunches use fresh worker ids, so name guessing would miss
+them).
+
+Success: the master pod reaches phase ``Succeeded`` (our master exits
+after the job; it does not idle for TensorBoard the way the reference
+master does, reference master.py:217-230).
+Failure: the master pod fails, or any labeled pod sits in ``Failed``
+while the master is gone.
+
+Exit code 0 on success, 1 on failure/timeout. Dumps the master log (and
+failed worker logs) on failure.
+"""
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--job_name", required=True)
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--timeout_secs", type=int, default=600)
+    p.add_argument("--poll_secs", type=float, default=3.0)
+    args = p.parse_args(argv)
+
+    from kubernetes import client as k8s_client
+    from kubernetes import config as k8s_config
+
+    k8s_config.load_kube_config()
+    api = k8s_client.CoreV1Api()
+    master_name = f"elasticdl-{args.job_name}-master"
+    selector = f"elasticdl-job-name={args.job_name}"
+
+    def master_phase():
+        try:
+            pod = api.read_namespaced_pod(
+                namespace=args.namespace, name=master_name
+            )
+            return pod.status.phase
+        except Exception:  # noqa: BLE001 — not found / transient API
+            return ""
+
+    def labeled_pods():
+        return api.list_namespaced_pod(
+            namespace=args.namespace, label_selector=selector
+        ).items
+
+    def dump_logs():
+        for pod in labeled_pods():
+            print(f"---- log {pod.metadata.name} ({pod.status.phase}) ----")
+            try:
+                print(
+                    api.read_namespaced_pod_log(
+                        namespace=args.namespace, name=pod.metadata.name,
+                        tail_lines=200,
+                    )
+                )
+            except Exception as e:  # noqa: BLE001
+                print(f"(log unavailable: {e})")
+
+    deadline = time.time() + args.timeout_secs
+    while time.time() < deadline:
+        phase = master_phase()
+        if phase == "Succeeded":
+            print(f"Job {args.job_name} succeeded.")
+            return 0
+        if phase == "Failed":
+            print(f"Job {args.job_name} FAILED (master pod Failed).")
+            dump_logs()
+            return 1
+        failed = [
+            p.metadata.name
+            for p in labeled_pods()
+            if p.status.phase == "Failed"
+        ]
+        if failed and not phase:
+            # workers failed and the master is gone: nothing will recover
+            print(f"Job {args.job_name} FAILED (pods: {failed}).")
+            dump_logs()
+            return 1
+        time.sleep(args.poll_secs)
+
+    print(f"Timed out after {args.timeout_secs}s (master phase: "
+          f"{master_phase() or 'missing'}).")
+    dump_logs()
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -478,3 +478,19 @@ def test_transformer_spec_contract():
     assert spec.build_model() is not None
     assert spec.loss is not None and spec.dataset_fn is not None
     assert spec.eval_metrics_fn is not None
+
+
+def test_flash_non_power_of_two_blocks_chunking():
+    """Regression: chunk size must stay a multiple of the block size —
+    a chunk smaller than the block ran ZERO in-chunk sub-blocks and
+    emitted all-NaN output (0/0) silently."""
+    rng = np.random.RandomState(0)
+    q = rng.randn(1, 2304, 2, 32).astype(np.float32)
+    k = rng.randn(1, 2304, 2, 32).astype(np.float32)
+    v = rng.randn(1, 2304, 2, 32).astype(np.float32)
+    out = np.asarray(
+        flash_attention(q, k, v, causal=True, block_q=384, block_k=384)
+    )
+    ref = np.asarray(mha_reference(q, k, v, causal=True))
+    assert not np.isnan(out).any()
+    np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)

@@ -615,3 +615,82 @@ def test_submit_yaml_dumps_without_cluster(tmp_path):
     idx = pod_args.index("--cluster_spec")
     assert pod_args[idx + 1] == str(spec_file)
     assert "--yaml" not in pod_args  # the in-cluster master must submit
+
+
+GOLDEN_SMOKE_ARGV = [
+    # the argv scripts/client_test.sh train submits (data paths fixed) —
+    # the clusterless fallback for the real-cluster smoke harness
+    "--model_def",
+    "mnist_functional_api.mnist_functional_api.custom_model",
+    "--distribution_strategy",
+    "AllreduceStrategy",
+    "--training_data",
+    "/tmp/edl-smoke-data/train",
+    "--validation_data",
+    "/tmp/edl-smoke-data/test",
+    "--minibatch_size",
+    "64",
+    "--num_minibatches_per_task",
+    "2",
+    "--evaluation_steps",
+    "4",
+    "--num_epochs",
+    "1",
+    "--job_name",
+    "smoke-train",
+    "--docker_image",
+    "elasticdl-smoke:ci",
+    "--image_pull_policy",
+    "Never",
+    "--num_workers",
+    "2",
+    "--master_resource_request",
+    "cpu=0.2,memory=1024Mi",
+    "--worker_resource_request",
+    "cpu=0.4,memory=2048Mi",
+    "--envs",
+    "JAX_PLATFORMS=cpu",
+    "--volume",
+    "host_path=/tmp/edl-smoke-data,mount_path=/tmp/edl-smoke-data",
+]
+
+
+def _golden_manifest_docs(tmp_path):
+    import yaml as yaml_lib
+
+    from elasticdl_tpu.api import _dispatch
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    out = tmp_path / "smoke.yaml"
+    args = parse_master_args(GOLDEN_SMOKE_ARGV + ["--yaml", str(out)])
+    _dispatch(args)
+    return list(yaml_lib.safe_load_all(out.read_text()))
+
+
+def test_smoke_manifest_matches_golden(tmp_path):
+    """Clusterless fallback for scripts/client_test.sh: the --yaml dump
+    of the smoke job must match the committed golden manifest byte for
+    byte (structure-compared), so manifest regressions (labels, argv
+    round-trip, env injection, volumes) are caught without a cluster.
+    Regenerate after INTENTIONAL changes:
+        python -m pytest tests/test_k8s.py::test_smoke_manifest_matches_golden --regen
+    (or run _golden_manifest_docs and rewrite the file)."""
+    import json
+    import os
+
+    import yaml as yaml_lib
+
+    docs = _golden_manifest_docs(tmp_path)
+    golden_path = os.path.join(
+        os.path.dirname(__file__), "testdata", "golden_smoke_manifest.yaml"
+    )
+    if not os.path.exists(golden_path):  # first run: write the golden
+        with open(golden_path, "w") as f:
+            yaml_lib.safe_dump_all(docs, f, sort_keys=False)
+        raise AssertionError(
+            f"golden manifest was missing; wrote {golden_path} — rerun"
+        )
+    golden = list(yaml_lib.safe_load_all(open(golden_path).read()))
+    assert json.dumps(docs, sort_keys=True) == json.dumps(
+        golden, sort_keys=True
+    ), "manifest drifted from tests/testdata/golden_smoke_manifest.yaml"
