@@ -1,0 +1,21 @@
+"""Version-portable shard_map: jax >= 0.8 moved it to jax.shard_map
+and renamed check_rep to check_vma."""
+
+from __future__ import annotations
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs, check: bool = False):
+    try:
+        from jax import shard_map as _sm
+
+        return _sm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check,
+        )
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check,
+        )

@@ -128,7 +128,7 @@ def pipeline_apply(
     mb = batch // num_microbatches
     x_mb = x.reshape(num_microbatches, mb, *x.shape[1:])
 
-    from jax.experimental.shard_map import shard_map
+    from elasticdl_tpu.ops._shard_map_compat import shard_map_compat
 
     from elasticdl_tpu.parallel.mesh import batch_divisor, data_parallel_axes
 
@@ -147,11 +147,10 @@ def pipeline_apply(
         axis_name=axis_name,
         num_stages=num_stages,
     )
-    out = shard_map(
+    out = shard_map_compat(
         body,
-        mesh=mesh,
+        mesh,
         in_specs=(param_spec, x_spec),
         out_specs=x_spec,
-        check_rep=False,
     )(stacked_params, x_mb)
     return out.reshape(batch, *x.shape[1:])

@@ -141,7 +141,7 @@ def ring_attention(
 
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
 
-    from jax.experimental.shard_map import shard_map
+    from elasticdl_tpu.ops._shard_map_compat import shard_map_compat
 
     if q.shape[1] % axis_size:
         raise ValueError(
@@ -162,10 +162,9 @@ def ring_attention(
         causal=causal,
         sm_scale=sm_scale,
     )
-    return shard_map(
+    return shard_map_compat(
         body,
-        mesh=mesh,
+        mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_rep=False,
     )(q, k, v)

@@ -86,7 +86,7 @@ def ulysses_attention(
             f"{axis_name}={sp}"
         )
 
-    from jax.experimental.shard_map import shard_map
+    from elasticdl_tpu.ops._shard_map_compat import shard_map_compat
 
     from elasticdl_tpu.ops.ring_attention import sequence_shard_spec
 
@@ -118,10 +118,9 @@ def ulysses_attention(
         group=group,
         sp=sp,
     )
-    return shard_map(
+    return shard_map_compat(
         body,
-        mesh=mesh,
+        mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_rep=False,
     )(q, k, v)
