@@ -10,6 +10,8 @@ accuracy metric; images scaled to [0,1].
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
@@ -22,25 +24,33 @@ from elasticdl_tpu.trainer.state import Modes
 
 class Cifar10CNN(nn.Module):
     num_classes: int = 10
+    dtype: Any = None  # compute dtype; params/BN stats stay f32
 
     @nn.compact
     def __call__(self, features, training: bool = False):
         x = features["image"] if isinstance(features, dict) else features
         x = x.reshape((x.shape[0], 32, 32, 3))
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
         for channels, rate in ((32, 0.2), (64, 0.3), (128, 0.4)):
             for _ in range(2):
-                x = nn.Conv(channels, (3, 3), padding="SAME")(x)
+                x = nn.Conv(
+                    channels, (3, 3), padding="SAME", dtype=self.dtype
+                )(x)
                 x = nn.BatchNorm(
                     use_running_average=not training,
                     momentum=0.9,
                     epsilon=1e-6,
+                    dtype=self.dtype,
                 )(x)
                 x = nn.relu(x)
             x = nn.max_pool(x, (2, 2), strides=(2, 2))
             # train-time dropout; the step builder threads the 'dropout' rng
             x = nn.Dropout(rate, deterministic=not training)(x)
         x = x.reshape((x.shape[0], -1))
-        return nn.Dense(self.num_classes, name="output")(x)
+        return nn.Dense(
+            self.num_classes, dtype=self.dtype, name="output"
+        )(x).astype(jnp.float32)
 
 
 def custom_model(**kwargs):

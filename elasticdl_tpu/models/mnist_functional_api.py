@@ -8,6 +8,8 @@ sparse-softmax-xent loss; accuracy metric; images scaled to [0,1].
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
@@ -20,20 +22,31 @@ from elasticdl_tpu.trainer.state import Modes
 
 class MnistCNN(nn.Module):
     num_classes: int = 10
+    # compute dtype (e.g. "bfloat16"); params/BN stats stay f32, logits
+    # cast back up for the loss — same contract as the other CNN and
+    # transformer zoo models (the FM/DNN recommenders are gather-bound
+    # and stay f32-only)
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, features, training: bool = False):
         x = features["image"] if isinstance(features, dict) else features
         x = x.reshape((x.shape[0], 28, 28, 1))
-        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID")(x))
-        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID")(x))
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", dtype=self.dtype)(x))
         # momentum 0.9 (not flax's 0.99 default) so running stats are usable
         # after short training runs; eval-mode forward depends on them
-        x = nn.BatchNorm(use_running_average=not training, momentum=0.9)(x)
+        x = nn.BatchNorm(
+            use_running_average=not training, momentum=0.9, dtype=self.dtype
+        )(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = nn.Dropout(0.25, deterministic=not training)(x)
         x = x.reshape((x.shape[0], -1))
-        return nn.Dense(self.num_classes)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x).astype(
+            jnp.float32
+        )
 
 
 def custom_model(**kwargs):

@@ -10,7 +10,10 @@ separate entry point and hyperparameters.
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
+import jax.numpy as jnp
 import numpy as np
 import optax
 
@@ -21,18 +24,25 @@ from elasticdl_tpu.trainer.state import Modes
 
 class CustomModel(nn.Module):
     num_classes: int = 10
+    dtype: Any = None  # compute dtype; params/BN stats stay f32
 
     @nn.compact
     def __call__(self, features, training: bool = False):
         x = features["image"] if isinstance(features, dict) else features
         x = x.reshape((x.shape[0], 28, 28, 1))
-        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID")(x))
-        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID")(x))
-        x = nn.BatchNorm(use_running_average=not training, momentum=0.9)(x)
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", dtype=self.dtype)(x))
+        x = nn.BatchNorm(
+            use_running_average=not training, momentum=0.9, dtype=self.dtype
+        )(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = nn.Dropout(0.25, deterministic=not training)(x)
         x = x.reshape((x.shape[0], -1))
-        return nn.Dense(self.num_classes)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x).astype(
+            jnp.float32
+        )
 
 
 def custom_model(**kwargs):
