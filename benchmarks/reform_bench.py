@@ -115,7 +115,17 @@ def measure(workdir: str) -> dict:
         runner.join(timeout=30)
 
     counters = master.task_d.counters(TaskType.TRAINING)
-    event = master.reform_events[0] if master.reform_events else {}
+    # the event CAUSED BY our kill: under heavy host contention a worker
+    # can miss heartbeats while compiling and trigger a spurious pre-kill
+    # re-form — blindly reading [0] then yields a negative detect_secs
+    event = next(
+        (
+            e
+            for e in master.reform_events
+            if e["detected_at"] >= killed_at
+        ),
+        master.reform_events[0] if master.reform_events else {},
+    )
     pull_at = master.servicer.first_stream_pull_at()
     out = {
         "reform_latency_secs": round(event.get("latency_secs", -1.0), 3),
