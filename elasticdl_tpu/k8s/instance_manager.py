@@ -16,6 +16,7 @@ recovery; pod events only accelerate detection via the
 from __future__ import annotations
 
 import threading
+import time
 
 from elasticdl_tpu.k8s.client import COORDINATOR_PORT, Client
 from elasticdl_tpu.utils.log_utils import default_logger as logger
@@ -166,7 +167,28 @@ class K8sInstanceManager:
             target=self._replenish_standbys, daemon=True
         ).start()
 
-    def stop_workers(self):
+    def stop_workers(self, grace_secs: float = 0.0):
+        # k8s' own termination grace is a SIGTERM->SIGKILL delay, and
+        # the worker has no SIGTERM handler — deletion would still kill
+        # an epilogue (final dump / checkpoint flush) mid-collective.
+        # So the voluntary-exit wait happens HERE: poll the worker pods
+        # toward a terminal phase before deleting them.
+        if grace_secs > 0:
+            with self._lock:
+                pod_names = list(self._pods.values())
+            deadline = time.monotonic() + grace_secs
+            pending = set(pod_names)
+            while pending and time.monotonic() < deadline:
+                for name in list(pending):
+                    pod = self._client.read_pod(name)
+                    phase = ""
+                    if pod is not None:
+                        _meta, status = _pod_fields(pod)
+                        phase = (status or {}).get("phase", "")
+                    if pod is None or phase in ("Succeeded", "Failed"):
+                        pending.discard(name)
+                if pending:
+                    time.sleep(0.5)
         with self._lock:
             self._stopping = True
             pods = dict(self._pods)

@@ -267,7 +267,16 @@ class Master:
         # any RPC-polling standby must learn the job is over
         self.servicer.drain_standbys()
         if self.instance_manager is not None:
-            self.instance_manager.stop_workers()
+            # voluntary-exit grace ONLY when the queue actually drained:
+            # on failure the world hangs in collectives, and on an
+            # interrupt workers are still mid-stream — both would eat
+            # the full window and get terminated anyway
+            clean_finish = (
+                not self._job_failed and self.task_d.finished()
+            )
+            self.instance_manager.stop_workers(
+                grace_secs=15.0 if clean_finish else 0.0
+            )
         if self._server is not None:
             self._server.stop(grace=2)
             self._server = None
@@ -557,11 +566,29 @@ class LocalInstanceManager:
             target=self._replenish_standbys, daemon=True
         ).start()
 
-    def stop_workers(self):
+    def stop_workers(self, grace_secs: float = 15.0):
+        """Stop worker subprocesses.  Workers exit on their own once the
+        step stream ends, but their epilogue (final-state dump, async
+        checkpoint flush) can still be mid-COLLECTIVE when the master's
+        queue drains — terminating immediately kills one process and the
+        JAX coordination service then fatals the others.  So first give
+        the voluntary-exit window (the k8s analogue is the pod grace
+        period), then terminate stragglers.  Failure paths pass
+        ``grace_secs=0``: crashed worlds hang in collectives and would
+        always eat the full window."""
         self._drain_standbys()
         with self._lock:
             procs = list(self._procs.values())
             self._procs.clear()
+        deadline = time.monotonic() + max(0.0, grace_secs)
+        for proc in procs:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                proc.wait(timeout=remaining)
+            except Exception:  # noqa: BLE001 — still running
+                pass
         for proc in procs:
             if proc.poll() is None:
                 proc.terminate()

@@ -226,7 +226,15 @@ class SPMDTrainer:
 
                 return jax.lax.scan(body, state, (feats, labels))
 
-            scan_fn = jax.jit(scan_steps, donate_argnums=(0,))
+            # pin the updated state to the mesh layout exactly like
+            # build_train_step does — without it the scan output's
+            # sharding can drift from state_shardings and multi-process
+            # host reads (checkpoint, dump) fail on the re-laid-out tree
+            scan_fn = jax.jit(
+                scan_steps,
+                donate_argnums=(0,),
+                out_shardings=(self.state_shardings, None),
+            )
             self._stacked_scan_cache[num_steps] = scan_fn
         with self.mesh, attention_mesh_scope(self.mesh):
             self._state, metrics = scan_fn(

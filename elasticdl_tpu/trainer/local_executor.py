@@ -173,88 +173,25 @@ class LocalExecutor:
     # ---- phases -----------------------------------------------------------
 
     def _train_task(self, task) -> int:
-        k = getattr(self._args, "steps_per_dispatch", 1) or 1
-        if k > 1:
-            return self._train_task_stacked(task, k)
-        processed = 0
-        for features, labels in self._task_dataset(
-            self._train_reader, task, Modes.TRAINING
-        ):
+        """One implementation for every ``--steps_per_dispatch`` (k=1 is
+        a group of one): the shared grouping policy in
+        ``trainer.stacking.run_stacked_steps``.  Eval/checkpoint hooks
+        run per dispatch group, so step-based triggers fire at dispatch
+        granularity (D9a; identical to per-step at k=1)."""
+        from elasticdl_tpu.trainer.stacking import run_stacked_steps
+
+        def _pre(features):
             self._ensure_trainer(features)
             self._profiler.on_step(self._version)
-            with self._timing.record("batch_process"):
-                self._trainer.train_step(
-                    self._place(features), self._place(labels)
-                )
-            processed += _batch_size(labels)
-            self._post_step_hooks()
-        return processed
 
-    def _train_task_stacked(self, task, k: int) -> int:
-        """``--steps_per_dispatch k``: group k equal-shape minibatches,
-        stack them on a leading axis and run ONE jitted scan of k
-        optimizer steps (``SPMDTrainer.train_steps_stacked``) — the same
-        updates in 1/k the dispatches.  Ragged tails (a task's final
-        short batch, or fewer than k batches left) fall back to the
-        per-step path.  Eval/checkpoint hooks run per GROUP, so
-        step-based triggers fire at dispatch granularity."""
-        processed = 0
-        group: list = []
-
-        def _flush():
-            nonlocal processed
-            if not group:
-                return
-            if len(group) == 1:
-                features, labels = group[0]
-                self._trainer.train_step(
-                    self._place(features), self._place(labels)
-                )
-                processed += _batch_size(labels)
-            else:
-                # pad each batch the way the per-step path does
-                # (place_padded): XLA needs the per-step leading dim to
-                # divide the data axes on multi-device meshes
-                padded = [
-                    (
-                        self._trainer.pad_batch(g[0])[0],
-                        self._trainer.pad_batch(g[1])[0],
-                    )
-                    for g in group
-                ]
-                stacked_f = jax.tree_util.tree_map(
-                    lambda *xs: np.stack(xs), *[p[0] for p in padded]
-                )
-                stacked_l = jax.tree_util.tree_map(
-                    lambda *xs: np.stack(xs), *[p[1] for p in padded]
-                )
-                self._trainer.train_steps_stacked(
-                    self._trainer.place_stacked(stacked_f),
-                    self._trainer.place_stacked(stacked_l),
-                )
-                processed += sum(_batch_size(g[1]) for g in group)
-            group.clear()
-            self._post_step_hooks()
-
-        first_shape = None
-        for features, labels in self._task_dataset(
-            self._train_reader, task, Modes.TRAINING
-        ):
-            self._ensure_trainer(features)
-            self._profiler.on_step(self._version)
-            shape = jax.tree_util.tree_leaves(features)[0].shape
-            if first_shape is None:
-                first_shape = shape
-            if shape != first_shape:
-                # ragged tail batch: flush the group, run it alone
-                _flush()
-                first_shape = shape
-            group.append((features, labels))
-            if len(group) == k:
-                _flush()
-                first_shape = None
-        _flush()
-        return processed
+        return run_stacked_steps(
+            lambda: self._trainer,
+            self._task_dataset(self._train_reader, task, Modes.TRAINING),
+            getattr(self._args, "steps_per_dispatch", 1) or 1,
+            pre_batch=_pre,
+            post_group=self._post_step_hooks,
+            dispatch_ctx=lambda: self._timing.record("batch_process"),
+        )
 
     def _post_step_hooks(self):
         # milestone-CROSSING, not exact-multiple: with steps_per_dispatch

@@ -1,0 +1,101 @@
+"""Shared ``--steps_per_dispatch`` grouping: k minibatches -> one scanned
+dispatch.
+
+THE one implementation of the grouping/ragged-tail policy, used by both
+runtimes (LocalExecutor and the lockstep worker) so their step semantics
+cannot drift: equal-shape batches are padded (the per-step path's
+``place_padded`` policy), stacked on a leading axis and run through
+``SPMDTrainer.train_steps_stacked``; a shape change (a task's ragged tail
+batch) or fewer than k leftovers fall back to single steps.  In lockstep
+worlds every process sees the same deterministic batch stream per task,
+so all processes compute the same grouping without communication.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, Iterable
+
+import jax
+import numpy as np
+
+
+def _batch_size(tree) -> int:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return int(np.shape(leaves[0])[0]) if leaves else 0
+
+
+def run_stacked_steps(
+    get_trainer: Callable,
+    batches: Iterable,
+    k: int,
+    pre_batch: Callable | None = None,
+    post_group: Callable | None = None,
+    dispatch_ctx: Callable | None = None,
+) -> int:
+    """Drive ``batches`` of ``(features, labels)`` through the trainer in
+    groups of ``k`` steps per dispatch; returns records processed.
+
+    ``get_trainer``: called lazily (the runtimes create their trainer on
+    the first batch — ``pre_batch`` is where that happens).
+    ``pre_batch(features)``: per incoming batch (ensure-trainer,
+    profiler hooks).  ``post_group()``: after every dispatch (milestone
+    hooks run at dispatch granularity, deviation D9a).
+    ``dispatch_ctx()``: context manager wrapping each device dispatch
+    (timing buckets).
+    """
+    ctx = dispatch_ctx or contextlib.nullcontext
+    group: list = []
+    first_shape = None
+    processed = 0
+
+    def _flush():
+        nonlocal processed
+        if not group:
+            return
+        trainer = get_trainer()
+        if len(group) == 1:
+            features, labels = group[0]
+            with ctx():
+                trainer.train_step(
+                    trainer.place_padded(features),
+                    trainer.place_padded(labels),
+                )
+            processed += _batch_size(labels)
+        else:
+            padded = [
+                (trainer.pad_batch(f)[0], trainer.pad_batch(l)[0])
+                for f, l in group
+            ]
+            stacked_f = jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs), *[p[0] for p in padded]
+            )
+            stacked_l = jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs), *[p[1] for p in padded]
+            )
+            with ctx():
+                trainer.train_steps_stacked(
+                    trainer.place_stacked(stacked_f),
+                    trainer.place_stacked(stacked_l),
+                )
+            processed += sum(_batch_size(g[1]) for g in group)
+        group.clear()
+        if post_group is not None:
+            post_group()
+
+    for features, labels in batches:
+        if pre_batch is not None:
+            pre_batch(features)
+        shape = jax.tree_util.tree_leaves(features)[0].shape
+        if first_shape is None:
+            first_shape = shape
+        if shape != first_shape:
+            # ragged tail batch: flush the group, start a fresh one
+            _flush()
+            first_shape = shape
+        group.append((features, labels))
+        if len(group) == k:
+            _flush()
+            first_shape = None
+    _flush()
+    return processed

@@ -215,14 +215,24 @@ class LockstepWorker:
     # ---- task execution ----------------------------------------------------
 
     def _train_task(self, task):
+        # shared grouping policy (trainer.stacking; k=1 is a group of
+        # one): every process sees the same deterministic batch stream
+        # per task, so all processes compute the same grouping — and
+        # the scanned dispatch contains the same collectives
+        from elasticdl_tpu.trainer.stacking import run_stacked_steps
+
+        def _pre(features):
+            self._ensure_trainer(features)
+            self._profiler.on_step(self._trainer.step)
+
         with self._crash_on_error(task):
-            for features, labels in self._task_batches(task, Modes.TRAINING):
-                self._ensure_trainer(features)
-                self._profiler.on_step(self._trainer.step)
-                with self._timing.record("batch_process"):
-                    self._trainer.train_step(
-                        self._place(features), self._place(labels)
-                    )
+            run_stacked_steps(
+                lambda: self._trainer,
+                self._task_batches(task, Modes.TRAINING),
+                getattr(self._args, "steps_per_dispatch", 1) or 1,
+                pre_batch=_pre,
+                dispatch_ctx=lambda: self._timing.record("batch_process"),
+            )
         self._report_task_result(task.task_id, include_timing=True)
         self._timing.report_timing(reset=True)
         self._report_version()

@@ -694,3 +694,43 @@ def test_smoke_manifest_matches_golden(tmp_path):
     assert json.dumps(docs, sort_keys=True) == json.dumps(
         golden, sort_keys=True
     ), "manifest drifted from tests/testdata/golden_smoke_manifest.yaml"
+
+
+def test_stop_workers_grace_waits_for_terminal_pods():
+    """stop_workers(grace_secs>0) waits for worker pods to reach a
+    terminal phase before deleting them — deleting earlier SIGTERMs an
+    epilogue (final dump / checkpoint flush) mid-collective."""
+    import threading
+    import time as _time
+
+    api = FakeApi()
+    im = K8sInstanceManager(
+        num_workers=2,
+        build_argv=_argv,
+        master_addr="m:1",
+        image_name="img:1",
+        namespace="ns",
+        job_name="job",
+        lockstep=True,
+        api=api,
+        watch=False,
+        standby_workers=0,
+    )
+    im.start_workers()
+    with im._lock:
+        pods = list(im._pods.values())
+    assert len(pods) == 2
+
+    done = threading.Event()
+    threading.Thread(
+        target=lambda: (im.stop_workers(grace_secs=10.0), done.set()),
+        daemon=True,
+    ).start()
+    _time.sleep(0.8)
+    # still waiting: pods are not terminal, nothing deleted yet
+    assert not done.is_set()
+    assert not any(p in api.deleted_pods for p in pods)
+    for p in pods:
+        api.pods[p]["status"] = {"phase": "Succeeded"}
+    assert done.wait(timeout=10)
+    assert all(p in api.deleted_pods for p in pods)
