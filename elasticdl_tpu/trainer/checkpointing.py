@@ -50,6 +50,7 @@ class PeriodicCheckpointer:
         self._process_id = process_id
         self._num_parts = max(1, num_parts)
         self._last_milestone = 0
+        self._last_saved_version = -1
         # async: the device->host snapshot (and any gather collective)
         # stays on the training thread; only the disk write moves to a
         # background thread, so the step stream never waits on IO.  One
@@ -85,13 +86,19 @@ class PeriodicCheckpointer:
         self.save_now(trainer, mesh)
         return True
 
-    def save_now(self, trainer, mesh):
+    def save_now(self, trainer, mesh, skip_if_current: bool = False):
+        """``skip_if_current``: no-op when this version was already
+        saved (the end-of-training save after a milestone save of the
+        final step would write the same checkpoint twice)."""
+        version = trainer.step
+        if skip_if_current and version == self._last_saved_version:
+            return
         # non-chiefs only write their table parts: don't pay device->host
         # copies for replicated leaves they would discard
         dense, parts = elastic.state_checkpoint_parts(
             trainer.state, mesh, materialize_dense=self.is_chief
         )
-        version = trainer.step
+        self._last_saved_version = version
         if not self._async:
             self._write(version, dense, parts)
             return

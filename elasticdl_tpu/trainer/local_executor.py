@@ -321,7 +321,9 @@ class LocalExecutor:
         )
         self._timing.report_timing(reset=True)
         if self._checkpointer.enabled and self._trainer is not None:
-            self._checkpointer.save_now(self._trainer, self._mesh)
+            self._checkpointer.save_now(
+                self._trainer, self._mesh, skip_if_current=True
+            )
             self._checkpointer.flush()
         results = self.evaluate()
         if self._args.output and self._trainer is not None:
