@@ -734,3 +734,42 @@ def test_stop_workers_grace_waits_for_terminal_pods():
         api.pods[p]["status"] = {"phase": "Succeeded"}
     assert done.wait(timeout=10)
     assert all(p in api.deleted_pods for p in pods)
+
+
+def test_stuck_pending_standby_evicted_after_max_skips():
+    """A standby stuck Pending across _MAX_PENDING_SKIPS reforms is
+    presumed unschedulable and evicted (deleted + dropped) so it cannot
+    wedge a pool slot forever; the refill then creates a fresh pod."""
+    api = FakeApi()
+    mailbox: dict = {}
+    im = K8sInstanceManager(
+        num_workers=2,
+        build_argv=_argv,
+        master_addr="m:1",
+        image_name="img:1",
+        namespace="ns",
+        job_name="job",
+        lockstep=True,
+        max_reforms=10,
+        api=api,
+        watch=False,
+        standby_workers=1,
+        post_assignment=lambda sid, a: mailbox.__setitem__(sid, a),
+    )
+    im.start_workers()
+    pod = "elasticdl-job-standby-0"
+    api.pods[pod]["status"] = {"phase": "Pending"}
+
+    # skips 1 and 2: deferred but kept pooled
+    for _ in range(im._MAX_PENDING_SKIPS - 1):
+        assert im._take_live_standbys(1) == []
+        with im._lock:
+            assert (pod, 0) in im._standbys
+    assert pod not in api.deleted_pods
+
+    # skip 3: presumed unschedulable -> evicted
+    assert im._take_live_standbys(1) == []
+    assert pod in api.deleted_pods
+    with im._lock:
+        assert (pod, 0) not in im._standbys
+    assert pod not in im._pending_skips  # aging state cleaned up
