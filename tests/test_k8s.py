@@ -773,3 +773,9 @@ def test_stuck_pending_standby_evicted_after_max_skips():
     with im._lock:
         assert (pod, 0) not in im._standbys
     assert pod not in im._pending_skips  # aging state cleaned up
+
+    # the refill creates a FRESH pod for the freed slot
+    im._replenish_standbys()
+    assert "elasticdl-job-standby-1" in api.pods
+    with im._lock:
+        assert ("elasticdl-job-standby-1", 1) in im._standbys
