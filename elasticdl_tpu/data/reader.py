@@ -103,6 +103,47 @@ def _native_decode_batch(
     """One-FFI-call decode of the whole batch; None = take the fallback."""
     import ctypes
 
+    n = len(payloads)
+    buf = b"".join(payloads)
+    offsets = (ctypes.c_uint64 * (n + 1))()
+    pos = 0
+    for i, p in enumerate(payloads):
+        offsets[i] = pos
+        pos += len(p)
+    offsets[n] = pos
+    return _native_decode_concat(buf, offsets, n, first)
+
+
+def decode_concat_batch(
+    buf, lengths, template: dict[str, np.ndarray]
+) -> dict[str, np.ndarray] | None:
+    """Decode records already CONCATENATED in ``buf`` (record ``i`` is
+    ``lengths[i]`` bytes) against ``template``'s schema — the zero-copy
+    half of the fused scan+decode path: ``buf``/``lengths`` are exactly
+    what the scanner's ``next_chunk`` returns, so a task's records go
+    disk -> chunk buffer -> batched arrays with no per-record Python
+    objects at any point.  ``None`` = native codec unavailable or schema
+    mismatch (caller falls back to the per-record decoder)."""
+    import ctypes
+
+    n = len(lengths)
+    if n == 0:
+        return {}
+    offs = np.empty(n + 1, dtype=np.uint64)
+    offs[0] = 0
+    np.cumsum(np.asarray(lengths, dtype=np.uint64), out=offs[1:])
+    if isinstance(buf, np.ndarray):
+        buf = buf.ctypes.data  # zero-copy: pass the buffer's address
+    return _native_decode_concat(
+        buf, offs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n, template
+    )
+
+
+def _native_decode_concat(
+    buf, offsets, n: int, first: dict[str, np.ndarray]
+) -> dict[str, np.ndarray] | None:
+    import ctypes
+
     from elasticdl_tpu.data import recordio
 
     lib = recordio.native_lib()
@@ -115,19 +156,11 @@ def _native_decode_batch(
     # the function instead of duplicating it
     from elasticdl_tpu.utils.tensor import _dtype_name
 
-    n = len(payloads)
     names = list(first)
     try:
         dtypes = [_dtype_name(first[k].dtype) for k in names]
     except ValueError:  # a dtype outside the wire format
         return None
-    buf = b"".join(payloads)
-    offsets = (ctypes.c_uint64 * (n + 1))()
-    pos = 0
-    for i, p in enumerate(payloads):
-        offsets[i] = pos
-        pos += len(p)
-    offsets[n] = pos
 
     c_names = (ctypes.c_char_p * len(names))(
         *[k.encode("utf-8") for k in names]

@@ -53,9 +53,11 @@ def _load_native():
         ctypes.c_int64,
     ]
     lib.edlio_scanner_next_batch.restype = ctypes.c_int64
+    # buf is c_void_p (not c_char_p) so callers can pass a numpy buffer's
+    # .ctypes.data and read records into it with zero intermediate copies
     lib.edlio_scanner_next_batch.argtypes = [
         ctypes.c_void_p,
-        ctypes.c_char_p,
+        ctypes.c_void_p,
         ctypes.c_uint64,
         ctypes.POINTER(ctypes.c_uint64),
         ctypes.c_int64,
@@ -76,7 +78,10 @@ def _load_native():
 def _register_decode(decode):
     decode.restype = ctypes.c_int64
     decode.argtypes = [
-        ctypes.c_char_p,                    # concatenated payloads
+        ctypes.c_void_p,                    # concatenated payloads
+        # (void* not char*: accepts both Python bytes and a numpy
+        # buffer's .ctypes.data, so the scanner's chunk buffer decodes
+        # with no intermediate copy)
         ctypes.POINTER(ctypes.c_uint64),    # n+1 offsets
         ctypes.c_int64,                     # n_records
         ctypes.c_int32,                     # n_features
@@ -135,13 +140,18 @@ class _NativeScanner:
     """Batch-reading scanner over the C++ codec.
 
     One FFI call fetches up to ``batch_records`` payloads into a reusable
-    buffer; ``record()``/iteration then slice views out of it.
+    numpy buffer; ``record()``/iteration then slice bytes out of it, and
+    :meth:`next_chunk` exposes the raw ``(buffer, lengths)`` pair directly
+    — the zero-per-record-object input of ``edl_decode_batch`` (the fused
+    scan+decode fast path, ``data/fast_pipeline.py``).
     """
 
     _BUF_CAP = 8 << 20  # 8 MiB
     _BATCH_RECORDS = 4096
 
     def __init__(self, path: str, start: int = 0, length: int = -1):
+        import numpy as np
+
         lib = _load_native()
         self._lib = lib
         self._h = lib.edlio_scanner_open(path.encode(), start, length)
@@ -151,25 +161,50 @@ class _NativeScanner:
                 if "out of range" in _native_error(lib)
                 else CorruptFileError(_native_error(lib))
             )
-        self._buf = ctypes.create_string_buffer(self._BUF_CAP)
-        self._lengths = (ctypes.c_uint64 * self._BATCH_RECORDS)()
+        self._buf = np.empty(self._BUF_CAP, dtype=np.uint8)
+        self._lengths = np.empty(self._BATCH_RECORDS, dtype=np.uint64)
         self._pending: list[bytes] = []
         self._pending_idx = 0
         self._exhausted = False
 
-    def _refill(self) -> bool:
+    def next_chunk(self):
+        """Read the next chunk of records in ONE FFI call; returns
+        ``(buf, lengths)`` — numpy views of the concatenated payload
+        bytes and per-record lengths — or ``None`` at end of range.
+
+        The views alias a reusable buffer: they are valid only until the
+        next ``next_chunk``/``record`` call (callers decode immediately;
+        ``data/fast_pipeline.py`` does)."""
+        if self._exhausted:
+            return None
         n = self._lib.edlio_scanner_next_batch(
-            self._h, self._buf, self._BUF_CAP, self._lengths, self._BATCH_RECORDS
+            self._h,
+            self._buf.ctypes.data,
+            self._BUF_CAP,
+            self._lengths.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint64)
+            ),
+            self._BATCH_RECORDS,
         )
         if n < 0:
             raise CorruptFileError(_native_error(self._lib))
         if n == 0:
             self._exhausted = True
+            return None
+        used = int(self._lengths[:n].sum())
+        return self._buf[:used], self._lengths[:n]
+
+    def _refill(self) -> bool:
+        chunk = self.next_chunk()
+        if chunk is None:
             return False
-        raw = self._buf.raw
+        buf, lengths = chunk
+        # one copy of only the FILLED region (the previous implementation
+        # copied the whole 8 MiB capacity per refill via ctypes .raw)
+        raw = buf.tobytes()
         out, off = [], 0
-        for i in range(n):
-            ln = self._lengths[i]
+        for ln in lengths:
+            ln = int(ln)
             out.append(raw[off : off + ln])
             off += ln
         self._pending = out

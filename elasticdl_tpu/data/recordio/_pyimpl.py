@@ -128,6 +128,25 @@ class Scanner:
                 return
             yield rec
 
+    def next_chunk(self, max_records: int = 4096):
+        """``(concat_payload_bytes, lengths)`` for up to ``max_records``
+        records, or ``None`` at end — same contract as the native
+        scanner's chunk API (there a single FFI call; here assembled
+        from per-record reads, correctness-equivalent fallback)."""
+        import numpy as np
+
+        recs = []
+        while len(recs) < max_records:
+            rec = self.record()
+            if rec is None:
+                break
+            recs.append(rec)
+        if not recs:
+            return None
+        buf = np.frombuffer(b"".join(recs), dtype=np.uint8)
+        lengths = np.array([len(r) for r in recs], dtype=np.uint64)
+        return buf, lengths
+
     def close(self):
         self._f.close()
 

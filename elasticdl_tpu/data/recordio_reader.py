@@ -25,6 +25,20 @@ class RecordIODataReader(AbstractDataReader):
         ) as scanner:
             yield from scanner
 
+    def read_record_chunks(self, task) -> Iterator:
+        """Yield ``(concat_buf, lengths)`` chunks of the task's range —
+        the raw-batch form feeding the fused scan+decode fast path
+        (``data/fast_pipeline.py``).  The yielded views may alias a
+        reusable buffer: consume each chunk before advancing."""
+        with recordio.Scanner(
+            task.shard_name, task.start, task.end - task.start
+        ) as scanner:
+            while True:
+                chunk = scanner.next_chunk()
+                if chunk is None:
+                    return
+                yield chunk
+
     def create_shards(self) -> dict[str, tuple[int, int]]:
         if not self._data_dir:
             return {}

@@ -1,0 +1,139 @@
+"""Cross-task host-pipeline prefetch: decode ahead while the device runs.
+
+The reference's worker overlapped host decode with device compute through
+tf.data's internal threading plus ``prefetch(1)``
+(``elasticdl/python/worker/worker.py:977``).  The TPU runtimes get the
+same overlap here, one level up: a single producer thread walks the TASK
+stream (dispatcher -> task -> minibatch pipeline) and fills a bounded
+queue, so while the device executes the current stacked dispatch — and
+while the main thread is blocked in host->device transfers, both of which
+release the GIL — the next task's records are already being read, decoded
+and batched.  On a single-core host this is the only free parallelism
+there is: decode burns the core exactly when the main thread isn't using
+it.
+
+Ordering and accounting semantics are unchanged from the serial loop:
+batches arrive in task order, a task's batches are contiguous, and the
+caller reports each task only after consuming all its batches — so
+exactly-once accounting, milestone hooks, and lockstep's deterministic
+batch stream behave identically.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator
+
+_TASK = "task"
+_BATCH = "batch"
+_END_TASK = "end"
+_ERROR = "error"
+_DONE = "done"
+
+
+class TaskPrefetcher:
+    """Iterate ``(task_id, task, batches)`` triples with the host
+    pipeline running ahead on a background thread.
+
+    ``next_task()`` -> ``(task_id, task)`` or ``(_, None)`` at end of
+    stream (the dispatcher contract).  ``make_batches(task)`` -> iterable
+    of minibatches.  ``max_buffered_batches`` bounds decode-ahead memory
+    — size it in batches the consumer actually works ahead by (e.g. two
+    ``--steps_per_dispatch`` groups, as LocalExecutor does), since the
+    bound multiplies the model's batch bytes.
+
+    Each yielded ``batches`` iterator must be consumed before advancing
+    the outer iteration (the runtimes' per-task loops do).
+    """
+
+    def __init__(
+        self,
+        next_task: Callable,
+        make_batches: Callable,
+        max_buffered_batches: int = 32,
+    ):
+        self._next_task = next_task
+        self._make_batches = make_batches
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, max_buffered_batches))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._produce, name="task-prefetch", daemon=True
+        )
+        self._started = False
+
+    # ---- producer ---------------------------------------------------------
+
+    def _put(self, item) -> bool:
+        """Blocking put that aborts when the consumer closed us."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self):
+        try:
+            while not self._stop.is_set():
+                tid, task = self._next_task()
+                if task is None:
+                    break
+                if not self._put((_TASK, (tid, task))):
+                    return
+                for batch in self._make_batches(task):
+                    if not self._put((_BATCH, batch)):
+                        return
+                if not self._put((_END_TASK, tid)):
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised by consumer
+            self._put((_ERROR, e))
+            return
+        self._put((_DONE, None))
+
+    # ---- consumer ---------------------------------------------------------
+
+    def __iter__(self) -> Iterator:
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        while True:
+            kind, payload = self._q.get()
+            if kind == _DONE:
+                return
+            if kind == _ERROR:
+                raise payload
+            assert kind == _TASK, f"protocol error: {kind} outside a task"
+            tid, task = payload
+            batches = self._task_batches(tid)
+            yield tid, task, batches
+            # the runtimes drain `batches` inside the loop body; guard
+            # against a partial consumer (e.g. an exception path) by
+            # draining the remainder so the stream stays aligned
+            for _ in batches:
+                pass
+
+    def _task_batches(self, expect_tid) -> Iterator:
+        while True:
+            kind, payload = self._q.get()
+            if kind == _BATCH:
+                yield payload
+            elif kind == _END_TASK:
+                assert payload == expect_tid
+                return
+            elif kind == _ERROR:
+                raise payload
+            else:  # pragma: no cover — protocol violation
+                raise AssertionError(f"unexpected {kind} inside task")
+
+    def close(self):
+        """Stop the producer and release it if blocked on a full queue."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        if self._started:
+            self._thread.join(timeout=5)

@@ -17,8 +17,9 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from elasticdl_tpu.data.dataset import Dataset, batched_model_pipeline
+from elasticdl_tpu.data.dataset import Dataset
 from elasticdl_tpu.data.factory import create_data_reader
+from elasticdl_tpu.data.fast_pipeline import build_task_batches
 from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
 from elasticdl_tpu.parallel.distributed import SPMDTrainer, trim_pad
 from elasticdl_tpu.parallel.mesh import MeshConfig
@@ -120,16 +121,21 @@ class LocalExecutor:
 
     # ---- plumbing ---------------------------------------------------------
 
-    def _task_dataset(self, reader, task, mode: Modes) -> Dataset:
-        ds = Dataset.from_generator(lambda: reader.read_records(task))
-        return batched_model_pipeline(
-            ds,
+    def _task_dataset(
+        self, reader, task, mode: Modes, prefetch: int = 2
+    ) -> Dataset:
+        # prefetch=0 on the training path: TaskPrefetcher's producer
+        # thread IS the overlap there; eval/predict (main-thread
+        # consumers) keep the in-dataset prefetch
+        return build_task_batches(
+            reader,
+            task,
             self._spec,
             mode,
             reader.metadata,
             self._args.minibatch_size,
             shuffle_records=mode == Modes.TRAINING,
-            prefetch=2,
+            prefetch=prefetch,
         )
 
     def _ensure_trainer(self, sample_features):
@@ -172,21 +178,31 @@ class LocalExecutor:
 
     # ---- phases -----------------------------------------------------------
 
-    def _train_task(self, task) -> int:
+    def _train_task(self, task, batches=None) -> int:
         """One implementation for every ``--steps_per_dispatch`` (k=1 is
         a group of one): the shared grouping policy in
         ``trainer.stacking.run_stacked_steps``.  Eval/checkpoint hooks
         run per dispatch group, so step-based triggers fire at dispatch
-        granularity (D9a; identical to per-step at k=1)."""
+        granularity (D9a; identical to per-step at k=1).
+
+        ``batches``: pre-built minibatch stream (the prefetching run
+        loop passes one so host decode overlaps device compute); default
+        builds the task's pipeline inline (retry paths, tests)."""
         from elasticdl_tpu.trainer.stacking import run_stacked_steps
 
         def _pre(features):
             self._ensure_trainer(features)
-            self._profiler.on_step(self._version)
+            # the profiler counts CALLS, one per minibatch == one per
+            # step; no version argument (the version only advances at
+            # the dispatch, so it would repeat within a group — ADVICE
+            # r3 finding 3)
+            self._profiler.on_step()
 
         return run_stacked_steps(
             lambda: self._trainer,
-            self._task_dataset(self._train_reader, task, Modes.TRAINING),
+            batches
+            if batches is not None
+            else self._task_dataset(self._train_reader, task, Modes.TRAINING),
             getattr(self._args, "steps_per_dispatch", 1) or 1,
             pre_batch=_pre,
             post_group=self._post_step_hooks,
@@ -297,16 +313,30 @@ class LocalExecutor:
         )
         total = 0
         ok = False
+        from elasticdl_tpu.trainer.host_pipeline import TaskPrefetcher
+
+        # decode-ahead bounded to ~two dispatch groups of batches
+        # ('auto' resolves per-batch inside run_stacked_steps; size the
+        # buffer for the largest k auto can pick)
+        k = getattr(self._args, "steps_per_dispatch", 1) or 1
+        from elasticdl_tpu.trainer.stacking import MAX_AUTO_K
+
+        k = MAX_AUTO_K if k == "auto" else int(k)
+        prefetcher = TaskPrefetcher(
+            lambda: dispatcher.get(0),
+            lambda task: self._task_dataset(
+                self._train_reader, task, Modes.TRAINING, prefetch=0
+            ),
+            max_buffered_batches=max(4, 2 * k),
+        )
         try:
-            while True:
-                tid, task = dispatcher.get(0)
-                if task is None:
-                    break
+            for tid, task, batches in prefetcher:
                 with self._timing.record("task_process"):
-                    total += self._train_task(task)
+                    total += self._train_task(task, batches)
                 dispatcher.report(tid, True)
             ok = True
         finally:
+            prefetcher.close()
             try:
                 # an in-flight async checkpoint (or a parked write error)
                 # must not be abandoned by a mid-training exception — nor

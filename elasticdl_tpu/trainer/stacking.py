@@ -14,6 +14,7 @@ so all processes compute the same grouping without communication.
 from __future__ import annotations
 
 import contextlib
+import os
 from typing import Callable, Iterable
 
 import jax
@@ -25,13 +26,101 @@ def _batch_size(tree) -> int:
     return int(np.shape(leaves[0])[0]) if leaves else 0
 
 
+# ---- `--steps_per_dispatch auto` sizing ------------------------------------
+
+# stay under the host->device link's fast-path size per stacked put.  The
+# default is the tunneled dev link's measured cliff (~13MB: a 25MB put
+# ran 6x slower, docs/designs/mixed_precision_mfu.md Finding 4);
+# production hosts without a cliff can raise it via the env var.
+TRANSFER_CLIFF_BYTES = int(
+    os.environ.get("EDL_TRANSFER_CLIFF_BYTES", 13 << 20)
+)
+# dispatches cheaper than this don't need amortizing: k=1 keeps
+# per-step hooks at full granularity.  ~100us is a normal local PCIe
+# dispatch; the tunneled dev link measures ~130ms.
+CHEAP_DISPATCH_SECS = 0.002
+MAX_AUTO_K = 32
+
+_DISPATCH_OVERHEAD: list = [None]
+
+
+def measured_dispatch_overhead() -> float:
+    """Seconds per dispatch of a trivial jitted op on FRESH input
+    buffers — the per-dispatch floor stacking amortizes.  Fresh inputs
+    matter: links that cache re-dispatched buffers (the dev tunnel) are
+    an order of magnitude faster on repeated ones.  Measured once per
+    process (~3 round trips), best-of-3 to shed contention."""
+    if _DISPATCH_OVERHEAD[0] is not None:
+        return _DISPATCH_OVERHEAD[0]
+    import time
+
+    f = jax.jit(lambda x: x + 1)
+    jax.device_get(f(np.zeros(256, np.float32)))  # compile
+    best = float("inf")
+    for i in range(3):
+        x = np.full(256, float(i + 1), np.float32)  # fresh buffer
+        t0 = time.perf_counter()
+        jax.device_get(f(x))
+        best = min(best, time.perf_counter() - t0)
+    _DISPATCH_OVERHEAD[0] = best
+    return best
+
+
+def auto_steps_per_dispatch(
+    batch_bytes: int, dispatch_overhead_secs: float
+) -> int:
+    """THE sizing rule: k = 1 when dispatch is cheap; otherwise the most
+    batches whose stacked transfer stays under the link's cliff, capped.
+
+    Pinned by tests/test_stacking_auto.py: 803KB mnist batches on a
+    130ms-dispatch link -> k=16 (the measured optimum of the r3 hand
+    sweep); sub-ms dispatch -> k=1 on any batch size."""
+    if dispatch_overhead_secs < CHEAP_DISPATCH_SECS or batch_bytes <= 0:
+        return 1
+    return max(1, min(MAX_AUTO_K, TRANSFER_CLIFF_BYTES // batch_bytes))
+
+
+def resolve_steps_per_dispatch(
+    k, sample_batch=None, deterministic: bool = False
+) -> int:
+    """Resolve a ``--steps_per_dispatch`` value (int or ``'auto'``).
+
+    ``sample_batch``: one (features, labels) pair — its leaf bytes are
+    the per-step transfer size.
+
+    ``deterministic=True`` (lockstep worlds) resolves from the batch
+    bytes ALONE — a pure function of the data, identical on every
+    process.  The wall-clock overhead probe is per-process: around the
+    CHEAP_DISPATCH_SECS threshold two co-scheduled processes could
+    measure opposite sides of it, compile different stacked programs,
+    and hang each other's collectives.  The byte rule without the probe
+    merely stacks on hosts that didn't need it — safe (the scan is
+    semantically identical and cheap-link stacking still amortizes a
+    little), whereas a k disagreement deadlocks the world.
+    """
+    if k != "auto":
+        return int(k or 1)
+    if sample_batch is None:
+        return 1
+    batch_bytes = sum(
+        np.asarray(leaf).nbytes
+        for leaf in jax.tree_util.tree_leaves(sample_batch)
+    )
+    if deterministic:
+        return auto_steps_per_dispatch(batch_bytes, float("inf"))
+    return auto_steps_per_dispatch(
+        batch_bytes, measured_dispatch_overhead()
+    )
+
+
 def run_stacked_steps(
     get_trainer: Callable,
     batches: Iterable,
-    k: int,
+    k,
     pre_batch: Callable | None = None,
     post_group: Callable | None = None,
     dispatch_ctx: Callable | None = None,
+    deterministic_auto: bool = False,
 ) -> int:
     """Drive ``batches`` of ``(features, labels)`` through the trainer in
     groups of ``k`` steps per dispatch; returns records processed.
@@ -86,6 +175,10 @@ def run_stacked_steps(
     for features, labels in batches:
         if pre_batch is not None:
             pre_batch(features)
+        if k == "auto":  # sized from the first real batch's bytes
+            k = resolve_steps_per_dispatch(
+                k, (features, labels), deterministic=deterministic_auto
+            )
         shape = jax.tree_util.tree_leaves(features)[0].shape
         if first_shape is None:
             first_shape = shape

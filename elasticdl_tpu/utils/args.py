@@ -172,13 +172,15 @@ def _add_data_params(parser: argparse.ArgumentParser):
     parser.add_argument("--minibatch_size", type=pos_int, default=64)
     parser.add_argument(
         "--steps_per_dispatch",
-        type=pos_int,
+        type=lambda v: v if v == "auto" else pos_int(v),
         default=1,
         help=(
             "Optimizer steps fused into one device dispatch (stacked "
             "batches + lax.scan, semantically identical to sequential "
             "steps). >1 amortizes per-dispatch overhead — decisive on "
-            "high-latency host-device links"
+            "high-latency host-device links. 'auto' derives it at "
+            "startup from the measured per-dispatch overhead and the "
+            "batch's transfer size (trainer/stacking.py sizing rule)"
         ),
     )
     parser.add_argument("--num_epochs", type=pos_int, default=1)

@@ -35,8 +35,8 @@ import traceback
 import jax
 import numpy as np
 
-from elasticdl_tpu.data.dataset import Dataset, batched_model_pipeline
 from elasticdl_tpu.data.factory import create_data_reader
+from elasticdl_tpu.data.fast_pipeline import build_task_batches
 from elasticdl_tpu.master.task_dispatcher import FAIL_COUNT
 from elasticdl_tpu.parallel import elastic
 from elasticdl_tpu.parallel.distributed import SPMDTrainer, trim_pad
@@ -194,19 +194,26 @@ class LockstepWorker:
     # ---- batching ----------------------------------------------------------
 
     def _task_batches(self, task, mode: Modes):
-        """Global minibatches of one task — identical on every process."""
-        ds = Dataset.from_generator(
-            lambda: iter(self._reader.read_records(task))
-        )
-        # per-task dataset + seeded shuffle: deterministic on every
-        # process, so the lockstep schedule agreement is preserved
-        return batched_model_pipeline(
-            ds,
+        """Global minibatches of one task — identical on every process.
+
+        The shared chooser picks the vectorized fast path when
+        available; its permutation shuffle is a pure function of (module
+        seed policy, task), so every process computes the same batch
+        stream and the lockstep schedule agreement is preserved on
+        either path (batch count is identical by construction)."""
+        return build_task_batches(
+            self._reader,
+            task,
             self._spec,
             mode,
             self._reader.metadata,
             self._minibatch_size,
             shuffle_records=mode == Modes.TRAINING,
+            # a host missing the native codec must fail loudly, not
+            # silently take the differently-shuffled classic path while
+            # its peers vectorize (the probe half of the choice is
+            # data-driven and therefore already identical everywhere)
+            require_deterministic_choice=True,
         )
 
     def _place(self, tree):
@@ -232,6 +239,11 @@ class LockstepWorker:
                 getattr(self._args, "steps_per_dispatch", 1) or 1,
                 pre_batch=_pre,
                 dispatch_ctx=lambda: self._timing.record("batch_process"),
+                # 'auto' must resolve identically on every process (a k
+                # disagreement compiles different stacked programs and
+                # deadlocks the collectives): byte rule only, no
+                # per-process wall-clock probe
+                deterministic_auto=True,
             )
         self._report_task_result(task.task_id, include_timing=True)
         self._timing.report_timing(reset=True)

@@ -332,11 +332,11 @@ class Worker:
         retried or lease-reclaimed task therefore can't double-count
         metrics (the master drops reports for inactive leases)."""
         reader = self._task_data_service.data_reader
-        from elasticdl_tpu.data.dataset import Dataset
+        from elasticdl_tpu.data.fast_pipeline import build_task_batches
 
-        ds = Dataset.from_generator(lambda: iter(reader.read_records(task)))
-        ds = batched_model_pipeline(
-            ds,
+        ds = build_task_batches(
+            reader,
+            task,
             self._spec,
             Modes.EVALUATION,
             reader.metadata,

@@ -1,0 +1,91 @@
+"""The `--steps_per_dispatch auto` sizing rule (trainer/stacking.py)."""
+
+import numpy as np
+
+from elasticdl_tpu.trainer import stacking
+
+
+def test_auto_k_pins_the_sizing_rule():
+    """The rule that replaced the r3 hand-tuned constants: on the
+    tunneled dev link (130ms dispatches), 803KB mnist batches get k=16 —
+    the measured optimum of the r3 sweep — and tiny deepfm batches cap
+    at MAX_AUTO_K; cheap-dispatch hosts get k=1 (no stacking needed)."""
+    mnist_bytes = 256 * 28 * 28 * 4 + 256 * 4  # f32 images + i32 labels
+    assert stacking.auto_steps_per_dispatch(mnist_bytes, 0.13) == 16
+    deepfm_bytes = 4096 * 10 * 4 + 4096 * 4
+    assert (
+        stacking.auto_steps_per_dispatch(deepfm_bytes, 0.13)
+        == stacking.MAX_AUTO_K
+    )
+    # cheap dispatch (local PCIe): stacking buys nothing, keep hooks
+    # per-step
+    assert stacking.auto_steps_per_dispatch(mnist_bytes, 0.0005) == 1
+    # degenerate inputs
+    assert stacking.auto_steps_per_dispatch(0, 0.13) == 1
+    # a batch bigger than the cliff still dispatches (k=1)
+    assert (
+        stacking.auto_steps_per_dispatch(
+            stacking.TRANSFER_CLIFF_BYTES * 2, 0.13
+        )
+        == 1
+    )
+
+
+def test_resolve_explicit_k_passthrough():
+    assert stacking.resolve_steps_per_dispatch(4) == 4
+    assert stacking.resolve_steps_per_dispatch(None) == 1
+    assert stacking.resolve_steps_per_dispatch(0) == 1
+
+
+def test_resolve_auto_uses_batch_bytes(monkeypatch):
+    monkeypatch.setattr(stacking, "_DISPATCH_OVERHEAD", [0.13])
+    feats = {"image": np.zeros((256, 28, 28), np.float32)}
+    labels = np.zeros(256, np.int32)
+    assert stacking.resolve_steps_per_dispatch(
+        "auto", (feats, labels)
+    ) == 16
+    # cheap link -> 1
+    monkeypatch.setattr(stacking, "_DISPATCH_OVERHEAD", [0.0001])
+    assert (
+        stacking.resolve_steps_per_dispatch("auto", (feats, labels)) == 1
+    )
+
+
+def test_run_stacked_steps_resolves_auto(monkeypatch):
+    """'auto' flows through the grouping loop: with a fake expensive
+    link the first batch's bytes pick the group size."""
+    monkeypatch.setattr(stacking, "_DISPATCH_OVERHEAD", [0.13])
+
+    class FakeTrainer:
+        def __init__(self):
+            self.stacked_calls = []
+            self.single_calls = 0
+
+        def pad_batch(self, tree):
+            return tree, 1
+
+        def place_padded(self, tree):
+            return tree
+
+        def place_stacked(self, tree):
+            return tree
+
+        def train_step(self, f, l):
+            self.single_calls += 1
+
+        def train_steps_stacked(self, f, l):
+            import jax
+
+            self.stacked_calls.append(
+                jax.tree_util.tree_leaves(f)[0].shape[0]
+            )
+
+    # ~1.05MB batches (f32 features + f64 labels) -> auto k = 12
+    batch = ({"x": np.zeros((256, 1024), np.float32)}, np.zeros(256))
+    batches = [batch] * 26
+    trainer = FakeTrainer()
+    n = stacking.run_stacked_steps(lambda: trainer, iter(batches), "auto")
+    assert n == 26 * 256
+    # two full groups + the 2-batch leftover group
+    assert trainer.stacked_calls == [12, 12, 2]
+    assert trainer.single_calls == 0
