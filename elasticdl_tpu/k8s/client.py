@@ -32,6 +32,28 @@ COORDINATOR_PORT = 8476
 MASTER_PORT = 50001
 
 
+class _TransientReadError:
+    """Sentinel for :meth:`Client.read_pod`: the read failed but the pod
+    may well still exist (API hiccup, throttling, network).  Distinct
+    from ``None`` (authoritative not-found)."""
+
+    def __repr__(self):  # pragma: no cover — logging aid
+        return "<transient k8s read error>"
+
+
+TRANSIENT_READ_ERROR = _TransientReadError()
+
+
+def _is_not_found(ex: Exception) -> bool:
+    """Authoritative object-absence ONLY: the kubernetes client's
+    ApiException carries ``status == 404`` (duck-typed replacement APIs
+    must follow the same convention).  Anything else — including
+    exception types a wrapper might raise incidentally — is treated as
+    transient, because misreading a blip as pod-gone is the dangerous
+    direction (it deletes live workers mid-epilogue)."""
+    return getattr(ex, "status", None) == 404
+
+
 def master_pod_name(job_name: str) -> str:
     return f"elasticdl-{job_name}-master"
 
@@ -288,13 +310,24 @@ class Client:
         )
 
     def read_pod(self, pod_name: str):
+        """The pod object; ``None`` when the pod does not exist; the
+        :data:`TRANSIENT_READ_ERROR` sentinel when the API call failed
+        for any OTHER reason.  Callers deciding pod LIFE from this must
+        not read the sentinel as pod-gone: one API blip would otherwise
+        e.g. cut the voluntary-exit grace window short and delete a
+        worker mid-epilogue (ADVICE r3 finding 2)."""
         try:
             return self._api.read_namespaced_pod(
                 name=pod_name, namespace=self.namespace
             )
-        except Exception as ex:  # noqa: BLE001 — absent pod is not fatal
-            logger.warning("Exception reading pod %s: %s", pod_name, ex)
-            return None
+        except Exception as ex:  # noqa: BLE001 — classified below
+            if _is_not_found(ex):
+                logger.warning("Pod %s not found", pod_name)
+                return None
+            logger.warning(
+                "Transient error reading pod %s: %s", pod_name, ex
+            )
+            return TRANSIENT_READ_ERROR
 
     def delete_pod(self, pod_name: str):
         try:
@@ -325,4 +358,7 @@ class Client:
             return None
 
     def get_master_pod(self):
-        return self.read_pod(self.get_master_pod_name())
+        pod = self.read_pod(self.get_master_pod_name())
+        # best-effort consumer (owner references): an errored read gives
+        # the same degraded-but-safe behavior as absence
+        return None if pod is TRANSIENT_READ_ERROR else pod

@@ -18,7 +18,11 @@ from __future__ import annotations
 import threading
 import time
 
-from elasticdl_tpu.k8s.client import COORDINATOR_PORT, Client
+from elasticdl_tpu.k8s.client import (
+    COORDINATOR_PORT,
+    TRANSIENT_READ_ERROR,
+    Client,
+)
 from elasticdl_tpu.utils.log_utils import default_logger as logger
 
 
@@ -181,6 +185,11 @@ class K8sInstanceManager:
             while pending and time.monotonic() < deadline:
                 for name in list(pending):
                     pod = self._client.read_pod(name)
+                    if pod is TRANSIENT_READ_ERROR:
+                        # API blip, not pod-terminal: keep waiting so
+                        # one flaky read can't cut the grace window
+                        # short and kill an epilogue (ADVICE r3 #2)
+                        continue
                     phase = ""
                     if pod is not None:
                         _meta, status = _pod_fields(pod)
@@ -329,6 +338,12 @@ class K8sInstanceManager:
                     break
                 entry = self._standbys.pop(0)
             pod = self._client.read_pod(entry[0])
+            if pod is TRANSIENT_READ_ERROR:
+                # unknown state is not dead: keep it pooled (a wrongly
+                # evicted live standby costs a warm slot; Pending-skip
+                # aging still bounds a genuinely wedged one)
+                not_ready.append(entry)
+                continue
             phase = ""
             if pod is not None:
                 _meta, status = _pod_fields(pod)

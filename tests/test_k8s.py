@@ -18,6 +18,14 @@ from elasticdl_tpu.k8s.instance_manager import K8sInstanceManager
 from elasticdl_tpu.k8s.tensorboard_client import TensorBoardClient
 
 
+class NotFoundError(Exception):
+    """Mimics kubernetes.client.ApiException(status=404): the ONLY
+    signal the production classifier accepts as authoritative absence
+    (client.py _is_not_found)."""
+
+    status = 404
+
+
 class FakeApi:
     def __init__(self):
         self.pods: dict[str, dict] = {}
@@ -35,12 +43,12 @@ class FakeApi:
 
     def read_namespaced_pod(self, name, namespace):
         if name not in self.pods:
-            raise KeyError(name)
+            raise NotFoundError(name)
         return self.pods[name]
 
     def read_namespaced_service(self, name, namespace):
         if name not in self.services:
-            raise KeyError(name)
+            raise NotFoundError(name)
         return self.services[name]
 
     def delete_namespaced_pod(self, name, namespace):
@@ -779,3 +787,124 @@ def test_stuck_pending_standby_evicted_after_max_skips():
     assert "elasticdl-job-standby-1" in api.pods
     with im._lock:
         assert ("elasticdl-job-standby-1", 1) in im._standbys
+
+
+def test_read_pod_distinguishes_not_found_from_transient():
+    """read_pod: None ONLY for authoritative absence (status == 404);
+    any other API failure — even a KeyError from a broken wrapper —
+    returns the TRANSIENT_READ_ERROR sentinel so life-or-death callers
+    don't treat a blip as pod-gone (ADVICE r3)."""
+    from elasticdl_tpu.k8s.client import TRANSIENT_READ_ERROR, Client
+
+    class FlakyApi(FakeApi):
+        def __init__(self):
+            super().__init__()
+            self.fail_with: Exception | None = None
+
+        def read_namespaced_pod(self, name, namespace):
+            if self.fail_with is not None:
+                raise self.fail_with
+            return super().read_namespaced_pod(name, namespace)
+
+    api = FlakyApi()
+    client = Client(
+        image_name="img:1", namespace="ns", job_name="job", api=api
+    )
+    assert client.read_pod("missing") is None  # 404 -> not found
+
+    api.fail_with = ConnectionError("apiserver hiccup")
+    assert client.read_pod("x") is TRANSIENT_READ_ERROR
+    # a bare KeyError from a broken wrapper is NOT authoritative absence
+    api.fail_with = KeyError("partial api response")
+    assert client.read_pod("x") is TRANSIENT_READ_ERROR
+    # best-effort consumer maps the sentinel to None
+    assert client.get_master_pod() is None
+
+
+def test_stop_workers_grace_survives_transient_read_errors():
+    """One flaky read during the grace poll must NOT cut the voluntary-
+    exit window short (the exact failure the window exists to avoid)."""
+    import threading
+    import time as _time
+
+    api = FakeApi()
+    im = K8sInstanceManager(
+        num_workers=1,
+        build_argv=_argv,
+        master_addr="m:1",
+        image_name="img:1",
+        namespace="ns",
+        job_name="job",
+        lockstep=True,
+        api=api,
+        watch=False,
+        standby_workers=0,
+    )
+    im.start_workers()
+    with im._lock:
+        pods = list(im._pods.values())
+
+    orig = api.read_namespaced_pod
+    fail = {"on": True}
+
+    def flaky(name, namespace):
+        if fail["on"]:
+            raise ConnectionError("apiserver hiccup")
+        return orig(name, namespace)
+
+    api.read_namespaced_pod = flaky
+
+    done = threading.Event()
+    threading.Thread(
+        target=lambda: (im.stop_workers(grace_secs=15.0), done.set()),
+        daemon=True,
+    ).start()
+    _time.sleep(0.8)
+    # reads are erroring: the window must still be open, nothing deleted
+    assert not done.is_set()
+    assert not any(p in api.deleted_pods for p in pods)
+    # API recovers, pod reaches terminal phase -> grace completes
+    for p in pods:
+        api.pods[p]["status"] = {"phase": "Succeeded"}
+    fail["on"] = False
+    assert done.wait(timeout=10)
+
+
+def test_transient_read_keeps_standby_pooled():
+    """An errored standby health read keeps the pod in the pool (unknown
+    is not dead) and does not advance the Pending-skip aging."""
+    api = FakeApi()
+    mailbox: dict = {}
+    im = K8sInstanceManager(
+        num_workers=2,
+        build_argv=_argv,
+        master_addr="m:1",
+        image_name="img:1",
+        namespace="ns",
+        job_name="job",
+        lockstep=True,
+        api=api,
+        watch=False,
+        standby_workers=1,
+        post_assignment=lambda sid, a: mailbox.__setitem__(sid, a),
+    )
+    im.start_workers()
+    pod = "elasticdl-job-standby-0"
+    assert pod in api.pods
+
+    orig = api.read_namespaced_pod
+
+    def explode(name, namespace):
+        raise ConnectionError("apiserver hiccup")
+
+    api.read_namespaced_pod = explode
+    assert im._take_live_standbys(1) == []
+    with im._lock:
+        assert (pod, 0) in im._standbys  # kept pooled
+    assert pod not in api.deleted_pods
+    assert pod not in im._pending_skips  # aging untouched
+
+    # API recovers, pod Running -> taken normally
+    api.read_namespaced_pod = orig
+    api.pods[pod]["status"] = {"phase": "Running"}
+    assert im._take_live_standbys(1) == [(pod, 0)]
