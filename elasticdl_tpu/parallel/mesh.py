@@ -49,9 +49,15 @@ def parse_mesh_shape(mesh_shape: str) -> dict[str, int]:
     return out
 
 
-def detect_num_slices(devices) -> int:
+def detect_num_slices(devices, slice_index_fn=None) -> int:
     """Distinct TPU slices among ``devices`` (1 when the backend exposes
-    no ``slice_index`` — CPU, single slice, or older runtimes)."""
+    no ``slice_index`` — CPU, single slice, or older runtimes).
+
+    ``slice_index_fn`` overrides the attribute lookup — how the
+    multichip dryrun forces a multi-slice layout onto host-platform CPU
+    devices (which cannot carry a ``slice_index``)."""
+    if slice_index_fn is not None:
+        return len({slice_index_fn(d) for d in devices}) or 1
     slices = {getattr(d, "slice_index", None) for d in devices}
     if None in slices or not slices:
         return 1
@@ -95,7 +101,7 @@ def plan_dcn_axes(
 
 
 def order_devices_hybrid(
-    devices, sizes: dict[str, int], dcn: dict[str, int]
+    devices, sizes: dict[str, int], dcn: dict[str, int], slice_index_fn=None
 ) -> np.ndarray:
     """Fallback hybrid ordering: group devices by slice, lay each slice
     out row-major over the intra-slice (ICI) shape, and concatenate
@@ -106,9 +112,12 @@ def order_devices_hybrid(
     topology-aware intra-slice orders; this fallback keeps the same
     slice/axis assignment when that API is unavailable.)
     """
+    get_slice = slice_index_fn or (
+        lambda d: getattr(d, "slice_index", 0)
+    )
     by_slice: dict = {}
     for d in devices:
-        by_slice.setdefault(getattr(d, "slice_index", 0), []).append(d)
+        by_slice.setdefault(get_slice(d), []).append(d)
     slice_ids = sorted(by_slice)
     if len({len(v) for v in by_slice.values()}) != 1:
         raise ValueError(f"unequal devices per slice: {sorted(by_slice)}")
@@ -169,7 +178,11 @@ class MeshConfig:
             )
         return sizes
 
-    def create(self, devices=None) -> Mesh:
+    def create(self, devices=None, slice_index_fn=None) -> Mesh:
+        """``slice_index_fn``: override the per-device slice attribute —
+        the dryrun's hook for exercising the hybrid ICI/DCN layout on
+        host-platform CPU devices (``__graft_entry__.dryrun_multichip``
+        forces 2 slices through plan_dcn_axes with it)."""
         devices = devices if devices is not None else jax.devices()
         sizes = self.resolved_axes(len(devices))
         total = int(np.prod(list(sizes.values())))
@@ -178,11 +191,14 @@ class MeshConfig:
         devices = list(devices)[:total]
         axis_names = tuple(sizes)
         shape = tuple(sizes[a] for a in axis_names)
-        n_slices = detect_num_slices(devices)
+        get_slice = slice_index_fn or (
+            lambda d: getattr(d, "slice_index", 0)
+        )
+        n_slices = detect_num_slices(devices, slice_index_fn)
         if n_slices > 1:
             per_slice: dict = {}
             for d in devices:
-                key = getattr(d, "slice_index", 0)
+                key = get_slice(d)
                 per_slice[key] = per_slice.get(key, 0) + 1
             if len(set(per_slice.values())) != 1:
                 # a sub-mesh that doesn't tile the slices evenly (e.g. an
@@ -200,14 +216,23 @@ class MeshConfig:
                 sizes[a] // dcn.get(a, 1) for a in axis_names
             )
             dcn_shape = tuple(dcn.get(a, 1) for a in axis_names)
-            try:
-                from jax.experimental import mesh_utils
-
-                device_array = mesh_utils.create_hybrid_device_mesh(
-                    ici_shape, dcn_shape, devices=devices
+            if slice_index_fn is not None:
+                # forced slices: mesh_utils would re-read the (absent)
+                # device attributes — use the in-repo hybrid ordering
+                device_array = order_devices_hybrid(
+                    devices, sizes, dcn, slice_index_fn
                 )
-            except Exception:
-                device_array = order_devices_hybrid(devices, sizes, dcn)
+            else:
+                try:
+                    from jax.experimental import mesh_utils
+
+                    device_array = mesh_utils.create_hybrid_device_mesh(
+                        ici_shape, dcn_shape, devices=devices
+                    )
+                except Exception:
+                    device_array = order_devices_hybrid(
+                        devices, sizes, dcn
+                    )
             topology = f"{n_slices} slices (DCN axes {dcn})"
         else:
             if self.dcn_axes:
