@@ -173,18 +173,20 @@ def _configs(n_chips: int = 1):
     }
 
 
-# loop-body-counted-once cross-check, done ONCE per bench run: compile
-# the LONE step of the first config and compare its flops against the
-# loop program's body flops.  Detects an XLA unroll of the while loop
-# (which would multiply the loop analysis by the unroll factor).  The
-# single-step AOT compile is tunnel-flaky, so a failed check degrades to
-# scale 1.0 rather than killing the metric.
-_LOOP_FLOPS_SCALE: list = [None]
+# loop-body-counted-once cross-check, done once PER CONFIG: compile the
+# LONE step of the config and compare its flops against the loop
+# program's body flops.  Detects an XLA unroll of the while loop (which
+# would multiply the loop analysis by the unroll factor).  Keyed per
+# config because unroll decisions are per-program — one global cache
+# would stamp the first config's unroll factor onto every model (ADVICE
+# r3 finding 1).  The single-step AOT compile is tunnel-flaky, so a
+# failed check degrades to scale 1.0 rather than killing the metric.
+_LOOP_FLOPS_SCALE: dict = {}
 
 
-def _loop_flops_scale(trainer, pf, pl, loop_body_flops) -> float:
-    if _LOOP_FLOPS_SCALE[0] is not None:
-        return _LOOP_FLOPS_SCALE[0]
+def _loop_flops_scale(name, trainer, pf, pl, loop_body_flops) -> float:
+    if name in _LOOP_FLOPS_SCALE:
+        return _LOOP_FLOPS_SCALE[name]
     scale = 1.0
     try:
         cost = (
@@ -207,7 +209,7 @@ def _loop_flops_scale(trainer, pf, pl, loop_body_flops) -> float:
                 )
     except Exception:  # noqa: BLE001 — best-effort cross-check
         pass
-    _LOOP_FLOPS_SCALE[0] = scale
+    _LOOP_FLOPS_SCALE[name] = scale
     return scale
 
 
@@ -270,18 +272,32 @@ def _measure(name, cfg, mesh):
 
     state = compiled(state, pf, pl)  # warmup call (STEPS steps)
     _sync(state)
-    dt = float("inf")
+    times = []
     for _ in range(REPEATS):
         t0 = time.perf_counter()
         state = compiled(state, pf, pl)
         _sync(state)
-        dt = min(dt, time.perf_counter() - t0)
+        times.append(time.perf_counter() - t0)
 
+    # the chip is time-shared (tunneled dev setups, observed ±30%
+    # between runs): the BEST repetition is the least-contended
+    # measurement and stays the headline; median + spread are recorded
+    # so round-over-round movement can be attributed to contention
+    # rather than code (VERDICT r3 weak #3)
+    times.sort()
+    dt = times[0]
+    median = times[len(times) // 2]
     n_chips = max(1, mesh.devices.size)
     result = {
         "samples_per_sec_per_chip": round(
             STEPS * cfg["batch"] / dt / n_chips, 1
         ),
+        "samples_per_sec_per_chip_median": round(
+            STEPS * cfg["batch"] / median / n_chips, 1
+        ),
+        # how much slower the worst repetition ran vs the best: the
+        # contention band any single-run number lives in
+        "spread_pct": round((times[-1] / times[0] - 1) * 100, 1),
         "batch": cfg["batch"],
     }
     if "tokens_per_sample" in cfg:
@@ -300,7 +316,7 @@ def _measure(name, cfg, mesh):
         if isinstance(cost, (list, tuple)):  # older jax returns [dict]
             cost = cost[0] if cost else {}
         flops = float((cost or {}).get("flops", 0.0)) * STEPS
-        flops *= _loop_flops_scale(trainer, pf, pl, flops / STEPS)
+        flops *= _loop_flops_scale(name, trainer, pf, pl, flops / STEPS)
         if flops > 0:
             # pallas kernels are opaque custom calls with no flops in
             # the cost analysis: add the config's analytic attention
@@ -337,14 +353,25 @@ def _measure_e2e(
     num_shards=8,
 ):
     """End-to-end throughput through the REAL training path: EDLIO shard
-    files on disk -> reader -> dataset_fn decode -> batching -> host
+    files on disk -> reader -> vectorized decode -> batching -> host
     placement -> jitted SPMD step, driven by LocalExecutor exactly as
     ``elasticdl train --distribution_strategy=Local`` runs it
     (BASELINE.md's metric; the step-only configs above exclude the whole
     data plane).
 
-    Steady state = every task after the first (the first carries jit
-    compilation); per-task boundaries come from the real TaskDispatcher.
+    Measurement window: first-task mark (jit compilation done) -> a
+    DEVICE-SYNCED final mark.  Dispatches are async and the prefetching
+    host pipeline runs ahead, so per-task host marks alone would credit
+    records the chip hasn't consumed yet; the window closes with a host
+    readback of ``state.step`` — which data-depends on every dispatched
+    optimizer step — so every counted record's update exists on device.
+
+    Also measures the two pipeline ceilings and reports them as
+    ``budget`` (VERDICT r3 #1): the host decode rate (pipeline iterated
+    with no device) and the device-path rate (pre-decoded batches
+    through stack/place/dispatch/sync) — the e2e rate should sit within
+    ~85% of min(host, device_path); any further gap would be overlap
+    slack in the runtime, not a roofline.
     """
     import tempfile
 
@@ -352,15 +379,26 @@ def _measure_e2e(
 
     from elasticdl_tpu.data.recordio_gen import synthetic
     from elasticdl_tpu.trainer.local_executor import LocalExecutor
+    from elasticdl_tpu.trainer.state import Modes
     from elasticdl_tpu.utils.args import parse_master_args
 
     marks = []
+    final = []
 
     class _TimedExecutor(LocalExecutor):
-        def _train_task(self, task):
-            n = super()._train_task(task)
+        def _train_task(self, task, batches=None):
+            n = super()._train_task(task, batches)
             marks.append((time.perf_counter(), n))
             return n
+
+        def evaluate(self, tag="final"):
+            # no validation_data in the bench config: this is the
+            # post-training hook — close the window with a sync that
+            # data-depends on every step
+            if self._trainer is not None and not final:
+                int(jax.device_get(self._trainer.state.step))
+                final.append(time.perf_counter())
+            return {}
 
     with tempfile.TemporaryDirectory() as td:
         data_dir = getattr(synthetic, gen_name)(
@@ -381,23 +419,93 @@ def _measure_e2e(
             "--num_epochs",
             "1",
         ] + list(extra_argv)
-        _TimedExecutor(parse_master_args(argv)).run()
+        executor = _TimedExecutor(parse_master_args(argv))
+        executor.run()
 
-    if len(marks) < 3:
-        raise RuntimeError(
-            f"e2e needs >= 3 tasks for a steady-state window, got "
-            f"{len(marks)}"
+        if len(marks) < 3 or not final:
+            raise RuntimeError(
+                f"e2e needs >= 3 tasks for a steady-state window, got "
+                f"{len(marks)}"
+            )
+        steady_records = sum(n for _, n in marks[1:])
+        dt = final[0] - marks[0][0]
+        n_chips = max(1, len(jax.devices()))
+        e2e_rate = steady_records / dt / n_chips
+
+        # ---- budget: host decode ceiling ------------------------------
+        reader = executor._train_reader
+        shards = reader.create_shards()
+        from elasticdl_tpu.data.fast_pipeline import build_task_batches
+        from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+        disp = TaskDispatcher(
+            shards, records_per_task=records_per_task, num_epochs=1
         )
-    steady_records = sum(n for _, n in marks[1:])
-    dt = marks[-1][0] - marks[0][0]
-    n_chips = max(1, len(jax.devices()))
+        host_records = 0
+        t0 = time.perf_counter()
+        for _ in range(3):
+            _tid, task = disp.get(0)
+            if task is None:
+                break
+            for _feats, labels in build_task_batches(
+                reader,
+                task,
+                executor._spec,
+                Modes.TRAINING,
+                reader.metadata,
+                batch,
+                shuffle_records=True,
+            ):
+                host_records += int(labels.shape[0])
+        host_rate = host_records / (time.perf_counter() - t0) / n_chips
+
+        # ---- budget: device-path floor --------------------------------
+        # pre-decoded batches through the exact dispatch path the run
+        # uses (stack/pad -> place -> stacked dispatch), synced at end:
+        # what the link+chip could sustain if decode were free
+        from elasticdl_tpu.trainer.stacking import run_stacked_steps
+
+        disp2 = TaskDispatcher(
+            shards, records_per_task=records_per_task, num_epochs=1
+        )
+        _tid, task = disp2.get(0)
+        staged = list(
+            build_task_batches(
+                reader,
+                task,
+                executor._spec,
+                Modes.TRAINING,
+                reader.metadata,
+                batch,
+                shuffle_records=True,
+            )
+        )
+        k = int(getattr(executor._args, "steps_per_dispatch", 1) or 1)
+        trainer = executor._trainer
+        dev_records = 0
+        t0 = time.perf_counter()
+        for _ in range(3):
+            run_stacked_steps(lambda: trainer, staged, k)
+            dev_records += sum(int(l.shape[0]) for _f, l in staged)
+        int(jax.device_get(trainer.state.step))
+        dev_rate = dev_records / (time.perf_counter() - t0) / n_chips
+
+    roofline = min(host_rate, dev_rate)
     return {
-        "e2e_samples_per_sec_per_chip": round(
-            steady_records / dt / n_chips, 1
-        ),
+        "e2e_samples_per_sec_per_chip": round(e2e_rate, 1),
         "batch": batch,
         "records_measured": steady_records,
         "tasks_measured": len(marks) - 1,
+        "budget": {
+            "host_pipeline_records_per_sec": round(host_rate),
+            "device_path_records_per_sec": round(dev_rate),
+            "binding": "host"
+            if host_rate < dev_rate
+            else "device_path",
+            # e2e over the overlapped-pipeline roofline: < ~0.85 would
+            # mean runtime slack, not a data-plane limit
+            "e2e_vs_roofline": round(e2e_rate / roofline, 3),
+        },
     }
 
 
@@ -421,9 +529,17 @@ E2E_CONFIGS = {
         gen_name="gen_frappe",
         model_def="deepfm_edl_embedding.deepfm_edl_embedding.custom_model",
         batch=4096,
-        num_records=655360,
-        records_per_task=65536,
-        extra_argv=("--steps_per_dispatch", "16"),
+        # 8 shards x 131072 = exactly one 32-batch task per shard: every
+        # dispatch group shares one scan shape, so the steady window
+        # carries zero recompiles (a ragged remainder task would compile
+        # a second scan length mid-window).  k=32 measured best for this
+        # record size (5.2MB stacked puts, one dispatch per task): the
+        # tunneled link charges ~0.25s per fresh-buffer dispatch, so
+        # records-per-dispatch is the binding knob once decode is
+        # vectorized (budget.device_path in the artifact).
+        num_records=1048576,
+        records_per_task=131072,
+        extra_argv=("--steps_per_dispatch", "32"),
     ),
 }
 
@@ -453,6 +569,23 @@ def _measure_accuracy():
             eval_records=4096,
             batch=64,
             threshold=0.8,
+        ),
+        # BASELINE.md config 4's OTHER half: census_dnn_model — the
+        # feature-column path (hash-bucket + embedding_column host
+        # transform, device-side DenseFeatures), per-record dataset_fn,
+        # no batch_parse fast path.  Probed on-chip: 0.818 @ 256 steps
+        # (VERDICT r3 #5).
+        "census": dict(
+            gen_name="gen_census",
+            model_def=(
+                "census_dnn_model.census_functional_api.custom_model"
+            ),
+            train_records=32768,
+            eval_records=4096,
+            batch=256,
+            threshold=0.8,
+            epochs=2,
+            extra_argv=("--num_epochs", "2"),
         ),
         # vocab 512 (data + model): per-id observation counts high enough
         # for the factorization to generalize — same recipe as the
@@ -509,26 +642,26 @@ def _measure_accuracy():
         acc = float(results.get("accuracy", results.get("accuracy_logits", 0.0)))
         out[name] = {
             "accuracy": round(acc, 4),
-            "steps": cfg["train_records"] // cfg["batch"],
+            "steps": cfg["train_records"]
+            // cfg["batch"]
+            * cfg.get("epochs", 1),
             "pass": acc >= cfg["threshold"],
             "threshold": cfg["threshold"],
         }
     return out
 
 
-def _measure_reform():
-    """Elastic re-formation latency (BASELINE.md config 5), in a CPU
-    subprocess so the kill-and-relaunch job never touches the chip the
-    throughput configs are timing."""
+def _run_cpu_bench_script(name: str) -> dict:
+    """Run a benchmarks/ script in a CPU subprocess (kill-and-relaunch
+    jobs must never touch the chip the throughput configs are timing)
+    and parse its one-line JSON."""
     import subprocess
 
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = ""
     script = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        "benchmarks",
-        "reform_bench.py",
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks", name
     )
     proc = subprocess.run(
         [sys.executable, script],
@@ -542,9 +675,21 @@ def _measure_reform():
         if line.startswith("{"):
             return json.loads(line)
     raise RuntimeError(
-        f"no JSON from reform bench (rc={proc.returncode}): "
+        f"no JSON from {name} (rc={proc.returncode}): "
         f"{proc.stderr[-300:]}"
     )
+
+
+def _measure_reform():
+    """Elastic re-formation latency (BASELINE.md config 5)."""
+    return _run_cpu_bench_script("reform_bench.py")
+
+
+def _measure_preemption_accuracy():
+    """BASELINE.md config 5's CONJUNCTIVE acceptance: a worker SIGKILLed
+    mid-run, exactly-once records, AND final accuracy over the bar
+    (VERDICT r3 #3)."""
+    return _run_cpu_bench_script("preemption_accuracy_bench.py")
 
 
 def main():
@@ -623,6 +768,18 @@ def main():
     except Exception as ex:  # noqa: BLE001 — same isolation as above
         print(f"bench config elastic_reform failed: {ex}", file=sys.stderr)
         models["elastic_reform"] = {"error": str(ex)[:200]}
+
+    if accuracy_mode:
+        try:
+            models["accuracy_under_preemption"] = (
+                _measure_preemption_accuracy()
+            )
+        except Exception as ex:  # noqa: BLE001 — same isolation as above
+            print(
+                f"bench accuracy_under_preemption failed: {ex}",
+                file=sys.stderr,
+            )
+            models["accuracy_under_preemption"] = {"error": str(ex)[:200]}
 
     # the headline must survive its own config failing (the whole point
     # of the per-config isolation above)

@@ -41,14 +41,24 @@ sys.path.insert(
 HEARTBEAT_TIMEOUT_SECS = 3
 
 
-def measure(workdir: str) -> dict:
+def measure(
+    workdir: str, num_records: int = 512, num_epochs: int = 2
+) -> dict:
+    """Run the kill-and-reform lockstep job; returns the reform metrics.
+
+    Parameterized so the accuracy-under-preemption gate
+    (``preemption_accuracy_bench.py``) can reuse the exact same
+    kill/re-form machinery on a to-accuracy training budget."""
     from elasticdl_tpu.data.recordio_gen import synthetic
     from elasticdl_tpu.master.main import build_master
     from elasticdl_tpu.utils.args import parse_master_args
     from elasticdl_tpu.utils.constants import TaskType
 
     train = synthetic.gen_mnist(
-        os.path.join(workdir, "train"), num_records=512, num_shards=2, seed=3
+        os.path.join(workdir, "train"),
+        num_records=num_records,
+        num_shards=2,
+        seed=3,
     )
     ckpt = os.path.join(workdir, "ckpt")
     args = parse_master_args(
@@ -62,7 +72,7 @@ def measure(workdir: str) -> dict:
             "--records_per_task",
             "64",
             "--num_epochs",
-            "2",
+            str(num_epochs),
             "--compute_dtype",
             "float32",
             "--shuffle_seed",
@@ -142,7 +152,7 @@ def measure(workdir: str) -> dict:
         "records_ok": (
             rc == [0]
             and master.task_d.finished()
-            and counters.total_records == 2 * 512
+            and counters.total_records == num_epochs * num_records
         ),
         "heartbeat_timeout_secs": HEARTBEAT_TIMEOUT_SECS,
         # >0 proves the re-formed world came from the hot-standby pool

@@ -1,0 +1,118 @@
+"""Decode a jax.profiler trace into per-op / per-category roofline rows.
+
+The in-tree ``StepProfiler`` (``--profile_dir``) captures an xplane
+protobuf; the TensorBoard profile plugin in this image cannot parse it
+(TF/plugin version skew), so this decodes the proto directly: every XLA
+op event carries ``hlo_category``, ``flops``, ``bytes_accessed``,
+``source`` and a device duration — enough to attribute step time and
+compute achieved TFLOP/s / GB/s per category (the evidence behind the
+cifar10 roofline analysis in ``docs/designs/mixed_precision_mfu.md``).
+
+Usage:
+  python benchmarks/trace_tools.py <trace_dir_or_xplane.pb>
+
+Prints ONE JSON line: {"device_ms_per_step": ..., "categories": {...}}
+(assumes the trace window held `steps` equal steps; pass --steps N,
+default 3 — the StepProfiler window default is 5).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+from collections import defaultdict
+
+
+def find_xplane(path: str) -> str:
+    if os.path.isfile(path):
+        return path
+    files = glob.glob(
+        os.path.join(path, "**", "*.xplane.pb"), recursive=True
+    )
+    if not files:
+        raise FileNotFoundError(f"no *.xplane.pb under {path}")
+    return max(files, key=os.path.getmtime)
+
+
+def decode(xplane_path: str) -> dict:
+    """{category: {"secs": s, "flops": f, "bytes": b}} for the TPU plane's
+    'XLA Ops' line, plus the total device seconds."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    with open(xplane_path, "rb") as f:
+        xs.ParseFromString(f.read())
+    planes = [p for p in xs.planes if p.name.startswith("/device:")]
+    if not planes:
+        raise ValueError(f"no device plane in {xplane_path}")
+    plane = planes[0]
+    stat_meta = {m.id: m.name for m in plane.stat_metadata.values()}
+    meta = plane.event_metadata
+
+    def stat(md, key):
+        for s in md.stats:
+            if stat_meta.get(s.metadata_id) == key:
+                for field in (
+                    "double_value",
+                    "int64_value",
+                    "uint64_value",
+                    "str_value",
+                ):
+                    if s.HasField(field):
+                        return getattr(s, field)
+        return None
+
+    lines = [l for l in plane.lines if l.name == "XLA Ops"]
+    if not lines:
+        raise ValueError(
+            f"no 'XLA Ops' line; lines: {[l.name for l in plane.lines]}"
+        )
+    cats: dict = defaultdict(lambda: [0.0, 0.0, 0.0])
+    for e in lines[0].events:
+        md = meta[e.metadata_id]
+        c = stat(md, "hlo_category") or "unknown"
+        cats[c][0] += e.duration_ps / 1e12
+        cats[c][1] += float(stat(md, "flops") or 0)
+        cats[c][2] += float(stat(md, "bytes_accessed") or 0)
+    return {
+        c: {"secs": t, "flops": f, "bytes": b}
+        for c, (t, f, b) in cats.items()
+    }
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    steps = 3
+    for a in sys.argv[1:]:
+        if a.startswith("--steps"):
+            steps = int(a.split("=", 1)[1])
+    if not args:
+        print(__doc__)
+        return 1
+    cats = decode(find_xplane(args[0]))
+    total = sum(v["secs"] for v in cats.values())
+    out = {
+        "device_ms_per_step": round(total / steps * 1000, 3),
+        "categories": {
+            c: {
+                "time_pct": round(v["secs"] / total * 100, 1),
+                "tflops_per_sec": round(v["flops"] / v["secs"] / 1e12, 1)
+                if v["secs"]
+                else 0,
+                "gb_per_sec": round(v["bytes"] / v["secs"] / 1e9)
+                if v["secs"]
+                else 0,
+            }
+            for c, v in sorted(
+                cats.items(), key=lambda kv: -kv[1]["secs"]
+            )
+        },
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
