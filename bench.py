@@ -480,7 +480,8 @@ def _measure_e2e(
                 shuffle_records=True,
             )
         )
-        k = int(getattr(executor._args, "steps_per_dispatch", 1) or 1)
+        # run_stacked_steps resolves 'auto' itself from the first batch
+        k = getattr(executor._args, "steps_per_dispatch", 1) or 1
         trainer = executor._trainer
         dev_records = 0
         t0 = time.perf_counter()
@@ -518,28 +519,37 @@ E2E_CONFIGS = {
         gen_name="gen_mnist",
         model_def="mnist_functional_api.mnist_functional_api.custom_model",
         batch=256,
-        num_records=163840,
+        # 8 shards x 16384 = exactly two 32-batch tasks per shard: one
+        # scan shape for the whole window (163840 left 4096-record
+        # remainder tasks whose 16-step scan compiled mid-window)
+        num_records=131072,
         records_per_task=8192,
-        # k=16 measured best on the tunneled dev chip: 12.8MB stacked
-        # transfers stay under the link's fast-path size cliff (k=32's
-        # 25MB transfers fell to 1/6th the rate)
-        extra_argv=("--steps_per_dispatch", "16"),
+        # auto sizing: with the uint8 wire (device_parse normalization
+        # on-chip) a 256-record batch is ~200KB, so auto allows 36 steps
+        # per dispatch (7MB put target) — the 32-batch tasks here yield
+        # one ~6.3MB group each, in the link's measured-good put range.
+        # r3's hand-tuned k=16 shipped f32 images in 12.8MB groups that
+        # sat exactly ON the link's transfer cliff (BENCH_r04's synced
+        # window measured that at 30x below the r3 host-marks number)
+        extra_argv=("--steps_per_dispatch", "auto"),
     ),
     "deepfm_e2e": dict(
         gen_name="gen_frappe",
         model_def="deepfm_edl_embedding.deepfm_edl_embedding.custom_model",
         batch=4096,
-        # 8 shards x 131072 = exactly one 32-batch task per shard: every
+        # 8 shards x 262144 = exactly one 64-batch task per shard: every
         # dispatch group shares one scan shape, so the steady window
         # carries zero recompiles (a ragged remainder task would compile
-        # a second scan length mid-window).  k=32 measured best for this
-        # record size (5.2MB stacked puts, one dispatch per task): the
-        # tunneled link charges ~0.25s per fresh-buffer dispatch, so
-        # records-per-dispatch is the binding knob once decode is
-        # vectorized (budget.device_path in the artifact).
-        num_records=1048576,
-        records_per_task=131072,
-        extra_argv=("--steps_per_dispatch", "32"),
+        # a second scan length mid-window).  auto resolves k=64
+        # (MAX_AUTO_K) with int16 wire ids (batch_parse narrowing),
+        # keeping the stacked put at ~6.3MB — the link's measured-good
+        # range — while maximizing records per dispatch: the tunneled
+        # link charges ~0.25s per fresh-buffer dispatch, so records-per-
+        # dispatch is the binding knob once decode is vectorized
+        # (budget.device_path in the artifact).
+        num_records=2097152,
+        records_per_task=262144,
+        extra_argv=("--steps_per_dispatch", "auto"),
     ),
 }
 

@@ -83,11 +83,21 @@ def decode(xplane_path: str) -> dict:
 
 
 def main():
-    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    argv = sys.argv[1:]
+    args = []
     steps = 3
-    for a in sys.argv[1:]:
+    i = 0
+    while i < len(argv):
+        a = argv[i]
         if a.startswith("--steps"):
-            steps = int(a.split("=", 1)[1])
+            if "=" in a:
+                steps = int(a.split("=", 1)[1])
+            else:  # space form: --steps N
+                i += 1
+                steps = int(argv[i])
+        else:
+            args.append(a)
+        i += 1
     if not args:
         print(__doc__)
         return 1

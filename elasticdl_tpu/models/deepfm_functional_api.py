@@ -64,8 +64,26 @@ class DeepFM(nn.Module):
         return {"logits": logits, "probs": probs}
 
 
+# Wire dtype for the id columns: int16 halves host->device transfer
+# bytes (the e2e bottleneck once decode is vectorized — the model casts
+# ids to int32 on device, so only the wire narrows).  Only safe while
+# every id fits; custom_model re-derives it from the ACTUAL input_dim so
+# a user override past int16 range widens the wire automatically.  A
+# module-level value keeps batch_parse (a module function) in sync with
+# the built model, and is identical across lockstep processes because
+# every process builds the same model.
+_ID_WIRE_DTYPE = np.int16
+
+
+def _id_wire_dtype(input_dim: int):
+    return np.int16 if input_dim <= np.iinfo(np.int16).max else np.int32
+
+
 def custom_model(**kwargs):
-    return DeepFM(**kwargs)
+    global _ID_WIRE_DTYPE
+    model = DeepFM(**kwargs)
+    _ID_WIRE_DTYPE = _id_wire_dtype(model.input_dim)
+    return model
 
 
 def loss(labels, predictions):
@@ -96,8 +114,19 @@ def batch_parse(example_batch, mode):
     """Vectorized ``dataset_fn`` equivalent: one call per minibatch over
     the natively batch-decoded arrays (data/dataset.py fast path) — the
     per-record map caps the e2e pipeline at ~30k records/s while the
-    DeepFM step consumes hundreds of thousands."""
-    feature = example_batch["feature"].astype(np.int32)
+    DeepFM step consumes hundreds of thousands.  Ids ship at the
+    narrowest wire dtype the model's vocab allows (int16 for the default
+    5383) and widen to int32 on device.  The narrowing is VALIDATED
+    against the batch's actual ids, so even a caller that never built
+    the model (stale ``_ID_WIRE_DTYPE``) can't silently wrap an id past
+    int16 range — such a batch just ships int32."""
+    dtype = _ID_WIRE_DTYPE
+    ids = example_batch["feature"]
+    if dtype is np.int16 and ids.size and int(ids.max()) > np.iinfo(
+        np.int16
+    ).max:
+        dtype = np.int32
+    feature = ids.astype(dtype)
     if mode == Modes.PREDICTION:
         return {"feature": feature}
     return {"feature": feature}, example_batch["label"].astype(np.int32)

@@ -48,6 +48,7 @@ class SPMDTrainer:
         donate: bool = True,
         rng_seed: int = 0,
         embedding_threshold: int | None = EMBEDDING_AUTO_DISTRIBUTE_BYTES,
+        device_parse: Callable | None = None,
     ):
         """``embedding_threshold``: tables bigger than this many bytes are
         auto-distributed over the mesh (the reference's 2MB model-handler
@@ -58,8 +59,13 @@ class SPMDTrainer:
         sample_features = _host_slice_for_init(sample_features)
 
         def create_state():
+            init_features = (
+                device_parse(sample_features)
+                if device_parse is not None
+                else sample_features
+            )
             variables = model.init(
-                jax.random.PRNGKey(rng_seed), sample_features, training=False
+                jax.random.PRNGKey(rng_seed), init_features, training=False
             )
             params = variables.get("params", {})
             model_state = {
@@ -107,9 +113,10 @@ class SPMDTrainer:
             remat=remat,
             donate=donate,
             state_shardings=self.state_shardings,
+            device_parse=device_parse,
         )
-        self._eval_step = build_eval_step(loss_fn)
-        self._predict_step = build_predict_step()
+        self._eval_step = build_eval_step(loss_fn, device_parse=device_parse)
+        self._predict_step = build_predict_step(device_parse=device_parse)
 
     # ---- batch placement --------------------------------------------------
 

@@ -38,10 +38,11 @@ class TaskPrefetcher:
 
     ``next_task()`` -> ``(task_id, task)`` or ``(_, None)`` at end of
     stream (the dispatcher contract).  ``make_batches(task)`` -> iterable
-    of minibatches.  ``max_buffered_batches`` bounds decode-ahead memory
-    — size it in batches the consumer actually works ahead by (e.g. two
-    ``--steps_per_dispatch`` groups, as LocalExecutor does), since the
-    bound multiplies the model's batch bytes.
+    of minibatches.  Decode-ahead memory is bounded by BOTH
+    ``max_buffered_batches`` (size it in batches the consumer works
+    ahead by, e.g. two ``--steps_per_dispatch`` groups) and
+    ``max_buffered_bytes`` (so large-image batches can't multiply the
+    count bound into gigabytes).
 
     Each yielded ``batches`` iterator must be consumed before advancing
     the outer iteration (the runtimes' per-task loops do).
@@ -52,10 +53,19 @@ class TaskPrefetcher:
         next_task: Callable,
         make_batches: Callable,
         max_buffered_batches: int = 32,
+        max_buffered_bytes: int = 64 << 20,
     ):
         self._next_task = next_task
         self._make_batches = make_batches
-        self._q: queue.Queue = queue.Queue(maxsize=max(1, max_buffered_batches))
+        # the queue itself is unbounded; _put blocks on whichever budget
+        # (batch count or BYTES) is exhausted first — a flat batch count
+        # alone would buffer gigabytes for large-image models
+        self._q: queue.Queue = queue.Queue()
+        self._max_batches = max(1, max_buffered_batches)
+        self._max_bytes = max_buffered_bytes
+        self._credit = threading.Condition()
+        self._buffered_batches = 0
+        self._buffered_bytes = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._produce, name="task-prefetch", daemon=True
@@ -64,15 +74,45 @@ class TaskPrefetcher:
 
     # ---- producer ---------------------------------------------------------
 
-    def _put(self, item) -> bool:
-        """Blocking put that aborts when the consumer closed us."""
-        while not self._stop.is_set():
-            try:
-                self._q.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
+    @staticmethod
+    def _batch_bytes(batch) -> int:
+        import jax
+        import numpy as np
+
+        return sum(
+            getattr(leaf, "nbytes", 0) or np.asarray(leaf).nbytes
+            for leaf in jax.tree_util.tree_leaves(batch)
+        )
+
+    def _put(self, item, nbytes: int = 0) -> bool:
+        """Blocking put that aborts when the consumer closed us; batch
+        items charge both buffering budgets, and marker items (task
+        boundaries etc., nbytes=0) are throttled by total queue depth so
+        a stream of empty tasks cannot drain the whole dispatcher into
+        the unbounded queue."""
+        marker_cap = 2 * self._max_batches + 8
+        with self._credit:
+            while not self._stop.is_set():
+                if nbytes == 0:
+                    if self._q.qsize() < marker_cap:
+                        self._q.put(item)
+                        return True
+                elif (
+                    self._buffered_batches < self._max_batches
+                    and self._buffered_bytes < self._max_bytes
+                ):
+                    self._buffered_batches += 1
+                    self._buffered_bytes += nbytes
+                    self._q.put(item)
+                    return True
+                self._credit.wait(timeout=0.1)
         return False
+
+    def _release(self, nbytes: int):
+        with self._credit:
+            self._buffered_batches -= 1
+            self._buffered_bytes -= nbytes
+            self._credit.notify()
 
     def _produce(self):
         try:
@@ -83,7 +123,8 @@ class TaskPrefetcher:
                 if not self._put((_TASK, (tid, task))):
                     return
                 for batch in self._make_batches(task):
-                    if not self._put((_BATCH, batch)):
+                    nbytes = max(1, self._batch_bytes(batch))
+                    if not self._put((_BATCH, (batch, nbytes))):
                         return
                 if not self._put((_END_TASK, tid)):
                     return
@@ -118,7 +159,9 @@ class TaskPrefetcher:
         while True:
             kind, payload = self._q.get()
             if kind == _BATCH:
-                yield payload
+                batch, nbytes = payload
+                self._release(nbytes)
+                yield batch
             elif kind == _END_TASK:
                 assert payload == expect_tid
                 return

@@ -157,6 +157,7 @@ class LocalExecutor:
             else compute_dtype,
             remat=bool(getattr(self._args, "remat", False)),
             donate=bool(getattr(self._args, "donate_state", True)),
+            device_parse=self._spec.device_parse,
         )
         version = restore_trainer_state(self._trainer, self._args)
         if version is not None:

@@ -28,18 +28,23 @@ def _batch_size(tree) -> int:
 
 # ---- `--steps_per_dispatch auto` sizing ------------------------------------
 
-# stay under the host->device link's fast-path size per stacked put.  The
-# default is the tunneled dev link's measured cliff (~13MB: a 25MB put
-# ran 6x slower, docs/designs/mixed_precision_mfu.md Finding 4);
-# production hosts without a cliff can raise it via the env var.
+# stay under the host->device link's fast-path size per stacked put.
+# Calibrated empirically on the tunneled dev link (r4 sweeps): 5.2MB and
+# 6.3MB stacked puts sustain the fast path, 12.1MB and 12.8MB collapse
+# ~2-20x, 25MB ~6x — so the sizing target stays at 7MB, comfortably
+# inside the measured-good region.  Production hosts without a cliff can
+# raise it via the env var.
 TRANSFER_CLIFF_BYTES = int(
-    os.environ.get("EDL_TRANSFER_CLIFF_BYTES", 13 << 20)
+    os.environ.get("EDL_TRANSFER_CLIFF_BYTES", 7 << 20)
 )
 # dispatches cheaper than this don't need amortizing: k=1 keeps
 # per-step hooks at full granularity.  ~100us is a normal local PCIe
 # dispatch; the tunneled dev link measures ~130ms.
 CHEAP_DISPATCH_SECS = 0.002
-MAX_AUTO_K = 32
+# scan-length cap: bounds compile time, host stacking memory, and hook
+# (milestone/checkpoint) granularity; 64 measured fastest for small-
+# record CTR batches on the dev link (one ~0.25s dispatch per 64 steps)
+MAX_AUTO_K = 64
 
 _DISPATCH_OVERHEAD: list = [None]
 
@@ -70,11 +75,13 @@ def auto_steps_per_dispatch(
     batch_bytes: int, dispatch_overhead_secs: float
 ) -> int:
     """THE sizing rule: k = 1 when dispatch is cheap; otherwise the most
-    batches whose stacked transfer stays under the link's cliff, capped.
+    batches whose stacked transfer stays under the link's put-size
+    target, capped.
 
-    Pinned by tests/test_stacking_auto.py: 803KB mnist batches on a
-    130ms-dispatch link -> k=16 (the measured optimum of the r3 hand
-    sweep); sub-ms dispatch -> k=1 on any batch size."""
+    Pinned by tests/test_stacking_auto.py: on a 130ms-dispatch link,
+    803KB f32 mnist batches -> k=9 (7MB target), the ~200KB uint8-wire
+    form -> k=36, tiny CTR batches -> MAX_AUTO_K; sub-ms dispatch ->
+    k=1 on any batch size."""
     if dispatch_overhead_secs < CHEAP_DISPATCH_SECS or batch_bytes <= 0:
         return 1
     return max(1, min(MAX_AUTO_K, TRANSFER_CLIFF_BYTES // batch_bytes))

@@ -68,6 +68,7 @@ def build_train_step(
     donate: bool = True,
     extra_grad_fn: Callable | None = None,
     state_shardings=None,
+    device_parse: Callable | None = None,
 ) -> Callable:
     """Build ``(state, features, labels) -> (state, step_metrics)``.
 
@@ -82,9 +83,15 @@ def build_train_step(
         given, the updated state is pinned to the same mesh layout (the
         SPMD path) — this is the ONE step builder both LocalExecutor and
         SPMDTrainer share, so their step semantics cannot drift.
+    device_parse: optional model hook run INSIDE the jitted step before
+        the forward (and before compute_dtype casting): elementwise
+        decode/normalization of compact wire dtypes (e.g. uint8 images
+        -> f32/255), so the host->device transfer ships the small form.
     """
 
     def forward_loss(params, state, features, labels):
+        if device_parse is not None:
+            features = device_parse(features)
         features = _cast_floats(features, compute_dtype)
         outputs, new_model_state = _apply(state, params, features, True)
         loss = loss_fn(labels, outputs)
@@ -124,7 +131,10 @@ def build_train_step(
     )
 
 
-def build_eval_step(loss_fn: Callable | None = None) -> Callable:
+def build_eval_step(
+    loss_fn: Callable | None = None,
+    device_parse: Callable | None = None,
+) -> Callable:
     """Build ``(state, features, labels) -> outputs_or_(outputs, loss)``.
 
     Outputs are returned to the host and reported to the master for metric
@@ -133,6 +143,8 @@ def build_eval_step(loss_fn: Callable | None = None) -> Callable:
     """
 
     def eval_step(state: TrainState, features, labels):
+        if device_parse is not None:
+            features = device_parse(features)
         outputs, _ = _apply(state, state.params, features, False)
         if loss_fn is None:
             return outputs
@@ -141,8 +153,10 @@ def build_eval_step(loss_fn: Callable | None = None) -> Callable:
     return jax.jit(eval_step)
 
 
-def build_predict_step() -> Callable:
+def build_predict_step(device_parse: Callable | None = None) -> Callable:
     def predict_step(state: TrainState, features):
+        if device_parse is not None:
+            features = device_parse(features)
         outputs, _ = _apply(state, state.params, features, False)
         return outputs
 

@@ -92,6 +92,12 @@ class ModelSpec:
     # data/dataset.py batched_model_pipeline) and returns the same
     # element dataset_fn's mapped elements would after batching
     batch_parse: Callable | None = None
+    # optional DEVICE-side half of the parse, applied INSIDE the jitted
+    # step (train/eval/predict) before the model: lets batch_parse ship
+    # compact wire dtypes (e.g. uint8 images) and move elementwise
+    # normalization onto the chip — the role tf.data's device-side
+    # transforms play for the reference.  Signature: features -> features.
+    device_parse: Callable | None = None
     eval_metrics_fn: Callable | None = None
     learning_rate_scheduler: Any | None = None
     prediction_outputs_processor: Any | None = None
@@ -154,6 +160,9 @@ def resolve_model_spec(
         # batch_parse must not silently bypass
         batch_parse=(
             _get("batch_parse") if dataset_fn == "dataset_fn" else None
+        ),
+        device_parse=(
+            _get("device_parse") if dataset_fn == "dataset_fn" else None
         ),
         eval_metrics_fn=_get(eval_metrics_fn),
         learning_rate_scheduler=_get("learning_rate_scheduler"),

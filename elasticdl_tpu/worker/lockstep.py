@@ -177,6 +177,7 @@ class LockstepWorker:
             compute_dtype=None if compute_dtype == "float32" else compute_dtype,
             remat=bool(getattr(self._args, "remat", False)),
             donate=bool(getattr(self._args, "donate_state", True)),
+            device_parse=self._spec.device_parse,
         )
         version = restore_trainer_state(
             self._trainer, self._args, self._process_id

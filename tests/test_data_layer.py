@@ -405,8 +405,15 @@ class TestBatchedModelPipeline:
             )
         )
         assert len(fast) == len(classic) == 3
+        # the fast path ships the wire form (uint8); composing the
+        # model's device_parse (the in-step half) must reproduce the
+        # dataset_fn batches exactly
+        assert spec.device_parse is not None
         for (ff, fl), (cf, cl) in zip(fast, classic):
-            np.testing.assert_array_equal(ff["image"], cf["image"])
+            assert ff["image"].dtype == np.uint8
+            np.testing.assert_array_equal(
+                np.asarray(spec.device_parse(ff)["image"]), cf["image"]
+            )
             np.testing.assert_array_equal(fl, cl)
 
     def test_prediction_mode_features_only(self, tmp_path):
@@ -436,7 +443,13 @@ class TestBatchedModelPipeline:
         )
         assert len(batches) == 1
         assert set(batches[0]) == {"image"}
-        assert batches[0]["image"].dtype == np.float32
+        # wire form: uint8 on the host side, f32 after the in-step
+        # device_parse (applied by build_predict_step)
+        assert batches[0]["image"].dtype == np.uint8
+        assert (
+            np.asarray(spec.device_parse(batches[0])["image"]).dtype
+            == np.float32
+        )
 
     def test_renamed_dataset_fn_disables_fast_path(self):
         """--dataset_fn selects a different parse; batch_parse must not

@@ -11,8 +11,14 @@ def test_auto_k_pins_the_sizing_rule():
     the measured optimum of the r3 sweep — and tiny deepfm batches cap
     at MAX_AUTO_K; cheap-dispatch hosts get k=1 (no stacking needed)."""
     mnist_bytes = 256 * 28 * 28 * 4 + 256 * 4  # f32 images + i32 labels
-    assert stacking.auto_steps_per_dispatch(mnist_bytes, 0.13) == 16
-    deepfm_bytes = 4096 * 10 * 4 + 4096 * 4
+    # the 7MB put target (calibrated: 5-6.5MB puts sustain the link's
+    # fast path, >=12MB collapses) sizes f32 mnist to 9 and the uint8
+    # wire to 36 — r3's hand-tuned k=16 shipped 12.8MB f32 groups that
+    # sat exactly on the cliff
+    assert stacking.auto_steps_per_dispatch(mnist_bytes, 0.13) == 9
+    mnist_u8 = 256 * 28 * 28 + 256 * 4  # uint8 wire (device_parse)
+    assert stacking.auto_steps_per_dispatch(mnist_u8, 0.13) == 36
+    deepfm_bytes = 4096 * 10 * 2 + 4096 * 4  # int16 wire ids
     assert (
         stacking.auto_steps_per_dispatch(deepfm_bytes, 0.13)
         == stacking.MAX_AUTO_K
@@ -43,7 +49,7 @@ def test_resolve_auto_uses_batch_bytes(monkeypatch):
     labels = np.zeros(256, np.int32)
     assert stacking.resolve_steps_per_dispatch(
         "auto", (feats, labels)
-    ) == 16
+    ) == 9
     # cheap link -> 1
     monkeypatch.setattr(stacking, "_DISPATCH_OVERHEAD", [0.0001])
     assert (
@@ -80,12 +86,12 @@ def test_run_stacked_steps_resolves_auto(monkeypatch):
                 jax.tree_util.tree_leaves(f)[0].shape[0]
             )
 
-    # ~1.05MB batches (f32 features + f64 labels) -> auto k = 12
+    # ~1.05MB batches (f32 features + f64 labels) -> auto k = 6
     batch = ({"x": np.zeros((256, 1024), np.float32)}, np.zeros(256))
     batches = [batch] * 26
     trainer = FakeTrainer()
     n = stacking.run_stacked_steps(lambda: trainer, iter(batches), "auto")
     assert n == 26 * 256
-    # two full groups + the 2-batch leftover group
-    assert trainer.stacked_calls == [12, 12, 2]
+    # four full groups + the 2-batch leftover group
+    assert trainer.stacked_calls == [6, 6, 6, 6, 2]
     assert trainer.single_calls == 0
