@@ -469,6 +469,13 @@ def _measure_e2e(
             shards, records_per_task=records_per_task, num_epochs=1
         )
         _tid, task = disp2.get(0)
+        # run_stacked_steps resolves 'auto' itself from the first batch;
+        # staging mirrors the executor's training pipeline (including
+        # PreStacked dispatch groups) so the floor measures the same path
+        k = getattr(executor._args, "steps_per_dispatch", 1) or 1
+        trainer = executor._trainer
+        from elasticdl_tpu.parallel.mesh import batch_divisor
+
         staged = list(
             build_task_batches(
                 reader,
@@ -478,16 +485,14 @@ def _measure_e2e(
                 reader.metadata,
                 batch,
                 shuffle_records=True,
+                stack_k=k if (k == "auto" or int(k) > 1) else None,
+                stack_divisor=batch_divisor(trainer.mesh),
             )
         )
-        # run_stacked_steps resolves 'auto' itself from the first batch
-        k = getattr(executor._args, "steps_per_dispatch", 1) or 1
-        trainer = executor._trainer
         dev_records = 0
         t0 = time.perf_counter()
         for _ in range(3):
-            run_stacked_steps(lambda: trainer, staged, k)
-            dev_records += sum(int(l.shape[0]) for _f, l in staged)
+            dev_records += run_stacked_steps(lambda: trainer, staged, k)
         int(jax.device_get(trainer.state.step))
         dev_rate = dev_records / (time.perf_counter() - t0) / n_chips
 

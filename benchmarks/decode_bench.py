@@ -1,10 +1,17 @@
 """Host input-pipeline benchmark: fused native decode+batch vs the
-per-record Python decoder (the data-plane half of the framework; the
-device half is ``bench.py``).
+per-record Python decoder, plus the END-TO-END host pipeline rates —
+the vectorized task pipeline (scan chunks -> native decode -> permute ->
+slice, ``data/fast_pipeline.py``) against the classic per-record
+generator chain, both from real EDLIO shards on disk.  (The data-plane
+half of the framework; the device half is ``bench.py``, whose
+``*_e2e.budget.host_pipeline_records_per_sec`` should match the
+vectorized figure here.)
 
 Prints ONE JSON line:
   {"native_records_per_sec": N, "python_records_per_sec": N,
-   "speedup": N, "batch": B, "record_bytes": R}
+   "speedup": N, "batch": B, "record_bytes": R,
+   "pipeline": {"vectorized_records_per_sec": N,
+                "classic_records_per_sec": N, "speedup": N}}
 
 Run: ``python benchmarks/decode_bench.py``
 """
@@ -66,9 +73,88 @@ def main():
                 "batch": BATCH,
                 "record_bytes": len(payloads[0]),
                 "native_codec_loaded": recordio.native_available(),
+                "pipeline": _pipeline_rates(),
             }
         )
     )
+
+
+def _pipeline_rates(num_records: int = 131072, batch: int = 4096) -> dict:
+    """Disk-to-minibatch rate of the vectorized task pipeline vs the
+    classic per-record generator chain, on frappe-schema shards."""
+    import tempfile
+
+    from elasticdl_tpu.data.dataset import Dataset, batched_model_pipeline
+    from elasticdl_tpu.data.fast_pipeline import build_task_batches
+    from elasticdl_tpu.data.factory import create_data_reader
+    from elasticdl_tpu.data.recordio_gen import synthetic
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.trainer.state import Modes
+    from elasticdl_tpu.utils.model_utils import get_model_spec
+
+    with tempfile.TemporaryDirectory() as td:
+        data_dir = synthetic.gen_frappe(
+            os.path.join(td, "d"),
+            num_records=num_records,
+            num_shards=2,
+            seed=0,
+        )
+        reader = create_data_reader(data_dir, records_per_task=num_records)
+        spec = get_model_spec(
+            "", "deepfm_edl_embedding.deepfm_edl_embedding.custom_model"
+        )
+        disp = TaskDispatcher(
+            reader.create_shards(),
+            records_per_task=num_records,
+            num_epochs=1,
+        )
+        _tid, task = disp.get(0)
+
+        def run_vectorized():
+            n = 0
+            for _f, labels in build_task_batches(
+                reader,
+                task,
+                spec,
+                Modes.TRAINING,
+                reader.metadata,
+                batch,
+                shuffle_records=True,
+            ):
+                n += labels.shape[0]
+            return n
+
+        def run_classic():
+            n = 0
+            for _f, labels in batched_model_pipeline(
+                Dataset.from_generator(lambda: reader.read_records(task)),
+                spec,
+                Modes.TRAINING,
+                reader.metadata,
+                batch,
+                shuffle_records=True,
+            ):
+                n += labels.shape[0]
+            return n
+
+        out = {}
+        for name, fn in (
+            ("vectorized", run_vectorized),
+            ("classic", run_classic),
+        ):
+            n = fn()  # warm (page cache, imports)
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                fn()
+                best = min(best, time.perf_counter() - t0)
+            out[f"{name}_records_per_sec"] = round(n / best)
+        out["speedup"] = round(
+            out["vectorized_records_per_sec"]
+            / max(1, out["classic_records_per_sec"]),
+            1,
+        )
+        return out
 
 
 if __name__ == "__main__":

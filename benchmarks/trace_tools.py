@@ -89,12 +89,17 @@ def main():
     i = 0
     while i < len(argv):
         a = argv[i]
-        if a.startswith("--steps"):
+        if a == "--steps" or a.startswith("--steps="):
             if "=" in a:
                 steps = int(a.split("=", 1)[1])
-            else:  # space form: --steps N
+            elif i + 1 < len(argv):  # space form: --steps N
                 i += 1
                 steps = int(argv[i])
+            else:
+                print("--steps requires a value", file=sys.stderr)
+                return 2
+        elif a.startswith("--"):
+            pass  # unknown flags are ignored, never treated as paths
         else:
             args.append(a)
         i += 1

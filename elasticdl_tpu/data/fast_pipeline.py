@@ -46,6 +46,7 @@ from __future__ import annotations
 
 from typing import Iterator
 
+import jax
 import numpy as np
 
 from elasticdl_tpu.data.dataset import Dataset, batched_model_pipeline
@@ -78,10 +79,26 @@ def _vectorized_task_batches(
     batch_size: int,
     shuffle_seed: int | None,
     window_bytes: int = _WINDOW_BYTES,
+    stack_k: int | None = None,
+    stack_divisor: int = 1,
 ) -> Iterator:
     """Yield parsed minibatches of ``task``'s records, all-C/numpy per
     record.  Raises :class:`FallbackNeeded` before the first yield if the
-    first chunk does not decode natively."""
+    first chunk does not decode natively.
+
+    ``stack_k`` (training runtimes): emit runs of ``stack_k`` full
+    batches as :class:`~elasticdl_tpu.trainer.stacking.PreStacked`
+    dispatch groups — ``batch_parse`` applied ONCE over the k*B rows and
+    the result reshaped ``(k, B, ...)``, a zero-copy view of the
+    contiguous permuted window (valid because batch_parse is row-wise by
+    contract: batch composition is arbitrary).  Requires ``batch_size``
+    divisible by ``stack_divisor`` (the mesh's batch divisor, so the
+    padding step the plain path applies would be a no-op); leftover full
+    batches and the final partial batch are emitted plain."""
+    if stack_k is not None and stack_k != "auto" and stack_k < 2:
+        stack_k = None
+    if stack_k is not None and batch_size % max(1, stack_divisor):
+        stack_k = None
     chunks = reader.read_record_chunks(task)
     first = next(iter(chunks), None)
     if first is None:
@@ -99,6 +116,35 @@ def _vectorized_task_batches(
         if shuffle_seed is not None
         else None
     )
+
+    if stack_k is not None:
+        # probe one parsed batch: prediction-shaped parses (no labels)
+        # cannot group, whatever the caller asked for
+        n0 = min(batch_size, int(len(lengths)))
+        sample = batch_parse(
+            {k: v[:n0] for k, v in decoded.items()}, mode
+        )
+        if not isinstance(sample, tuple):
+            stack_k = None
+        elif stack_k == "auto":
+            # size the dispatch group from the PARSED wire bytes of one
+            # batch (scaled from however many rows the first chunk
+            # holds) — the same rule run_stacked_steps would apply
+            from elasticdl_tpu.trainer.stacking import (
+                auto_steps_per_dispatch,
+                measured_dispatch_overhead,
+            )
+
+            sample_bytes = sum(
+                np.asarray(leaf).nbytes
+                for leaf in jax.tree_util.tree_leaves(sample)
+            )
+            stack_k = auto_steps_per_dispatch(
+                int(sample_bytes / max(1, n0) * batch_size),
+                measured_dispatch_overhead(),
+            )
+            if stack_k < 2:
+                stack_k = None
 
     window: list[dict] = [decoded]
     pending = int(len(lengths))
@@ -121,7 +167,44 @@ def _vectorized_task_batches(
             perm = rng.permutation(n)
             merged = {k: v[perm] for k, v in merged.items()}
         full = n // batch_size * batch_size
-        for lo in range(0, full, batch_size):
+        lo = 0
+        if stack_k is not None:
+            from elasticdl_tpu.trainer.stacking import PreStacked
+
+            # a window smaller than k full batches still groups — one
+            # PreStacked of however many full batches it holds (e.g. a
+            # 32-batch task under auto k=36 dispatches as one scan-32)
+            k_eff = min(stack_k, full // batch_size)
+            group_rows = max(1, k_eff) * batch_size
+            while k_eff >= 2 and full - lo >= group_rows:
+                parsed = batch_parse(
+                    {
+                        k: v[lo : lo + group_rows]
+                        for k, v in merged.items()
+                    },
+                    mode,
+                )
+                feats, labels = parsed
+                stacked_f = jax.tree_util.tree_map(
+                    lambda a: a.reshape(
+                        (k_eff, batch_size) + a.shape[1:]
+                    ),
+                    feats,
+                )
+                stacked_l = jax.tree_util.tree_map(
+                    lambda a: a.reshape(
+                        (k_eff, batch_size) + a.shape[1:]
+                    ),
+                    labels,
+                )
+                yield PreStacked(
+                    stacked_f,
+                    stacked_l,
+                    group_rows,
+                    jax.tree_util.tree_map(lambda a: a[0], stacked_f),
+                )
+                lo += group_rows
+        for lo in range(lo, full, batch_size):
             yield batch_parse(
                 {k: v[lo : lo + batch_size] for k, v in merged.items()},
                 mode,
@@ -177,6 +260,8 @@ def build_task_batches(
     shuffle_records: bool = False,
     prefetch: int = 0,
     require_deterministic_choice: bool = False,
+    stack_k: int | None = None,
+    stack_divisor: int = 1,
 ) -> Dataset:
     """THE task -> minibatch-stream chooser for per-task runtimes.
 
@@ -230,7 +315,14 @@ def build_task_batches(
 
     def gen():
         fast = _vectorized_task_batches(
-            reader, task, batch_parse, mode, batch_size, seed
+            reader,
+            task,
+            batch_parse,
+            mode,
+            batch_size,
+            seed,
+            stack_k=stack_k,
+            stack_divisor=stack_divisor,
         )
         try:
             first = next(fast)

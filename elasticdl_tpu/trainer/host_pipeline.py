@@ -79,21 +79,26 @@ class TaskPrefetcher:
         import jax
         import numpy as np
 
+        from elasticdl_tpu.trainer.stacking import PreStacked
+
+        if isinstance(batch, PreStacked):
+            batch = (batch.features, batch.labels)
         return sum(
             getattr(leaf, "nbytes", 0) or np.asarray(leaf).nbytes
             for leaf in jax.tree_util.tree_leaves(batch)
         )
 
-    def _put(self, item, nbytes: int = 0) -> bool:
+    def _put(self, item, count: int = 0, nbytes: int = 0) -> bool:
         """Blocking put that aborts when the consumer closed us; batch
-        items charge both buffering budgets, and marker items (task
-        boundaries etc., nbytes=0) are throttled by total queue depth so
-        a stream of empty tasks cannot drain the whole dispatcher into
-        the unbounded queue."""
+        items charge both buffering budgets (``count`` = batches carried
+        — a PreStacked group counts its steps, not 1), and marker items
+        (task boundaries etc., count=0) are throttled by total queue
+        depth so a stream of empty tasks cannot drain the whole
+        dispatcher into the unbounded queue."""
         marker_cap = 2 * self._max_batches + 8
         with self._credit:
             while not self._stop.is_set():
-                if nbytes == 0:
+                if count == 0:
                     if self._q.qsize() < marker_cap:
                         self._q.put(item)
                         return True
@@ -101,20 +106,22 @@ class TaskPrefetcher:
                     self._buffered_batches < self._max_batches
                     and self._buffered_bytes < self._max_bytes
                 ):
-                    self._buffered_batches += 1
+                    self._buffered_batches += count
                     self._buffered_bytes += nbytes
                     self._q.put(item)
                     return True
                 self._credit.wait(timeout=0.1)
         return False
 
-    def _release(self, nbytes: int):
+    def _release(self, count: int, nbytes: int):
         with self._credit:
-            self._buffered_batches -= 1
+            self._buffered_batches -= count
             self._buffered_bytes -= nbytes
             self._credit.notify()
 
     def _produce(self):
+        from elasticdl_tpu.trainer.stacking import PreStacked
+
         try:
             while not self._stop.is_set():
                 tid, task = self._next_task()
@@ -123,8 +130,17 @@ class TaskPrefetcher:
                 if not self._put((_TASK, (tid, task))):
                     return
                 for batch in self._make_batches(task):
+                    count = (
+                        batch.num_steps
+                        if isinstance(batch, PreStacked)
+                        else 1
+                    )
                     nbytes = max(1, self._batch_bytes(batch))
-                    if not self._put((_BATCH, (batch, nbytes))):
+                    if not self._put(
+                        (_BATCH, (batch, count, nbytes)),
+                        count=count,
+                        nbytes=nbytes,
+                    ):
                         return
                 if not self._put((_END_TASK, tid)):
                     return
@@ -159,8 +175,8 @@ class TaskPrefetcher:
         while True:
             kind, payload = self._q.get()
             if kind == _BATCH:
-                batch, nbytes = payload
-                self._release(nbytes)
+                batch, count, nbytes = payload
+                self._release(count, nbytes)
                 yield batch
             elif kind == _END_TASK:
                 assert payload == expect_tid

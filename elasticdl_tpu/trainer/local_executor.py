@@ -126,7 +126,18 @@ class LocalExecutor:
     ) -> Dataset:
         # prefetch=0 on the training path: TaskPrefetcher's producer
         # thread IS the overlap there; eval/predict (main-thread
-        # consumers) keep the in-dataset prefetch
+        # consumers) keep the in-dataset prefetch.
+        # stack_k: training batches arrive as ready-made PreStacked
+        # dispatch groups (zero-copy reshapes built on the producer
+        # thread) when --steps_per_dispatch > 1 — the per-batch group
+        # assembly otherwise costs ~1-2ms x k on the consumer thread.
+        stack_k = None
+        if mode == Modes.TRAINING:
+            k = getattr(self._args, "steps_per_dispatch", 1) or 1
+            if k == "auto" or (isinstance(k, int) and k > 1):
+                stack_k = k
+        from elasticdl_tpu.parallel.mesh import batch_divisor
+
         return build_task_batches(
             reader,
             task,
@@ -136,6 +147,8 @@ class LocalExecutor:
             self._args.minibatch_size,
             shuffle_records=mode == Modes.TRAINING,
             prefetch=prefetch,
+            stack_k=stack_k,
+            stack_divisor=batch_divisor(self._mesh),
         )
 
     def _ensure_trainer(self, sample_features):

@@ -458,3 +458,169 @@ def test_device_parse_step_equivalence():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
         )
+
+
+def test_prestacked_groups_match_plain_batches(tmp_path):
+    """stack_k emits PreStacked groups whose (k, B, ...) contents equal
+    the plain path's batches exactly (same permutation, same rows), with
+    leftover batches plain."""
+    from elasticdl_tpu.trainer.stacking import PreStacked
+
+    reader, spec, disp = _frappe_setup(
+        tmp_path, num_records=12000, records_per_task=6000
+    )
+    _tid, task = disp.get(0)
+    plain = list(
+        build_task_batches(
+            reader,
+            task,
+            spec,
+            Modes.TRAINING,
+            reader.metadata,
+            512,
+            shuffle_records=True,
+        )
+    )
+    stacked = list(
+        build_task_batches(
+            reader,
+            task,
+            spec,
+            Modes.TRAINING,
+            reader.metadata,
+            512,
+            shuffle_records=True,
+            stack_k=4,
+        )
+    )
+    # 6000 records / 512 = 11 full batches + tail -> 2 groups of 4,
+    # then 3 plain full batches, then the partial tail
+    assert isinstance(stacked[0], PreStacked)
+    assert isinstance(stacked[1], PreStacked)
+    assert all(not isinstance(x, PreStacked) for x in stacked[2:])
+    assert len(stacked) == 2 + 3 + 1
+
+    rebuilt = []
+    for item in stacked:
+        if isinstance(item, PreStacked):
+            for i in range(item.num_steps):
+                rebuilt.append(
+                    (
+                        {
+                            k: v[i]
+                            for k, v in item.features.items()
+                        },
+                        item.labels[i],
+                    )
+                )
+        else:
+            rebuilt.append(item)
+    assert len(rebuilt) == len(plain)
+    for (fa, la), (fb, lb) in zip(rebuilt, plain):
+        np.testing.assert_array_equal(fa["feature"], fb["feature"])
+        np.testing.assert_array_equal(la, lb)
+
+
+def test_run_stacked_steps_dispatches_prestacked():
+    """PreStacked items dispatch directly (one stacked call, no
+    grouping), counting records and firing hooks per group."""
+    from elasticdl_tpu.trainer import stacking
+
+    class FakeTrainer:
+        def __init__(self):
+            self.stacked = []
+            self.single = 0
+
+        def place_stacked(self, tree):
+            return tree
+
+        def place_padded(self, tree):
+            return tree
+
+        def pad_batch(self, tree):
+            return tree, 1
+
+        def train_step(self, f, l):
+            self.single += 1
+
+        def train_steps_stacked(self, f, l):
+            import jax
+
+            self.stacked.append(
+                jax.tree_util.tree_leaves(f)[0].shape[:2]
+            )
+
+    feats = {"x": np.zeros((4, 8, 3), np.float32)}
+    labels = np.zeros((4, 8), np.int32)
+    group = stacking.PreStacked(
+        feats, labels, 32, {"x": feats["x"][0]}
+    )
+    tail = ({"x": np.zeros((5, 3), np.float32)}, np.zeros(5, np.int32))
+    pre, post = [], []
+    trainer = FakeTrainer()
+    n = stacking.run_stacked_steps(
+        lambda: trainer,
+        iter([group, tail]),
+        4,
+        pre_batch=lambda f: pre.append(1),
+        post_group=lambda: post.append(1),
+    )
+    assert n == 32 + 5
+    assert trainer.stacked == [(4, 8)]
+    assert trainer.single == 1  # the tail dispatches as a single step
+    assert len(pre) == 4 + 1  # one hook call per step
+    assert len(post) == 2  # one per dispatch group
+
+
+def test_prestacked_caps_group_to_window(tmp_path):
+    """stack_k larger than the task's full-batch count still groups:
+    one PreStacked of however many full batches exist (auto k=36 over a
+    32-batch task must not silently fall back to per-batch grouping)."""
+    from elasticdl_tpu.trainer.stacking import PreStacked
+
+    reader, spec, disp = _frappe_setup(
+        tmp_path, num_records=4096, records_per_task=2048
+    )
+    _tid, task = disp.get(0)
+    items = list(
+        build_task_batches(
+            reader,
+            task,
+            spec,
+            Modes.TRAINING,
+            reader.metadata,
+            512,
+            shuffle_records=True,
+            stack_k=36,
+        )
+    )
+    # 2048/512 = 4 full batches -> one PreStacked(4), no tail
+    assert len(items) == 1
+    assert isinstance(items[0], PreStacked)
+    assert items[0].num_steps == 4
+    assert items[0].num_records == 2048
+
+
+def test_prestacked_disabled_for_prediction_parse(tmp_path):
+    """An explicit int stack_k with a prediction-shaped batch_parse
+    (no labels) downgrades to plain batches instead of crashing."""
+    from elasticdl_tpu.trainer.stacking import PreStacked
+
+    reader, spec, disp = _frappe_setup(
+        tmp_path, num_records=4096, records_per_task=2048
+    )
+    _tid, task = disp.get(0)
+    items = list(
+        build_task_batches(
+            reader,
+            task,
+            spec,
+            Modes.PREDICTION,
+            reader.metadata,
+            512,
+            shuffle_records=False,
+            stack_k=4,
+        )
+    )
+    assert all(not isinstance(x, PreStacked) for x in items)
+    assert sum(x["feature"].shape[0] for x in items) == 2048
