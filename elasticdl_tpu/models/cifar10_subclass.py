@@ -11,7 +11,9 @@ from __future__ import annotations
 
 from elasticdl_tpu.models.cifar10_functional_api import (  # noqa: F401
     Cifar10CNN,
+    batch_parse,
     dataset_fn,
+    device_parse,
     eval_metrics_fn,
     loss,
 )

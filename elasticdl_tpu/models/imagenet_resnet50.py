@@ -20,7 +20,9 @@ from elasticdl_tpu.data.reader import encode_example
 # re-export the model contract so --model_def=imagenet_resnet50... works
 from elasticdl_tpu.models.resnet50_subclass import (  # noqa: F401
     CustomModel,
+    batch_parse,
     dataset_fn,
+    device_parse,
     eval_metrics_fn,
     loss,
     optimizer,

@@ -18,6 +18,10 @@ import optax
 from elasticdl_tpu.data.reader import decode_example
 from elasticdl_tpu.trainer.metrics import Accuracy
 from elasticdl_tpu.trainer.state import Modes
+from elasticdl_tpu.models._image_wire import (  # noqa: F401
+    batch_parse,
+    device_parse,
+)
 
 
 class MnistCNN(nn.Module):
@@ -78,31 +82,6 @@ def dataset_fn(dataset, mode, metadata):
     return dataset
 
 
-def batch_parse(example_batch, mode):
-    """Vectorized ``dataset_fn`` equivalent: one call per minibatch on
-    natively-decoded ``(B, ...)`` arrays (the runtimes prefer this over
-    the per-record path when defined — data/dataset.py
-    batched_model_pipeline).
-
-    Ships images at their on-disk uint8 — 4x fewer host->device bytes
-    than the classic path's f32 — and leaves the /255 normalization to
-    :func:`device_parse` inside the jitted step."""
-    if mode == Modes.PREDICTION:
-        return {"image": example_batch["image"]}
-    return (
-        {"image": example_batch["image"]},
-        example_batch["label"].astype(np.int32),
-    )
-
-
-def device_parse(features):
-    """Device-side half of :func:`batch_parse`, applied inside the
-    jitted train/eval/predict steps (trainer/step.py): uint8 wire
-    images -> the f32/255 input the model trains on (identical math to
-    ``dataset_fn``'s host-side normalization)."""
-    import jax.numpy as jnp
-
-    return {"image": features["image"].astype(jnp.float32) / 255.0}
 
 
 def eval_metrics_fn():

@@ -20,6 +20,10 @@ import optax
 from elasticdl_tpu.data.reader import decode_example
 from elasticdl_tpu.trainer.metrics import Accuracy
 from elasticdl_tpu.trainer.state import Modes
+from elasticdl_tpu.models._image_wire import (  # noqa: F401
+    batch_parse,
+    device_parse,
+)
 
 
 class CustomModel(nn.Module):
@@ -72,6 +76,8 @@ def dataset_fn(dataset, mode, metadata):
     if mode == Modes.TRAINING:
         dataset = dataset.shuffle(1024, seed=0)
     return dataset
+
+
 
 
 def eval_metrics_fn():

@@ -19,6 +19,10 @@ from elasticdl_tpu.data.reader import decode_example
 from elasticdl_tpu.models.resnet50_model import L2_WEIGHT_DECAY, ResNet50
 from elasticdl_tpu.trainer.metrics import Accuracy
 from elasticdl_tpu.trainer.state import Modes
+from elasticdl_tpu.models._image_wire import (  # noqa: F401
+    batch_parse,
+    device_parse,
+)
 
 
 class CustomModel(ResNet50):
@@ -70,6 +74,8 @@ def dataset_fn(dataset, mode, metadata):
     if mode == Modes.TRAINING:
         dataset = dataset.shuffle(1024, seed=0)
     return dataset
+
+
 
 
 def eval_metrics_fn():
