@@ -49,6 +49,21 @@ def optimizer(lr=1e-3):
     return optax.adam(lr)
 
 
+def batch_parse(example_batch, mode):
+    """Vectorized ``dataset_fn`` equivalent (data/fast_pipeline.py):
+    every column transform (astype / digitize / modulo-hash) is a
+    shape-preserving numpy op, so the per-record host transform runs
+    unchanged over whole ``(B,)`` decoded columns — the feature-column
+    path joins the zero-per-record-object pipeline."""
+    feats_in = {
+        k: v for k, v in example_batch.items() if k != LABEL_KEY
+    }
+    feats = fc.transform_features(COLUMNS, feats_in)
+    if mode == Modes.PREDICTION:
+        return feats
+    return feats, example_batch[LABEL_KEY].astype(np.int32)
+
+
 def dataset_fn(dataset, mode, metadata):
     def _parse(record):
         ex = decode_example(record)

@@ -9,6 +9,7 @@ the sequential entry point.
 from elasticdl_tpu.models.census_dnn_model.census_functional_api import (  # noqa: F401,E501
     CensusDNN,
     custom_model,
+    batch_parse,
     dataset_fn,
     eval_metrics_fn,
     loss,

@@ -7,6 +7,7 @@ subclass (``CustomModel``).
 
 from elasticdl_tpu.models.census_dnn_model.census_functional_api import (  # noqa: F401,E501
     CensusDNN,
+    batch_parse,
     dataset_fn,
     eval_metrics_fn,
     loss,
