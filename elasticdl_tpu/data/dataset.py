@@ -173,8 +173,14 @@ class Dataset:
 
 
 # records shuffled ahead of the vectorized parse, matching the model
-# zoo's per-record convention (e.g. mnist dataset_fn: shuffle(1024, seed=0))
+# zoo's per-record convention (e.g. mnist dataset_fn: shuffle(1024, seed=0)).
+# DEFAULT_SHUFFLE_POLICY is THE default for every path that honors the
+# module-owned ``batch_shuffle = (buffer, seed)`` policy — the classic
+# fast path here and the vectorized window shuffle
+# (fast_pipeline._shuffle_policy import it, so the two paths cannot
+# silently diverge on buffer or seed).
 _SHUFFLE_BUFFER = 1024
+DEFAULT_SHUFFLE_POLICY = (_SHUFFLE_BUFFER, 0)
 
 
 def batched_model_pipeline(
@@ -213,7 +219,7 @@ def batched_model_pipeline(
         policy = getattr(
             getattr(spec, "module", None),
             "batch_shuffle",
-            (_SHUFFLE_BUFFER, 0),
+            DEFAULT_SHUFFLE_POLICY,
         )
         if shuffle_records and policy is not None:
             buffer_size, seed = policy

@@ -49,7 +49,11 @@ from typing import Iterator
 import jax
 import numpy as np
 
-from elasticdl_tpu.data.dataset import Dataset, batched_model_pipeline
+from elasticdl_tpu.data.dataset import (
+    DEFAULT_SHUFFLE_POLICY,
+    Dataset,
+    batched_model_pipeline,
+)
 from elasticdl_tpu.data.reader import (
     decode_concat_batch,
     decode_example,
@@ -61,9 +65,6 @@ from elasticdl_tpu.data.reader import (
 # the classic path's 1024-record reservoir.
 _WINDOW_BYTES = 64 << 20
 
-# classic-path shuffle convention (dataset.py _SHUFFLE_BUFFER): module
-# policy `batch_shuffle = (buffer, seed)` overrides; None disables.
-_DEFAULT_SHUFFLE = (1024, 0)
 
 
 class FallbackNeeded(Exception):
@@ -242,7 +243,9 @@ def _shuffle_policy(spec, shuffle_records: bool) -> int | None:
     if not shuffle_records:
         return None
     policy = getattr(
-        getattr(spec, "module", None), "batch_shuffle", _DEFAULT_SHUFFLE
+        getattr(spec, "module", None),
+        "batch_shuffle",
+        DEFAULT_SHUFFLE_POLICY,
     )
     if policy is None:
         return None

@@ -70,6 +70,15 @@ class MasterServicer:
     # ---- RPC handlers -----------------------------------------------------
 
     def get_task(self, request: msg.GetTaskRequest) -> msg.TaskResponse:
+        """Lease the next task for ``worker_id``.
+
+        Contract: a WAIT response means "new work may appear later —
+        poll again after a short sleep".  Callers MUST NOT busy-spin on
+        WAIT: the servicer runs in-process for local jobs, and a spin
+        loop starves the thread that holds the last re-queued lease
+        (worker/worker.py sleeps between polls; reference
+        worker.py:498-505 does the same).
+        """
         # every task pull is a liveness signal (cheap implicit heartbeat;
         # the worker's background heartbeat covers long compute gaps)
         with self._lock:

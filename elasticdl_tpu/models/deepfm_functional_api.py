@@ -71,7 +71,13 @@ class DeepFM(nn.Module):
 # a user override past int16 range widens the wire automatically.  A
 # module-level value keeps batch_parse (a module function) in sync with
 # the built model, and is identical across lockstep processes because
-# every process builds the same model.
+# every process builds the same model.  It is a pure function of the
+# built model — NEVER of batch history: a per-batch or sticky widening
+# would let the dtype flip between batches (recompiling the jitted step
+# per flip, ADVICE r4) or diverge between a lockstep rejoiner and the
+# survivors that saw earlier batches.  Ids a resolved-int16 wire cannot
+# carry are >= 2^15 > input_dim — out of the embedding's vocab — so
+# batch_parse rejects them as corrupt data instead of widening.
 _ID_WIRE_DTYPE = np.int16
 
 
@@ -116,17 +122,35 @@ def batch_parse(example_batch, mode):
     per-record map caps the e2e pipeline at ~30k records/s while the
     DeepFM step consumes hundreds of thousands.  Ids ship at the
     narrowest wire dtype the model's vocab allows (int16 for the default
-    5383) and widen to int32 on device.  The narrowing is VALIDATED
-    against the batch's actual ids, so even a caller that never built
-    the model (stale ``_ID_WIRE_DTYPE``) can't silently wrap an id past
-    int16 range — such a batch just ships int32."""
-    dtype = _ID_WIRE_DTYPE
+    5383) and widen to int32 on device.  The ids are VALIDATED, never
+    coerced: a negative id raises (``astype`` would wrap it silently),
+    and an id past int16 range under an int16-resolved wire also raises
+    — such an id is >= 2^15 > input_dim, outside the embedding's vocab,
+    so it is corrupt data for THIS model, not a reason to widen.  The
+    dtype therefore never depends on batch history: no int16<->int32
+    flips (each would recompile the jitted step) and no divergence
+    between lockstep processes with different histories (a rejoiner
+    resolves the same dtype from the same model)."""
     ids = example_batch["feature"]
-    if dtype is np.int16 and ids.size and int(ids.max()) > np.iinfo(
-        np.int16
-    ).max:
-        dtype = np.int32
-    feature = ids.astype(dtype)
+    if ids.size:
+        lo = int(ids.min())
+        if lo < 0:
+            raise ValueError(
+                f"negative feature id {lo}: deepfm ids must be >= 0 "
+                "(0 is the mask_zero padding id) — the record data is "
+                "corrupt"
+            )
+        hi = int(ids.max())
+        if hi > np.iinfo(_ID_WIRE_DTYPE).max:
+            raise ValueError(
+                f"feature id {hi} exceeds {np.dtype(_ID_WIRE_DTYPE).name} "
+                "range, so it is past the largest input_dim that dtype "
+                "resolves for — outside the embedding vocab (corrupt "
+                "data, or the model was built with a smaller input_dim "
+                "than the dataset needs: pass --model_params "
+                "input_dim=...)"
+            )
+    feature = ids.astype(_ID_WIRE_DTYPE)
     if mode == Modes.PREDICTION:
         return {"feature": feature}
     return {"feature": feature}, example_batch["label"].astype(np.int32)

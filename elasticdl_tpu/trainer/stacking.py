@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 from typing import Callable, Iterable
 
 import jax
@@ -70,6 +71,10 @@ CHEAP_DISPATCH_SECS = 0.002
 MAX_AUTO_K = 64
 
 _DISPATCH_OVERHEAD: list = [None]
+# one probe per process: the TaskPrefetcher producer thread (fast_pipeline
+# auto sizing) and the main thread can both arrive here; concurrent probes
+# would contend with each other and cache an inflated overhead
+_DISPATCH_OVERHEAD_LOCK = threading.Lock()
 
 
 def measured_dispatch_overhead() -> float:
@@ -78,20 +83,21 @@ def measured_dispatch_overhead() -> float:
     matter: links that cache re-dispatched buffers (the dev tunnel) are
     an order of magnitude faster on repeated ones.  Measured once per
     process (~3 round trips), best-of-3 to shed contention."""
-    if _DISPATCH_OVERHEAD[0] is not None:
-        return _DISPATCH_OVERHEAD[0]
-    import time
+    with _DISPATCH_OVERHEAD_LOCK:
+        if _DISPATCH_OVERHEAD[0] is not None:
+            return _DISPATCH_OVERHEAD[0]
+        import time
 
-    f = jax.jit(lambda x: x + 1)
-    jax.device_get(f(np.zeros(256, np.float32)))  # compile
-    best = float("inf")
-    for i in range(3):
-        x = np.full(256, float(i + 1), np.float32)  # fresh buffer
-        t0 = time.perf_counter()
-        jax.device_get(f(x))
-        best = min(best, time.perf_counter() - t0)
-    _DISPATCH_OVERHEAD[0] = best
-    return best
+        f = jax.jit(lambda x: x + 1)
+        jax.device_get(f(np.zeros(256, np.float32)))  # compile
+        best = float("inf")
+        for i in range(3):
+            x = np.full(256, float(i + 1), np.float32)  # fresh buffer
+            t0 = time.perf_counter()
+            jax.device_get(f(x))
+            best = min(best, time.perf_counter() - t0)
+        _DISPATCH_OVERHEAD[0] = best
+        return best
 
 
 def auto_steps_per_dispatch(

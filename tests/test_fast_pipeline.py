@@ -398,6 +398,28 @@ def test_deepfm_wire_dtype_narrows_and_widens():
     feats, _ = dfm.batch_parse(batch, Modes.TRAINING)
     assert feats["feature"].dtype == np.int32
 
+    # the wire dtype is a pure function of the BUILT model, never of
+    # batch history (a history-dependent dtype would flip int16<->int32
+    # — one step recompile per flip — and diverge between lockstep
+    # processes with different histories).  An id past int16 range
+    # under an int16-resolved wire is >= 2^15 > input_dim, outside the
+    # embedding vocab: corrupt data, raise rather than widen
+    dfm.custom_model()  # resolves int16
+    with pytest.raises(ValueError, match="exceeds int16 range"):
+        dfm.batch_parse(
+            dict(batch, feature=np.full((8, 10), 40000, np.int64)),
+            Modes.TRAINING,
+        )
+    feats, _ = dfm.batch_parse(batch, Modes.TRAINING)
+    assert feats["feature"].dtype == np.int16  # unchanged by the reject
+
+    # negative ids are corrupt data (astype would wrap silently): raise
+    with pytest.raises(ValueError, match="negative feature id"):
+        dfm.batch_parse(
+            dict(batch, feature=np.full((2, 10), -1, np.int64)),
+            Modes.TRAINING,
+        )
+
     # restore the default for other tests (module-level state)
     dfm.custom_model()
     # int16 ids drive the model fine (device-side widening)

@@ -6,10 +6,13 @@ from elasticdl_tpu.trainer import stacking
 
 
 def test_auto_k_pins_the_sizing_rule():
-    """The rule that replaced the r3 hand-tuned constants: on the
-    tunneled dev link (130ms dispatches), 803KB mnist batches get k=16 —
-    the measured optimum of the r3 sweep — and tiny deepfm batches cap
-    at MAX_AUTO_K; cheap-dispatch hosts get k=1 (no stacking needed)."""
+    """The rule that replaced the r3 hand-tuned constants: a 7MB put
+    target sizes the dispatch group, so on the tunneled dev link (130ms
+    dispatches) 803KB f32 mnist batches get k=9 and the 205KB uint8
+    wire gets k=36 — superseding r3's hand-tuned k=16, whose 12.8MB f32
+    groups sat exactly on the link's transfer cliff.  Tiny deepfm
+    batches cap at MAX_AUTO_K; cheap-dispatch hosts get k=1 (no
+    stacking needed)."""
     mnist_bytes = 256 * 28 * 28 * 4 + 256 * 4  # f32 images + i32 labels
     # the 7MB put target (calibrated: 5-6.5MB puts sustain the link's
     # fast path, >=12MB collapses) sizes f32 mnist to 9 and the uint8

@@ -320,15 +320,32 @@ class TestServicerConcurrency:
         leases: list = []
         errors: list = []
         barrier = threading.Barrier(num_workers)
+        # hard deadline: with 16 threads on a loaded 1-core host a bare
+        # busy-spin on WAIT can GIL-starve the thread holding the last
+        # re-queued task for tens of minutes (observed: a 27-minute
+        # stall under full-suite load).  Threads back off on WAIT per
+        # the servicer contract and abort loudly past the deadline
+        # instead of letting join() report an opaque hang.
+        deadline = time.monotonic() + 60
 
         def worker(worker_id):
             try:
                 barrier.wait()
                 while True:
+                    if time.monotonic() > deadline:
+                        raise AssertionError(
+                            f"worker {worker_id} passed the 60s deadline; "
+                            f"dispatcher finished={dispatcher.finished()} "
+                            f"leases so far={len(leases)}"
+                        )
                     resp = servicer.get_task(
                         msg.GetTaskRequest(worker_id=worker_id)
                     )
                     if resp.task_id < 0 and resp.type == int(TaskType.WAIT):
+                        # the get_task contract: WAIT means "poll later",
+                        # not "spin" — yield the GIL so the lease-holding
+                        # thread can run
+                        time.sleep(0.005)
                         continue
                     if resp.task_id < 0:
                         return  # job complete
