@@ -1,8 +1,14 @@
 """Benchmark: training throughput of the framework's SPMD step on real
 hardware, across the BASELINE.md model set.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "models": {...}}
+Prints ONE COMPACT JSON line (last line of stdout, <= ~1500 bytes —
+the driver records only a ~2000-char stdout tail, and r4's 4KB line
+got truncated into an unparseable artifact):
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+   "detail": "BENCH_full.json", "models": {<short-key summaries>}}
+and writes the full per-config detail (all measured fields, error
+texts, budget decompositions, the short-key legend) to
+``BENCH_full.json`` next to this file.
 
 Headline metric: ResNet-50 (cifar10 shapes) samples/sec/chip — the
 strongest MXU witness of the set (VERDICT r1) — with per-model extras for
@@ -50,21 +56,58 @@ PEAK_FLOPS = [
 ]
 
 
-# order-of-magnitude sanity anchors (quiet-host r4 measurements) for the
-# degraded-link retry below — NOT asserted values, just "a result 2.5x+
-# below this is almost certainly the link, not the code"
-TYPICAL_RATE = {
-    "mnist": 60_000,
-    "resnet50_cifar10": 140_000,
-    "deepfm": 1_000_000,
-    "imagenet_resnet50": 2_700,
-    "transformer_seq8192": 17,
-    "transformer_gpt2s_seq2048": 50,
-}
-TYPICAL_E2E_RATE = {
-    "mnist_e2e": 30_000,
-    "deepfm_e2e": 300_000,
-}
+def _typical_rates(device_kind: str, path: str | None = None) -> dict:
+    """Per-config "typical" rates for the degraded-window retry,
+    DERIVED from the last committed full artifact (``BENCH_full.json``)
+    rather than hard-coded: constants would encode one chip's one-round
+    behavior, so after a hardware change the 40% threshold would fire
+    always, and after the next data-plane speedup never (VERDICT r4
+    weak #4).  Only history from the SAME device kind counts; with no
+    usable history a config simply gets no retry (the first run on new
+    hardware establishes the history).  E2e configs additionally derive
+    a typical rate from their own run's budget roofline (see
+    ``_e2e_typical``), which needs no history at all."""
+    if path is None:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_full.json"
+        )
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if payload.get("device") != device_kind:
+        return {}
+    out = {}
+    for name, m in (payload.get("models") or {}).items():
+        if not isinstance(m, dict):
+            continue
+        if m.get("link_degraded") or m.get("link_degraded_retry"):
+            # a degraded-window measurement must not become the next
+            # run's "typical" — it would gate the retry at the degraded
+            # level and the detector would never fire again
+            continue
+        rate = m.get("samples_per_sec_per_chip") or m.get(
+            "e2e_samples_per_sec_per_chip"
+        )
+        if rate:
+            out[name] = float(rate)
+    return out
+
+
+def _e2e_typical(result: dict, history_rate: float | None) -> float | None:
+    """Typical rate for an e2e config: the larger of the committed
+    history and THIS run's pipeline roofline (min of the host-decode
+    and device-path floors, measured alongside the e2e window).  An e2e
+    rate under 40% of its own roofline is runtime slack or a degraded
+    window mid-measurement either way — worth one retry."""
+    budget = result.get("budget") or {}
+    roofline = min(
+        budget.get("host_pipeline_records_per_sec") or float("inf"),
+        budget.get("device_path_records_per_sec") or float("inf"),
+    )
+    candidates = [r for r in (history_rate, roofline) if r and r != float("inf")]
+    return max(candidates) if candidates else None
 
 
 def _retry_if_degraded(models, name, measure, rate_key, typical):
@@ -393,6 +436,16 @@ def _measure(name, cfg, mesh):
     return result
 
 
+def _probe_dispatch_secs() -> float:
+    """Fresh-buffer dispatch round-trip, UNCACHED (the link-state stamp
+    for comparing measurement windows): the shared probe behind the
+    auto-k sizing, so the stamps stay comparable to the overhead it
+    measures."""
+    from elasticdl_tpu.trainer.stacking import probe_dispatch_overhead
+
+    return probe_dispatch_overhead(trials=2)
+
+
 def _measure_e2e(
     gen_name,
     model_def,
@@ -482,6 +535,13 @@ def _measure_e2e(
         n_chips = max(1, len(jax.devices()))
         e2e_rate = steady_records / dt / n_chips
 
+        # link-state stamp AROUND the budget windows: the e2e window and
+        # the budget floors are measured minutes apart on a time-shared
+        # link, so a drifting link could skew e2e_vs_roofline either way
+        # — the probes make that drift visible in the artifact instead
+        # of leaving the ratio unexplainable (VERDICT r4 weak #2)
+        probe_before = _probe_dispatch_secs()
+
         # ---- budget: host decode ceiling ------------------------------
         reader = executor._train_reader
         shards = reader.create_shards()
@@ -512,39 +572,49 @@ def _measure_e2e(
         # ---- budget: device-path floor --------------------------------
         # pre-decoded batches through the exact dispatch path the run
         # uses (stack/pad -> place -> stacked dispatch), synced at end:
-        # what the link+chip could sustain if decode were free
+        # what the link+chip could sustain if decode were free.  Each
+        # iteration dispatches a DIFFERENT task's staged batches: the
+        # tunneled link serves re-dispatched (cached) buffers ~10x
+        # faster than fresh ones, so re-dispatching one task 3x — as
+        # this floor did through r4 — overstated the floor and produced
+        # the unexplainable e2e_vs_roofline=0.695 (the e2e path ships
+        # fresh buffers every dispatch; the floor must too).
         from elasticdl_tpu.trainer.stacking import run_stacked_steps
 
         disp2 = TaskDispatcher(
             shards, records_per_task=records_per_task, num_epochs=1
         )
-        _tid, task = disp2.get(0)
-        # run_stacked_steps resolves 'auto' itself from the first batch;
-        # staging mirrors the executor's training pipeline (including
-        # PreStacked dispatch groups) so the floor measures the same path
         k = getattr(executor._args, "steps_per_dispatch", 1) or 1
         trainer = executor._trainer
         from elasticdl_tpu.parallel.mesh import batch_divisor
 
-        staged = list(
-            build_task_batches(
-                reader,
-                task,
-                executor._spec,
-                Modes.TRAINING,
-                reader.metadata,
-                batch,
-                shuffle_records=True,
-                stack_k=k if (k == "auto" or int(k) > 1) else None,
-                stack_divisor=batch_divisor(trainer.mesh),
+        staged_tasks = []
+        for _ in range(3):
+            _tid, task = disp2.get(0)
+            if task is None:
+                break
+            staged_tasks.append(
+                list(
+                    build_task_batches(
+                        reader,
+                        task,
+                        executor._spec,
+                        Modes.TRAINING,
+                        reader.metadata,
+                        batch,
+                        shuffle_records=True,
+                        stack_k=k if (k == "auto" or int(k) > 1) else None,
+                        stack_divisor=batch_divisor(trainer.mesh),
+                    )
+                )
             )
-        )
         dev_records = 0
         t0 = time.perf_counter()
-        for _ in range(3):
+        for staged in staged_tasks:
             dev_records += run_stacked_steps(lambda: trainer, staged, k)
         int(jax.device_get(trainer.state.step))
         dev_rate = dev_records / (time.perf_counter() - t0) / n_chips
+        probe_after = _probe_dispatch_secs()
 
     roofline = min(host_rate, dev_rate)
     return {
@@ -561,6 +631,12 @@ def _measure_e2e(
             # e2e over the overlapped-pipeline roofline: < ~0.85 would
             # mean runtime slack, not a data-plane limit
             "e2e_vs_roofline": round(e2e_rate / roofline, 3),
+            # fresh-buffer dispatch floor before/after the budget
+            # windows; a large shift means the link state moved between
+            # the e2e window and its budget, so the ratio carries
+            # contention skew rather than runtime slack
+            "probe_dispatch_secs_before": round(probe_before, 4),
+            "probe_dispatch_secs_after": round(probe_after, 4),
         },
     }
 
@@ -757,6 +833,114 @@ def _measure_preemption_accuracy():
     return _run_cpu_bench_script("preemption_accuracy_bench.py")
 
 
+# ---- compact artifact ------------------------------------------------------
+
+# the driver records only a ~2000-char TAIL of stdout: r4's single ~4KB
+# JSON line lost its front half — metric/value and every step config —
+# and the canonical artifact recorded `parsed: null` (VERDICT r4 weak
+# #1).  The LAST line is now a compact (<= ~1500B, pinned by
+# tests/test_bench_artifact.py) summary carrying EVERY config's headline
+# numbers and gate verdicts; the full detail goes to BENCH_full.json,
+# which the compact line names in `detail`.
+COMPACT_KEY_LEGEND = {
+    "r": "rate (samples/sec/chip; e2e: through the full data plane)",
+    "med": "median-repetition rate",
+    "sp": "spread_pct (worst vs best repetition)",
+    "mfu": "model flops utilization",
+    "tok": "tokens/sec/chip",
+    "vsb": "vs_baseline (reference TF2 step on host CPU)",
+    "vs": "e2e rate / device-resident step rate at the same batch",
+    "roof": "e2e rate / min(host decode, device path) budget roofline",
+    "bind": "binding budget ceiling: h=host decode, d=device path",
+    "deg": "1 = degraded link window detected (see full detail)",
+    "acc": "[accuracy, 1 if >= threshold]",
+    "s": "seconds",
+    "ok": "1 = gate passed",
+    "err": "1 = config failed (error text in full detail)",
+    "ts_vs_local": "task-stream worker e2e rate / LocalExecutor's (CPU)",
+    "lockstep_vs_local": (
+        "2-process lockstep e2e rate / LocalExecutor's (CPU; "
+        "every-process-reads-every-task decode overhead)"
+    ),
+}
+
+
+def _round_sig(x: float, sig: int = 4) -> float:
+    """Round to ``sig`` significant digits (byte economy in the compact
+    line: 234517.3 -> 234500)."""
+    if not x:
+        return 0
+    import math
+
+    d = sig - 1 - math.floor(math.log10(abs(x)))
+    out = round(x, d)
+    return int(out) if d <= 0 else out
+
+
+def _compact_models(models: dict) -> dict:
+    out = {}
+    for name, m in models.items():
+        if not isinstance(m, dict):
+            continue
+        if "error" in m:
+            out[name] = {"err": 1}
+            continue
+        c = {}
+        if name == "accuracy":
+            for k, v in m.items():
+                if isinstance(v, dict) and "accuracy" in v:
+                    c[k] = [v["accuracy"], int(bool(v.get("pass")))]
+                elif isinstance(v, dict) and "error" in v:
+                    # a failed gate must stay visible in the compact
+                    # artifact — silent truncation is the r4 bug class
+                    c[k] = {"err": 1}
+            out[name] = c
+            continue
+        if name == "elastic_reform":
+            c["s"] = m.get("reform_latency_secs")
+            c["ok"] = int(bool(m.get("records_ok", True)))
+            out[name] = c
+            continue
+        if name == "accuracy_under_preemption":
+            c["acc"] = m.get("accuracy")
+            c["ok"] = int(bool(m.get("pass", m.get("records_ok"))))
+            out[name] = c
+            continue
+        if name == "runtime_ratios":
+            c["ts_vs_local"] = m.get("taskstream_vs_local")
+            c["lockstep_vs_local"] = m.get("lockstep_e2e_vs_local")
+            out[name] = c
+            continue
+        rate = m.get("samples_per_sec_per_chip")
+        if rate is not None:
+            c["r"] = _round_sig(rate)
+        med = m.get("samples_per_sec_per_chip_median")
+        if med is not None:
+            c["med"] = _round_sig(med)
+        if m.get("spread_pct") is not None:
+            c["sp"] = round(m["spread_pct"], 1)
+        if m.get("mfu") is not None:
+            c["mfu"] = round(m["mfu"], 3)
+        if m.get("tokens_per_sec_per_chip") is not None:
+            c["tok"] = _round_sig(m["tokens_per_sec_per_chip"])
+        if m.get("vs_baseline") is not None:
+            c["vsb"] = m["vs_baseline"]
+        e2e = m.get("e2e_samples_per_sec_per_chip")
+        if e2e is not None:
+            c["r"] = _round_sig(e2e)
+        if m.get("vs_step_only") is not None:
+            c["vs"] = m["vs_step_only"]
+        budget = m.get("budget") or {}
+        if budget.get("e2e_vs_roofline") is not None:
+            c["roof"] = budget["e2e_vs_roofline"]
+        if budget.get("binding"):
+            c["bind"] = budget["binding"][0]
+        if m.get("link_degraded") or m.get("link_degraded_retry"):
+            c["deg"] = 1
+        out[name] = c
+    return out
+
+
 def main():
     import jax  # noqa: F401 — device init before timing
 
@@ -780,6 +964,11 @@ def main():
         baselines = payload.get("samples_per_sec", {})
         baseline_batches = payload.get("batch_sizes", {})
 
+    device_kind = getattr(
+        mesh.devices.flatten()[0], "device_kind", "unknown"
+    )
+    typical = _typical_rates(device_kind)
+
     models = {}
     for name, cfg in _configs(max(1, mesh.devices.size)).items():
         try:
@@ -789,7 +978,7 @@ def main():
                 name,
                 lambda: _measure(name, cfg, mesh),
                 "samples_per_sec_per_chip",
-                TYPICAL_RATE.get(name),
+                typical.get(name),
             )
         except Exception as ex:  # noqa: BLE001 — one config must not
             # take down the headline metric (e.g. a flaky remote-compile
@@ -822,7 +1011,7 @@ def main():
                 name,
                 lambda: _measure_e2e(**cfg),
                 "e2e_samples_per_sec_per_chip",
-                TYPICAL_E2E_RATE.get(name),
+                _e2e_typical(models[name], typical.get(name)),
             )
         except Exception as ex:  # noqa: BLE001 — same isolation as above
             print(f"bench config {name} failed: {ex}", file=sys.stderr)
@@ -848,6 +1037,16 @@ def main():
         print(f"bench config elastic_reform failed: {ex}", file=sys.stderr)
         models["elastic_reform"] = {"error": str(ex)[:200]}
 
+    # relative e2e throughput of the three runtimes on host CPU
+    # (taskstream_vs_local: VERDICT r5 #3; lockstep_e2e_vs_local: #8)
+    try:
+        models["runtime_ratios"] = _run_cpu_bench_script(
+            "runtime_ratio_bench.py"
+        )
+    except Exception as ex:  # noqa: BLE001 — same isolation as above
+        print(f"bench runtime_ratios failed: {ex}", file=sys.stderr)
+        models["runtime_ratios"] = {"error": str(ex)[:200]}
+
     if accuracy_mode:
         try:
             models["accuracy_under_preemption"] = (
@@ -863,25 +1062,48 @@ def main():
     # the headline must survive its own config failing (the whole point
     # of the per-config isolation above)
     head = models.get("resnet50_cifar10") or {}
+    full = {
+        "metric": "resnet50_cifar10_train_samples_per_sec_per_chip",
+        "value": head.get("samples_per_sec_per_chip"),
+        "unit": "samples/sec/chip",
+        # null (not 0.0) when no anchor exists — a consumer must
+        # not read "baseline missing" as "infinitely regressed"
+        "vs_baseline": head.get("vs_baseline"),
+        "device": device_kind,
+        "models": models,
+        "compact_key_legend": COMPACT_KEY_LEGEND,
+        "baseline_source": (
+            "benchmarks/baseline.json "
+            "(tf2 GradientTape step, host CPU; "
+            "regenerate: python benchmarks/baseline_tf.py)"
+        ),
+    }
+    full_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_full.json"
+    )
+    try:
+        with open(full_path, "w") as f:
+            json.dump(full, f, indent=1)
+            f.write("\n")
+    except OSError as ex:
+        # a read-only checkout must not cost the run its artifact: the
+        # compact line below needs only in-memory data
+        print(f"bench: could not write {full_path}: {ex}", file=sys.stderr)
+
+    # LAST line: the compact summary — the ONLY line the driver is
+    # guaranteed to capture whole (2000-char stdout tail)
     print(
         json.dumps(
             {
-                "metric": "resnet50_cifar10_train_samples_per_sec_per_chip",
-                "value": head.get("samples_per_sec_per_chip"),
-                "unit": "samples/sec/chip",
-                # null (not 0.0) when no anchor exists — a consumer must
-                # not read "baseline missing" as "infinitely regressed"
-                "vs_baseline": head.get("vs_baseline"),
-                "device": getattr(
-                    mesh.devices.flatten()[0], "device_kind", "unknown"
-                ),
-                "models": models,
-                "baseline_source": (
-                    "benchmarks/baseline.json "
-                    "(tf2 GradientTape step, host CPU; "
-                    "regenerate: python benchmarks/baseline_tf.py)"
-                ),
-            }
+                "metric": full["metric"],
+                "value": full["value"],
+                "unit": full["unit"],
+                "vs_baseline": full["vs_baseline"],
+                "device": device_kind,
+                "detail": "BENCH_full.json",
+                "models": _compact_models(models),
+            },
+            separators=(",", ":"),
         )
     )
 

@@ -26,11 +26,10 @@ Eligibility is probed, not assumed: the first chunk must decode natively
 (uniform schema, wire-format dtypes).  If it doesn't — or the model has
 no ``batch_parse``, or the reader no ``read_record_chunks`` — callers get
 the classic pipeline via :func:`build_task_batches`, the chooser shared
-by the per-task runtimes: LocalExecutor, the lockstep worker, and the
-task-stream worker's eval tasks.  (The task-stream worker's TRAINING
-loop reads a record stream through TaskDataService's per-record
-accounting, which is inherently record-at-a-time; it keeps the classic
-pipeline.)
+by ALL the per-task runtimes: LocalExecutor, the lockstep worker, and
+the task-stream worker (training since r5 — ``worker.py
+_train_task_stream`` — plus its eval/predict task paths; the exactly-
+once accounting takes per-batch counts, so it is pipeline-agnostic).
 
 Shuffle semantics: the classic path streams records through a
 ``shuffle(buffer, seed)`` reservoir; here the same ``batch_shuffle``

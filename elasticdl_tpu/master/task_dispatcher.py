@@ -224,13 +224,28 @@ class TaskDispatcher:
         exec_counters: dict[str, int] | None = None,
     ):
         """Report task completion; failures re-queue the task
-        (reference task_dispatcher.py:260-293)."""
+        (reference task_dispatcher.py:260-293).
+
+        Completing a task also REFRESHES the lease clock of the
+        reporter's other active leases: prefetching workers lease a
+        bounded window of tasks ahead of consumption
+        (``worker/task_data_service.py``), so an ahead-leased task's
+        clock would otherwise run during the whole decode-ahead window
+        and ``task_timeout_secs`` sized for lease-then-train would
+        silently re-queue it (duplicate training).  A report is proof
+        of progress; a worker that stops completing tasks stops
+        refreshing, and its leases still expire.
+        """
         eval_completed = False
         with self._lock:
             assignment = self._active.pop(task_id, None)
             if assignment is None:
                 logger.warning("Unknown or already-reclaimed task id: %d", task_id)
                 return
+            now = time.monotonic()
+            for a in self._active.values():
+                if a.worker_id == assignment.worker_id:
+                    a.leased_at = now
             task = assignment.task
             counters = self._counters.setdefault(task.type, JobCounters())
             if exec_counters:

@@ -77,27 +77,35 @@ _DISPATCH_OVERHEAD: list = [None]
 _DISPATCH_OVERHEAD_LOCK = threading.Lock()
 
 
-def measured_dispatch_overhead() -> float:
+def probe_dispatch_overhead(trials: int = 3) -> float:
     """Seconds per dispatch of a trivial jitted op on FRESH input
-    buffers — the per-dispatch floor stacking amortizes.  Fresh inputs
-    matter: links that cache re-dispatched buffers (the dev tunnel) are
-    an order of magnitude faster on repeated ones.  Measured once per
-    process (~3 round trips), best-of-3 to shed contention."""
-    with _DISPATCH_OVERHEAD_LOCK:
-        if _DISPATCH_OVERHEAD[0] is not None:
-            return _DISPATCH_OVERHEAD[0]
-        import time
+    buffers (best-of-``trials`` to shed contention), UNCACHED — the
+    link-state measurement itself.  Fresh inputs matter: links that
+    cache re-dispatched buffers (the dev tunnel) are an order of
+    magnitude faster on repeated ones.  bench.py uses this directly to
+    stamp the link state around its measurement windows; runtime
+    callers want the cached :func:`measured_dispatch_overhead`."""
+    import time
 
-        f = jax.jit(lambda x: x + 1)
-        jax.device_get(f(np.zeros(256, np.float32)))  # compile
-        best = float("inf")
-        for i in range(3):
-            x = np.full(256, float(i + 1), np.float32)  # fresh buffer
-            t0 = time.perf_counter()
-            jax.device_get(f(x))
-            best = min(best, time.perf_counter() - t0)
-        _DISPATCH_OVERHEAD[0] = best
-        return best
+    f = jax.jit(lambda x: x + 1)
+    jax.device_get(f(np.zeros(256, np.float32)))  # compile
+    best = float("inf")
+    for i in range(trials):
+        x = np.full(256, float(i + 1), np.float32)  # fresh buffer
+        t0 = time.perf_counter()
+        jax.device_get(f(x))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def measured_dispatch_overhead() -> float:
+    """Cached-per-process :func:`probe_dispatch_overhead` — the
+    per-dispatch floor the auto-k sizing amortizes (~3 round trips,
+    measured once)."""
+    with _DISPATCH_OVERHEAD_LOCK:
+        if _DISPATCH_OVERHEAD[0] is None:
+            _DISPATCH_OVERHEAD[0] = probe_dispatch_overhead()
+        return _DISPATCH_OVERHEAD[0]
 
 
 def auto_steps_per_dispatch(

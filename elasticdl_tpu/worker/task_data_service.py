@@ -53,6 +53,7 @@ class TaskDataService:
         self._reported_record_count = 0
         self._current_task = None
         self._pending_tasks: deque = deque()
+        self._last_poll_was_wait = False
 
     def _reset(self):
         self._reported_record_count = 0
@@ -172,6 +173,72 @@ class TaskDataService:
             for data in self.data_reader.read_records(task):
                 if data is not None:
                     yield data
+
+    # ---- per-task fast-path stream (training) ------------------------------
+
+    def start_training_stream(self):
+        """Main-thread entry for the worker's vectorized training loop:
+        poll the master until a TRAINING task arrives, handling WAIT by
+        invoking ``worker.on_wait`` (eval drain — main-thread-only work)
+        and sleeping, exactly like :meth:`get_dataset`'s warm-up loop.
+        Returns the first task — leased AND registered for exactly-once
+        accounting — or ``None`` when the job is complete or a
+        SAVE_MODEL task arrived (stashed; caller processes it).
+
+        The first time through, one record of the first task is read so
+        ``data_reader.metadata`` is populated before any pipeline runs
+        (reference :156-172's warm-up).
+        """
+        while True:
+            _tid, task = self.lease_training_task()
+            if task is not None:
+                if not self._has_warmed_up:
+                    for _ in self.data_reader.read_records(task):
+                        break
+                    self._has_warmed_up = True
+                return task
+            if self._pending_save_model_task is not None:
+                return None
+            if not self._last_poll_was_wait:
+                logger.info("No more tasks, stopping")
+                return None
+            on_wait = getattr(self._worker, "on_wait", None)
+            if on_wait is not None:
+                on_wait()
+            time.sleep(self._wait_sleep_secs)
+
+    def lease_training_task(self):
+        """Lease the next TRAINING task and register it for exactly-once
+        accounting; safe to call from a prefetcher's producer thread
+        (never sleeps, never calls back into the worker).  Returns
+        ``(task_id, task)``, or ``(None, None)`` when the stream pauses —
+        job complete, WAIT (``_last_poll_was_wait`` distinguishes; only
+        :meth:`start_training_stream` reads it, after the stream drains),
+        or a SAVE_MODEL task (stashed for the main thread).
+
+        Tasks are registered in lease order, which with a single
+        producer is also batch-stream order, so :meth:`report_record_done`
+        pops them exactly as the classic straddling stream did.
+        Ahead-leasing is safe under dispatcher lease timeouts
+        (``task_timeout_secs``): every task report refreshes the
+        reporter's other leases (``TaskDispatcher.report``), so an
+        ahead-leased task only expires if this worker stops completing
+        tasks altogether.
+        """
+        task = self._worker.get_task()
+        if not task.shard_name:
+            self._last_poll_was_wait = task.is_wait
+            return None, None
+        if task.type == int(TaskType.SAVE_MODEL):
+            with self._lock:
+                self._pending_save_model_task = task
+            self._last_poll_was_wait = True  # stream pauses, job not done
+            return None, None
+        with self._lock:
+            self._pending_tasks.append(task)
+            if len(self._pending_tasks) == 1:
+                self._current_task = task
+        return task.task_id, task
 
     def get_save_model_task_and_dataset(self):
         if not self._pending_save_model_task:

@@ -295,6 +295,32 @@ class TestMessages:
         np.testing.assert_array_equal(out.labels.values, [0, 1, 2, 3])
 
 
+def test_lease_refresh_on_report_protects_ahead_leases():
+    """Prefetching workers lease tasks ahead of consumption; a task
+    report refreshes the reporter's other leases (progress proof), so
+    ahead-leased tasks survive ``task_timeout_secs`` sized for
+    lease-then-train — while a worker that stops reporting still loses
+    its leases to the reclaim."""
+    disp = TaskDispatcher(
+        {"s0": (0, 64)},
+        records_per_task=16,
+        num_epochs=1,
+        task_timeout_secs=2.0,
+    )
+    t1, _ = disp.get(0)
+    t2, _ = disp.get(0)  # leased ahead by the prefetcher
+    time.sleep(1.2)
+    disp.report(t1, True)  # progress: refreshes t2's lease clock
+    time.sleep(1.2)  # t2 now 2.4s old by lease, 1.2s by refresh
+    t3, _ = disp.get(0)  # get() runs the reclaim
+    assert t3 not in (t1, t2)  # t2 was NOT re-queued and re-served
+    assert disp.is_active(t2)
+    # no more reports: both remaining leases expire for real
+    time.sleep(2.2)
+    disp.get(0)
+    assert not disp.is_active(t2)
+
+
 class TestServicerConcurrency:
     """The reference serves RPCs from a 64-thread gRPC pool
     (master.py:301-324); every dispatcher/servicer mutation is guarded by
