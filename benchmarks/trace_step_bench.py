@@ -35,17 +35,24 @@ _ATTN_CATEGORIES = ("custom-call", "custom call", "fusion.custom")
 
 
 def main() -> int:
-    argv = [a for a in sys.argv[1:] if not a.startswith("--")]
+    args = sys.argv[1:]
     steps = 3
-    for i, a in enumerate(sys.argv[1:]):
+    positional = []
+    i = 0
+    while i < len(args):
+        a = args[i]
         if a == "--steps":
-            steps = int(sys.argv[1:][i + 1])
+            i += 1
+            steps = int(args[i])
         elif a.startswith("--steps="):
             steps = int(a.split("=", 1)[1])
-    if not argv:
+        elif not a.startswith("--"):
+            positional.append(a)
+        i += 1
+    if not positional:
         print(__doc__)
         return 1
-    name = argv[0]
+    name = positional[0]
 
     import jax
 

@@ -174,23 +174,24 @@ class TaskDataService:
                 if data is not None:
                     yield data
 
-    # ---- per-task fast-path stream (training) ------------------------------
+    # ---- per-task fast-path stream (training / prediction) -----------------
 
-    def start_training_stream(self):
-        """Main-thread entry for the worker's vectorized training loop:
-        poll the master until a TRAINING task arrives, handling WAIT by
-        invoking ``worker.on_wait`` (eval drain — main-thread-only work)
-        and sleeping, exactly like :meth:`get_dataset`'s warm-up loop.
-        Returns the first task — leased AND registered for exactly-once
-        accounting — or ``None`` when the job is complete or a
-        SAVE_MODEL task arrived (stashed; caller processes it).
+    def start_task_stream(self):
+        """Main-thread entry for the worker's vectorized per-task loops
+        (training and prediction): poll the master until a data task
+        arrives, handling WAIT by invoking ``worker.on_wait`` (eval
+        drain — main-thread-only work) and sleeping, exactly like
+        :meth:`get_dataset`'s warm-up loop.  Returns the first task —
+        leased AND registered for exactly-once accounting — or ``None``
+        when the job is complete or a SAVE_MODEL task arrived (stashed;
+        caller processes it).
 
         The first time through, one record of the first task is read so
         ``data_reader.metadata`` is populated before any pipeline runs
         (reference :156-172's warm-up).
         """
         while True:
-            _tid, task = self.lease_training_task()
+            _tid, task = self.lease_task()
             if task is not None:
                 if not self._has_warmed_up:
                     for _ in self.data_reader.read_records(task):
@@ -207,14 +208,16 @@ class TaskDataService:
                 on_wait()
             time.sleep(self._wait_sleep_secs)
 
-    def lease_training_task(self):
-        """Lease the next TRAINING task and register it for exactly-once
+    def lease_task(self):
+        """Lease the next data task (training or prediction, whichever
+        queue this job runs) and register it for exactly-once
         accounting; safe to call from a prefetcher's producer thread
         (never sleeps, never calls back into the worker).  Returns
         ``(task_id, task)``, or ``(None, None)`` when the stream pauses —
         job complete, WAIT (``_last_poll_was_wait`` distinguishes; only
-        :meth:`start_training_stream` reads it, after the stream drains),
-        or a SAVE_MODEL task (stashed for the main thread).
+        :meth:`start_task_stream` reads it, on the main thread after the
+        stream drains), or a SAVE_MODEL task (stashed for the main
+        thread).
 
         Tasks are registered in lease order, which with a single
         producer is also batch-stream order, so :meth:`report_record_done`

@@ -28,7 +28,6 @@ import traceback
 import jax
 import numpy as np
 
-from elasticdl_tpu.data.dataset import batched_model_pipeline
 from elasticdl_tpu.parallel.distributed import SPMDTrainer, trim_pad
 from elasticdl_tpu.parallel.mesh import MeshConfig
 from elasticdl_tpu.rpc import messages as msg
@@ -284,7 +283,7 @@ class Worker:
         """
         tds = self._task_data_service
         while True:
-            first = tds.start_training_stream()
+            first = tds.start_task_stream()
             if first is None:
                 # job finished or final SAVE_MODEL arrived
                 # (reference worker.py:969-971)
@@ -313,22 +312,13 @@ class Worker:
         and relaunches within its ``--relaunch_on_worker_failure``
         budget — the lockstep runtime's crash-on-error policy
         (DEVIATIONS.md #3) applied to data corruption."""
-        from elasticdl_tpu.trainer.host_pipeline import TaskPrefetcher
         from elasticdl_tpu.trainer.stacking import MAX_AUTO_K, PreStacked
 
         tds = self._task_data_service
         k = getattr(self._args, "steps_per_dispatch", 1) or 1
         k_bound = MAX_AUTO_K if k == "auto" else int(k)
-        served = [first_task]
-
-        def next_task():
-            if served:
-                task = served.pop()
-                return task.task_id, task
-            return tds.lease_training_task()
-
-        prefetcher = TaskPrefetcher(
-            next_task,
+        prefetcher = self._task_prefetcher(
+            first_task,
             self._task_batches,
             max_buffered_batches=max(4, 2 * k_bound),
         )
@@ -365,6 +355,23 @@ class Worker:
         finally:
             prefetcher.close()
         return total
+
+    def _task_prefetcher(self, first_task, make_batches, **kwargs):
+        """The shared stream scaffolding for the per-task loops
+        (training and prediction): serve the already-leased first task,
+        then let the producer thread lease the rest."""
+        from elasticdl_tpu.trainer.host_pipeline import TaskPrefetcher
+
+        tds = self._task_data_service
+        served = [first_task]
+
+        def next_task():
+            if served:
+                task = served.pop()
+                return task.task_id, task
+            return tds.lease_task()
+
+        return TaskPrefetcher(next_task, make_batches, **kwargs)
 
     def _task_batches(self, task):
         """One task's minibatch stream on the shared fast/classic
@@ -476,29 +483,39 @@ class Worker:
         self.report_task_result(task.task_id, err)
 
     def _predict_only(self):
+        """Prediction on the same vectorized per-task plane as training:
+        ``build_task_batches`` (the fast/classic chooser disables
+        stacking for prediction-shaped parses) with the ``TaskPrefetcher``
+        decoding the next task while the device runs."""
+        from elasticdl_tpu.data.fast_pipeline import build_task_batches
+
+        tds = self._task_data_service
+        reader = tds.data_reader
         while True:
-            dataset = self._task_data_service.get_dataset()
-            if dataset is None:
+            first = tds.start_task_stream()
+            if first is None:
                 break
-            dataset = batched_model_pipeline(
-                dataset,
-                self._spec,
-                Modes.PREDICTION,
-                self._task_data_service.data_reader.metadata,
-                self._minibatch_size,
-                prefetch=2,
+            prefetcher = self._task_prefetcher(
+                first,
+                lambda task: build_task_batches(
+                    reader,
+                    task,
+                    self._spec,
+                    Modes.PREDICTION,
+                    reader.metadata,
+                    self._minibatch_size,
+                    prefetch=0,
+                ),
             )
-            for features in dataset:
-                task = self._task_data_service.get_current_task()
-                err = self._process_minibatch(
-                    task.type if task else int(TaskType.PREDICTION),
-                    features,
-                    None,
-                )
-                self._task_data_service.report_record_done(
-                    _batch_len(features), err
-                )
-            del dataset
+            try:
+                for _tid, task, batches in prefetcher:
+                    for features in batches:
+                        err = self._process_minibatch(
+                            task.type, features, None
+                        )
+                        tds.report_record_done(_batch_len(features), err)
+            finally:
+                prefetcher.close()
 
     def _process_save_model_task_if_needed(self) -> bool:
         task, _ = self._task_data_service.get_save_model_task_and_dataset()
