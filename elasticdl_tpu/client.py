@@ -42,6 +42,19 @@ def main(argv=None) -> int:
     if command == "clean":
         result = api.clean(_parse_clean_args(rest))
     else:
+        # make JAX_PLATFORMS authoritative BEFORE any backend
+        # initializes: platform plugins may register and initialize
+        # regardless of the env var (a tunneled TPU plugin does — and
+        # when its link is down, that initialization HANGS a job that
+        # asked for cpu).  --jax_platform still overrides later via the
+        # same configure_platform call.  Gated to the compute commands
+        # so clean/--help stay jax-free.
+        import os
+
+        if os.environ.get("JAX_PLATFORMS"):
+            from elasticdl_tpu.parallel.elastic import configure_platform
+
+            configure_platform(os.environ["JAX_PLATFORMS"])
         args = parse_master_args(rest)
         result = getattr(api, command)(args)
     if result:
