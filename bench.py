@@ -522,6 +522,7 @@ def _measure_e2e(
             "--num_epochs",
             "1",
         ] + list(extra_argv)
+        probe_e2e_start = _probe_dispatch_secs()
         executor = _TimedExecutor(parse_master_args(argv))
         executor.run()
 
@@ -535,11 +536,12 @@ def _measure_e2e(
         n_chips = max(1, len(jax.devices()))
         e2e_rate = steady_records / dt / n_chips
 
-        # link-state stamp AROUND the budget windows: the e2e window and
-        # the budget floors are measured minutes apart on a time-shared
-        # link, so a drifting link could skew e2e_vs_roofline either way
-        # — the probes make that drift visible in the artifact instead
-        # of leaving the ratio unexplainable (VERDICT r4 weak #2)
+        # link-state stamp at the budget windows' start (a third was
+        # taken before the e2e window): the e2e window and the budget
+        # floors are measured minutes apart on a time-shared link, so a
+        # drifting link could skew e2e_vs_roofline either way — the
+        # probes make that drift visible in the artifact instead of
+        # leaving the ratio unexplainable (VERDICT r4 weak #2)
         probe_before = _probe_dispatch_secs()
 
         # ---- budget: host decode ceiling ------------------------------
@@ -631,10 +633,11 @@ def _measure_e2e(
             # e2e over the overlapped-pipeline roofline: < ~0.85 would
             # mean runtime slack, not a data-plane limit
             "e2e_vs_roofline": round(e2e_rate / roofline, 3),
-            # fresh-buffer dispatch floor before/after the budget
-            # windows; a large shift means the link state moved between
-            # the e2e window and its budget, so the ratio carries
-            # contention skew rather than runtime slack
+            # fresh-buffer dispatch floor at e2e start / budget start /
+            # budget end; a large shift means the link state moved
+            # between the e2e window and its budget, so the ratio
+            # carries contention skew rather than runtime slack
+            "probe_dispatch_secs_e2e_start": round(probe_e2e_start, 4),
             "probe_dispatch_secs_before": round(probe_before, 4),
             "probe_dispatch_secs_after": round(probe_after, 4),
         },

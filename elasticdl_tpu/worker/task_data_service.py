@@ -1,17 +1,23 @@
-"""Task-queue -> dataset bridge with exactly-once task accounting.
+"""Task-lease stream with exactly-once task accounting.
 
-Reference: ``elasticdl/python/worker/task_data_service.py`` — the dataset
-generator pulls tasks from the master *inside* iteration, so one
-continuous record stream spans many tasks, and batches may straddle task
+Reference: ``elasticdl/python/worker/task_data_service.py`` — there, a
+dataset generator pulls tasks from the master *inside* iteration, so one
+continuous record stream spans many tasks and batches may straddle task
 boundaries.  ``report_record_done`` keeps the cumulative processed-record
 count and pops+reports every pending task the count has covered
 (``task_data_service.py:75-107``), which is what guarantees each task is
-reported exactly once no matter how batch size divides task size.
+reported exactly once no matter how batch size divides task size.  That
+count-based accounting is kept bit-for-bit (SURVEY §7 hard-part 4); the
+record stream itself is replaced by the per-task lease methods below
+(``start_task_stream``/``lease_task``), which feed the vectorized
+per-task pipelines — the accounting takes counts, not records, so it is
+pipeline-agnostic (and still handles counts that straddle tasks).
 
-Deviation: the reference adds a fixed ``minibatch_size`` per batch even
-for the final short batch; this build adds the batch's *actual* length, so
-the cumulative count equals records truly processed (same pop behavior,
-tighter bookkeeping).
+Deviations: (1) the reference adds a fixed ``minibatch_size`` per batch
+even for the final short batch; this build adds the batch's *actual*
+length, so the cumulative count equals records truly processed (same pop
+behavior, tighter bookkeeping).  (2) Batches are built per task, not
+across tasks (DEVIATIONS.md #6).
 """
 
 from __future__ import annotations
@@ -45,21 +51,13 @@ class TaskDataService:
         params = dict(data_reader_params or {})
         self.data_reader = create(data_origin=data_origin, **params)
         self._lock = threading.Lock()
-        self._pending_dataset = True
         self._pending_save_model_task = None
-        self._warm_up_task = None
         self._has_warmed_up = False
         self._failed_record_count = 0
         self._reported_record_count = 0
         self._current_task = None
         self._pending_tasks: deque = deque()
         self._last_poll_was_wait = False
-
-    def _reset(self):
-        self._reported_record_count = 0
-        self._failed_record_count = 0
-        self._pending_tasks = deque()
-        self._current_task = None
 
     def get_current_task(self):
         return self._current_task
@@ -110,78 +108,14 @@ class TaskDataService:
             task.task_id, err_msg, exec_counters=counters, include_timing=True
         )
 
-    # ---- dataset construction ---------------------------------------------
-
-    def get_dataset(self) -> Dataset | None:
-        """A dataset spanning all tasks the master will serve, or None when
-        the job is done / a SAVE_MODEL task arrived / WAIT cleared."""
-        if not self._pending_dataset:
-            return None
-        if self._pending_tasks:
-            logger.error("Cannot get new dataset with pending tasks")
-            return None
-        self._reset()
-        # warm-up: fetch one task and touch the reader so metadata is
-        # available before dataset_fn runs (reference :156-172)
-        if self._warm_up_task is None and not self._has_warmed_up:
-            while True:
-                task = self._worker.get_task()
-                if not task.is_wait:
-                    break
-                # WAIT may mean "only eval tasks remain" — let the worker
-                # drain them instead of deadlocking on the training queue
-                on_wait = getattr(self._worker, "on_wait", None)
-                if on_wait is not None:
-                    on_wait()
-                time.sleep(self._wait_sleep_secs)
-            if task.type == int(TaskType.SAVE_MODEL):
-                self._pending_save_model_task = task
-                return None
-            if not task.shard_name:
-                logger.info("No more tasks, stopping")
-                return None
-            self._warm_up_task = task
-            for _ in self.data_reader.read_records(task):
-                break
-            self._has_warmed_up = True
-        self._pending_dataset = False
-        return Dataset.from_generator(self._gen)
-
-    def _gen(self):
-        while True:
-            if self._warm_up_task is not None and self._has_warmed_up:
-                task = self._warm_up_task
-                self._warm_up_task = None
-            else:
-                task = self._worker.get_task()
-            if not task.shard_name:
-                if task.is_wait:
-                    # more tasks may appear (e.g. eval) — caller should
-                    # call get_dataset() again
-                    self._pending_dataset = True
-                    logger.info("No tasks for now, maybe more later")
-                else:
-                    logger.info("No more tasks, stopping")
-                break
-            with self._lock:
-                if task.type == int(TaskType.SAVE_MODEL):
-                    self._pending_save_model_task = task
-                    continue
-                self._pending_tasks.append(task)
-                if len(self._pending_tasks) == 1:
-                    self._current_task = task
-            for data in self.data_reader.read_records(task):
-                if data is not None:
-                    yield data
-
     # ---- per-task fast-path stream (training / prediction) -----------------
 
     def start_task_stream(self):
         """Main-thread entry for the worker's vectorized per-task loops
         (training and prediction): poll the master until a data task
         arrives, handling WAIT by invoking ``worker.on_wait`` (eval
-        drain — main-thread-only work) and sleeping, exactly like
-        :meth:`get_dataset`'s warm-up loop.  Returns the first task —
+        drain — main-thread-only work) and sleeping (reference
+        ``:156-172``'s warm-up loop).  Returns the first task —
         leased AND registered for exactly-once accounting — or ``None``
         when the job is complete or a SAVE_MODEL task arrived (stashed;
         caller processes it).

@@ -60,6 +60,7 @@ def _fully_populated_models():
             "device_path_records_per_sec": 282000,
             "binding": "device_path",
             "e2e_vs_roofline": 0.831,
+            "probe_dispatch_secs_e2e_start": 0.2468,
             "probe_dispatch_secs_before": 0.2471,
             "probe_dispatch_secs_after": 0.2513,
         },

@@ -55,50 +55,63 @@ class _CountingReader:
             yield i
 
 
+def _wire_tds(scripted):
+    """Hand-wire a TaskDataService (no reader-factory I/O)."""
+    import threading
+    from collections import deque
+
+    tds = TaskDataService.__new__(TaskDataService)
+    tds._worker = scripted
+    tds._training_with_evaluation = False
+    tds._wait_sleep_secs = 0
+    tds.data_reader = _CountingReader()
+    tds._lock = threading.Lock()
+    tds._pending_save_model_task = None
+    tds._has_warmed_up = True  # skip warm-up (no factory reader)
+    tds._failed_record_count = 0
+    tds._reported_record_count = 0
+    tds._current_task = None
+    tds._pending_tasks = deque()
+    tds._last_poll_was_wait = False
+    return tds
+
+
 @pytest.mark.parametrize(
     "task_sizes,batch",
     [
-        ([10, 10, 10], 4),   # batch straddles task boundaries
-        ([3, 3, 3], 7),      # batch bigger than a whole task
+        ([10, 10, 10], 4),   # counts straddle task boundaries
+        ([3, 3, 3], 7),      # one count covers several whole tasks
         ([8], 8),            # exact fit
         ([5, 2, 9], 6),      # mixed
     ],
 )
-def test_exactly_once_task_accounting(task_sizes, batch, monkeypatch):
+def test_exactly_once_task_accounting(task_sizes, batch):
+    """The count-based pop-while accounting (reference
+    task_data_service.py:75-107) is pipeline-agnostic: tasks registered
+    via the live lease API, counts reported in arbitrary groupings —
+    including groupings that straddle or span whole tasks — must report
+    each task exactly once, in order."""
     starts = np.cumsum([0] + task_sizes[:-1])
     tasks = [
         _task(i + 1, int(s), int(s) + n)
         for i, (s, n) in enumerate(zip(starts, task_sizes))
     ]
     scripted = _ScriptedWorker(tasks)
-    tds = TaskDataService.__new__(TaskDataService)
-    # wire by hand (no reader factory I/O)
-    import threading
-    from collections import deque
+    tds = _wire_tds(scripted)
 
-    tds._worker = scripted
-    tds._training_with_evaluation = False
-    tds._wait_sleep_secs = 0
-    tds.data_reader = _CountingReader()
-    tds._lock = threading.Lock()
-    tds._pending_dataset = True
-    tds._pending_save_model_task = None
-    tds._warm_up_task = None
-    tds._has_warmed_up = True  # skip warm-up (no factory reader)
-    tds._failed_record_count = 0
-    tds._reported_record_count = 0
-    tds._current_task = None
-    tds._pending_tasks = deque()
+    leased = []
+    while True:
+        _tid, task = tds.lease_task()
+        if task is None:
+            break
+        leased.append(task)
+    assert [t.task_id for t in leased] == [t.task_id for t in tasks]
 
-    ds = tds.get_dataset()
-    buf = []
-    for rec in ds:
-        buf.append(rec)
-        if len(buf) == batch:
-            tds.report_record_done(len(buf))
-            buf = []
-    if buf:
-        tds.report_record_done(len(buf))
+    total = sum(task_sizes)
+    for _ in range(total // batch):
+        tds.report_record_done(batch)
+    if total % batch:
+        tds.report_record_done(total % batch)
 
     reported_ids = [r[0] for r in scripted.reported]
     assert reported_ids == [t.task_id for t in tasks]  # each exactly once
