@@ -300,7 +300,7 @@ def build_task_batches(
                 "for all workers."
             )
 
-    def classic() -> Dataset:
+    def classic(prefetch_n: int = prefetch) -> Dataset:
         return batched_model_pipeline(
             Dataset.from_generator(lambda: reader.read_records(task)),
             spec,
@@ -308,7 +308,7 @@ def build_task_batches(
             metadata,
             batch_size,
             shuffle_records=shuffle_records,
-            prefetch=prefetch,
+            prefetch=prefetch_n,
         )
 
     if batch_parse is None or chunk_reader is None:
@@ -330,8 +330,10 @@ def build_task_batches(
             first = next(fast)
         except (FallbackNeeded, StopIteration):
             # probe failed (or empty task): identical record stream via
-            # the classic path; nothing has been yielded yet
-            yield from classic()
+            # the classic path; nothing has been yielded yet.  The
+            # OUTER wrapper below already prefetches — an inner layer
+            # here would double-buffer and spawn a second thread
+            yield from classic(prefetch_n=0)
             return
         yield first
         yield from fast

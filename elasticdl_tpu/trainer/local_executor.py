@@ -131,11 +131,12 @@ class LocalExecutor:
         # dispatch groups (zero-copy reshapes built on the producer
         # thread) when --steps_per_dispatch > 1 — the per-batch group
         # assembly otherwise costs ~1-2ms x k on the consumer thread.
-        stack_k = None
-        if mode == Modes.TRAINING:
-            k = getattr(self._args, "steps_per_dispatch", 1) or 1
-            if k == "auto" or (isinstance(k, int) and k > 1):
-                stack_k = k
+        from elasticdl_tpu.trainer.stacking import choose_stack_k
+
+        stack_k = choose_stack_k(
+            getattr(self._args, "steps_per_dispatch", 1),
+            mode == Modes.TRAINING,
+        )
         from elasticdl_tpu.parallel.mesh import batch_divisor
 
         return build_task_batches(

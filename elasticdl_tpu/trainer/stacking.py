@@ -124,6 +124,25 @@ def auto_steps_per_dispatch(
     return max(1, min(MAX_AUTO_K, TRANSFER_CLIFF_BYTES // batch_bytes))
 
 
+def choose_stack_k(steps_per_dispatch, training: bool, allow_auto: bool = True):
+    """THE stack_k selection rule for ``build_task_batches`` callers —
+    one definition instead of one per runtime.
+
+    Returns ``None`` (no pipeline-side stacking) outside training, for
+    k <= 1, and for ``'auto'`` when ``allow_auto=False`` — lockstep
+    worlds set that: the pipeline's auto sizing probes per-process wall
+    clock, and a k disagreement between processes would compile
+    different stacked programs and deadlock the collectives (their
+    plain-batch path re-sizes deterministically inside
+    ``run_stacked_steps`` instead)."""
+    if not training:
+        return None
+    k = steps_per_dispatch or 1
+    if k == "auto":
+        return "auto" if allow_auto else None
+    return k if isinstance(k, int) and k > 1 else None
+
+
 def resolve_steps_per_dispatch(
     k, sample_batch=None, deterministic: bool = False
 ) -> int:

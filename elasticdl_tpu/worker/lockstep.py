@@ -201,7 +201,25 @@ class LockstepWorker:
         available; its permutation shuffle is a pure function of (module
         seed policy, task), so every process computes the same batch
         stream and the lockstep schedule agreement is preserved on
-        either path (batch count is identical by construction)."""
+        either path (batch count is identical by construction).
+
+        An EXPLICIT ``--steps_per_dispatch k`` additionally emits
+        zero-copy PreStacked dispatch groups from the decode window
+        (pure function of task data + k — identical everywhere, so the
+        world agrees on every dispatch shape), skipping the per-batch
+        pad/stack assembly run_stacked_steps would otherwise do on the
+        training thread.  ``allow_auto=False``: see
+        :func:`~elasticdl_tpu.trainer.stacking.choose_stack_k` — a
+        per-process auto probe could deadlock the world."""
+        from elasticdl_tpu.parallel.mesh import batch_divisor
+        from elasticdl_tpu.trainer.stacking import choose_stack_k
+
+        stack_k = choose_stack_k(
+            getattr(self._args, "steps_per_dispatch", 1),
+            mode == Modes.TRAINING,
+            allow_auto=False,
+        )
+
         return build_task_batches(
             self._reader,
             task,
@@ -215,6 +233,8 @@ class LockstepWorker:
             # its peers vectorize (the probe half of the choice is
             # data-driven and therefore already identical everywhere)
             require_deterministic_choice=True,
+            stack_k=stack_k,
+            stack_divisor=batch_divisor(self._mesh),
         )
 
     def _place(self, tree):
@@ -343,8 +363,17 @@ class LockstepWorker:
         with self._crash_on_error(task):
             if self._trainer is None:
                 # export requested with no training step run (restart after
-                # training drained): initialize from one example batch
-                for features, _ in self._task_batches(task, Modes.TRAINING):
+                # training drained): initialize from one example batch —
+                # which with explicit --steps_per_dispatch arrives as a
+                # PreStacked group, not a (features, labels) pair
+                from elasticdl_tpu.trainer.stacking import PreStacked
+
+                for item in self._task_batches(task, Modes.TRAINING):
+                    features = (
+                        item.sample_features
+                        if isinstance(item, PreStacked)
+                        else item[0]
+                    )
                     self._ensure_trainer(features)
                     break
             if self._trainer is None:

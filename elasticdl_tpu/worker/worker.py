@@ -379,10 +379,12 @@ class Worker:
         asks for them (prefetch=0: the TaskPrefetcher IS the overlap)."""
         from elasticdl_tpu.data.fast_pipeline import build_task_batches
         from elasticdl_tpu.parallel.mesh import batch_divisor
+        from elasticdl_tpu.trainer.stacking import choose_stack_k
 
         reader = self._task_data_service.data_reader
-        k = getattr(self._args, "steps_per_dispatch", 1) or 1
-        stack_k = k if (k == "auto" or (isinstance(k, int) and k > 1)) else None
+        stack_k = choose_stack_k(
+            getattr(self._args, "steps_per_dispatch", 1), training=True
+        )
         return build_task_batches(
             reader,
             task,
@@ -452,6 +454,10 @@ class Worker:
             Modes.EVALUATION,
             reader.metadata,
             self._minibatch_size,
+            # eval consumes on the main thread (no TaskPrefetcher):
+            # in-dataset prefetch supplies the decode/compute overlap,
+            # matching LocalExecutor's eval path
+            prefetch=2,
         )
         err = ""
         all_outputs, all_labels = [], []

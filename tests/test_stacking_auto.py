@@ -40,6 +40,20 @@ def test_auto_k_pins_the_sizing_rule():
     )
 
 
+def test_choose_stack_k_shared_rule():
+    """THE stack_k selection rule the three runtimes share: stacking
+    only in training and only for k>1; 'auto' passes through except in
+    lockstep worlds (allow_auto=False — a per-process auto probe could
+    deadlock the collectives)."""
+    assert stacking.choose_stack_k(4, training=True) == 4
+    assert stacking.choose_stack_k("auto", training=True) == "auto"
+    assert stacking.choose_stack_k("auto", True, allow_auto=False) is None
+    assert stacking.choose_stack_k(4, training=False) is None
+    assert stacking.choose_stack_k(1, training=True) is None
+    assert stacking.choose_stack_k(None, training=True) is None
+    assert stacking.choose_stack_k(0, training=True) is None
+
+
 def test_resolve_explicit_k_passthrough():
     assert stacking.resolve_steps_per_dispatch(4) == 4
     assert stacking.resolve_steps_per_dispatch(None) == 1
