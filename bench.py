@@ -944,7 +944,68 @@ def _compact_models(models: dict) -> dict:
     return out
 
 
+def _device_preflight(timeout_secs: float = 240.0, probe_argv=None):
+    """Probe device init in a SUBPROCESS before anything else: the
+    tunneled dev TPU can go down such that backend init HANGS rather
+    than erroring (observed: ``jax.devices()`` blocked indefinitely for
+    hours), and a hung bench leaves the driver with NO artifact at all.
+    Returns None when the device answers; an error string otherwise —
+    main() then emits a parseable compact line carrying the error
+    instead of hanging.  ``EDL_BENCH_PREFLIGHT_SECS=0`` disables."""
+    import subprocess
+
+    env_secs = os.environ.get("EDL_BENCH_PREFLIGHT_SECS")
+    if env_secs is not None:
+        try:
+            timeout_secs = float(env_secs)
+        except ValueError:
+            # a malformed override must not cost the run its artifact
+            print(
+                f"bench: ignoring malformed EDL_BENCH_PREFLIGHT_SECS="
+                f"{env_secs!r}",
+                file=sys.stderr,
+            )
+    if timeout_secs <= 0:
+        return None
+    argv = probe_argv or [
+        sys.executable,
+        "-c",
+        "import jax; print(jax.devices()[0].device_kind)",
+    ]
+    try:
+        proc = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout_secs
+        )
+    except subprocess.TimeoutExpired:
+        return (
+            f"device init did not answer within {timeout_secs:.0f}s "
+            "(tunnel down?)"
+        )
+    if proc.returncode != 0:
+        return f"device init failed: {proc.stderr.strip()[-160:]}"
+    return None
+
+
 def main():
+    preflight_error = _device_preflight()
+    if preflight_error is not None:
+        print(f"bench: {preflight_error}", file=sys.stderr)
+        print(
+            json.dumps(
+                {
+                    "metric": (
+                        "resnet50_cifar10_train_samples_per_sec_per_chip"
+                    ),
+                    "value": None,
+                    "unit": "samples/sec/chip",
+                    "vs_baseline": None,
+                    "error": preflight_error,
+                },
+                separators=(",", ":"),
+            )
+        )
+        return
+
     import jax  # noqa: F401 — device init before timing
 
     from elasticdl_tpu.parallel.mesh import MeshConfig

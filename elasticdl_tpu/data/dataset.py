@@ -141,25 +141,44 @@ class Dataset:
             q: queue.Queue = queue.Queue(maxsize=buffer_size)
             _END = object()
             error: list = []
+            # consumers may abandon the iterator mid-stream (an eval
+            # loop breaking on error, a `take`, a GC'd generator): the
+            # producer must notice and exit, or it blocks in q.put
+            # forever and leaks a thread + its buffered batches per
+            # abandoned stream
+            closed = threading.Event()
+
+            def _put_while_open(item) -> bool:
+                while not closed.is_set():
+                    try:
+                        q.put(item, timeout=0.2)
+                        return True
+                    except queue.Full:
+                        continue
+                return False
 
             def producer():
                 try:
                     for x in parent():
-                        q.put(x)
+                        if not _put_while_open(x):
+                            return
                 except BaseException as e:  # noqa: BLE001 - re-raised below
                     error.append(e)
                 finally:
-                    q.put(_END)
+                    _put_while_open(_END)
 
             t = threading.Thread(target=producer, daemon=True)
             t.start()
-            while True:
-                x = q.get()
-                if x is _END:
-                    if error:
-                        raise error[0]
-                    return
-                yield x
+            try:
+                while True:
+                    x = q.get()
+                    if x is _END:
+                        if error:
+                            raise error[0]
+                        return
+                    yield x
+            finally:
+                closed.set()
 
         return Dataset(gen)
 

@@ -243,6 +243,49 @@ def test_e2e_typical_prefers_in_run_roofline(bench):
     assert bench._e2e_typical({}, None) is None
 
 
+def test_device_preflight_detects_hang_and_failure(bench, monkeypatch):
+    """A hung TPU tunnel must fail the bench FAST with a parseable
+    error line, not hang the driver's whole bench window (observed: a
+    multi-hour outage where jax.devices() blocked indefinitely)."""
+    import sys as _sys
+
+    # an ambient kill-switch/override on the dev box must not leak in
+    monkeypatch.delenv("EDL_BENCH_PREFLIGHT_SECS", raising=False)
+    # healthy device: no error
+    ok = bench._device_preflight(
+        timeout_secs=30, probe_argv=[_sys.executable, "-c", "print('v5')"]
+    )
+    assert ok is None
+    # hang: subprocess exceeds the timeout
+    err = bench._device_preflight(
+        timeout_secs=0.5,
+        probe_argv=[_sys.executable, "-c", "import time; time.sleep(30)"],
+    )
+    assert "did not answer" in err
+    # hard failure: nonzero exit propagates the stderr tail
+    err = bench._device_preflight(
+        timeout_secs=30,
+        probe_argv=[
+            _sys.executable,
+            "-c",
+            "import sys; sys.stderr.write('tunnel exploded'); sys.exit(3)",
+        ],
+    )
+    assert "tunnel exploded" in err
+    # env kill-switch
+    monkeypatch.setenv("EDL_BENCH_PREFLIGHT_SECS", "0")
+    assert bench._device_preflight(probe_argv=["/bin/false"]) is None
+    # a malformed override must not crash the bench before its artifact
+    monkeypatch.setenv("EDL_BENCH_PREFLIGHT_SECS", "off")
+    assert (
+        bench._device_preflight(
+            timeout_secs=30,
+            probe_argv=[_sys.executable, "-c", "print('v5')"],
+        )
+        is None
+    )
+
+
 def test_no_hardcoded_per_config_rate_tables(bench):
     """The r4 TYPICAL_RATE / TYPICAL_E2E_RATE constants must stay gone
     (VERDICT r4 #5): 'typical' comes from _typical_rates/_e2e_typical."""

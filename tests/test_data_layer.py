@@ -226,6 +226,38 @@ class TestDataset:
         with pytest.raises(RuntimeError, match="producer failed"):
             list(Dataset.from_generator(boom).prefetch(2))
 
+    def test_prefetch_releases_producer_on_abandoned_stream(self):
+        """A consumer breaking out mid-stream (eval loop on error, a
+        take(), a GC'd generator) must release the producer thread —
+        a blocking q.put would leak one thread + its buffered batches
+        per abandoned stream for the life of the process."""
+        import threading
+        import time
+
+        started = threading.active_count()
+        produced = []
+
+        def source():
+            for i in range(10_000):
+                produced.append(i)
+                yield i
+
+        for _ in range(5):
+            it = iter(Dataset.from_generator(source).prefetch(2))
+            assert next(it) == 0
+            it.close()  # abandon mid-stream (what a `break` does at GC)
+        deadline = time.monotonic() + 5
+        while (
+            threading.active_count() > started
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        assert threading.active_count() <= started, (
+            f"{threading.active_count() - started} prefetch producer "
+            "thread(s) leaked"
+        )
+        assert len(produced) < 100  # producer stopped early, too
+
     def test_repeat_take(self):
         ds = Dataset.from_records([1, 2, 3]).repeat().take(7)
         assert list(ds) == [1, 2, 3, 1, 2, 3, 1]
