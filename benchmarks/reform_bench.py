@@ -1,13 +1,14 @@
 """Elastic re-formation latency benchmark (BASELINE.md config 5).
 
-Runs a real 2-process lockstep job on the host CPU backend, SIGKILLs one
-worker mid-epoch, and measures the mesh re-formation the master performs
+A thin consumer of the chaos harness (``elasticdl_tpu.chaos.harness``):
+a real 2-process lockstep job on the host CPU backend runs under the
+``preempt_one_worker`` fault plan — one worker SIGKILLs itself at a
+deterministic training step — and the harness measures the mesh
+re-formation the master performs plus checks the elastic invariants
 (reference behavior: pod kill -> task re-queue -> relaunch,
-``elasticdl/python/master/k8s_instance_manager.py:241-275``; here the
-whole ``jax.distributed`` world is fenced, re-queued, and relaunched —
-``master/master.py:_handle_dead_workers``).
+``elasticdl/python/master/k8s_instance_manager.py:241-275``).
 
-Prints ONE JSON line:
+Prints ONE JSON line (schema unchanged since r3):
   {"reform_latency_secs": R, "kill_to_step_secs": T,
    "detect_secs": D, "records_ok": true}
 
@@ -25,11 +26,8 @@ from __future__ import annotations
 
 import json
 import os
-import signal
 import sys
 import tempfile
-import threading
-import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("XLA_FLAGS", "")
@@ -42,126 +40,45 @@ HEARTBEAT_TIMEOUT_SECS = 3
 
 
 def measure(
-    workdir: str, num_records: int = 512, num_epochs: int = 2
+    workdir: str,
+    num_records: int = 512,
+    num_epochs: int = 2,
+    evaluate: bool = False,
 ) -> dict:
-    """Run the kill-and-reform lockstep job; returns the reform metrics.
+    """Run the kill-and-reform lockstep job through the chaos harness;
+    returns the reform metrics (plus ``accuracy`` when ``evaluate``).
 
     Parameterized so the accuracy-under-preemption gate
     (``preemption_accuracy_bench.py``) can reuse the exact same
     kill/re-form machinery on a to-accuracy training budget."""
-    from elasticdl_tpu.data.recordio_gen import synthetic
-    from elasticdl_tpu.master.main import build_master
-    from elasticdl_tpu.utils.args import parse_master_args
-    from elasticdl_tpu.utils.constants import TaskType
+    from elasticdl_tpu.chaos.harness import ChaosJobConfig, run_chaos_job
+    from elasticdl_tpu.chaos.plan import named_plan
 
-    train = synthetic.gen_mnist(
-        os.path.join(workdir, "train"),
-        num_records=num_records,
-        num_shards=2,
-        seed=3,
+    report = run_chaos_job(
+        ChaosJobConfig(
+            plan=named_plan("preempt_one_worker", num_workers=2),
+            workdir=workdir,
+            num_records=num_records,
+            num_epochs=num_epochs,
+            heartbeat_timeout_secs=HEARTBEAT_TIMEOUT_SECS,
+            evaluate=evaluate,
+        )
     )
-    ckpt = os.path.join(workdir, "ckpt")
-    args = parse_master_args(
-        [
-            "--model_def",
-            "mnist_functional_api.mnist_functional_api.custom_model",
-            "--training_data",
-            train,
-            "--minibatch_size",
-            "32",
-            "--records_per_task",
-            "64",
-            "--num_epochs",
-            str(num_epochs),
-            "--compute_dtype",
-            "float32",
-            "--shuffle_seed",
-            "5",
-            "--jax_platform",
-            "cpu",
-            "--envs",
-            "JAX_PLATFORMS=cpu,XLA_FLAGS= ",
-            "--port",
-            "0",
-            "--distribution_strategy",
-            "AllreduceStrategy",
-            "--num_workers",
-            "2",
-            "--checkpoint_dir",
-            ckpt,
-            "--checkpoint_steps",
-            "2",
-            "--heartbeat_timeout_secs",
-            str(HEARTBEAT_TIMEOUT_SECS),
-        ]
-    )
-    master = build_master(args)
-    master.prepare()
-    rc: list[int] = []
-    runner = threading.Thread(target=lambda: rc.append(master.run()))
-    runner.start()
-    killed_at = None
-    try:
-        deadline = time.monotonic() + 300
-        while time.monotonic() < deadline:
-            if os.path.isdir(ckpt) and any(
-                name.startswith("version-") for name in os.listdir(ckpt)
-            ):
-                break
-            time.sleep(0.25)
-        else:
-            raise RuntimeError("job never reached the first checkpoint")
-
-        victims = master.instance_manager.worker_ids()
-        victim = master.instance_manager._procs[victims[-1]]
-        killed_at = time.monotonic()
-        os.kill(victim.pid, signal.SIGKILL)
-
-        runner.join(timeout=600)
-        if runner.is_alive():
-            raise RuntimeError("master never finished after the kill")
-    finally:
-        master.request_stop()
-        runner.join(timeout=30)
-
-    counters = master.task_d.counters(TaskType.TRAINING)
-    # the event CAUSED BY our kill: under heavy host contention a worker
-    # can miss heartbeats while compiling and trigger a spurious pre-kill
-    # re-form — blindly reading [0] then yields a negative detect_secs
-    event = next(
-        (
-            e
-            for e in master.reform_events
-            if e["detected_at"] >= killed_at
-        ),
-        master.reform_events[0] if master.reform_events else {},
-    )
-    pull_at = master.servicer.first_stream_pull_at()
     out = {
-        "reform_latency_secs": round(event.get("latency_secs", -1.0), 3),
-        "detect_secs": (
-            round(event["detected_at"] - killed_at, 3)
-            if event and killed_at is not None
-            else None
-        ),
-        "kill_to_step_secs": (
-            round(pull_at - killed_at, 3)
-            if pull_at is not None and killed_at is not None
-            else None
-        ),
-        "records_ok": (
-            rc == [0]
-            and master.task_d.finished()
-            and counters.total_records == num_epochs * num_records
-        ),
+        "reform_latency_secs": report["reform_latency_secs"],
+        "detect_secs": report["detect_secs"],
+        "kill_to_step_secs": report["kill_to_step_secs"],
+        "records_ok": report["records_ok"],
         "heartbeat_timeout_secs": HEARTBEAT_TIMEOUT_SECS,
         # >0 proves the re-formed world came from the hot-standby pool
         # (the cold-start path would dominate reform_latency_secs)
-        "standby_activated": master.instance_manager.standby_activations,
+        "standby_activated": report["standby_activated"],
     }
     if not out["records_ok"]:
-        out["rc"] = rc
-        out["total_records"] = counters.total_records
+        out["rc"] = [report["rc"]] if report["rc"] is not None else []
+        out["total_records"] = report.get("total_records")
+    if evaluate:
+        out["accuracy"] = report.get("accuracy", 0.0)
     return out
 
 

@@ -1,0 +1,33 @@
+"""Deterministic fault injection + elastic-invariant checking.
+
+The elastic contract (PAPER.md §1, docs/designs/elastic_reformation.md)
+is that training survives preemption: workers die, the master re-forms
+the world, and the job continues with no lost or duplicated data.  This
+package is the correctness tooling that *proves* it, systematically:
+
+- :mod:`.plan` — a pure-data fault plan ("preempt process 1 at step 6",
+  "drop heartbeats for 6 s", "shrink the world, then restore it"),
+  seeded and replayable, serialized as JSON;
+- :mod:`.hooks` — the worker-side injector: hook points threaded into
+  the lockstep loop, the heartbeat thread, the host batch pipeline and
+  the checkpoint/resume path fire the plan's faults deterministically
+  (by model-version step, fenced by cluster generation so a re-formed
+  world does not re-fire them) and append every firing to a shared
+  event log;
+- :mod:`.invariants` — an observer-fed checker asserting the elastic
+  contract: every training task trained exactly once, record totals
+  accounted, model version monotonic per worker per generation, and
+  training progress resumed past every re-formation;
+- :mod:`.harness` — runs a real multi-process model-zoo job under a
+  plan with the checker attached and returns a JSON-able report (the
+  shared machinery behind ``benchmarks/reform_bench.py`` and
+  ``benchmarks/preemption_accuracy_bench.py``);
+- :mod:`.runner` — the CLI: ``python -m elasticdl_tpu.chaos.runner
+  --plan preempt_one_worker``.
+"""
+
+from elasticdl_tpu.chaos.plan import Fault, FaultKind, FaultPlan  # noqa: F401
+from elasticdl_tpu.chaos.invariants import (  # noqa: F401
+    InvariantChecker,
+    Violation,
+)

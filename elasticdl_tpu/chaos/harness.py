@@ -1,0 +1,496 @@
+"""The chaos harness: run a real model-zoo job under a fault plan with
+the invariant checker attached; return a JSON-able report.
+
+This is the one shared implementation behind the chaos runner CLI,
+``benchmarks/reform_bench.py`` and
+``benchmarks/preemption_accuracy_bench.py``: a 2-process lockstep mnist
+job on the host CPU backend, faults injected from the plan (worker-side
+via the env-exported plan file, master-side via the capacity driver),
+and the elastic contract checked end to end.
+
+Clock note: workers log fault firings with ``time.monotonic()``;
+CLOCK_MONOTONIC is machine-wide on Linux, so the master-side metrics
+(detection latency, kill-to-step) subtract worker event times from the
+master's own monotonic readings directly — valid because chaos jobs are
+single-host by construction.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+from elasticdl_tpu.chaos import hooks as chaos_hooks
+from elasticdl_tpu.chaos.invariants import InvariantChecker
+from elasticdl_tpu.chaos.plan import FaultKind, FaultPlan
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+# fault kinds whose firing is "the preemption" for latency metrics
+_KILL_KINDS = frozenset(
+    {
+        FaultKind.PREEMPT,
+        FaultKind.KILL_COORDINATOR,
+        FaultKind.KILL_IN_CHECKPOINT,
+        FaultKind.DROP_HEARTBEAT,
+    }
+)
+
+# deliberate-corruption modes: prove the checker catches what it claims
+# to catch (a checker that cannot fail is not a checker)
+CORRUPTIONS = ("", "double_report", "lose_task", "version_rollback")
+
+
+@dataclass
+class ChaosJobConfig:
+    plan: FaultPlan
+    workdir: str
+    num_records: int = 512
+    num_epochs: int = 2
+    num_workers: int = 2
+    minibatch_size: int = 32
+    records_per_task: int = 64
+    checkpoint_steps: int = 2
+    heartbeat_timeout_secs: float = 3.0
+    data_seed: int = 3
+    shuffle_seed: int = 5
+    # restore the final checkpoint and score a held-out split
+    evaluate: bool = False
+    eval_records: int = 512
+    eval_seed: int = 9
+    corrupt: str = ""  # one of CORRUPTIONS
+    run_timeout_secs: float = 600.0
+    extra_master_args: list = field(default_factory=list)
+
+
+def _master_args(config: ChaosJobConfig, train_dir: str, ckpt_dir: str):
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    envs = [
+        "JAX_PLATFORMS=cpu",
+        "XLA_FLAGS= ",
+        f"{chaos_hooks.PLAN_ENV}={os.path.join(config.workdir, 'chaos_plan.json')}",
+        f"{chaos_hooks.EVENTS_ENV}={os.path.join(config.workdir, 'chaos_events.jsonl')}",
+    ]
+    return parse_master_args(
+        [
+            "--model_def",
+            "mnist_functional_api.mnist_functional_api.custom_model",
+            "--training_data",
+            train_dir,
+            "--minibatch_size",
+            str(config.minibatch_size),
+            "--records_per_task",
+            str(config.records_per_task),
+            "--num_epochs",
+            str(config.num_epochs),
+            "--compute_dtype",
+            "float32",
+            "--shuffle_seed",
+            str(config.shuffle_seed),
+            "--jax_platform",
+            "cpu",
+            "--envs",
+            ",".join(envs),
+            "--port",
+            "0",
+            "--distribution_strategy",
+            "AllreduceStrategy",
+            "--num_workers",
+            str(config.num_workers),
+            "--checkpoint_dir",
+            ckpt_dir,
+            "--checkpoint_steps",
+            str(config.checkpoint_steps),
+            "--heartbeat_timeout_secs",
+            str(config.heartbeat_timeout_secs),
+            *config.extra_master_args,
+        ]
+    )
+
+
+def _install_corruption(master, checker: InvariantChecker, mode: str):
+    """Deliberately corrupt the run so the checker MUST flag it.
+
+    - ``double_report``: the first successful training completion is
+      delivered to observers twice (a double-counting dispatcher bug);
+    - ``lose_task``: the first successful training completion is hidden
+      from observers (a silently-lost completion);
+    - ``version_rollback``: once training passes version 4, a
+      lower-version report is injected (state regression).
+    """
+    from elasticdl_tpu.utils.constants import TaskType
+
+    if not mode:
+        return
+    if mode not in CORRUPTIONS:
+        raise ValueError(f"unknown corruption {mode!r}; valid: {CORRUPTIONS}")
+    fired: list = []
+    if mode in ("double_report", "lose_task"):
+        task_d = master.task_d
+        orig_report = task_d.report
+
+        def corrupt_report(task_id, success=True, exec_counters=None):
+            assignment = task_d._active.get(task_id)
+            task = assignment.task if assignment else None
+            is_victim = (
+                success
+                and not fired
+                and task is not None
+                and task.type == TaskType.TRAINING
+            )
+            if is_victim and mode == "lose_task":
+                fired.append(task_id)
+                # process the completion with the checker disconnected:
+                # the dispatcher counts it, observers never learn
+                observers, task_d._observers = task_d._observers, []
+                try:
+                    orig_report(
+                        task_id, success=success, exec_counters=exec_counters
+                    )
+                finally:
+                    task_d._observers = observers
+                return
+            orig_report(task_id, success=success, exec_counters=exec_counters)
+            if is_victim and mode == "double_report":
+                fired.append(task_id)
+                task_d._notify("on_task_reported", task_id, task, True, True)
+
+        task_d.report = corrupt_report
+    elif mode == "version_rollback":
+
+        def rollback(worker_id, version):
+            if version >= 4 and not fired:
+                fired.append(version)
+                checker.on_version_report(worker_id, version - 3)
+
+        master.servicer.add_version_observer(rollback)
+
+
+class _CapacityDriver(threading.Thread):
+    """Master-side fault execution: capacity faults trigger on the
+    master-observed model version and re-form the world at the new
+    size."""
+
+    def __init__(self, master, plan: FaultPlan, events_path: str):
+        super().__init__(name="chaos-capacity-driver", daemon=True)
+        self._master = master
+        self._pending = list(plan.master_faults())
+        self._events_path = events_path
+        self._stop = threading.Event()
+
+    def stop(self):
+        self._stop.set()
+
+    def run(self):
+        im = self._master.instance_manager
+        if im is None or not getattr(im, "lockstep", False):
+            return
+        full_size = im.world_size
+        while self._pending and not self._stop.is_set():
+            version = self._master.servicer.get_model_version()
+            due = sorted(
+                (f for f in self._pending if version >= f.at_step),
+                key=lambda f: f.at_step,
+            )
+            if not due:
+                self._stop.wait(0.2)
+                continue
+            # ONE fault per re-formation: firing shrink and restore in
+            # the same poll would coalesce into a single full-size
+            # reform — the shrunken world would never exist, yet both
+            # faults would be logged as executed
+            fault = due[0]
+            self._pending.remove(fault)
+            if fault.kind == FaultKind.REDUCE_CAPACITY:
+                im.set_world_size(im.world_size - fault.count)
+            else:
+                im.set_world_size(full_size)
+            self._record(fault, version, im.world_size)
+            reforms_before = len(self._master.reform_events)
+            self._master.request_reform(f"chaos:{fault.fault_id}")
+            deadline = time.monotonic() + 30.0
+            while (
+                not self._stop.is_set()
+                and len(self._master.reform_events) == reforms_before
+                and time.monotonic() < deadline
+            ):
+                self._stop.wait(0.2)
+
+    def _record(self, fault, version: int, world_size: int):
+        logger.warning(
+            "CHAOS capacity fault %s at version %d -> world size %d",
+            fault.fault_id,
+            version,
+            world_size,
+        )
+        chaos_hooks.append_event(
+            self._events_path,
+            {
+                "fault_id": fault.fault_id,
+                "kind": fault.kind,
+                "process_id": None,
+                "step": version,
+                "world_size": world_size,
+                "time": time.time(),
+                "monotonic": time.monotonic(),
+            },
+        )
+
+
+def _read_events(path: str) -> tuple[list[dict], list[dict]]:
+    """(fault firings, observations) from the shared event log."""
+    faults: list[dict] = []
+    observations: list[dict] = []
+    if not os.path.exists(path):
+        return faults, observations
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                event = json.loads(line)
+            except ValueError:
+                continue  # torn line from a killed writer
+            (observations if "observation" in event else faults).append(event)
+    return faults, observations
+
+
+def run_chaos_job(config: ChaosJobConfig) -> dict:
+    """Run one chaos'd job end to end; returns the report dict.
+
+    The report's ``invariants_ok`` is the verdict; ``records_ok`` keeps
+    the benchmarks' historical record-accounting boolean."""
+    from elasticdl_tpu.data.recordio_gen import synthetic
+    from elasticdl_tpu.master.main import build_master
+    from elasticdl_tpu.utils.constants import TaskType
+
+    os.makedirs(config.workdir, exist_ok=True)
+    plan_path = os.path.join(config.workdir, "chaos_plan.json")
+    events_path = os.path.join(config.workdir, "chaos_events.jsonl")
+    config.plan.save(plan_path)
+    if os.path.exists(events_path):
+        os.remove(events_path)
+    # a reused --workdir must start FRESH: a leftover checkpoint would
+    # make restore_trainer_state resume at the previous run's final
+    # version, so the plan's step-armed faults would fire against a
+    # different (already-trained) trajectory than the report claims
+    import shutil
+
+    shutil.rmtree(os.path.join(config.workdir, "ckpt"), ignore_errors=True)
+
+    train = synthetic.gen_mnist(
+        os.path.join(config.workdir, "train"),
+        num_records=config.num_records,
+        num_shards=2,
+        seed=config.data_seed,
+    )
+    ckpt = os.path.join(config.workdir, "ckpt")
+    args = _master_args(config, train, ckpt)
+
+    expected_records = config.num_epochs * config.num_records
+    checker = InvariantChecker(expected_records=expected_records)
+
+    master = build_master(args)
+    master.task_d.add_observer(checker)
+    master.servicer.add_version_observer(checker.on_version_report)
+    master.reform_callbacks.append(checker.on_reform)
+    _install_corruption(master, checker, config.corrupt)
+
+    driver = _CapacityDriver(master, config.plan, events_path)
+    master.prepare()
+    rc: list[int] = []
+    runner = threading.Thread(
+        target=lambda: rc.append(master.run()), name="chaos-master-run"
+    )
+    started_at = time.monotonic()
+    runner.start()
+    driver.start()
+    timed_out = False
+    try:
+        runner.join(timeout=config.run_timeout_secs)
+        timed_out = runner.is_alive()
+    finally:
+        driver.stop()
+        master.request_stop()
+        runner.join(timeout=30)
+
+    counters = master.task_d.counters(TaskType.TRAINING)
+    fault_events, observations = _read_events(events_path)
+
+    # ---- latency metrics (first kill-type firing -> detection -> step)
+    kill_at = next(
+        (
+            e["monotonic"]
+            for e in fault_events
+            if e.get("kind") in _KILL_KINDS
+        ),
+        None,
+    )
+    # the re-formation CAUSED BY the fault (a heavily-loaded host can
+    # reform spuriously before the fault fires)
+    reform = next(
+        (
+            e
+            for e in master.reform_events
+            if kill_at is None or e["detected_at"] >= kill_at
+        ),
+        master.reform_events[0] if master.reform_events else {},
+    )
+    pull_at = master.servicer.first_stream_pull_at()
+    detect_secs = (
+        round(reform["detected_at"] - kill_at, 3)
+        if reform and kill_at is not None
+        else None
+    )
+    kill_to_step_secs = (
+        round(pull_at - kill_at, 3)
+        if pull_at is not None and kill_at is not None
+        else None
+    )
+
+    records_ok = (
+        rc == [0]
+        and master.task_d.finished()
+        and counters.total_records == expected_records
+    )
+    invariants = checker.summary(counters)
+
+    # ---- the plan must have EXECUTED: a fault-free run must not pass a
+    # fault-injection gate (the old reform_bench's os.kill guaranteed
+    # this by construction; here a plan-plumbing regression would
+    # otherwise train undisturbed and report PASS).  Conservative on
+    # purpose: a gen-0 kill legitimately pre-empts later same-generation
+    # faults, so individual unfired faults are reported, not failed.
+    fired_ids = {e.get("fault_id") for e in fault_events}
+    unfired = [
+        f.fault_id for f in config.plan.faults if f.fault_id not in fired_ids
+    ]
+    fault_violations = []
+    if config.plan.faults and not fault_events:
+        fault_violations.append(
+            "plan has %d fault(s) but none fired — injection plumbing "
+            "broken?" % len(config.plan.faults)
+        )
+    gen0_kills = [
+        f
+        for f in config.plan.faults
+        if f.cluster_version == 0 and f.kind in _KILL_KINDS
+    ]
+    if gen0_kills and not master.reform_events:
+        fault_violations.append(
+            "plan kills a generation-0 worker but no re-formation "
+            "occurred"
+        )
+    # a capacity fault is only EXECUTED once a re-formation realizes the
+    # new size — the driver records the request, but the job can finish
+    # (or the run loop stop) before the reform runs.  Accept either the
+    # matching chaos-reason reform or any reform at/after the firing
+    # (a racing failure-reform coalesces the resize into itself).
+    reform_reasons = {e.get("reason") for e in master.reform_events}
+    for event in fault_events:
+        if event.get("kind") not in (
+            FaultKind.REDUCE_CAPACITY,
+            FaultKind.RESTORE_CAPACITY,
+        ):
+            continue
+        realized = f"chaos:{event['fault_id']}" in reform_reasons or any(
+            e["detected_at"] >= event["monotonic"] - 2.0
+            for e in master.reform_events
+        )
+        if not realized:
+            fault_violations.append(
+                f"capacity fault {event['fault_id']} was requested but "
+                "no re-formation realized it"
+            )
+    invariants["invariants"].append(
+        {
+            "name": "faults_injected",
+            "status": "FAIL" if fault_violations else "PASS",
+            "violations": fault_violations,
+        }
+    )
+    if fault_violations:
+        invariants["ok"] = False
+
+    report = {
+        "plan": config.plan.name,
+        "seed": config.plan.seed,
+        "corrupt": config.corrupt,
+        "num_workers": config.num_workers,
+        "num_records": config.num_records,
+        "num_epochs": config.num_epochs,
+        "rc": rc[0] if rc else None,
+        "timed_out": timed_out,
+        "wall_secs": round(time.monotonic() - started_at, 3),
+        "records_ok": records_ok,
+        "faults_injected": fault_events,
+        "observations": observations,
+        "invariants": invariants["invariants"],
+        "invariants_ok": bool(
+            invariants["ok"] and records_ok and not timed_out
+        ),
+        "faults_unfired": unfired,
+        "tasks_tracked": invariants["tasks_tracked"],
+        "max_model_version": invariants["max_model_version"],
+        "reforms": [
+            {
+                k: round(v, 3) if isinstance(v, float) else v
+                for k, v in e.items()
+                if k != "detected_at"
+            }
+            for e in master.reform_events
+        ],
+        "reform_latency_secs": round(reform.get("latency_secs", -1.0), 3),
+        "detect_secs": detect_secs,
+        "kill_to_step_secs": kill_to_step_secs,
+        "heartbeat_timeout_secs": config.heartbeat_timeout_secs,
+        "standby_activated": getattr(
+            master.instance_manager, "standby_activations", 0
+        ),
+    }
+    if not records_ok:
+        report["total_records"] = counters.total_records
+
+    if config.evaluate and records_ok:
+        report["accuracy"] = round(
+            _evaluate_checkpoint(config, ckpt), 4
+        )
+    return report
+
+
+def _evaluate_checkpoint(config: ChaosJobConfig, ckpt: str) -> float:
+    """Restore the job's final checkpoint into a single-process evaluator
+    and score it on a held-out split (the lockstep layout re-shards onto
+    this process's local mesh via the save_utils reshard property)."""
+    from elasticdl_tpu.data.recordio_gen import synthetic
+    from elasticdl_tpu.trainer.local_executor import LocalExecutor
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    eval_dir = synthetic.gen_mnist(
+        os.path.join(config.workdir, "eval"),
+        num_records=config.eval_records,
+        num_shards=1,
+        seed=config.eval_seed,
+    )
+    args = parse_master_args(
+        [
+            "--model_def",
+            "mnist_functional_api.mnist_functional_api.custom_model",
+            "--validation_data",
+            eval_dir,
+            "--minibatch_size",
+            str(config.minibatch_size),
+            "--records_per_task",
+            str(config.eval_records),
+            "--checkpoint_dir",
+            ckpt,
+            "--compute_dtype",
+            "float32",
+        ]
+    )
+    results = LocalExecutor(args).run()
+    return float(results.get("accuracy", 0.0))

@@ -1,0 +1,317 @@
+"""Fault plans: pure data, seeded, replayable.
+
+A plan is a named list of :class:`Fault`s.  Worker-side faults fire at a
+deterministic point in the training schedule — when the process's model
+version (``trainer.step``) reaches ``at_step`` — and are fenced by
+``cluster_version`` so a re-formed world (generation 1, 2, …) does not
+re-fire a generation-0 fault after restart.  Master-side faults
+(capacity changes) trigger on the master-observed model version.
+
+Plans serialize to/from JSON (``to_json``/``from_json``), so a chaos run
+is reproducible from its report; :func:`random_plan` derives a plan from
+a seed alone, so fuzzing sweeps are replayable by seed.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import asdict, dataclass, field
+
+
+class FaultKind:
+    """Fault vocabulary.  Worker-side kinds fire inside a worker process
+    (hooks.py); master-side kinds fire in the master's control loop
+    (harness.py driver)."""
+
+    # worker-side
+    PREEMPT = "preempt_worker"  # SIGKILL self at step (the preemption)
+    KILL_COORDINATOR = "kill_coordinator"  # PREEMPT pinned to process 0
+    DROP_HEARTBEAT = "drop_heartbeat"  # suppress heartbeats for a window
+    DELAY_BATCHES = "delay_batches"  # sleep per host-pipeline batch
+    KILL_IN_CHECKPOINT = "kill_in_checkpoint"  # die entering a save
+    # master-side
+    REDUCE_CAPACITY = "reduce_capacity"  # shrink the world by `count`
+    RESTORE_CAPACITY = "restore_capacity"  # back to full size
+
+    WORKER_SIDE = frozenset(
+        {
+            PREEMPT,
+            KILL_COORDINATOR,
+            DROP_HEARTBEAT,
+            DELAY_BATCHES,
+            KILL_IN_CHECKPOINT,
+        }
+    )
+    MASTER_SIDE = frozenset({REDUCE_CAPACITY, RESTORE_CAPACITY})
+    ALL = WORKER_SIDE | MASTER_SIDE
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One injected fault.
+
+    ``process_id`` targets one process of the lockstep world (``None``
+    on master-side faults); ``cluster_version`` is the world generation
+    the fault belongs to; ``at_step`` is the model version that arms it.
+    ``duration_secs`` bounds window faults (heartbeat drop, batch
+    delay); ``delay_ms`` is the per-batch sleep of DELAY_BATCHES;
+    ``count`` is the shrink amount of REDUCE_CAPACITY.
+    """
+
+    kind: str
+    fault_id: str
+    at_step: int = 0
+    process_id: int | None = None
+    cluster_version: int = 0
+    duration_secs: float = 0.0
+    delay_ms: float = 0.0
+    count: int = 1
+
+    def __post_init__(self):
+        if self.kind not in FaultKind.ALL:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; valid: "
+                f"{sorted(FaultKind.ALL)}"
+            )
+
+
+@dataclass
+class FaultPlan:
+    name: str
+    faults: list[Fault] = field(default_factory=list)
+    seed: int | None = None
+    notes: str = ""
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "name": self.name,
+                "seed": self.seed,
+                "notes": self.notes,
+                "faults": [asdict(f) for f in self.faults],
+            },
+            indent=2,
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        raw = json.loads(text)
+        return cls(
+            name=raw["name"],
+            seed=raw.get("seed"),
+            notes=raw.get("notes", ""),
+            faults=[Fault(**f) for f in raw.get("faults", [])],
+        )
+
+    def save(self, path: str):
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(self.to_json())
+
+    @classmethod
+    def load(cls, path: str) -> "FaultPlan":
+        with open(path, encoding="utf-8") as f:
+            return cls.from_json(f.read())
+
+    def worker_faults(self) -> list[Fault]:
+        return [f for f in self.faults if f.kind in FaultKind.WORKER_SIDE]
+
+    def master_faults(self) -> list[Fault]:
+        return [f for f in self.faults if f.kind in FaultKind.MASTER_SIDE]
+
+
+# ---- built-in plans ---------------------------------------------------------
+
+# Default arming step for kill faults: checkpoint_steps in the harness
+# is 2, and one 64-record task at batch 32 is 2 steps, so by step 6 a
+# periodic checkpoint has long since been written — the re-formed world
+# has state to resume from, which is the scenario under test.
+_KILL_STEP = 6
+
+
+def builtin_plans(num_workers: int = 2) -> dict[str, FaultPlan]:
+    """The named plans the runner/benchmarks use.  ``num_workers`` sizes
+    process targets (the victim of a plain preemption is the LAST
+    process — never the coordinator, which has its own plan)."""
+    last = max(0, num_workers - 1)
+    plans = {
+        "none": FaultPlan(
+            name="none", notes="no faults — the baseline trajectory"
+        ),
+        "preempt_one_worker": FaultPlan(
+            name="preempt_one_worker",
+            faults=[
+                Fault(
+                    kind=FaultKind.PREEMPT,
+                    fault_id="preempt-p%d" % last,
+                    at_step=_KILL_STEP,
+                    process_id=last,
+                )
+            ],
+            notes="SIGKILL one non-coordinator process mid-epoch",
+        ),
+        "preempt_coordinator": FaultPlan(
+            name="preempt_coordinator",
+            faults=[
+                Fault(
+                    kind=FaultKind.KILL_COORDINATOR,
+                    fault_id="kill-coordinator",
+                    at_step=_KILL_STEP,
+                    process_id=0,
+                )
+            ],
+            notes=(
+                "kill process 0 — the jax.distributed coordination "
+                "service dies with it (worst-case lockstep failure)"
+            ),
+        ),
+        "heartbeat_drop": FaultPlan(
+            name="heartbeat_drop",
+            faults=[
+                Fault(
+                    kind=FaultKind.DROP_HEARTBEAT,
+                    fault_id="hb-drop-p%d" % last,
+                    at_step=4,
+                    process_id=last,
+                    # must exceed the harness heartbeat timeout (3 s) so
+                    # the master declares the silent worker dead and
+                    # re-forms around a process that never crashed
+                    duration_secs=8.0,
+                )
+            ],
+            notes="a live-but-silent worker: heartbeats stop, process "
+            "survives; the stale world must be fenced out",
+        ),
+        "slow_host_pipeline": FaultPlan(
+            name="slow_host_pipeline",
+            faults=[
+                Fault(
+                    kind=FaultKind.DELAY_BATCHES,
+                    fault_id="slow-batches",
+                    at_step=2,
+                    process_id=None,  # every process
+                    delay_ms=40.0,
+                    duration_secs=6.0,
+                )
+            ],
+            notes="host-pipeline stall: batches arrive late on every "
+            "process; no correctness impact allowed",
+        ),
+        "checkpoint_kill": FaultPlan(
+            name="checkpoint_kill",
+            faults=[
+                Fault(
+                    kind=FaultKind.KILL_IN_CHECKPOINT,
+                    fault_id="ckpt-kill-p%d" % last,
+                    at_step=4,
+                    process_id=last,
+                )
+            ],
+            notes="die on entering a checkpoint save: resume must fall "
+            "back to the last complete checkpoint",
+        ),
+        "preempt_twice": FaultPlan(
+            name="preempt_twice",
+            faults=[
+                Fault(
+                    kind=FaultKind.PREEMPT,
+                    fault_id="preempt-gen0",
+                    at_step=_KILL_STEP,
+                    process_id=last,
+                ),
+                Fault(
+                    kind=FaultKind.PREEMPT,
+                    fault_id="preempt-gen1",
+                    at_step=_KILL_STEP + 6,
+                    process_id=last,
+                    cluster_version=1,
+                ),
+            ],
+            notes="a second preemption after the first re-formation "
+            "(generation-fenced: gen-1 fault arms only in gen 1)",
+        ),
+        "shrink_then_restore": FaultPlan(
+            name="shrink_then_restore",
+            faults=[
+                Fault(
+                    kind=FaultKind.REDUCE_CAPACITY,
+                    fault_id="shrink",
+                    at_step=4,
+                    count=max(1, num_workers - 1),
+                ),
+                Fault(
+                    kind=FaultKind.RESTORE_CAPACITY,
+                    fault_id="restore",
+                    at_step=10,
+                ),
+            ],
+            notes="capacity loss then recovery: the world re-forms "
+            "smaller, trains on, then re-forms back to full size",
+        ),
+    }
+    return plans
+
+
+def named_plan(name: str, num_workers: int = 2) -> FaultPlan:
+    plans = builtin_plans(num_workers)
+    if name not in plans:
+        raise KeyError(
+            f"unknown plan {name!r}; available: {sorted(plans)} "
+            f"(or 'random:<seed>')"
+        )
+    return plans[name]
+
+
+def random_plan(seed: int, num_workers: int = 2, max_faults: int = 3) -> FaultPlan:
+    """A replayable random plan: the same seed always yields the same
+    plan (the RNG is the only entropy source)."""
+    rng = random.Random(seed)
+    kinds = [
+        FaultKind.PREEMPT,
+        FaultKind.KILL_COORDINATOR,
+        FaultKind.DROP_HEARTBEAT,
+        FaultKind.DELAY_BATCHES,
+    ]
+    # faults that cost their world a re-formation: kills directly, and a
+    # heartbeat drop indirectly (its window outlasts the harness timeout,
+    # so the frozen worker is declared dead) — later faults must target
+    # the generation that exists by then or they silently never fire
+    reforming = (
+        FaultKind.PREEMPT,
+        FaultKind.KILL_COORDINATOR,
+        FaultKind.DROP_HEARTBEAT,
+    )
+    faults = []
+    for i in range(rng.randint(1, max_faults)):
+        kind = rng.choice(kinds)
+        proc = 0 if kind == FaultKind.KILL_COORDINATOR else rng.randrange(
+            num_workers
+        )
+        faults.append(
+            Fault(
+                kind=kind,
+                fault_id=f"random-{i}-{kind}",
+                at_step=rng.randint(2, 12),
+                process_id=proc,
+                cluster_version=sum(
+                    1 for f in faults if f.kind in reforming
+                ),
+                duration_secs=rng.choice([4.0, 6.0, 8.0])
+                if kind == FaultKind.DROP_HEARTBEAT
+                else 0.0,
+                delay_ms=float(rng.randint(10, 80))
+                if kind == FaultKind.DELAY_BATCHES
+                else 0.0,
+            )
+        )
+    return FaultPlan(
+        name=f"random:{seed}", seed=seed, faults=faults,
+        notes="seed-derived plan (replayable by seed alone)",
+    )
+
+
+def resolve_plan(name: str, num_workers: int = 2) -> FaultPlan:
+    """``named_plan`` plus the ``random:<seed>`` spelling."""
+    if name.startswith("random:"):
+        return random_plan(int(name.split(":", 1)[1]), num_workers)
+    return named_plan(name, num_workers)

@@ -1,0 +1,189 @@
+"""Chaos runner CLI.
+
+Run a model-zoo job (mnist_functional_api, CPU backend) under a named
+fault plan, check the elastic contract, print one JSON report, and exit
+non-zero if any invariant failed::
+
+    python -m elasticdl_tpu.chaos.runner --plan preempt_one_worker
+    python -m elasticdl_tpu.chaos.runner --plan random:1234 --no-baseline
+    python -m elasticdl_tpu.chaos.runner --list-plans
+
+By default the faulted run is paired with a fault-free baseline of the
+SAME job (same data seed, same shuffle seed) and the report carries the
+final-accuracy delta: a preempted-then-reformed job must reproduce the
+non-faulted trajectory (checkpoint resume, exactly-once data), so the
+delta is bounded by the ``trajectory_parity`` invariant.
+
+``--corrupt double_report`` (and friends) deliberately breaks the run
+so the checker's failure path is itself testable — a corrupted run MUST
+exit non-zero.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+# the chaos jobs are host-CPU by contract: they must never grab a TPU
+# the real job could be using, and must work on dev machines
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "")
+
+# default |accuracy(chaos) - accuracy(baseline)| bound: both runs train
+# the same records to completion, so the gap is resume noise (different
+# task interleaving after re-formation), not lost learning
+TRAJECTORY_TOLERANCE = 0.15
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    from elasticdl_tpu.chaos.harness import CORRUPTIONS
+
+    parser = argparse.ArgumentParser(
+        prog="python -m elasticdl_tpu.chaos.runner",
+        description="Deterministic fault injection for elastic training",
+    )
+    parser.add_argument(
+        "--plan",
+        default="preempt_one_worker",
+        help="Named plan (see --list-plans) or 'random:<seed>'",
+    )
+    parser.add_argument(
+        "--list-plans", action="store_true", help="List plans and exit"
+    )
+    parser.add_argument("--num-workers", type=int, default=2)
+    parser.add_argument("--num-records", type=int, default=1024)
+    parser.add_argument("--num-epochs", type=int, default=2)
+    parser.add_argument(
+        "--baseline",
+        dest="baseline",
+        action="store_true",
+        default=True,
+        help="Also run the fault-free baseline and report the accuracy "
+        "delta (default)",
+    )
+    parser.add_argument(
+        "--no-baseline", dest="baseline", action="store_false"
+    )
+    parser.add_argument(
+        "--trajectory-tolerance",
+        type=float,
+        default=TRAJECTORY_TOLERANCE,
+        help="Max |accuracy delta| vs the baseline trajectory",
+    )
+    parser.add_argument(
+        "--corrupt",
+        default="",
+        choices=list(CORRUPTIONS),
+        help="Deliberately corrupt the run to prove the checker fails "
+        "when it should",
+    )
+    parser.add_argument(
+        "--workdir",
+        default="",
+        help="Keep artifacts (plan, event log, checkpoints) here; "
+        "default: a temp dir, deleted on exit",
+    )
+    parser.add_argument(
+        "--output", default="", help="Also write the report JSON here"
+    )
+    parser.add_argument("--run-timeout-secs", type=float, default=600.0)
+    return parser
+
+
+def _run(args, workdir: str) -> dict:
+    from elasticdl_tpu.chaos.harness import ChaosJobConfig, run_chaos_job
+    from elasticdl_tpu.chaos.plan import resolve_plan
+
+    plan = resolve_plan(args.plan, num_workers=args.num_workers)
+    report = run_chaos_job(
+        ChaosJobConfig(
+            plan=plan,
+            workdir=os.path.join(workdir, "chaos"),
+            num_records=args.num_records,
+            num_epochs=args.num_epochs,
+            num_workers=args.num_workers,
+            evaluate=True,
+            corrupt=args.corrupt,
+            run_timeout_secs=args.run_timeout_secs,
+        )
+    )
+    if args.baseline and not args.corrupt:
+        # a corrupted run exits 1 regardless of the trajectory — the
+        # baseline job would double its runtime for nothing
+        from elasticdl_tpu.chaos.plan import named_plan
+
+        baseline = run_chaos_job(
+            ChaosJobConfig(
+                plan=named_plan("none", args.num_workers),
+                workdir=os.path.join(workdir, "baseline"),
+                num_records=args.num_records,
+                num_epochs=args.num_epochs,
+                num_workers=args.num_workers,
+                evaluate=True,
+                run_timeout_secs=args.run_timeout_secs,
+            )
+        )
+        report["baseline_accuracy"] = baseline.get("accuracy")
+        report["baseline_ok"] = baseline["invariants_ok"]
+        delta = None
+        if (
+            report.get("accuracy") is not None
+            and baseline.get("accuracy") is not None
+        ):
+            delta = round(report["accuracy"] - baseline["accuracy"], 4)
+        report["accuracy_delta"] = delta
+        parity_ok = (
+            delta is not None and abs(delta) <= args.trajectory_tolerance
+        )
+        report["invariants"].append(
+            {
+                "name": "trajectory_parity",
+                "status": "PASS" if parity_ok else "FAIL",
+                "violations": []
+                if parity_ok
+                else [
+                    f"|accuracy delta| {delta} exceeds "
+                    f"{args.trajectory_tolerance} vs the non-faulted "
+                    "trajectory"
+                    if delta is not None
+                    else "no accuracy available to compare"
+                ],
+            }
+        )
+        report["invariants_ok"] = bool(
+            report["invariants_ok"] and parity_ok and baseline["invariants_ok"]
+        )
+    return report
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    if args.list_plans:
+        from elasticdl_tpu.chaos.plan import builtin_plans
+
+        for name, plan in sorted(
+            builtin_plans(args.num_workers).items()
+        ):
+            print(f"{name:24s} {plan.notes}")
+        return 0
+
+    if args.workdir:
+        os.makedirs(args.workdir, exist_ok=True)
+        report = _run(args, args.workdir)
+    else:
+        with tempfile.TemporaryDirectory() as workdir:
+            report = _run(args, workdir)
+
+    text = json.dumps(report, indent=2)
+    print(text)
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+    return 0 if report["invariants_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -144,11 +144,14 @@ class K8sInstanceManager:
             return
         self._start(self._claim_worker_id())
 
-    def reform_world(self, cluster_version: int):
+    def reform_world(
+        self, cluster_version: int, count_against_budget: bool = True
+    ):
         """Tear down every worker pod and launch a new lockstep world
         under a fresh coordinator (the k8s analogue of the local
         backend's kill-and-respawn; the budget bounds deterministic
-        crash loops)."""
+        crash loops — elective resizes pass ``False`` and don't spend
+        it)."""
         with self._lock:
             pods = dict(self._pods)
             services = dict(self._services)
@@ -159,7 +162,8 @@ class K8sInstanceManager:
             self._client.delete_pod(pod_name)
         for service in services.values():
             self._client.delete_service(service)
-        self._reforms += 1
+        if count_against_budget:
+            self._reforms += 1
         if self._reforms > self._max_reforms:
             raise RuntimeError(
                 f"world re-formed {self._reforms - 1} times "

@@ -41,6 +41,15 @@ class Master:
         self._stop_requested = False
         self._job_failed = False
         self.reform_events: list[dict] = []
+        # callbacks(cluster_version, dead_workers, reason) invoked on
+        # every re-formation — chaos invariant checking, metrics
+        self.reform_callbacks: list = []
+        # elective re-formation (capacity change, chaos): the run loop
+        # owns re-formation, so external threads request, never perform.
+        # Lock-guarded: an unsynchronized read-then-clear could drop a
+        # request that lands between the load and the store.
+        self._reform_requested: str | None = None
+        self._reform_request_lock = threading.Lock()
 
         self._spec = get_model_spec(
             getattr(args, "model_zoo", "") or "",
@@ -188,6 +197,31 @@ class Master:
                     dead = [w for w in dead if w in live]
                 if dead:
                     self._handle_dead_workers(dead)
+                elif self._reform_requested is not None:
+                    # elective re-formation (world size changed): same
+                    # fence/recover/relaunch sequence, no dead workers
+                    with self._reform_request_lock:
+                        reason, self._reform_requested = (
+                            self._reform_requested,
+                            None,
+                        )
+                    im = self.instance_manager
+                    if im is not None and getattr(im, "lockstep", False):
+                        if len(im.worker_ids()) == getattr(
+                            im, "world_size", len(im.worker_ids())
+                        ):
+                            # a failure-driven re-formation between the
+                            # requester's set_world_size and its
+                            # request already realized this size:
+                            # tearing down the fresh, correctly-sized
+                            # world again would be pure downtime
+                            logger.info(
+                                "Skipping elective re-formation (%s): "
+                                "world already at target size",
+                                reason,
+                            )
+                        else:
+                            self._reform_lockstep([], reason=reason)
                 if (
                     self.reform_events
                     and "latency_secs" not in self.reform_events[-1]
@@ -223,33 +257,7 @@ class Master:
         """
         im = self.instance_manager
         if im is not None and getattr(im, "lockstep", False):
-            t0 = time.monotonic()
-            logger.warning(
-                "Workers %s timed out; re-forming the distributed world",
-                dead,
-            )
-            # fence FIRST: from here every stale worker's get_step_task is
-            # rejected, so none can re-lease a task we are about to recover
-            new_version = self.servicer.bump_cluster_version()
-            all_ids = set(dead) | set(im.worker_ids())
-            for worker_id in all_ids:
-                self.task_d.recover_tasks(worker_id)
-                self.servicer.forget_worker(worker_id)
-            self.servicer.reset_step_stream()
-            try:
-                im.reform_world(new_version)
-            except RuntimeError as ex:
-                logger.error("Giving up on the job: %s", ex)
-                self._job_failed = True
-                self.request_stop()
-                return
-            self.reform_events.append(
-                {
-                    "detected_at": t0,
-                    "cluster_version": new_version,
-                    "dead_workers": sorted(dead),
-                }
-            )
+            self._reform_lockstep(dead, reason="worker_failure")
             return
         for worker_id in dead:
             logger.warning("Worker %d timed out; recovering", worker_id)
@@ -257,6 +265,63 @@ class Master:
             self.servicer.forget_worker(worker_id)
             if im is not None:
                 im.restart_worker(worker_id)
+
+    def _reform_lockstep(self, dead: list[int], reason: str):
+        """Fence, recover, relaunch — the whole-world re-formation.
+        ``dead`` may be empty (elective re-formation: capacity change)."""
+        im = self.instance_manager
+        t0 = time.monotonic()
+        logger.warning(
+            "Re-forming the distributed world (%s; dead workers: %s)",
+            reason,
+            dead or "none",
+        )
+        # coalesce: ANY re-formation satisfies a pending elective request
+        # (the relaunch below already uses the latest world size) — a
+        # leftover request would tear down the fresh world a tick later
+        # and burn a unit of the reform budget for nothing
+        with self._reform_request_lock:
+            self._reform_requested = None
+        # fence FIRST: from here every stale worker's get_step_task is
+        # rejected, so none can re-lease a task we are about to recover
+        new_version = self.servicer.bump_cluster_version()
+        all_ids = set(dead) | set(im.worker_ids())
+        for worker_id in all_ids:
+            self.task_d.recover_tasks(worker_id)
+            self.servicer.forget_worker(worker_id)
+        self.servicer.reset_step_stream()
+        try:
+            im.reform_world(
+                new_version,
+                # only failure recovery spends the crash-loop budget; an
+                # elective resize is planned work, not a crash
+                count_against_budget=reason == "worker_failure",
+            )
+        except RuntimeError as ex:
+            logger.error("Giving up on the job: %s", ex)
+            self._job_failed = True
+            self.request_stop()
+            return
+        self.reform_events.append(
+            {
+                "detected_at": t0,
+                "cluster_version": new_version,
+                "dead_workers": sorted(dead),
+                "reason": reason,
+            }
+        )
+        for callback in self.reform_callbacks:
+            try:
+                callback(new_version, sorted(dead), reason)
+            except Exception:  # noqa: BLE001 — observers never break recovery
+                logger.exception("Reform callback failed")
+
+    def request_reform(self, reason: str = "elective"):
+        """Ask the run loop to re-form the lockstep world at its next
+        tick (e.g. after ``instance_manager.set_world_size``).  Safe
+        from any thread; coalesces with failure-driven re-formation."""
+        with self._reform_request_lock:
+            self._reform_requested = reason
 
     def request_stop(self):
         self._stop_requested = True
@@ -313,7 +378,13 @@ class Master:
                 {
                     k: v
                     for k, v in event.items()
-                    if k in ("cluster_version", "dead_workers", "latency_secs")
+                    if k
+                    in (
+                        "cluster_version",
+                        "dead_workers",
+                        "latency_secs",
+                        "reason",
+                    )
                 }
                 for event in events
             ]
@@ -370,6 +441,20 @@ class LocalInstanceManager:
         self._standbys: list = []
         self._draining = False
         self.standby_activations = 0
+        # current lockstep world size: capacity faults/elasticity shrink
+        # it below num_workers; the next (re)formation uses it
+        self._world_size = num_workers
+
+    @property
+    def world_size(self) -> int:
+        return self._world_size
+
+    def set_world_size(self, n: int):
+        """Resize the NEXT world (the live one is untouched until a
+        re-formation — ask the master via ``request_reform``).  Clamped
+        to [1, num_workers]: growth beyond the configured fleet would
+        need new capacity this manager does not own."""
+        self._world_size = max(1, min(self._num_workers, int(n)))
 
     def worker_ids(self) -> list[int]:
         with self._lock:
@@ -392,7 +477,7 @@ class LocalInstanceManager:
     def _start_world(self, cluster_version: int, num_processes: int | None = None):
         from elasticdl_tpu.parallel import elastic
 
-        n = num_processes if num_processes is not None else self._num_workers
+        n = num_processes if num_processes is not None else self._world_size
         coordinator = f"localhost:{elastic.pick_coordinator_port()}"
         for process_id in range(n):
             world = dict(
@@ -535,13 +620,18 @@ class LocalInstanceManager:
             proc.terminate()
         self._start(self._claim_worker_id())
 
-    def reform_world(self, cluster_version: int):
+    def reform_world(
+        self, cluster_version: int, count_against_budget: bool = True
+    ):
         """Kill the old world and launch a new one.  Survivors may be
         blocked inside a collective that will never complete — SIGKILL,
         not SIGTERM, is the correct mercy.  The old world is ALWAYS torn
         down; only the relaunch is subject to the reform budget (a
         deterministic crash must not loop forever, reference OOM
-        blacklist k8s_instance_manager.py:225-240)."""
+        blacklist k8s_instance_manager.py:225-240).
+        ``count_against_budget=False`` for ELECTIVE re-formations
+        (capacity changes): a planned resize is not a crash and must not
+        eat into the failure-recovery allowance."""
         with self._lock:
             procs = list(self._procs.values())
             self._procs.clear()
@@ -553,7 +643,8 @@ class LocalInstanceManager:
                 proc.wait(timeout=10)
             except Exception:  # noqa: BLE001
                 pass
-        self._reforms += 1
+        if count_against_budget:
+            self._reforms += 1
         if self._reforms > self._max_reforms:
             raise RuntimeError(
                 f"world re-formed {self._reforms - 1} times "

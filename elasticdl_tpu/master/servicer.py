@@ -59,8 +59,15 @@ class MasterServicer:
         # pods cannot receive stdin, so k8s standbys poll for these)
         self._world_assignments: dict[str, dict] = {}
         self._standby_drain = False
+        # (worker_id, model_version) observers — chaos invariant checking
+        self._version_observers: list = []
         if evaluation_service is not None:
             evaluation_service.set_master_servicer(self)
+
+    def add_version_observer(self, callback):
+        """``callback(worker_id, model_version)`` on every version
+        report; must not call back into the servicer."""
+        self._version_observers.append(callback)
 
     # ---- model version ----------------------------------------------------
 
@@ -193,6 +200,11 @@ class MasterServicer:
         (reference servicer.py:79-85, where the PS did the pinging)."""
         with self._lock:
             self._version = max(self._version, request.model_version)
+        for callback in self._version_observers:
+            try:
+                callback(request.worker_id, request.model_version)
+            except Exception:  # noqa: BLE001 — observers never break RPCs
+                logger.exception("Version observer failed")
         if self._evaluation_service is not None:
             self._evaluation_service.add_evaluation_task_if_needed(
                 master_locking=False, model_version=request.model_version

@@ -100,6 +100,10 @@ class TaskDispatcher:
         self._counters: dict[TaskType, JobCounters] = {}
         self._done_callbacks: list[Callable[[], None]] = []
         self._evaluation_service: Any = None
+        # lifecycle observers (chaos invariant checking, metrics).  May
+        # be notified while the dispatcher lock is held: observers must
+        # record and return, never call back into the dispatcher.
+        self._observers: list[Any] = []
 
         if self._shards[TaskType.TRAINING]:
             logger.info("Starting epoch 0")
@@ -108,6 +112,38 @@ class TaskDispatcher:
             self.create_tasks(TaskType.EVALUATION)
         elif self._shards[TaskType.PREDICTION]:
             self.create_tasks(TaskType.PREDICTION)
+
+    # ---- lifecycle observers ----------------------------------------------
+
+    def add_observer(self, observer: Any):
+        """Register a task-lifecycle observer.  Optional methods:
+        ``on_tasks_created(tasks)``, ``on_task_leased(task_id,
+        worker_id, task)``, ``on_task_reported(task_id, task, success,
+        counted)``, ``on_task_reclaimed(task_id, task)``.  Callbacks may
+        run under the dispatcher lock — observers must not re-enter.
+
+        Tasks created before attach (the constructor slices epoch 0) are
+        replayed immediately, so an observer attached between
+        construction and the first lease sees the complete lifecycle."""
+        with self._lock:
+            self._observers.append(observer)
+            backlog = self._pending + self._pending_eval
+        if backlog:
+            callback = getattr(observer, "on_tasks_created", None)
+            if callback is not None:
+                callback(backlog)
+
+    def _notify(self, method: str, *args):
+        for observer in self._observers:
+            callback = getattr(observer, method, None)
+            if callback is None:
+                continue
+            try:
+                callback(*args)
+            except Exception:  # noqa: BLE001 — observers never break dispatch
+                logger.exception(
+                    "Task observer %r.%s failed", observer, method
+                )
 
     # ---- task creation ----------------------------------------------------
 
@@ -157,6 +193,7 @@ class TaskDispatcher:
             self._counters[task_type].total_records,
             model_version,
         )
+        self._notify("on_tasks_created", tasks)
 
     # ---- task leasing -----------------------------------------------------
 
@@ -165,6 +202,7 @@ class TaskDispatcher:
         self._active[self._next_task_id] = _Assignment(
             worker_id, task, time.monotonic()
         )
+        self._notify("on_task_leased", self._next_task_id, worker_id, task)
         return self._next_task_id
 
     def get(self, worker_id: int) -> tuple[int, Task | None]:
@@ -241,6 +279,10 @@ class TaskDispatcher:
             assignment = self._active.pop(task_id, None)
             if assignment is None:
                 logger.warning("Unknown or already-reclaimed task id: %d", task_id)
+                # counted=False: a stale report was (correctly) dropped
+                self._notify(
+                    "on_task_reported", task_id, None, success, False
+                )
                 return
             now = time.monotonic()
             for a in self._active.values():
@@ -276,6 +318,7 @@ class TaskDispatcher:
                     task_id,
                     len(self._pending) + len(self._active),
                 )
+            self._notify("on_task_reported", task_id, task, success, True)
         if eval_completed:
             self._evaluation_service.complete_task(
                 eval_job_id=task.extended.get("eval_job_id")
@@ -313,6 +356,7 @@ class TaskDispatcher:
                 self._pending_eval.append(a.task)
             else:
                 self._pending.append(a.task)
+            self._notify("on_task_reclaimed", tid, a.task)
             logger.warning(
                 "Task %d leased by worker %d timed out after %.1fs; re-queued",
                 tid,

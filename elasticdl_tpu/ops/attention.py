@@ -28,10 +28,22 @@ from jax.experimental.pallas import tpu as pltpu
 # this many (all-equal) columns so stores stay tile-aligned
 _LANES = 128
 
+# JAX renamed pltpu.TPUCompilerParams -> pltpu.CompilerParams around
+# 0.5; accept either so the kernel builds across the versions this
+# framework supports (0.4.x pins the old name)
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+if _CompilerParams is None:
+    raise ImportError(
+        "jax.experimental.pallas.tpu exposes neither CompilerParams nor "
+        "TPUCompilerParams; update the alias above for this JAX version"
+    )
+
 # batch*heads and q/k-block dims are independent programs; only the
 # innermost (accumulation stream) dim is order-dependent — telling
 # Mosaic lets it pipeline the outer dims across cores
-_FLASH_COMPILER_PARAMS = pltpu.CompilerParams(
+_FLASH_COMPILER_PARAMS = _CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary")
 )
 

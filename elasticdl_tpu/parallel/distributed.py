@@ -33,6 +33,15 @@ from elasticdl_tpu.trainer.step import (
 )
 from elasticdl_tpu.utils.constants import EMBEDDING_AUTO_DISTRIBUTE_BYTES
 
+# Layout-invariant RNG: state is *created* sharded (init jitted with
+# out_shardings below), and with non-partitionable threefry (the JAX
+# 0.4.x default) the partitioner does NOT preserve random bits across
+# layouts — the same seed then inits different weights on dp=2,tp=2
+# than on one device, breaking mesh-parity tests and cross-topology
+# reproducibility.  Partitionable threefry makes random bits a pure
+# function of (key, position), independent of the mesh.
+jax.config.update("jax_threefry_partitionable", True)
+
 
 class SPMDTrainer:
     def __init__(

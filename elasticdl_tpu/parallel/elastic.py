@@ -36,9 +36,6 @@ def configure_platform(platform: str | None):
     """
     if platform:
         jax.config.update("jax_platforms", platform)
-        if platform == "cpu":
-            # cross-process CPU collectives need an explicit implementation
-            jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 
 def configure_compilation_cache(cache_dir: str | None):
@@ -64,6 +61,13 @@ def initialize_world(
     """Join the job's ``jax.distributed`` world (process 0 additionally
     hosts the coordination service at ``coordinator_addr``)."""
     configure_platform(platform)
+    if platform == "cpu":
+        # cross-process CPU collectives need an explicit implementation.
+        # Set ONLY here, between platform selection and distributed init:
+        # jaxlib's gloo factory requires the distributed client, so a
+        # single-process job (tests, LocalExecutor, the CLI) with this
+        # config set cannot initialize the cpu backend at all.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(
         coordinator_address=coordinator_addr,
         num_processes=num_processes,

@@ -93,6 +93,12 @@ class PeriodicCheckpointer:
         version = trainer.step
         if skip_if_current and version == self._last_saved_version:
             return
+        # chaos hook: a KILL_IN_CHECKPOINT fault dies HERE — after the
+        # decision to save, before any byte is written — so resume must
+        # fall back to the last complete checkpoint
+        from elasticdl_tpu.chaos import hooks as chaos_hooks
+
+        chaos_hooks.notify_checkpoint_save(int(version))
         # non-chiefs only write their table parts: don't pay device->host
         # copies for replicated leaves they would discard
         dense, parts = elastic.state_checkpoint_parts(
@@ -208,6 +214,9 @@ def restore_trainer_state(trainer, args, process_id: int = 0) -> int | None:
     state = checkpoint_to_state(trainer.state, values)
     version = int(extra.get("model_version", 0) or 0)
     restored_step = version if resume else 0
+    from elasticdl_tpu.chaos import hooks as chaos_hooks
+
+    chaos_hooks.notify_checkpoint_restore(restored_step)
     state = state.replace(step=np.asarray(restored_step, dtype=np.int32))
     trainer.state = jax.device_put(state, trainer.state_shardings)
     logger.info(

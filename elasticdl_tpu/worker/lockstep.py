@@ -104,6 +104,13 @@ class LockstepWorker:
         )
         self._trainer: SPMDTrainer | None = None
         self._stopped = False
+        # deterministic fault injection (chaos subsystem): a no-op unless
+        # the master exported a plan into this process's environment
+        from elasticdl_tpu.chaos import hooks as chaos_hooks
+
+        self._chaos = chaos_hooks.install_from_env(
+            self._process_id, self._cluster_version, self._worker_id
+        )
         self._checkpointer = PeriodicCheckpointer(
             getattr(args, "checkpoint_dir", "") or "",
             getattr(args, "checkpoint_steps", 0) or 0,
@@ -252,11 +259,21 @@ class LockstepWorker:
         def _pre(features):
             self._ensure_trainer(features)
             self._profiler.on_step(self._trainer.step)
+            if self._chaos is not None:
+                # per-minibatch arming point: step-scheduled faults fire
+                # at the exact model version the plan names
+                self._chaos.on_step(int(self._trainer.step))
 
         with self._crash_on_error(task):
+            # build the stream INSIDE the crash protocol: a loud
+            # deterministic-choice failure here must report-and-crash
+            # like any other lockstep error, not escape unreported
+            batches = self._task_batches(task, Modes.TRAINING)
+            if self._chaos is not None:
+                batches = self._chaos.wrap_batches(batches)
             run_stacked_steps(
                 lambda: self._trainer,
-                self._task_batches(task, Modes.TRAINING),
+                batches,
                 getattr(self._args, "steps_per_dispatch", 1) or 1,
                 pre_batch=_pre,
                 dispatch_ctx=lambda: self._timing.record("batch_process"),
@@ -397,6 +414,14 @@ class LockstepWorker:
 
         def beat():
             while not self._stopped:
+                if (
+                    self._chaos is not None
+                    and self._chaos.heartbeat_suppressed()
+                ):
+                    # injected silence: the process lives on but the
+                    # master must see a dead worker
+                    time.sleep(interval_secs)
+                    continue
                 try:
                     self._master.heartbeat(
                         msg.HeartbeatRequest(

@@ -1,0 +1,452 @@
+"""Chaos subsystem tests.
+
+The checker is correctness tooling, so the core tests here are
+falsification tests: a lost task, a double-reported task and a version
+rollback must each be DETECTED (a checker that cannot fail proves
+nothing).  Plan model tests pin the replayability contract; hook tests
+pin the generation/process fencing that keeps injected faults
+deterministic; the end-to-end kill-and-reform path is exercised by the
+slow marker test (and by ``benchmarks/reform_bench.py``, now a harness
+consumer).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import types
+
+import pytest
+
+from elasticdl_tpu.chaos.harness import _install_corruption, _read_events
+from elasticdl_tpu.chaos.hooks import ChaosInjector
+from elasticdl_tpu.chaos.invariants import InvariantChecker
+from elasticdl_tpu.chaos.plan import (
+    Fault,
+    FaultKind,
+    FaultPlan,
+    builtin_plans,
+    random_plan,
+    resolve_plan,
+)
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.utils.constants import TaskType
+
+
+# ---- fault plan model -------------------------------------------------------
+
+
+def test_plan_json_round_trip(tmp_path):
+    plan = resolve_plan("preempt_one_worker")
+    path = str(tmp_path / "plan.json")
+    plan.save(path)
+    loaded = FaultPlan.load(path)
+    assert loaded.name == plan.name
+    assert loaded.faults == plan.faults
+
+
+def test_random_plan_is_replayable_by_seed():
+    a, b = random_plan(1234), random_plan(1234)
+    assert a.faults == b.faults
+    assert a.faults != random_plan(1235).faults or a.seed != 1235
+
+
+def test_random_plan_generations_follow_reforms():
+    """A fault scheduled after k re-formation-causing faults targets
+    generation k — otherwise it could never fire (the world it names is
+    gone).  Heartbeat drops count: their window outlasts the harness
+    timeout, so the frozen worker is declared dead and the world
+    re-forms just like after a kill."""
+    reforming = (
+        FaultKind.PREEMPT,
+        FaultKind.KILL_COORDINATOR,
+        FaultKind.DROP_HEARTBEAT,
+    )
+    for seed in range(20):
+        plan = random_plan(seed)
+        reforms = 0
+        for fault in plan.faults:
+            assert fault.cluster_version == reforms
+            if fault.kind in reforming:
+                reforms += 1
+
+
+def test_unknown_fault_kind_rejected():
+    with pytest.raises(ValueError):
+        Fault(kind="meteor_strike", fault_id="x")
+
+
+def test_builtin_plans_parse_and_target_valid_processes():
+    plans = builtin_plans(num_workers=2)
+    assert {"none", "preempt_one_worker", "preempt_coordinator"} <= set(plans)
+    for plan in plans.values():
+        for fault in plan.faults:
+            if fault.process_id is not None:
+                assert 0 <= fault.process_id < 2
+    assert not plans["none"].faults
+
+
+def test_resolve_plan_random_spelling():
+    plan = resolve_plan("random:7")
+    assert plan.seed == 7
+    with pytest.raises(KeyError):
+        resolve_plan("no_such_plan")
+
+
+# ---- invariant checker: must catch what it claims to catch -----------------
+
+
+def _drive_clean_job(checker, shards=None, num_epochs=1):
+    d = TaskDispatcher(
+        shards or {"s": (0, 256)},
+        records_per_task=64,
+        num_epochs=num_epochs,
+        shuffle_seed=3,
+    )
+    d.add_observer(checker)
+    while True:
+        tid, task = d.get(worker_id=0)
+        if task is None:
+            break
+        d.report(tid, success=True)
+    return d
+
+
+def test_checker_passes_clean_run():
+    checker = InvariantChecker(expected_records=256)
+    d = _drive_clean_job(checker)
+    assert checker.check(d.counters(TaskType.TRAINING)) == []
+    summary = checker.summary()
+    assert summary["ok"]
+    assert all(i["status"] == "PASS" for i in summary["invariants"])
+
+
+def test_checker_detects_lost_task():
+    checker = InvariantChecker(expected_records=256)
+    d = TaskDispatcher(
+        {"s": (0, 256)}, records_per_task=64, shuffle_seed=3
+    )
+    d.add_observer(checker)
+    leases = []
+    while True:
+        tid, task = d.get(worker_id=0)
+        if task is None:
+            break
+        leases.append(tid)
+    # complete all but one; the last lease is never reported (lost)
+    for tid in leases[:-1]:
+        d.report(tid, success=True)
+    violations = checker.check()
+    assert any(v.invariant == "exactly_once" for v in violations)
+    assert any("never successfully trained" in v.detail for v in violations)
+    # records_accounted must flag the shortfall too
+    assert any(v.invariant == "records_accounted" for v in violations)
+
+
+def test_checker_detects_double_reported_task():
+    checker = InvariantChecker(expected_records=256)
+    d = _drive_clean_job(checker)
+    # simulate a dispatcher double-count: the same completion is
+    # delivered to observers twice
+    rec = next(iter(checker._tasks.values()))
+    checker.on_task_reported(99, rec.task, True, True)
+    violations = checker.check(d.counters(TaskType.TRAINING))
+    assert any(
+        v.invariant == "exactly_once" and "double-counted" in v.detail
+        for v in violations
+    )
+
+
+def test_checker_ignores_uncounted_reports():
+    """A report the dispatcher correctly DROPPED (stale lease) must not
+    count as a completion — dropping is the fix, not the bug."""
+    checker = InvariantChecker(expected_records=256)
+    d = _drive_clean_job(checker)
+    rec = next(iter(checker._tasks.values()))
+    checker.on_task_reported(99, rec.task, True, False)  # counted=False
+    assert checker.check(d.counters(TaskType.TRAINING)) == []
+
+
+def test_checker_detects_version_rollback():
+    checker = InvariantChecker()
+    checker.on_version_report(0, 3)
+    checker.on_version_report(0, 5)
+    checker.on_version_report(0, 4)  # rollback within one generation
+    violations = checker.check()
+    assert any(v.invariant == "version_monotonic" for v in violations)
+
+
+def test_checker_allows_rewind_across_reform_but_requires_progress():
+    checker = InvariantChecker()
+    checker.on_version_report(0, 6)
+    checker.on_reform(1, dead_workers=[1], reason="worker_failure")
+    # restored from the version-4 checkpoint: a legitimate rewind
+    checker.on_version_report(2, 4)
+    assert not any(
+        v.invariant == "version_monotonic" for v in checker.check()
+    )
+    # ...but stalling at the pre-reform high-water mark is a violation
+    assert any(v.invariant == "reform_progress" for v in checker.check())
+    checker.on_version_report(2, 8)
+    assert not any(
+        v.invariant == "reform_progress" for v in checker.check()
+    )
+
+
+def test_checker_epoch_tasks_are_distinct_identities():
+    """Each epoch re-slices the shards into fresh Task objects: the same
+    record range trained once per epoch is exactly-once, not double."""
+    checker = InvariantChecker(expected_records=512)
+    d = _drive_clean_job(checker, num_epochs=2)
+    assert checker.check(d.counters(TaskType.TRAINING)) == []
+    assert checker.summary()["tasks_tracked"] == 8  # 4 tasks x 2 epochs
+
+
+def test_checker_retried_task_counts_once():
+    """A task that fails, re-queues and then succeeds is exactly-once."""
+    checker = InvariantChecker(expected_records=256)
+    d = TaskDispatcher(
+        {"s": (0, 256)}, records_per_task=64, shuffle_seed=3
+    )
+    d.add_observer(checker)
+    tid, task = d.get(worker_id=0)
+    d.report(tid, success=False)  # fails; re-queued
+    while True:
+        tid, task = d.get(worker_id=1)
+        if task is None:
+            break
+        d.report(tid, success=True)
+    assert checker.check(d.counters(TaskType.TRAINING)) == []
+
+
+def test_checker_observer_replay_on_attach():
+    """Attaching after construction (the harness does) still sees the
+    epoch-0 tasks the dispatcher constructor created."""
+    d = TaskDispatcher({"s": (0, 256)}, records_per_task=64, shuffle_seed=3)
+    checker = InvariantChecker(expected_records=256)
+    d.add_observer(checker)
+    assert checker.summary()["tasks_tracked"] == 4
+
+
+# ---- worker-side injector fencing ------------------------------------------
+
+
+def _plan_with(*faults):
+    return FaultPlan(name="t", faults=list(faults))
+
+
+def test_injector_arms_only_matching_process_and_generation():
+    fault = Fault(
+        kind=FaultKind.PREEMPT, fault_id="k", at_step=5, process_id=1
+    )
+    gen1 = Fault(
+        kind=FaultKind.PREEMPT,
+        fault_id="k2",
+        at_step=5,
+        process_id=0,
+        cluster_version=1,
+    )
+    # wrong process, wrong generation: nothing armed
+    inj = ChaosInjector(
+        _plan_with(fault, gen1), process_id=0, cluster_version=0,
+        worker_id=0,
+    )
+    assert inj._pending == []
+    # right process + generation
+    inj = ChaosInjector(
+        _plan_with(fault, gen1), process_id=1, cluster_version=0,
+        worker_id=3,
+    )
+    assert [f.fault_id for f in inj._pending] == ["k"]
+    inj = ChaosInjector(
+        _plan_with(fault, gen1), process_id=0, cluster_version=1,
+        worker_id=5,
+    )
+    assert [f.fault_id for f in inj._pending] == ["k2"]
+
+
+def test_injector_heartbeat_drop_freezes_whole_process(tmp_path):
+    """DROP_HEARTBEAT models a frozen process: the training thread
+    stalls for the window (step-task pulls are implicit heartbeats — a
+    worker that keeps pulling is correctly never declared dead) and the
+    beat thread is suppressed throughout it."""
+    events = str(tmp_path / "events.jsonl")
+    fault = Fault(
+        kind=FaultKind.DROP_HEARTBEAT,
+        fault_id="hb",
+        at_step=3,
+        process_id=0,
+        duration_secs=0.2,
+    )
+    inj = ChaosInjector(
+        _plan_with(fault), process_id=0, cluster_version=0, worker_id=0,
+        events_path=events,
+    )
+    assert not inj.heartbeat_suppressed()
+    inj.on_step(2)
+    assert not inj.heartbeat_suppressed()  # not armed yet
+    t0 = time.monotonic()
+    suppressed_during: list[bool] = []
+    timer = __import__("threading").Timer(
+        0.1, lambda: suppressed_during.append(inj.heartbeat_suppressed())
+    )
+    timer.start()
+    inj.on_step(3)
+    assert time.monotonic() - t0 >= 0.2  # training thread stalled
+    timer.join()
+    assert suppressed_during == [True]  # beats suppressed mid-window
+    assert not inj.heartbeat_suppressed()  # window closed with the stall
+    inj.on_step(4)  # fire-once: must not re-freeze
+    assert not inj.heartbeat_suppressed()
+    faults, _ = _read_events(events)
+    assert [e["fault_id"] for e in faults] == ["hb"]
+    assert faults[0]["step"] == 3
+    assert "monotonic" in faults[0] and "time" in faults[0]
+
+
+def test_injector_batch_delay_preserves_stream(tmp_path):
+    fault = Fault(
+        kind=FaultKind.DELAY_BATCHES,
+        fault_id="slow",
+        at_step=0,
+        delay_ms=1.0,
+        duration_secs=5.0,
+    )
+    inj = ChaosInjector(
+        _plan_with(fault), process_id=0, cluster_version=0, worker_id=0,
+        events_path=str(tmp_path / "e.jsonl"),
+    )
+    inj.on_step(0)
+    # the shim only delays — every batch passes through, in order
+    assert list(inj.wrap_batches(iter(range(5)))) == [0, 1, 2, 3, 4]
+
+
+def test_injector_kill_in_checkpoint_arms_via_save_hook(tmp_path, monkeypatch):
+    killed = []
+    monkeypatch.setattr(os, "kill", lambda pid, sig: killed.append(sig))
+    fault = Fault(
+        kind=FaultKind.KILL_IN_CHECKPOINT,
+        fault_id="ck",
+        at_step=4,
+        process_id=0,
+    )
+    inj = ChaosInjector(
+        _plan_with(fault), process_id=0, cluster_version=0, worker_id=0,
+        events_path=str(tmp_path / "e.jsonl"),
+    )
+    inj.on_step(4)  # arms (does not fire at a step boundary)
+    assert not killed
+    inj.on_checkpoint_save(2)  # below at_step: survives
+    assert not killed
+    inj.on_checkpoint_save(4)
+    assert killed  # died entering the save
+    faults, _ = _read_events(str(tmp_path / "e.jsonl"))
+    assert faults[0]["phase"] == "checkpoint_save"
+
+
+def test_events_log_skips_torn_lines(tmp_path):
+    path = str(tmp_path / "e.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"fault_id": "a", "kind": "preempt_worker"}) + "\n")
+        f.write('{"fault_id": "b", "ki')  # torn write from a killed proc
+    faults, _ = _read_events(path)
+    assert [e["fault_id"] for e in faults] == ["a"]
+
+
+# ---- deliberate corruption must trip the checker ---------------------------
+
+
+def _fake_master(dispatcher):
+    servicer = types.SimpleNamespace(
+        _observers=[], add_version_observer=lambda cb: None
+    )
+    return types.SimpleNamespace(task_d=dispatcher, servicer=servicer)
+
+
+def test_corruption_double_report_is_detected():
+    checker = InvariantChecker(expected_records=256)
+    d = TaskDispatcher({"s": (0, 256)}, records_per_task=64, shuffle_seed=3)
+    d.add_observer(checker)
+    _install_corruption(_fake_master(d), checker, "double_report")
+    while True:
+        tid, task = d.get(worker_id=0)
+        if task is None:
+            break
+        d.report(tid, success=True)
+    assert d.finished()  # the JOB completes fine — the ACCOUNTING is corrupt
+    violations = checker.check(d.counters(TaskType.TRAINING))
+    assert any(
+        v.invariant == "exactly_once" and "double-counted" in v.detail
+        for v in violations
+    )
+
+
+def test_corruption_lose_task_is_detected():
+    checker = InvariantChecker(expected_records=256)
+    d = TaskDispatcher({"s": (0, 256)}, records_per_task=64, shuffle_seed=3)
+    d.add_observer(checker)
+    _install_corruption(_fake_master(d), checker, "lose_task")
+    while True:
+        tid, task = d.get(worker_id=0)
+        if task is None:
+            break
+        d.report(tid, success=True)
+    violations = checker.check(d.counters(TaskType.TRAINING))
+    assert any(
+        v.invariant == "exactly_once"
+        and "never successfully trained" in v.detail
+        for v in violations
+    )
+
+
+def test_corruption_rejects_unknown_mode():
+    d = TaskDispatcher({"s": (0, 64)}, records_per_task=64)
+    with pytest.raises(ValueError):
+        _install_corruption(
+            _fake_master(d), InvariantChecker(), "cosmic_rays"
+        )
+
+
+# ---- master-side plumbing ---------------------------------------------------
+
+
+def test_instance_manager_world_size_clamped():
+    from elasticdl_tpu.master.master import LocalInstanceManager
+
+    im = LocalInstanceManager.__new__(LocalInstanceManager)
+    im._num_workers = 4
+    im._world_size = 4
+    im.set_world_size(2)
+    assert im.world_size == 2
+    im.set_world_size(0)
+    assert im.world_size == 1  # never below one process
+    im.set_world_size(99)
+    assert im.world_size == 4  # never beyond the configured fleet
+
+
+# ---- end to end (multi-process; slow) --------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_runner_preempt_end_to_end(tmp_path):
+    """The acceptance path: a preempt_one_worker chaos job completes,
+    all invariants PASS, and the report carries the injected fault."""
+    from elasticdl_tpu.chaos.harness import ChaosJobConfig, run_chaos_job
+    from elasticdl_tpu.chaos.plan import named_plan
+
+    report = run_chaos_job(
+        ChaosJobConfig(
+            plan=named_plan("preempt_one_worker", num_workers=2),
+            workdir=str(tmp_path),
+            num_records=512,
+            num_epochs=2,
+        )
+    )
+    assert report["invariants_ok"], report
+    assert report["records_ok"]
+    assert [e["kind"] for e in report["faults_injected"]] == [
+        "preempt_worker"
+    ]
+    assert report["reforms"], "the kill never re-formed the world"
+    assert report["reform_latency_secs"] > 0
