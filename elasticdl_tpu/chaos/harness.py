@@ -106,6 +106,11 @@ def _master_args(config: ChaosJobConfig, train_dir: str, ckpt_dir: str):
             str(config.checkpoint_steps),
             "--heartbeat_timeout_secs",
             str(config.heartbeat_timeout_secs),
+            # telemetry event log (master lifecycle + worker step
+            # samples) lands in the run dir, so the report CLI can join
+            # it with the chaos artifacts written alongside
+            "--telemetry_dir",
+            os.path.join(config.workdir, "telemetry"),
             *config.extra_master_args,
         ]
     )
@@ -281,6 +286,11 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
     import shutil
 
     shutil.rmtree(os.path.join(config.workdir, "ckpt"), ignore_errors=True)
+    # same freshness rule for the telemetry event log: stale step events
+    # from a previous run would corrupt the report's per-generation stats
+    shutil.rmtree(
+        os.path.join(config.workdir, "telemetry"), ignore_errors=True
+    )
 
     train = synthetic.gen_mnist(
         os.path.join(config.workdir, "train"),

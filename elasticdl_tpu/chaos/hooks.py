@@ -100,6 +100,22 @@ class ChaosInjector:
             **extra,
         }
         logger.warning("CHAOS firing %s: %s", fault.fault_id, event)
+        # mirror into the telemetry event log FIRST (no fsync there —
+        # the chaos log below is the durable record), so the run report
+        # can annotate downtime without reaching for chaos_events.jsonl
+        from elasticdl_tpu.telemetry import worker_hooks as telemetry_hooks
+        from elasticdl_tpu.telemetry.events import EVENT_FAULT_INJECTED
+
+        telemetry_hooks.emit_event(
+            EVENT_FAULT_INJECTED,
+            fault_id=fault.fault_id,
+            kind=fault.kind,
+            # share THIS event's stamps so the report's fault dedup sees
+            # one firing, not two a fraction of a millisecond apart
+            time=event["time"],
+            monotonic=event["monotonic"],
+            **extra,
+        )
         # fsync: a firing may be the process's last act before SIGKILL
         append_event(self._events_path, event, fsync=True)
 

@@ -159,6 +159,33 @@ def _run(args, workdir: str) -> dict:
     return report
 
 
+def write_result_json(report: dict, workdir: str) -> str:
+    """Machine-readable verdict next to the run artifacts
+    (``chaos_result.json``): CI and the telemetry report CLI read
+    per-invariant PASS/FAIL from here instead of scraping stdout."""
+    result = {
+        "plan": report["plan"],
+        "seed": report["seed"],
+        "corrupt": report.get("corrupt", ""),
+        "invariants": [
+            {"name": i["name"], "status": i["status"]}
+            for i in report["invariants"]
+        ],
+        "invariants_ok": report["invariants_ok"],
+        "rc": report.get("rc"),
+        "accuracy": report.get("accuracy"),
+        "accuracy_delta": report.get("accuracy_delta"),
+        "reform_latency_secs": report.get("reform_latency_secs"),
+        "detect_secs": report.get("detect_secs"),
+        "kill_to_step_secs": report.get("kill_to_step_secs"),
+    }
+    path = os.path.join(workdir, "chaos_result.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    return path
+
+
 def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
     if args.list_plans:
@@ -173,9 +200,11 @@ def main(argv=None) -> int:
     if args.workdir:
         os.makedirs(args.workdir, exist_ok=True)
         report = _run(args, args.workdir)
+        write_result_json(report, args.workdir)
     else:
         with tempfile.TemporaryDirectory() as workdir:
             report = _run(args, workdir)
+            write_result_json(report, workdir)
 
     text = json.dumps(report, indent=2)
     print(text)

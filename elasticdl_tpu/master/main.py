@@ -36,6 +36,16 @@ def build_master(args) -> Master:
             return None
         lockstep = num_workers > 1
         max_reforms = getattr(args, "relaunch_on_worker_failure", 3)
+        envs = dict(getattr(args, "envs_dict", {}) or {})
+        telemetry_dir = getattr(args, "telemetry_dir", "") or ""
+        if telemetry_dir:
+            # workers append step samples to the shared event log; the
+            # dir travels by env (like the chaos plan), not by argv
+            from elasticdl_tpu.telemetry.worker_hooks import (
+                TELEMETRY_DIR_ENV,
+            )
+
+            envs.setdefault(TELEMETRY_DIR_ENV, telemetry_dir)
         if backend == "k8s":
             import os
 
@@ -52,7 +62,7 @@ def build_master(args) -> Master:
                 image_name=getattr(args, "docker_image", "") or "",
                 namespace=args.namespace,
                 job_name=args.job_name,
-                envs=getattr(args, "envs_dict", {}) or {},
+                envs=envs,
                 lockstep=lockstep,
                 max_reforms=max_reforms,
                 worker_resource_request=getattr(
@@ -78,7 +88,7 @@ def build_master(args) -> Master:
             master,
             num_workers,
             build_argv,
-            envs=getattr(args, "envs_dict", {}) or {},
+            envs=envs,
             # N>1 workers = one jax.distributed world training ONE model
             lockstep=lockstep,
             max_reforms=max_reforms,

@@ -138,11 +138,32 @@ class Master:
             instance_manager_factory(self) if instance_manager_factory else None
         )
 
+        # ---- telemetry (registry + event log + /metrics endpoint)
+        from elasticdl_tpu.telemetry.master_hooks import MasterTelemetry
+
+        self.telemetry = MasterTelemetry(
+            getattr(args, "telemetry_dir", "") or ""
+        )
+        self.telemetry.attach(
+            self.task_d, self.servicer, tb_service=self.tb_service
+        )
+        self._telemetry_server = None
+
     # ---- lifecycle ---------------------------------------------------------
 
     @property
     def port(self):
         return self._port
+
+    @property
+    def metrics_port(self) -> int | None:
+        """Bound port of the /metrics + /healthz endpoint (None when
+        disabled via a negative ``--metrics_port``)."""
+        return (
+            self._telemetry_server.port
+            if self._telemetry_server is not None
+            else None
+        )
 
     def prepare(self, port: int | None = None):
         """Start services + control-plane server
@@ -155,6 +176,23 @@ class Master:
         self._server = create_server(self.servicer, port)
         self._server.start()
         self._port = self._server._edl_bound_port
+        metrics_port = getattr(self._args, "metrics_port", 0)
+        if metrics_port is not None and metrics_port >= 0:
+            from elasticdl_tpu.telemetry.httpd import TelemetryHTTPServer
+
+            self._telemetry_server = TelemetryHTTPServer(
+                self.telemetry.registry,
+                health_fn=self.telemetry.build_health_fn(
+                    self.job_type.value, lambda: self.instance_manager
+                ),
+                port=metrics_port,
+                host=getattr(self._args, "metrics_host", "127.0.0.1")
+                or "127.0.0.1",
+            )
+            self._telemetry_server.start()
+        self.telemetry.job_start(
+            self.job_type.value, getattr(self._args, "num_workers", 0) or 0
+        )
         if self.tb_service is not None:
             self.tb_service.start()
         if self.instance_manager is not None:
@@ -196,6 +234,9 @@ class Master:
                         self.servicer.forget_worker(ghost)
                     dead = [w for w in dead if w in live]
                 if dead:
+                    self.telemetry.worker_dead(
+                        dead, self.servicer.cluster_version
+                    )
                     self._handle_dead_workers(dead)
                 elif self._reform_requested is not None:
                     # elective re-formation (world size changed): same
@@ -238,6 +279,9 @@ class Master:
                             "World re-formed in %.2fs (cluster version %d)",
                             event["latency_secs"],
                             event["cluster_version"],
+                        )
+                        self.telemetry.reform_latency(
+                            event["cluster_version"], event["latency_secs"]
                         )
                 time.sleep(poll_secs)
         except KeyboardInterrupt:
@@ -286,6 +330,10 @@ class Master:
         # rejected, so none can re-lease a task we are about to recover
         new_version = self.servicer.bump_cluster_version()
         all_ids = set(dead) | set(im.worker_ids())
+        old_world_size = len(all_ids)
+        self.telemetry.reform_start(
+            new_version, dead, reason, old_world_size
+        )
         for worker_id in all_ids:
             self.task_d.recover_tasks(worker_id)
             self.servicer.forget_worker(worker_id)
@@ -302,6 +350,11 @@ class Master:
             self._job_failed = True
             self.request_stop()
             return
+        self.telemetry.reform_complete(
+            new_version,
+            old_world_size,
+            getattr(im, "world_size", old_world_size),
+        )
         self.reform_events.append(
             {
                 "detected_at": t0,
@@ -345,6 +398,10 @@ class Master:
         if self._server is not None:
             self._server.stop(grace=2)
             self._server = None
+        self.telemetry.job_end(1 if self._job_failed else 0)
+        if self._telemetry_server is not None:
+            self._telemetry_server.stop()
+            self._telemetry_server = None
         if self.tb_service is not None:
             # reference master.py:217-230 keeps TB alive after job end
             self.tb_service.close()

@@ -61,6 +61,9 @@ class MasterServicer:
         self._standby_drain = False
         # (worker_id, model_version) observers — chaos invariant checking
         self._version_observers: list = []
+        # telemetry event sink: ``fn(event_name, **fields)`` for quiesce
+        # lifecycle records; never raises into an RPC
+        self._event_sink = None
         if evaluation_service is not None:
             evaluation_service.set_master_servicer(self)
 
@@ -68,6 +71,18 @@ class MasterServicer:
         """``callback(worker_id, model_version)`` on every version
         report; must not call back into the servicer."""
         self._version_observers.append(callback)
+
+    def set_event_sink(self, sink):
+        """``sink(event, **fields)`` — the telemetry event log."""
+        self._event_sink = sink
+
+    def _emit(self, event: str, **fields):
+        if self._event_sink is None:
+            return
+        try:
+            self._event_sink(event, **fields)
+        except Exception:  # noqa: BLE001 — telemetry never breaks RPCs
+            logger.exception("Telemetry event sink failed")
 
     # ---- model version ----------------------------------------------------
 
@@ -299,13 +314,35 @@ class MasterServicer:
             self._heartbeats.pop(worker_id, None)
             self._marked_dead.discard(worker_id)
 
+    def live_workers(self) -> list[int]:
+        """Workers with a recorded heartbeat that are not marked dead
+        (the /healthz liveness view)."""
+        with self._lock:
+            return sorted(set(self._heartbeats) - self._marked_dead)
+
+    @property
+    def cluster_version(self) -> int:
+        return self._cluster_version
+
+    @property
+    def is_quiescing(self) -> bool:
+        return self._quiesce
+
     def begin_quiesce(self):
         """Ask all workers to pause at the next task boundary (first phase
         of mesh re-formation)."""
         with self._lock:
             self._quiesce = True
+            generation = self._cluster_version
+        from elasticdl_tpu.telemetry.events import EVENT_QUIESCE_BEGIN
+
+        self._emit(EVENT_QUIESCE_BEGIN, generation=generation)
 
     def end_quiesce(self):
         with self._lock:
             self._quiesce = False
             self._cluster_version += 1
+            generation = self._cluster_version
+        from elasticdl_tpu.telemetry.events import EVENT_QUIESCE_END
+
+        self._emit(EVENT_QUIESCE_END, generation=generation)

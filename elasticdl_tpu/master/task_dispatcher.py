@@ -119,7 +119,10 @@ class TaskDispatcher:
         """Register a task-lifecycle observer.  Optional methods:
         ``on_tasks_created(tasks)``, ``on_task_leased(task_id,
         worker_id, task)``, ``on_task_reported(task_id, task, success,
-        counted)``, ``on_task_reclaimed(task_id, task)``.  Callbacks may
+        counted)``, ``on_task_done(task_id, task, worker_id, success,
+        exec_counters)`` (counted reports only — carries the reporter
+        and its exec counters for telemetry), ``on_task_reclaimed(
+        task_id, task)``.  Callbacks may
         run under the dispatcher lock — observers must not re-enter.
 
         Tasks created before attach (the constructor slices epoch 0) are
@@ -319,6 +322,14 @@ class TaskDispatcher:
                     len(self._pending) + len(self._active),
                 )
             self._notify("on_task_reported", task_id, task, success, True)
+            self._notify(
+                "on_task_done",
+                task_id,
+                task,
+                assignment.worker_id,
+                success,
+                dict(exec_counters or {}),
+            )
         if eval_completed:
             self._evaluation_service.complete_task(
                 eval_job_id=task.extended.get("eval_job_id")
@@ -439,6 +450,13 @@ class TaskDispatcher:
 
     def counters(self, task_type: TaskType) -> JobCounters:
         return self._counters.setdefault(task_type, JobCounters())
+
+    def exec_metrics_snapshot(self, task_type: TaskType) -> dict:
+        """Copy of the summed exec counters taken under the dispatcher
+        lock — scrape-time readers (telemetry collect callbacks) must
+        not iterate the live dict while a report mutates it."""
+        with self._lock:
+            return dict(self.counters(task_type).exec_metrics)
 
     def snapshot(self) -> dict:
         with self._lock:

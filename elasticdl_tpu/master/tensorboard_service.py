@@ -78,14 +78,33 @@ class TensorboardService:
 
     def keep_running(self, check_fn=lambda: True, poll_secs: float = 10.0):
         """Block while the TB subprocess serves (reference master.py:217-230
-        keeps TB alive after job end)."""
-        while self.tb_process is not None and check_fn():
-            if self.tb_process.poll() is not None:
-                return
-            time.sleep(poll_secs)
+        keeps TB alive after job end).  ``check_fn`` and the subprocess
+        are re-checked on a fine-grained tick so a flip is honored
+        promptly instead of after a full ``poll_secs`` sleep
+        (``poll_secs`` caps the tick for callers that pass a tighter
+        cadence)."""
+        tick = min(0.2, poll_secs) if poll_secs > 0 else 0.05
+        while (
+            self.tb_process is not None
+            and check_fn()
+            and self.tb_process.poll() is None
+        ):
+            time.sleep(tick)
 
     def close(self):
         if self._summary_writer is not None:
             self._summary_writer.close()
-        if self.tb_process is not None and self.tb_process.poll() is None:
-            self.tb_process.terminate()
+        if self.tb_process is not None:
+            if self.tb_process.poll() is None:
+                self.tb_process.terminate()
+            try:
+                # reap: terminate() alone leaves a zombie holding the pid
+                # (and its port) until the master process exits
+                self.tb_process.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.tb_process.kill()
+                try:
+                    self.tb_process.wait(timeout=5)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    logger.warning("tensorboard process did not exit")
+            self.tb_process = None

@@ -1,0 +1,34 @@
+"""Telemetry spine: metrics registry, elastic event log, ``/metrics``
+endpoint, run-report CLI (docs/designs/telemetry.md).
+
+- :mod:`elasticdl_tpu.telemetry.registry` — process-local counters /
+  gauges / histograms with Prometheus text exposition;
+- :mod:`elasticdl_tpu.telemetry.events` — append-only JSONL elastic
+  lifecycle log shared by master + workers;
+- :mod:`elasticdl_tpu.telemetry.master_hooks` — the master's observer
+  wiring and health snapshot;
+- :mod:`elasticdl_tpu.telemetry.worker_hooks` — per-step samples, free
+  (single early-return) when telemetry is not installed;
+- :mod:`elasticdl_tpu.telemetry.httpd` — daemon-thread HTTP endpoint;
+- :mod:`elasticdl_tpu.telemetry.report` — ``python -m
+  elasticdl_tpu.telemetry.report <run_dir>``.
+"""
+
+from elasticdl_tpu.telemetry.events import EventLog, read_events
+from elasticdl_tpu.telemetry.registry import (
+    STEP_LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+
+__all__ = [
+    "Counter",
+    "EventLog",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "STEP_LATENCY_BUCKETS",
+    "read_events",
+]

@@ -1,0 +1,141 @@
+"""Structured elastic-lifecycle event log (append-only JSONL).
+
+One file per run (``<telemetry_dir>/events.jsonl``), shared by the
+master and every worker subprocess via O_APPEND — the same
+single-writer-per-line discipline as the chaos event log
+(:mod:`elasticdl_tpu.chaos.hooks`), so lines from concurrent writers
+never interleave and a torn final line from a SIGKILL'd writer is
+skipped on read.
+
+Schema: every record carries ``time`` (wall clock), ``monotonic``
+(machine-wide CLOCK_MONOTONIC — single-host runs can subtract across
+processes) and ``event``; lifecycle context (``generation``, ``step``,
+``worker_id``, ...) rides as flat keys.  Event names are snake_case and
+defined once below (scripts/check_telemetry_names.py enforces both).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+# ---- event vocabulary (one definition site per name) ------------------------
+
+EVENT_JOB_START = "job_start"
+EVENT_JOB_END = "job_end"
+EVENT_STEP = "step"
+EVENT_TASK_DISPATCH = "task_dispatch"
+EVENT_TASK_DONE = "task_done"
+EVENT_TASK_RECOVERED = "task_recovered"
+EVENT_WORKER_DEAD = "worker_dead"
+EVENT_QUIESCE_BEGIN = "quiesce_begin"
+EVENT_QUIESCE_END = "quiesce_end"
+EVENT_REFORM_START = "reform_start"
+EVENT_REFORM_COMPLETE = "reform_complete"
+EVENT_REFORM_LATENCY = "reform_latency"
+EVENT_WORKER_TIMING = "worker_timing"
+EVENT_CHECKPOINT_SAVE = "checkpoint_save"
+EVENT_CHECKPOINT_RESTORE = "checkpoint_restore"
+EVENT_FAULT_INJECTED = "fault_injected"
+
+EVENTS_FILENAME = "events.jsonl"
+
+
+class EventLog:
+    """Append-only JSONL writer; a no-path log swallows every emit, so
+    callers never branch on whether telemetry is configured.
+
+    ``async_writes=True`` moves the disk write to a daemon thread: the
+    master emits from under the TaskDispatcher lock (observer
+    callbacks), so a synchronous write there would serialize every
+    worker's get-task/report RPC behind file I/O.  Timestamps are taken
+    at EMIT time either way; ``flush()`` drains the queue (the master
+    calls it at job end).  Workers keep the default synchronous write —
+    their emits are on the training thread only, and a SIGKILL'd
+    process (chaos preempt) must not lose its final queued events.
+    """
+
+    def __init__(self, path: str = "", async_writes: bool = False):
+        self._path = path
+        self._async = async_writes and bool(path)
+        self._queue: queue.SimpleQueue | None = None
+        self._thread: threading.Thread | None = None
+        if path:
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+        if self._async:
+            self._queue = queue.SimpleQueue()
+            self._thread = threading.Thread(
+                target=self._drain, name="telemetry-events", daemon=True
+            )
+            self._thread.start()
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._path)
+
+    def emit(self, event: str, **fields):
+        if not self._path:
+            return
+        record = {
+            "time": time.time(),
+            "monotonic": time.monotonic(),
+            "event": event,
+            **fields,
+        }
+        if self._async:
+            self._queue.put(record)
+        else:
+            self._write(record)
+
+    def flush(self, timeout: float = 5.0):
+        """Block until everything queued so far is on disk (async logs
+        only; a synchronous log is always flushed)."""
+        if not self._async:
+            return
+        done = threading.Event()
+        self._queue.put(done)
+        done.wait(timeout)
+
+    def _drain(self):
+        while True:
+            item = self._queue.get()
+            if isinstance(item, threading.Event):
+                item.set()
+                continue
+            self._write(item)
+
+    def _write(self, record: dict):
+        try:
+            with open(self._path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(record) + "\n")
+        except OSError:
+            logger.exception("Telemetry event log write failed")
+
+
+def read_events(path: str) -> list[dict]:
+    """Parse one events.jsonl; torn lines (a writer killed mid-write)
+    are skipped, matching the chaos log reader."""
+    events: list[dict] = []
+    if not os.path.exists(path):
+        return events
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue
+    return events

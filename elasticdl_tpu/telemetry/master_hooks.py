@@ -1,0 +1,285 @@
+"""Master-side telemetry: one object owning the registry + event log.
+
+Wired by :class:`~elasticdl_tpu.master.master.Master` as a
+``TaskDispatcher`` observer, a servicer version observer and the re-form
+path's direct collaborator, so the elastic lifecycle is measured with NO
+new plumbing through the hot loop — the observers the chaos checker
+already rides (PR 1) are the same ones telemetry rides.
+
+Registry refresh happens at scrape time via a collect callback (queue
+depths, epoch, live workers, the workers' ``time_<bucket>_ms`` wall
+clock buckets mirrored from the dispatcher's exec-counter sums), so the
+run loop pays nothing for ``/metrics`` being up.
+"""
+
+from __future__ import annotations
+
+import os
+
+from elasticdl_tpu.telemetry.events import (
+    EVENT_JOB_END,
+    EVENT_JOB_START,
+    EVENT_REFORM_COMPLETE,
+    EVENT_REFORM_LATENCY,
+    EVENT_REFORM_START,
+    EVENT_TASK_DISPATCH,
+    EVENT_TASK_DONE,
+    EVENT_TASK_RECOVERED,
+    EVENT_WORKER_DEAD,
+    EVENTS_FILENAME,
+    EventLog,
+)
+from elasticdl_tpu.telemetry.registry import MetricsRegistry
+
+# family names referenced from more than one code path live here so each
+# is REGISTERED at exactly one call site (scripts/check_telemetry_names.py)
+_TASKS_DISPATCHED = "elasticdl_tasks_dispatched_total"
+_TASKS_COMPLETED = "elasticdl_tasks_completed_total"
+_WORKER_TIME_MS = "elasticdl_worker_time_ms_total"
+
+
+class MasterTelemetry:
+    def __init__(self, telemetry_dir: str = "", registry=None):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        # async: master emits happen inside TaskDispatcher observer
+        # callbacks (under the dispatcher lock) — the control plane must
+        # never queue worker RPCs behind a disk write
+        self.events = EventLog(
+            os.path.join(telemetry_dir, EVENTS_FILENAME)
+            if telemetry_dir
+            else "",
+            async_writes=True,
+        )
+        r = self.registry
+
+        def per_type(name, help_text):
+            # pre-create the training child so every family is visible
+            # on /metrics from the first scrape, before any task flows
+            return r.counter(name, help_text, labels={"type": "training"})
+
+        per_type(_TASKS_DISPATCHED, "Task leases handed to workers")
+        per_type(_TASKS_COMPLETED, "Tasks reported successfully")
+        self._tasks_recovered = r.counter(
+            "elasticdl_tasks_recovered_total",
+            "Tasks re-queued after failure, lease timeout or worker death",
+        )
+        self._records = r.counter(
+            "elasticdl_records_processed_total",
+            "Records covered by successfully completed tasks",
+        )
+        self._model_version = r.gauge(
+            "elasticdl_model_version", "Highest model version reported"
+        )
+        self._generation = r.gauge(
+            "elasticdl_cluster_generation",
+            "World generation (bumped by every re-formation)",
+        )
+        self._workers_live = r.gauge(
+            "elasticdl_workers_live", "Workers with a live heartbeat"
+        )
+        self._workers_dead = r.counter(
+            "elasticdl_workers_dead_total",
+            "Workers declared dead (heartbeat miss or process exit)",
+        )
+        self._reforms = r.counter(
+            "elasticdl_reforms_total", "World re-formations"
+        )
+        self._reform_downtime = r.histogram(
+            "elasticdl_reform_downtime_seconds",
+            "Failure detection to first step-task pull of the new world",
+        )
+        self._tasks_pending = r.gauge(
+            "elasticdl_tasks_pending", "Tasks queued, not leased"
+        )
+        self._tasks_active = r.gauge(
+            "elasticdl_tasks_active", "Tasks currently leased"
+        )
+        self._epoch = r.gauge("elasticdl_epoch", "Current training epoch")
+
+        self._task_d = None
+        self._servicer = None
+        self._tb_service = None
+        self._tb_mirrored_version = -1
+        r.add_collect_callback(self._collect)
+
+    # ---- wiring ------------------------------------------------------------
+
+    def attach(self, task_dispatcher, servicer, tb_service=None):
+        self._task_d = task_dispatcher
+        self._servicer = servicer
+        self._tb_service = tb_service
+        task_dispatcher.add_observer(self)
+        servicer.add_version_observer(self.on_version_report)
+        servicer.set_event_sink(self.events.emit)
+
+    def _collect(self, _registry):
+        """Scrape-time refresh of point-in-time values."""
+        if self._task_d is not None:
+            snap = self._task_d.snapshot()
+            self._tasks_pending.set(snap["pending"] + snap["pending_eval"])
+            self._tasks_active.set(len(snap["active"]))
+            self._epoch.set(snap["epoch"])
+            from elasticdl_tpu.utils.constants import TaskType
+
+            for key, value in self._task_d.exec_metrics_snapshot(
+                TaskType.TRAINING
+            ).items():
+                if key.startswith("time_") and key.endswith("_ms"):
+                    self.registry.counter(
+                        _WORKER_TIME_MS,
+                        "Worker wall-clock buckets (utils.timing_utils)",
+                        labels={"bucket": key[len("time_") : -len("_ms")]},
+                    ).set_total(value)
+        if self._servicer is not None:
+            self._workers_live.set(len(self._servicer.live_workers()))
+            self._generation.set(self._servicer.cluster_version)
+
+    def build_health_fn(self, job_type: str, instance_manager_fn=lambda: None):
+        """The ``/healthz`` payload closure (also used directly by
+        tests): generation, live workers, model version, quiesce."""
+        servicer = self._servicer
+
+        def health() -> dict:
+            im = instance_manager_fn()
+            live = (
+                im.worker_ids()
+                if im is not None
+                else (servicer.live_workers() if servicer else [])
+            )
+            quiescing = bool(servicer and servicer.is_quiescing)
+            return {
+                "status": "quiescing" if quiescing else "ok",
+                "job_type": job_type,
+                "generation": servicer.cluster_version if servicer else 0,
+                "model_version": (
+                    servicer.get_model_version() if servicer else 0
+                ),
+                "live_workers": sorted(live),
+                "num_live_workers": len(live),
+                "quiescing": quiescing,
+            }
+
+        return health
+
+    # ---- TaskDispatcher observer -------------------------------------------
+
+    def on_task_leased(self, task_id, worker_id, task):
+        type_name = task.type.name.lower()
+        self.registry.counter(
+            _TASKS_DISPATCHED, labels={"type": type_name}
+        ).inc()
+        self.events.emit(
+            EVENT_TASK_DISPATCH,
+            task_id=task_id,
+            worker_id=worker_id,
+            type=type_name,
+            shard=task.shard_name,
+            records=task.num_records,
+        )
+
+    def on_task_done(
+        self, task_id, task, worker_id, success, exec_counters=None
+    ):
+        type_name = task.type.name.lower()
+        if success:
+            self.registry.counter(
+                _TASKS_COMPLETED, labels={"type": type_name}
+            ).inc()
+            self._records.inc(task.num_records)
+            self.events.emit(
+                EVENT_TASK_DONE,
+                task_id=task_id,
+                worker_id=worker_id,
+                type=type_name,
+                records=task.num_records,
+                **{
+                    k: v
+                    for k, v in (exec_counters or {}).items()
+                    if k.startswith("time_")
+                },
+            )
+        else:
+            self._tasks_recovered.inc()
+            self.events.emit(
+                EVENT_TASK_RECOVERED,
+                task_id=task_id,
+                worker_id=worker_id,
+                type=type_name,
+                records=task.num_records,
+                reason="report_failed",
+            )
+
+    def on_task_reclaimed(self, task_id, task):
+        self._tasks_recovered.inc()
+        self.events.emit(
+            EVENT_TASK_RECOVERED,
+            task_id=task_id,
+            type=task.type.name.lower(),
+            records=task.num_records,
+            reason="lease_timeout",
+        )
+
+    # ---- servicer / master lifecycle ---------------------------------------
+
+    def on_version_report(self, worker_id, model_version):
+        if model_version <= self._model_version.value:
+            return
+        self._model_version.set(model_version)
+        if self._tb_service is not None and (
+            model_version > self._tb_mirrored_version
+        ):
+            # registry scalars mirrored so TB (and metrics.jsonl) keeps
+            # carrying the run's health timeline unchanged
+            self._tb_mirrored_version = model_version
+            self._tb_service.write_dict_to_summary(
+                {
+                    "telemetry/model_version": model_version,
+                    "telemetry/workers_live": self._workers_live.value,
+                    "telemetry/records_processed": self._records.value,
+                    "telemetry/reforms": self._reforms.value,
+                },
+                model_version,
+            )
+
+    def job_start(self, job_type: str, num_workers: int):
+        self.events.emit(
+            EVENT_JOB_START, job_type=job_type, num_workers=num_workers
+        )
+
+    def job_end(self, rc: int):
+        self.events.emit(EVENT_JOB_END, rc=rc)
+        self.events.flush()
+
+    def worker_dead(self, worker_ids, generation: int):
+        self._workers_dead.inc(len(worker_ids))
+        for worker_id in worker_ids:
+            self.events.emit(
+                EVENT_WORKER_DEAD, worker_id=worker_id, generation=generation
+            )
+
+    def reform_start(self, generation, dead, reason, old_world_size):
+        self._generation.set(generation)
+        self.events.emit(
+            EVENT_REFORM_START,
+            generation=generation,
+            dead_workers=sorted(dead),
+            reason=reason,
+            old_world_size=old_world_size,
+        )
+
+    def reform_complete(self, generation, old_world_size, new_world_size):
+        self._reforms.inc()
+        self.events.emit(
+            EVENT_REFORM_COMPLETE,
+            generation=generation,
+            old_world_size=old_world_size,
+            new_world_size=new_world_size,
+        )
+
+    def reform_latency(self, generation, latency_secs: float):
+        self._reform_downtime.observe(latency_secs)
+        self.events.emit(
+            EVENT_REFORM_LATENCY,
+            generation=generation,
+            latency_secs=latency_secs,
+        )

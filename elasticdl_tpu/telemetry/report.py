@@ -1,0 +1,331 @@
+"""Run-report CLI: join the telemetry event log with chaos artifacts.
+
+::
+
+    python -m elasticdl_tpu.telemetry.report <run_dir> [--json] [--output f]
+
+``<run_dir>`` is any directory tree containing telemetry ``events.jsonl``
+files (e.g. a chaos runner ``--workdir``, which holds one run under
+``chaos/telemetry/`` and one under ``baseline/telemetry/``).  For each
+run the report computes, per world generation:
+
+- step count and p50/p95/p99 step time (from worker ``step`` samples);
+- reform downtime — last ``step`` of generation N to first ``step`` of
+  generation N+1 — annotated with the chaos fault that caused it (from
+  ``chaos_events.jsonl`` / mirrored ``fault_injected`` events) and the
+  tasks recovered inside the gap;
+- per-worker records/sec (lockstep note: every process steps through the
+  full global batch, so per-worker rates describe step cadence, not
+  disjoint data slices);
+- worker wall-clock bucket totals (``time_<bucket>_ms``) summed from
+  ``task_done`` events.
+
+``chaos_result.json`` (written by ``python -m elasticdl_tpu.chaos.runner``)
+is surfaced verbatim so CI reads verdicts and numbers from one place.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from collections import defaultdict
+
+from elasticdl_tpu.telemetry.events import EVENTS_FILENAME, read_events
+
+# a fault can fire slightly before the victim's last recorded step lands
+# in the log (the event is written at step START); allow this much skew
+# when attributing a downtime gap to a fault
+_FAULT_ATTRIBUTION_SLACK_SECS = 5.0
+
+
+def percentile(samples: list[float], q: float) -> float:
+    """Nearest-rank percentile (q in [0, 100]) — exact over raw samples,
+    no interpolation surprises in tiny runs."""
+    if not samples:
+        raise ValueError("no samples")
+    ordered = sorted(samples)
+    rank = max(1, math.ceil(q / 100.0 * len(ordered)))
+    return ordered[min(rank, len(ordered)) - 1]
+
+
+def _find_files(run_dir: str, filename: str) -> list[str]:
+    found = []
+    for root, _dirs, files in os.walk(run_dir):
+        if filename in files:
+            found.append(os.path.join(root, filename))
+    return sorted(found)
+
+
+def _load_fault_events(run_dir: str) -> list[dict]:
+    """Fault firings from every chaos event log under the run dir plus
+    any mirrored ``fault_injected`` telemetry events (deduplicated by
+    fault id + firing time)."""
+    faults = []
+    for path in _find_files(run_dir, "chaos_events.jsonl"):
+        for event in read_events(path):
+            if "observation" not in event:
+                faults.append(event)
+    seen = {(f.get("fault_id"), round(f.get("monotonic", 0), 3)) for f in faults}
+    for path in _find_files(run_dir, EVENTS_FILENAME):
+        for event in read_events(path):
+            if event.get("event") != "fault_injected":
+                continue
+            key = (event.get("fault_id"), round(event.get("monotonic", 0), 3))
+            if key not in seen:
+                seen.add(key)
+                faults.append(event)
+    return sorted(faults, key=lambda f: f.get("monotonic", 0.0))
+
+
+def _generation_stats(steps: list[dict]) -> dict:
+    samples = [
+        e["duration_secs"] for e in steps if e.get("duration_secs") is not None
+    ]
+    workers = sorted({e.get("worker_id", 0) for e in steps})
+    stats = {
+        "steps": len(steps),
+        "workers": workers,
+        "records": sum(e.get("records", 0) for e in steps),
+        "first_step_at": steps[0]["monotonic"],
+        "last_step_at": steps[-1]["monotonic"],
+    }
+    if samples:
+        stats.update(
+            {
+                "step_time_p50_ms": percentile(samples, 50) * 1000.0,
+                "step_time_p95_ms": percentile(samples, 95) * 1000.0,
+                "step_time_p99_ms": percentile(samples, 99) * 1000.0,
+                "step_time_mean_ms": sum(samples) / len(samples) * 1000.0,
+            }
+        )
+    return stats
+
+
+def _worker_throughput(steps: list[dict]) -> dict[int, float]:
+    """records/sec per worker, summed over the spans the worker was
+    actually stepping (gaps between generations excluded because each
+    generation's span is measured independently)."""
+    spans: dict[int, float] = defaultdict(float)
+    records: dict[int, float] = defaultdict(float)
+    by_worker_gen: dict[tuple, list[dict]] = defaultdict(list)
+    for event in steps:
+        key = (event.get("worker_id", 0), event.get("generation", 0))
+        by_worker_gen[key].append(event)
+    for (worker_id, _gen), events in by_worker_gen.items():
+        span = events[-1]["monotonic"] - events[0]["monotonic"]
+        if span > 0:
+            spans[worker_id] += span
+            records[worker_id] += sum(e.get("records", 0) for e in events)
+    return {
+        w: records[w] / spans[w] for w in sorted(spans) if spans[w] > 0
+    }
+
+
+def _attribute_fault(faults: list[dict], gap_start: float, gap_end: float):
+    candidates = [
+        f
+        for f in faults
+        if gap_start - _FAULT_ATTRIBUTION_SLACK_SECS
+        <= f.get("monotonic", 0.0)
+        <= gap_end
+    ]
+    return candidates[-1] if candidates else None
+
+
+def analyze_events(events: list[dict], faults: list[dict]) -> dict:
+    """Summarize one run's telemetry event stream (pure function — the
+    unit tests drive it with canned logs)."""
+    steps = sorted(
+        (e for e in events if e.get("event") == "step"),
+        key=lambda e: e.get("monotonic", 0.0),
+    )
+    by_gen: dict[int, list[dict]] = defaultdict(list)
+    for event in steps:
+        by_gen[event.get("generation", 0)].append(event)
+
+    generations = {
+        gen: _generation_stats(by_gen[gen]) for gen in sorted(by_gen)
+    }
+
+    recovered = [e for e in events if e.get("event") == "task_recovered"]
+    reform_events = [
+        e
+        for e in events
+        if e.get("event") in ("reform_start", "reform_complete", "reform_latency")
+    ]
+
+    downtimes = []
+    ordered_gens = sorted(by_gen)
+    for prev, nxt in zip(ordered_gens, ordered_gens[1:]):
+        gap_start = generations[prev]["last_step_at"]
+        gap_end = generations[nxt]["first_step_at"]
+        downtime = {
+            "from_generation": prev,
+            "to_generation": nxt,
+            "downtime_secs": max(0.0, gap_end - gap_start),
+            "tasks_recovered": sum(
+                1
+                for e in recovered
+                if gap_start <= e.get("monotonic", 0.0) <= gap_end
+            ),
+        }
+        fault = _attribute_fault(faults, gap_start, gap_end)
+        if fault is not None:
+            downtime["cause"] = {
+                "fault_id": fault.get("fault_id"),
+                "kind": fault.get("kind"),
+                "process_id": fault.get("process_id"),
+                "at_step": fault.get("step"),
+            }
+        downtimes.append(downtime)
+
+    # task_done carries per-task DELTAS (lockstep exec counters);
+    # worker_timing carries a runtime's cumulative TOTALS (local
+    # executor) — sum the former, take max-per-worker of the latter
+    time_buckets: dict[str, float] = defaultdict(float)
+    cumulative: dict[tuple, float] = {}
+    for event in events:
+        if event.get("event") == "task_done":
+            for key, value in event.items():
+                if key.startswith("time_") and key.endswith("_ms"):
+                    time_buckets[key[len("time_") : -len("_ms")]] += value
+        elif event.get("event") == "worker_timing":
+            for key, value in event.items():
+                if key.startswith("time_") and key.endswith("_ms"):
+                    wk = (event.get("worker_id", 0), key)
+                    cumulative[wk] = max(cumulative.get(wk, 0.0), value)
+    for (_worker, key), value in cumulative.items():
+        time_buckets[key[len("time_") : -len("_ms")]] += value
+
+    return {
+        "generations": generations,
+        "reform_downtime": downtimes,
+        "records_per_sec_by_worker": _worker_throughput(steps),
+        "tasks_recovered_total": len(recovered),
+        "reform_event_count": len(reform_events),
+        "worker_time_ms": dict(time_buckets),
+        "events_total": len(events),
+    }
+
+
+def build_report(run_dir: str) -> dict:
+    faults = _load_fault_events(run_dir)
+    runs = {}
+    for path in _find_files(run_dir, EVENTS_FILENAME):
+        rel = os.path.relpath(path, run_dir)
+        runs[rel] = analyze_events(read_events(path), faults)
+    report = {"run_dir": run_dir, "runs": runs, "faults": faults}
+    for path in _find_files(run_dir, "chaos_result.json"):
+        try:
+            with open(path, encoding="utf-8") as f:
+                report["chaos_result"] = json.load(f)
+            break
+        except (OSError, ValueError):
+            continue
+    return report
+
+
+def _format_text(report: dict) -> str:
+    lines = [f"Run report: {report['run_dir']}"]
+    chaos = report.get("chaos_result")
+    if chaos:
+        verdicts = " ".join(
+            f"{i['name']}={i['status']}" for i in chaos.get("invariants", [])
+        )
+        lines.append(
+            f"chaos: plan={chaos.get('plan')} seed={chaos.get('seed')} "
+            f"ok={chaos.get('invariants_ok')}"
+        )
+        if verdicts:
+            lines.append(f"  invariants: {verdicts}")
+    if not report["runs"]:
+        lines.append(
+            "no telemetry event logs found (run the master with "
+            "--telemetry_dir, or the chaos runner with --workdir)"
+        )
+    for rel, run in report["runs"].items():
+        lines.append(f"== {rel} ==")
+        for gen, stats in run["generations"].items():
+            pct = (
+                "  p50={:.1f}ms p95={:.1f}ms p99={:.1f}ms".format(
+                    stats["step_time_p50_ms"],
+                    stats["step_time_p95_ms"],
+                    stats["step_time_p99_ms"],
+                )
+                if "step_time_p50_ms" in stats
+                else ""
+            )
+            lines.append(
+                f"generation {gen}: {stats['steps']} steps{pct}  "
+                f"records={stats['records']} workers={stats['workers']}"
+            )
+        for gap in run["reform_downtime"]:
+            cause = gap.get("cause")
+            caused_by = (
+                "  cause: {} ({}, process {}, step {})".format(
+                    cause.get("fault_id"),
+                    cause.get("kind"),
+                    cause.get("process_id"),
+                    cause.get("at_step"),
+                )
+                if cause
+                else "  cause: unattributed"
+            )
+            lines.append(
+                "reform gen{}->gen{}: downtime {:.2f}s  "
+                "tasks recovered: {}{}".format(
+                    gap["from_generation"],
+                    gap["to_generation"],
+                    gap["downtime_secs"],
+                    gap["tasks_recovered"],
+                    caused_by,
+                )
+            )
+        for worker, rate in run["records_per_sec_by_worker"].items():
+            lines.append(f"throughput: worker {worker}: {rate:.1f} records/s")
+        if run["worker_time_ms"]:
+            buckets = " ".join(
+                f"{name}={total:.0f}ms"
+                for name, total in sorted(run["worker_time_ms"].items())
+            )
+            lines.append(f"worker time buckets: {buckets}")
+    return "\n".join(lines)
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m elasticdl_tpu.telemetry.report",
+        description="Summarize a run's telemetry event logs",
+    )
+    parser.add_argument("run_dir", help="Directory tree holding events.jsonl")
+    parser.add_argument(
+        "--json", action="store_true", help="Emit the full report as JSON"
+    )
+    parser.add_argument(
+        "--output", default="", help="Also write the JSON report here"
+    )
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    if not os.path.isdir(args.run_dir):
+        print(f"not a directory: {args.run_dir}", file=sys.stderr)
+        return 2
+    report = build_report(args.run_dir)
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(_format_text(report))
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2, default=str)
+            f.write("\n")
+    return 0 if report["runs"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

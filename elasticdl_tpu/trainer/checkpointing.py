@@ -99,6 +99,10 @@ class PeriodicCheckpointer:
         from elasticdl_tpu.chaos import hooks as chaos_hooks
 
         chaos_hooks.notify_checkpoint_save(int(version))
+        from elasticdl_tpu.telemetry import worker_hooks as telemetry_hooks
+        from elasticdl_tpu.telemetry.events import EVENT_CHECKPOINT_SAVE
+
+        telemetry_hooks.emit_event(EVENT_CHECKPOINT_SAVE, step=int(version))
         # non-chiefs only write their table parts: don't pay device->host
         # copies for replicated leaves they would discard
         dense, parts = elastic.state_checkpoint_parts(
@@ -217,6 +221,12 @@ def restore_trainer_state(trainer, args, process_id: int = 0) -> int | None:
     from elasticdl_tpu.chaos import hooks as chaos_hooks
 
     chaos_hooks.notify_checkpoint_restore(restored_step)
+    from elasticdl_tpu.telemetry import worker_hooks as telemetry_hooks
+    from elasticdl_tpu.telemetry.events import EVENT_CHECKPOINT_RESTORE
+
+    telemetry_hooks.emit_event(
+        EVENT_CHECKPOINT_RESTORE, step=restored_step, resume=bool(resume)
+    )
     state = state.replace(step=np.asarray(restored_step, dtype=np.int32))
     trainer.state = jax.device_put(state, trainer.state_shardings)
     logger.info(

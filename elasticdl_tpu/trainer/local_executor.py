@@ -111,6 +111,16 @@ class LocalExecutor:
         self._timing = Timing(
             enabled=args.log_level == "DEBUG", logger=logger
         )
+        # per-step telemetry samples (events.jsonl for the report CLI);
+        # --telemetry_dir or the inherited env enables it
+        import os as _os
+
+        from elasticdl_tpu.telemetry import worker_hooks as telemetry_hooks
+
+        self._telemetry = telemetry_hooks.install(
+            getattr(args, "telemetry_dir", "")
+            or _os.environ.get(telemetry_hooks.TELEMETRY_DIR_ENV, "")
+        )
         self._last_eval_milestone = 0
         from elasticdl_tpu.utils.profiling import StepProfiler
 
@@ -204,6 +214,7 @@ class LocalExecutor:
         loop passes one so host decode overlaps device compute); default
         builds the task's pipeline inline (retry paths, tests)."""
         from elasticdl_tpu.trainer.stacking import run_stacked_steps
+        from elasticdl_tpu.telemetry.worker_hooks import record_step
 
         def _pre(features):
             self._ensure_trainer(features)
@@ -212,6 +223,7 @@ class LocalExecutor:
             # the dispatch, so it would repeat within a group — ADVICE
             # r3 finding 3)
             self._profiler.on_step()
+            record_step(self._version, self._args.minibatch_size)
 
         return run_stacked_steps(
             lambda: self._trainer,
@@ -364,6 +376,9 @@ class LocalExecutor:
         logger.info(
             "Training complete: %d records, %d steps", total, self._version
         )
+        from elasticdl_tpu.telemetry.worker_hooks import publish_timing
+
+        publish_timing(self._timing)
         self._timing.report_timing(reset=True)
         if self._checkpointer.enabled and self._trainer is not None:
             self._checkpointer.save_now(

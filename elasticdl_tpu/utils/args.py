@@ -232,6 +232,33 @@ def _add_train_params(parser: argparse.ArgumentParser):
     )
     parser.add_argument("--tensorboard_log_dir", default="")
     parser.add_argument(
+        "--telemetry_dir",
+        default="",
+        help=(
+            "Write the structured elastic event log (events.jsonl) here; "
+            "workers inherit it via the environment.  Summarize with "
+            "python -m elasticdl_tpu.telemetry.report"
+        ),
+    )
+    parser.add_argument(
+        "--metrics_port",
+        type=int,
+        default=0,
+        help=(
+            "Port for the master's /metrics (Prometheus) + /healthz "
+            "endpoint; 0 picks a free port, negative disables the server"
+        ),
+    )
+    parser.add_argument(
+        "--metrics_host",
+        default="127.0.0.1",
+        help=(
+            "Bind address for /metrics + /healthz.  Loopback by default "
+            "(the endpoint is unauthenticated); set 0.0.0.0 to let a "
+            "scraper reach it from off the machine"
+        ),
+    )
+    parser.add_argument(
         "--profile_dir",
         default="",
         help=(
@@ -600,6 +627,11 @@ _MASTER_ONLY_FLAGS = frozenset(
         "standby_workers",
         "yaml",
         "cluster_spec",
+        # workers receive the telemetry dir via ELASTICDL_TPU_TELEMETRY_DIR
+        # (master/main.py) and never serve /metrics themselves
+        "telemetry_dir",
+        "metrics_port",
+        "metrics_host",
     }
 )
 

@@ -69,6 +69,19 @@ class Timing:
                 self._reported_ms[name] = cum_ms
         return out
 
+    def totals_ms(self) -> dict[str, int]:
+        """Cumulative bucket totals as ``time_<bucket>_ms`` keys — the
+        ABSOLUTE counterpart of :meth:`exec_counters` deltas, for
+        telemetry consumers (event log, registry mirror) that want the
+        run total in one read.  Does not advance the delta bookkeeping."""
+        if not self._enabled:
+            return {}
+        return {
+            f"time_{name}_ms": round(total * 1000)
+            for name, total in self._totals.items()
+            if round(total * 1000)
+        }
+
     def report_timing(self, reset: bool = False):
         if self._enabled and self._logger is not None:
             for name, stats in self.summary().items():

@@ -111,6 +111,16 @@ class LockstepWorker:
         self._chaos = chaos_hooks.install_from_env(
             self._process_id, self._cluster_version, self._worker_id
         )
+        # telemetry step sampling (no-op unless the master exported
+        # ELASTICDL_TPU_TELEMETRY_DIR): a re-formed world installs a
+        # fresh recorder stamped with its generation
+        from elasticdl_tpu.telemetry import worker_hooks as telemetry_hooks
+
+        telemetry_hooks.install_from_env(
+            worker_id=self._worker_id,
+            process_id=self._process_id,
+            generation=self._cluster_version,
+        )
         self._checkpointer = PeriodicCheckpointer(
             getattr(args, "checkpoint_dir", "") or "",
             getattr(args, "checkpoint_steps", 0) or 0,
@@ -256,9 +266,15 @@ class LockstepWorker:
         # the scanned dispatch contains the same collectives
         from elasticdl_tpu.trainer.stacking import run_stacked_steps
 
+        from elasticdl_tpu.telemetry.worker_hooks import record_step
+
         def _pre(features):
             self._ensure_trainer(features)
             self._profiler.on_step(self._trainer.step)
+            # per-step telemetry sample (a single early-return when
+            # telemetry is not installed); every process steps through
+            # the full global batch, so records == global minibatch
+            record_step(int(self._trainer.step), self._minibatch_size)
             if self._chaos is not None:
                 # per-minibatch arming point: step-scheduled faults fire
                 # at the exact model version the plan names

@@ -1,0 +1,129 @@
+#!/usr/bin/env python3
+"""Telemetry naming lint (wired into scripts/run_tier1.sh).
+
+Enforces the contracts docs/designs/telemetry.md relies on:
+
+1. every metric name passed literally to ``.counter(`` / ``.gauge(`` /
+   ``.histogram(`` and every event name passed literally to ``.emit(`` /
+   ``emit_event(`` is snake_case;
+2. each such name has exactly ONE registration/definition site (names
+   used from several modules must live in a shared constant — e.g. the
+   ``EVENT_*`` vocabulary in ``telemetry/events.py`` — so the registry
+   and the event schema each have a single source of truth);
+3. every ``EVENT_*`` constant in ``telemetry/events.py`` is snake_case
+   and defined once;
+4. no bare ``print(`` statements inside ``elasticdl_tpu/`` outside the
+   allowlisted CLI entry points — runtime output goes through the
+   logger or the telemetry spine, where it is structured and greppable.
+
+Pure stdlib + regex: runs in any environment, imports nothing from the
+package.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO_ROOT, "elasticdl_tpu")
+
+SNAKE_CASE = re.compile(r"^[a-z][a-z0-9_]*$")
+METRIC_CALL = re.compile(
+    r"\.(?:counter|gauge|histogram)\(\s*[\"']([^\"']+)[\"']", re.S
+)
+EMIT_CALL = re.compile(r"(?:\.emit|emit_event)\(\s*[\"']([^\"']+)[\"']", re.S)
+EVENT_CONST = re.compile(r"^EVENT_\w+\s*=\s*[\"']([^\"']+)[\"']", re.M)
+BARE_PRINT = re.compile(r"^\s*print\(")
+
+# CLI entry points whose stdout IS their product (reports, dataset
+# paths); everything else logs
+PRINT_ALLOWLIST = (
+    os.path.join("elasticdl_tpu", "chaos", "runner.py"),
+    os.path.join("elasticdl_tpu", "telemetry", "report.py"),
+    os.path.join("elasticdl_tpu", "client.py"),
+    os.path.join("elasticdl_tpu", "data", "recordio", "build.py"),
+    os.path.join("elasticdl_tpu", "data", "recordio_gen") + os.sep,
+)
+
+
+def iter_sources():
+    for root, _dirs, files in os.walk(PACKAGE):
+        if "__pycache__" in root:
+            continue
+        for name in sorted(files):
+            if name.endswith(".py"):
+                path = os.path.join(root, name)
+                with open(path, encoding="utf-8") as f:
+                    yield os.path.relpath(path, REPO_ROOT), f.read()
+
+
+def main() -> int:
+    errors: list[str] = []
+    metric_sites: dict[str, list[str]] = {}
+    event_sites: dict[str, list[str]] = {}
+
+    for rel, text in iter_sources():
+        # full-text scan: registration calls wrap across lines
+        for pattern, sites in (
+            (METRIC_CALL, metric_sites),
+            (EMIT_CALL, event_sites),
+        ):
+            for match in pattern.finditer(text):
+                lineno = text.count("\n", 0, match.start()) + 1
+                sites.setdefault(match.group(1), []).append(
+                    f"{rel}:{lineno}"
+                )
+        for lineno, line in enumerate(text.splitlines(), 1):
+            if BARE_PRINT.match(line) and not rel.startswith(
+                PRINT_ALLOWLIST
+            ):
+                errors.append(
+                    f"{rel}:{lineno}: bare print() — use the logger or "
+                    "the telemetry event log"
+                )
+
+    for kind, sites in (("metric", metric_sites), ("event", event_sites)):
+        for name, where in sorted(sites.items()):
+            if not SNAKE_CASE.match(name):
+                errors.append(
+                    f"{where[0]}: {kind} name {name!r} is not snake_case"
+                )
+            if len(where) > 1:
+                errors.append(
+                    f"{kind} name {name!r} registered at {len(where)} "
+                    f"sites ({', '.join(where)}); hoist it into a shared "
+                    "constant with one definition site"
+                )
+
+    events_py = os.path.join(PACKAGE, "telemetry", "events.py")
+    with open(events_py, encoding="utf-8") as f:
+        const_values = EVENT_CONST.findall(f.read())
+    for value in const_values:
+        if not SNAKE_CASE.match(value):
+            errors.append(
+                f"telemetry/events.py: EVENT constant value {value!r} "
+                "is not snake_case"
+            )
+    duplicates = {v for v in const_values if const_values.count(v) > 1}
+    for value in sorted(duplicates):
+        errors.append(
+            f"telemetry/events.py: event name {value!r} defined more "
+            "than once"
+        )
+
+    if errors:
+        for error in errors:
+            print(f"check_telemetry_names: {error}", file=sys.stderr)
+        return 1
+    print(
+        "check_telemetry_names: OK "
+        f"({len(metric_sites)} metric names, "
+        f"{len(set(const_values)) + len(event_sites)} event names)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,599 @@
+"""Telemetry subsystem tests (ISSUE 2).
+
+Covers the four acceptance surfaces: registry exposition round-trip,
+event-log schema for a scripted preempt -> reform sequence, ``/healthz``
+during quiesce (plus ``/metrics`` family count), and the report CLI on a
+canned run dir — plus the overhead contract (disabled per-step path is a
+single early-return) and the satellite fixes (TensorboardService
+shutdown, Timing routing, chaos_result.json, naming lint).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.telemetry import report as report_cli
+from elasticdl_tpu.telemetry import worker_hooks
+from elasticdl_tpu.telemetry.events import EventLog, read_events
+from elasticdl_tpu.telemetry.httpd import TelemetryHTTPServer
+from elasticdl_tpu.telemetry.master_hooks import MasterTelemetry
+from elasticdl_tpu.telemetry.registry import (
+    STEP_LATENCY_BUCKETS,
+    Histogram,
+    MetricsRegistry,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_worker_hooks():
+    worker_hooks.uninstall()
+    yield
+    worker_hooks.uninstall()
+
+
+# ---- registry / exposition --------------------------------------------------
+
+
+def _parse_exposition(text: str) -> dict[str, float]:
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        samples[name] = float(value)
+    return samples
+
+
+def test_registry_exposition_round_trip():
+    r = MetricsRegistry()
+    r.counter("demo_total", "a counter").inc(3)
+    r.gauge("demo_gauge", "a gauge").set(1.5)
+    h = r.histogram("demo_seconds", "a histogram", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = r.exposition()
+    samples = _parse_exposition(text)
+    assert samples["demo_total"] == 3
+    assert samples["demo_gauge"] == 1.5
+    # cumulative buckets: 0.05 <= 0.1; 0.5 <= 1.0; 5.0 -> +Inf
+    assert samples['demo_seconds_bucket{le="0.1"}'] == 1
+    assert samples['demo_seconds_bucket{le="1"}'] == 2
+    assert samples['demo_seconds_bucket{le="+Inf"}'] == 3
+    assert samples["demo_seconds_count"] == 3
+    assert abs(samples["demo_seconds_sum"] - 5.55) < 1e-9
+    assert "# TYPE demo_seconds histogram" in text
+    assert "# HELP demo_total a counter" in text
+
+
+def test_registry_labels_and_reregistration():
+    r = MetricsRegistry()
+    a = r.counter("family_total", labels={"type": "a"})
+    b = r.counter("family_total", labels={"type": "b"})
+    assert a is not b
+    assert r.counter("family_total", labels={"type": "a"}) is a
+    a.inc()
+    samples = _parse_exposition(r.exposition())
+    assert samples['family_total{type="a"}'] == 1
+    assert samples['family_total{type="b"}'] == 0
+    with pytest.raises(ValueError):
+        r.gauge("family_total")  # kind conflict
+    with pytest.raises(ValueError):
+        r.counter("NotSnakeCase")
+
+
+def test_counter_set_total_is_monotone():
+    r = MetricsRegistry()
+    c = r.counter("mirrored_total")
+    c.set_total(10)
+    c.set_total(4)  # must never go down
+    assert c.value == 10
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_histogram_default_buckets_are_log_spaced_step_range():
+    h = Histogram()
+    assert h.bounds == STEP_LATENCY_BUCKETS
+    assert h.bounds[0] == 0.001 and h.bounds[-1] == 60.0
+    h.observe(0.004)
+    snap = h.snapshot()
+    assert snap["buckets"][0.005] == 1
+    assert snap["buckets"][0.0025] == 0
+
+
+def test_collect_callback_runs_per_scrape():
+    r = MetricsRegistry()
+    g = r.gauge("fresh_gauge")
+    calls = []
+    r.add_collect_callback(lambda reg: (calls.append(1), g.set(len(calls))))
+    r.exposition()
+    samples = _parse_exposition(r.exposition())
+    assert samples["fresh_gauge"] == 2
+
+
+def test_percentile_nearest_rank():
+    samples = [float(i) for i in range(1, 101)]
+    assert report_cli.percentile(samples, 50) == 50.0
+    assert report_cli.percentile(samples, 95) == 95.0
+    assert report_cli.percentile(samples, 99) == 99.0
+    assert report_cli.percentile([7.0], 99) == 7.0
+
+
+# ---- event log schema: scripted preempt -> reform ---------------------------
+
+
+def _scripted_preempt_reform(tmp_path):
+    """Drive master-side telemetry through a full preempt -> reform:
+    lease + complete a task, kill a worker, recover its task, re-form."""
+    telemetry = MasterTelemetry(str(tmp_path))
+    task_d = TaskDispatcher(
+        {"s": (0, 128)}, records_per_task=64, shuffle_seed=1
+    )
+    servicer = MasterServicer(32, task_d)
+    telemetry.attach(task_d, servicer)
+
+    telemetry.job_start("training_only", 2)
+    tid0, _ = task_d.get(worker_id=0)
+    task_d.report(tid0, True, exec_counters={"time_batch_process_ms": 21})
+    servicer.report_version(
+        type("R", (), {"worker_id": 0, "model_version": 2})()
+    )
+    tid1, _ = task_d.get(worker_id=1)
+    # worker 1 dies: master marks it, recovers its lease, re-forms
+    telemetry.worker_dead([1], generation=0)
+    new_gen = servicer.bump_cluster_version()
+    telemetry.reform_start(new_gen, [1], "worker_failure", old_world_size=2)
+    task_d.recover_tasks(1)
+    telemetry.reform_complete(new_gen, old_world_size=2, new_world_size=2)
+    telemetry.reform_latency(new_gen, 1.25)
+    telemetry.job_end(0)
+    return os.path.join(str(tmp_path), "events.jsonl")
+
+
+def test_event_log_schema_preempt_reform(tmp_path):
+    path = _scripted_preempt_reform(tmp_path)
+    events = read_events(path)
+    for record in events:
+        assert {"time", "monotonic", "event"} <= set(record)
+        assert isinstance(record["time"], float)
+    names = [e["event"] for e in events]
+    assert names[0] == "job_start"
+    assert names[-1] == "job_end"
+    for expected in (
+        "task_dispatch",
+        "task_done",
+        "worker_dead",
+        "reform_start",
+        "task_recovered",
+        "reform_complete",
+        "reform_latency",
+    ):
+        assert expected in names, f"missing {expected} in {names}"
+    # recovery happens INSIDE the reform window
+    assert names.index("reform_start") < names.index("task_recovered")
+    assert names.index("task_recovered") < names.index("reform_complete")
+    done = next(e for e in events if e["event"] == "task_done")
+    assert done["worker_id"] == 0
+    assert done["records"] == 64
+    assert done["time_batch_process_ms"] == 21  # exec counters ride along
+    start = next(e for e in events if e["event"] == "reform_start")
+    assert start["generation"] == 1
+    assert start["dead_workers"] == [1]
+    assert start["old_world_size"] == 2
+    complete = next(e for e in events if e["event"] == "reform_complete")
+    assert complete["new_world_size"] == 2
+    recovered = next(e for e in events if e["event"] == "task_recovered")
+    assert recovered["reason"] == "report_failed"
+
+
+def test_quiesce_events_via_servicer_sink(tmp_path):
+    telemetry = MasterTelemetry(str(tmp_path))
+    task_d = TaskDispatcher({"s": (0, 64)}, records_per_task=64)
+    servicer = MasterServicer(32, task_d)
+    telemetry.attach(task_d, servicer)
+    servicer.begin_quiesce()
+    assert servicer.is_quiescing
+    servicer.end_quiesce()
+    telemetry.events.flush()  # master event log writes asynchronously
+    events = read_events(os.path.join(str(tmp_path), "events.jsonl"))
+    names = [e["event"] for e in events]
+    assert names == ["quiesce_begin", "quiesce_end"]
+    assert events[0]["generation"] == 0
+    assert events[1]["generation"] == 1  # end_quiesce bumps the generation
+
+
+# ---- HTTP endpoint ----------------------------------------------------------
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5
+    ) as resp:
+        return resp.headers.get("Content-Type", ""), resp.read().decode()
+
+
+def test_metrics_endpoint_and_healthz_during_quiesce(tmp_path):
+    telemetry = MasterTelemetry()
+    task_d = TaskDispatcher({"s": (0, 128)}, records_per_task=64)
+    servicer = MasterServicer(32, task_d)
+    telemetry.attach(task_d, servicer)
+    server = TelemetryHTTPServer(
+        telemetry.registry,
+        health_fn=telemetry.build_health_fn("training_only"),
+        port=0,
+    )
+    server.start()
+    try:
+        ctype, text = _get(server.port, "/metrics")
+        assert "text/plain" in ctype and "version=0.0.4" in ctype
+        families = [
+            line.split()[2]
+            for line in text.splitlines()
+            if line.startswith("# TYPE")
+        ]
+        assert len(families) >= 8, families
+        assert len(set(families)) == len(families)
+        # acceptance: valid exposition — every sample line parses
+        _parse_exposition(text)
+
+        _, body = _get(server.port, "/healthz")
+        health = json.loads(body)
+        assert health["status"] == "ok" and health["quiescing"] is False
+        servicer.begin_quiesce()
+        _, body = _get(server.port, "/healthz")
+        health = json.loads(body)
+        assert health["status"] == "quiescing"
+        assert health["quiescing"] is True
+        assert health["generation"] == 0
+        assert "model_version" in health and "live_workers" in health
+        with pytest.raises(urllib.error.HTTPError):
+            _get(server.port, "/nope")
+    finally:
+        server.stop()
+
+
+# ---- worker hooks / overhead contract ---------------------------------------
+
+
+def test_record_step_disabled_is_single_early_return(monkeypatch):
+    """With telemetry not installed the per-step path must not even read
+    the clock: poison every timer the module could reach and call the
+    hook — any work beyond the None check would raise."""
+    assert worker_hooks.get_recorder() is None
+
+    def boom(*_a, **_k):
+        raise AssertionError("disabled path touched the clock")
+
+    monkeypatch.setattr(worker_hooks.time, "monotonic", boom)
+    monkeypatch.setattr(worker_hooks.time, "time", boom, raising=False)
+    worker_hooks.record_step(5, 32)
+    worker_hooks.emit_event("anything_here")
+    worker_hooks.publish_timing(None)  # would explode on .totals_ms()
+
+
+def test_step_recorder_samples_and_generation_stamp(tmp_path):
+    worker_hooks.install(
+        str(tmp_path), worker_id=3, process_id=1, generation=2
+    )
+    worker_hooks.record_step(10, 32)
+    worker_hooks.record_step(11, 32)
+    events = read_events(os.path.join(str(tmp_path), "events.jsonl"))
+    assert [e["step"] for e in events] == [10, 11]
+    assert all(e["generation"] == 2 for e in events)
+    assert all(e["worker_id"] == 3 for e in events)
+    assert "duration_secs" not in events[0]  # no interval yet
+    assert events[1]["duration_secs"] >= 0
+
+
+def test_publish_timing_routes_buckets(tmp_path):
+    from elasticdl_tpu.utils.timing_utils import Timing
+
+    timing = Timing(enabled=True)
+    with timing.record("batch_process"):
+        time.sleep(0.002)
+    worker_hooks.install(str(tmp_path), worker_id=0)
+    worker_hooks.publish_timing(timing)
+    events = read_events(os.path.join(str(tmp_path), "events.jsonl"))
+    assert events[-1]["event"] == "worker_timing"
+    assert events[-1]["time_batch_process_ms"] >= 1
+    assert timing.totals_ms()["time_batch_process_ms"] >= 1
+
+
+def test_exec_counters_mirrored_to_metrics():
+    telemetry = MasterTelemetry()
+    task_d = TaskDispatcher({"s": (0, 64)}, records_per_task=64)
+    servicer = MasterServicer(32, task_d)
+    telemetry.attach(task_d, servicer)
+    tid, _ = task_d.get(0)
+    task_d.report(tid, True, exec_counters={"time_device_step_ms": 42})
+    samples = _parse_exposition(telemetry.registry.exposition())
+    assert (
+        samples['elasticdl_worker_time_ms_total{bucket="device_step"}'] == 42
+    )
+    assert samples['elasticdl_tasks_completed_total{type="training"}'] == 1
+    assert samples["elasticdl_records_processed_total"] == 64
+
+
+def test_dispatcher_on_task_done_observer():
+    calls = []
+
+    class Observer:
+        def on_task_done(self, task_id, task, worker_id, success, counters):
+            calls.append((task_id, worker_id, success, counters))
+
+    task_d = TaskDispatcher({"s": (0, 64)}, records_per_task=64)
+    task_d.add_observer(Observer())
+    tid, _ = task_d.get(worker_id=7)
+    task_d.report(tid, True, exec_counters={"time_x_ms": 1})
+    task_d.report(999, True)  # stale: must NOT reach on_task_done
+    assert calls == [(tid, 7, True, {"time_x_ms": 1})]
+
+
+# ---- report CLI on a canned run dir -----------------------------------------
+
+
+def _write_jsonl(path, records):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        for record in records:
+            f.write(json.dumps(record) + "\n")
+
+
+def _canned_run_dir(tmp_path) -> str:
+    """Two generations of step samples separated by a 4s gap caused by a
+    preemption, with a recovered task inside the gap."""
+    run = tmp_path / "run"
+    t0 = 1000.0
+    events = []
+    for i in range(10):
+        events.append(
+            {
+                "time": 1.7e9 + t0 + i * 0.1,
+                "monotonic": t0 + i * 0.1,
+                "event": "step",
+                "step": i,
+                "generation": 0,
+                "worker_id": 0,
+                "records": 32,
+                **({"duration_secs": 0.1} if i else {}),
+            }
+        )
+    gap_start = t0 + 0.9
+    events.append(
+        {
+            "time": 1.7e9 + gap_start + 4.0,
+            "monotonic": gap_start + 4.0,
+            "event": "task_recovered",
+            "task_id": 5,
+            "reason": "report_failed",
+        }
+    )
+    for i in range(5):
+        events.append(
+            {
+                "time": 1.7e9 + gap_start + 5.0 + i * 0.2,
+                "monotonic": gap_start + 5.0 + i * 0.2,
+                "event": "step",
+                "step": 8 + i,
+                "generation": 1,
+                "worker_id": 2,
+                "records": 32,
+                **({"duration_secs": 0.2} if i else {}),
+            }
+        )
+    events.append(
+        {
+            "time": 1.7e9,
+            "monotonic": t0 + 12.0,
+            "event": "task_done",
+            "task_id": 9,
+            "worker_id": 2,
+            "records": 64,
+            "time_batch_process_ms": 30,
+        }
+    )
+    _write_jsonl(str(run / "telemetry" / "events.jsonl"), events)
+    _write_jsonl(
+        str(run / "chaos_events.jsonl"),
+        [
+            {
+                "fault_id": "f0",
+                "kind": "preempt",
+                "process_id": 1,
+                "step": 8,
+                "time": 1.7e9 + gap_start + 0.05,
+                "monotonic": gap_start + 0.05,
+            }
+        ],
+    )
+    with open(str(run / "chaos_result.json"), "w", encoding="utf-8") as f:
+        json.dump(
+            {
+                "plan": "preempt_one_worker",
+                "seed": 0,
+                "invariants": [
+                    {"name": "exactly_once", "status": "PASS"},
+                    {"name": "version_monotonic", "status": "PASS"},
+                ],
+                "invariants_ok": True,
+            },
+            f,
+        )
+    return str(run)
+
+
+def test_report_cli_on_canned_run_dir(tmp_path, capsys):
+    run_dir = _canned_run_dir(tmp_path)
+    out_path = str(tmp_path / "report.json")
+    rc = report_cli.main([run_dir, "--output", out_path])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "p50=" in text and "p95=" in text and "p99=" in text
+    assert "downtime 5.00s" in text  # the injected 5s gap, attributed
+    assert "cause: f0 (preempt" in text
+    assert "plan=preempt_one_worker" in text
+    assert "exactly_once=PASS" in text
+
+    with open(out_path, encoding="utf-8") as f:
+        report = json.load(f)
+    run = report["runs"][os.path.join("telemetry", "events.jsonl")]
+    gen0 = run["generations"]["0"]
+    assert gen0["steps"] == 10
+    assert abs(gen0["step_time_p50_ms"] - 100.0) < 1e-6
+    downtime = run["reform_downtime"][0]
+    assert downtime["downtime_secs"] > 0
+    assert downtime["cause"]["fault_id"] == "f0"
+    assert downtime["tasks_recovered"] == 1
+    assert run["records_per_sec_by_worker"]["0"] > 0
+    assert run["worker_time_ms"]["batch_process"] == 30
+    assert report["chaos_result"]["invariants_ok"] is True
+
+
+def test_report_cli_empty_dir(tmp_path):
+    assert report_cli.main([str(tmp_path)]) == 1
+    assert report_cli.main([str(tmp_path / "missing")]) == 2
+
+
+def test_report_handles_torn_lines(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(json.dumps({"event": "step", "monotonic": 1.0}) + "\n")
+        f.write('{"event": "step", "monoto')  # killed writer
+    assert len(read_events(path)) == 1
+
+
+# ---- satellite: TensorboardService shutdown ---------------------------------
+
+
+def _sleeper():
+    return subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"])
+
+
+def test_tensorboard_close_reaps_subprocess(tmp_path):
+    from elasticdl_tpu.master.tensorboard_service import TensorboardService
+
+    service = TensorboardService(str(tmp_path))
+    service.tb_process = _sleeper()
+    service.close()
+    assert service.tb_process is None  # terminated AND reaped, no zombie
+
+
+def test_tensorboard_keep_running_exits_promptly_on_check_fn(tmp_path):
+    from elasticdl_tpu.master.tensorboard_service import TensorboardService
+
+    service = TensorboardService(str(tmp_path))
+    service.tb_process = _sleeper()
+    try:
+        flips = {"n": 0}
+
+        def check_fn():
+            flips["n"] += 1
+            return flips["n"] < 3
+
+        started = time.monotonic()
+        service.keep_running(check_fn=check_fn, poll_secs=30.0)
+        assert time.monotonic() - started < 5.0  # not a full poll window
+    finally:
+        service.close()
+
+
+# ---- satellite: chaos_result.json + naming lint -----------------------------
+
+
+def test_chaos_runner_writes_result_json(tmp_path):
+    from elasticdl_tpu.chaos.runner import write_result_json
+
+    report = {
+        "plan": "preempt_one_worker",
+        "seed": 7,
+        "corrupt": "",
+        "invariants": [
+            {"name": "exactly_once", "status": "PASS", "violations": []}
+        ],
+        "invariants_ok": True,
+        "rc": 0,
+        "reform_latency_secs": 2.5,
+    }
+    path = write_result_json(report, str(tmp_path))
+    with open(path, encoding="utf-8") as f:
+        result = json.load(f)
+    assert result["plan"] == "preempt_one_worker"
+    assert result["seed"] == 7
+    assert result["invariants"] == [
+        {"name": "exactly_once", "status": "PASS"}
+    ]
+    assert result["invariants_ok"] is True
+
+
+def test_telemetry_naming_lint_passes():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "check_telemetry_names.py")],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---- master wiring (in-process, no workers) ---------------------------------
+
+
+def test_master_serves_metrics_and_events(tmp_path):
+    """A real Master (instance_backend=none) exposes /metrics with ≥8
+    families and writes job lifecycle events to --telemetry_dir."""
+    from elasticdl_tpu.data.recordio_gen import synthetic
+    from elasticdl_tpu.master.main import build_master
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    train = synthetic.gen_mnist(
+        str(tmp_path / "train"), num_records=64, num_shards=1, seed=1
+    )
+    args = parse_master_args(
+        [
+            "--model_def",
+            "mnist_functional_api.mnist_functional_api.custom_model",
+            "--training_data",
+            train,
+            "--records_per_task",
+            "32",
+            "--minibatch_size",
+            "32",
+            "--num_workers",
+            "0",
+            "--port",
+            "0",
+            "--telemetry_dir",
+            str(tmp_path / "telemetry"),
+        ]
+    )
+    master = build_master(args)
+    master.prepare()
+    try:
+        assert master.metrics_port is not None
+        _, text = _get(master.metrics_port, "/metrics")
+        families = [
+            line.split()[2]
+            for line in text.splitlines()
+            if line.startswith("# TYPE")
+        ]
+        assert len(families) >= 8
+        _, body = _get(master.metrics_port, "/healthz")
+        assert json.loads(body)["status"] == "ok"
+    finally:
+        master.request_stop()
+        master.stop()
+    events = read_events(str(tmp_path / "telemetry" / "events.jsonl"))
+    names = [e["event"] for e in events]
+    assert "job_start" in names and "job_end" in names
