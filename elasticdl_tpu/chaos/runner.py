@@ -179,6 +179,21 @@ def write_result_json(report: dict, workdir: str) -> str:
         "detect_secs": report.get("detect_secs"),
         "kill_to_step_secs": report.get("kill_to_step_secs"),
     }
+    # causal-trace summary (reform phase breakdown + stragglers) so CI
+    # reads the critical path from the same artifact as the verdicts
+    try:
+        from elasticdl_tpu.telemetry.trace import analyze_run_dir
+
+        analysis = analyze_run_dir(workdir)
+        result["trace"] = {
+            rel: {
+                "reform_downtime": run["reform_downtime"],
+                "recovered_task_spans": run["recovered_task_spans"],
+            }
+            for rel, run in analysis["runs"].items()
+        }
+    except Exception:  # noqa: BLE001 — tracing never blocks the verdict
+        result["trace"] = {}
     path = os.path.join(workdir, "chaos_result.json")
     with open(path, "w", encoding="utf-8") as f:
         json.dump(result, f, indent=2)

@@ -229,6 +229,20 @@ class K8sInstanceManager:
 
     def _start_world(self, cluster_version: int, num_processes=None):
         n = num_processes if num_processes is not None else self._num_workers
+        # reform trace context (set by Master._reform_lockstep): cold
+        # pods inherit it by env, standby pods in the assignment payload
+        # (WorldAssignmentResponse.trace) — either way their world_join
+        # spans link into the re-formation's trace
+        trace = getattr(self, "pending_world_trace", None)
+        self.pending_world_trace = None
+        from elasticdl_tpu.telemetry.tracing import TRACE_PARENT_ENV
+
+        if trace:
+            import json as _json
+
+            self._envs[TRACE_PARENT_ENV] = _json.dumps(dict(trace))
+        else:
+            self._envs.pop(TRACE_PARENT_ENV, None)
         worker_ids = [self._claim_worker_id() for _ in range(n)]
         # the coordinator is process 0's per-worker-id DNS name; the
         # service is (re)pointed at whichever pod plays process 0, so the
@@ -250,7 +264,9 @@ class K8sInstanceManager:
                 )
             if standbys:
                 self._activate_standby_pod(
-                    *standbys.pop(0), worker_id, kwargs
+                    *standbys.pop(0),
+                    worker_id,
+                    {**kwargs, "trace": dict(trace)} if trace else kwargs,
                 )
             else:
                 self._start(worker_id, **kwargs)

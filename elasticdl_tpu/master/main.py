@@ -41,11 +41,17 @@ def build_master(args) -> Master:
         if telemetry_dir:
             # workers append step samples to the shared event log; the
             # dir travels by env (like the chaos plan), not by argv
+            from elasticdl_tpu.telemetry.tracing import (
+                TRACE_SAMPLE_RATE_ENV,
+            )
             from elasticdl_tpu.telemetry.worker_hooks import (
                 TELEMETRY_DIR_ENV,
             )
 
             envs.setdefault(TELEMETRY_DIR_ENV, telemetry_dir)
+            sample_rate = getattr(args, "trace_sample_rate", None)
+            if sample_rate is not None:
+                envs.setdefault(TRACE_SAMPLE_RATE_ENV, str(sample_rate))
         if backend == "k8s":
             import os
 

@@ -142,7 +142,8 @@ class Master:
         from elasticdl_tpu.telemetry.master_hooks import MasterTelemetry
 
         self.telemetry = MasterTelemetry(
-            getattr(args, "telemetry_dir", "") or ""
+            getattr(args, "telemetry_dir", "") or "",
+            trace_sample_rate=getattr(args, "trace_sample_rate", None),
         )
         self.telemetry.attach(
             self.task_d, self.servicer, tb_service=self.tb_service
@@ -334,19 +335,38 @@ class Master:
         self.telemetry.reform_start(
             new_version, dead, reason, old_world_size
         )
-        for worker_id in all_ids:
-            self.task_d.recover_tasks(worker_id)
-            self.servicer.forget_worker(worker_id)
-        self.servicer.reset_step_stream()
+        reform_trace = self.telemetry.reform_trace_context()
+        from elasticdl_tpu.telemetry.tracing import (
+            SPAN_REFORM_FENCE,
+            SPAN_REFORM_RELAUNCH,
+        )
+
+        with self.telemetry.tracer.span(
+            SPAN_REFORM_FENCE, trace_ctx=reform_trace, generation=new_version
+        ):
+            for worker_id in all_ids:
+                self.task_d.recover_tasks(worker_id)
+                self.servicer.forget_worker(worker_id)
+            self.servicer.reset_step_stream()
+        # the relaunched world's workers link their world_join spans
+        # into this re-formation's trace (argv spawns get it by env,
+        # standbys in the stdin/RPC assignment payload)
+        im.pending_world_trace = reform_trace
         try:
-            im.reform_world(
-                new_version,
-                # only failure recovery spends the crash-loop budget; an
-                # elective resize is planned work, not a crash
-                count_against_budget=reason == "worker_failure",
-            )
+            with self.telemetry.tracer.span(
+                SPAN_REFORM_RELAUNCH,
+                trace_ctx=reform_trace,
+                generation=new_version,
+            ):
+                im.reform_world(
+                    new_version,
+                    # only failure recovery spends the crash-loop budget;
+                    # an elective resize is planned work, not a crash
+                    count_against_budget=reason == "worker_failure",
+                )
         except RuntimeError as ex:
             logger.error("Giving up on the job: %s", ex)
+            self.telemetry.reform_failed(new_version)
             self._job_failed = True
             self.request_stop()
             return
@@ -501,6 +521,10 @@ class LocalInstanceManager:
         # current lockstep world size: capacity faults/elasticity shrink
         # it below num_workers; the next (re)formation uses it
         self._world_size = num_workers
+        # trace context of the re-formation the NEXT world belongs to
+        # (set by Master._reform_lockstep, consumed by _start_world):
+        # relaunched workers parent their world_join spans under it
+        self.pending_world_trace: dict | None = None
 
     @property
     def world_size(self) -> int:
@@ -536,6 +560,7 @@ class LocalInstanceManager:
 
         n = num_processes if num_processes is not None else self._world_size
         coordinator = f"localhost:{elastic.pick_coordinator_port()}"
+        trace, self.pending_world_trace = self.pending_world_trace, None
         for process_id in range(n):
             world = dict(
                 coordinator_addr=coordinator,
@@ -543,16 +568,25 @@ class LocalInstanceManager:
                 process_id=process_id,
                 cluster_version=cluster_version,
             )
+            if trace:
+                world["trace"] = dict(trace)
             worker_id = self._claim_worker_id()
             if not self._activate_standby(worker_id, world):
                 self._start(worker_id, **world)
 
     def _spawn(self, worker_id: int, stdin_pipe: bool = False, **world_kwargs):
+        # the reform trace context travels by env, not argv (it is a
+        # dict, and argv is the flag round-trip)
+        trace = world_kwargs.pop("trace", None)
         argv = self._build_argv(
             worker_id, f"localhost:{self._master.port}", **world_kwargs
         )
         env = dict(os.environ)
         env.update(self._envs)
+        if trace:
+            from elasticdl_tpu.telemetry.tracing import TRACE_PARENT_ENV
+
+            env[TRACE_PARENT_ENV] = json.dumps(trace)
         # make the framework importable regardless of the master's cwd
         import elasticdl_tpu
 

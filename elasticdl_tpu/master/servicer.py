@@ -64,6 +64,10 @@ class MasterServicer:
         # telemetry event sink: ``fn(event_name, **fields)`` for quiesce
         # lifecycle records; never raises into an RPC
         self._event_sink = None
+        # trace-context provider: ``fn(task_id) -> dict`` supplying the
+        # dispatch span's {"trace_id", "span_id"} so every TaskResponse
+        # carries the trace it belongs to (telemetry/tracing.py)
+        self._trace_provider = None
         if evaluation_service is not None:
             evaluation_service.set_master_servicer(self)
 
@@ -75,6 +79,19 @@ class MasterServicer:
     def set_event_sink(self, sink):
         """``sink(event, **fields)`` — the telemetry event log."""
         self._event_sink = sink
+
+    def set_trace_provider(self, provider):
+        """``provider(task_id) -> dict`` — the task's trace context."""
+        self._trace_provider = provider
+
+    def _trace_for(self, task_id: int) -> dict:
+        if self._trace_provider is None:
+            return {}
+        try:
+            return self._trace_provider(task_id) or {}
+        except Exception:  # noqa: BLE001 — tracing never breaks RPCs
+            logger.exception("Trace provider failed")
+            return {}
 
     def _emit(self, event: str, **fields):
         if self._event_sink is None:
@@ -112,7 +129,11 @@ class MasterServicer:
 
         if task is not None:
             return msg.task_to_response(
-                task_id, task, self._version, self._minibatch_size
+                task_id,
+                task,
+                self._version,
+                self._minibatch_size,
+                trace=self._trace_for(task_id),
             )
         if (not self._task_d.finished()) or (
             self._task_d.invoke_deferred_callback()
@@ -161,7 +182,11 @@ class MasterServicer:
                 task_id, task = self._task_d.get(request.worker_id)
             if task is not None:
                 resp = msg.task_to_response(
-                    task_id, task, self._version, self._minibatch_size
+                    task_id,
+                    task,
+                    self._version,
+                    self._minibatch_size,
+                    trace=self._trace_for(task_id),
                 )
                 self._step_stream[request.seq] = resp
                 return resp

@@ -68,12 +68,26 @@ def initialize_world(
         # single-process job (tests, LocalExecutor, the CLI) with this
         # config set cannot initialize the cpu backend at all.
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    jax.distributed.initialize(
-        coordinator_address=coordinator_addr,
+    # reform-phase span (telemetry/tracing.py, no-op when tracing is
+    # off): the coordination-service handshake blocks until every peer
+    # of the (re-)formed world arrives, so its duration IS the
+    # world-formation term of reform downtime
+    from elasticdl_tpu.telemetry.tracing import (
+        SPAN_WORLD_INITIALIZE,
+        trace_span,
+    )
+
+    with trace_span(
+        SPAN_WORLD_INITIALIZE,
         num_processes=num_processes,
         process_id=process_id,
-        initialization_timeout=timeout_secs,
-    )
+    ):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_addr,
+            num_processes=num_processes,
+            process_id=process_id,
+            initialization_timeout=timeout_secs,
+        )
     logger.info(
         "Joined distributed world: process %d/%d (coordinator %s)",
         process_id,

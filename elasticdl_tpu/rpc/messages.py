@@ -26,6 +26,9 @@ from elasticdl_tpu.utils.tensor import (
 class GetTaskRequest:
     worker_id: int
     task_type: int = -1  # -1 = any; TaskType.EVALUATION for eval-only pulls
+    # optional trace context ({"trace_id", "span_id"}); empty dict on old
+    # payloads — decode() fills defaults, so the field is wire-compatible
+    trace: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -44,6 +47,10 @@ class TaskResponse:
     model_version: int = -1
     minibatch_size: int = 0
     extended: dict = field(default_factory=dict)
+    # trace context of the master's dispatch span: ONE task is ONE trace
+    # across master and workers (telemetry/tracing.py); empty when the
+    # master runs without tracing or on pre-trace payloads
+    trace: dict = field(default_factory=dict)
 
     @property
     def is_wait(self) -> bool:
@@ -76,6 +83,9 @@ class ReportTaskResultRequest:
     task_id: int
     err_message: str = ""
     exec_counters: dict = field(default_factory=dict)
+    # the dispatch trace context echoed back for wire symmetry and
+    # offline log joins; the master's own span bookkeeping is by task_id
+    trace: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -138,6 +148,9 @@ class WorldAssignmentResponse:
     num_processes: int = 1
     process_id: int = 0
     cluster_version: int = 0
+    # reform trace context: the activated standby's world_join span links
+    # into the master's re-formation trace
+    trace: dict = field(default_factory=dict)
 
 
 _SIMPLE_TYPES = {
@@ -192,7 +205,11 @@ def decode(buf: bytes):
 
 
 def task_to_response(
-    task_id: int, task, model_version: int, minibatch_size: int
+    task_id: int,
+    task,
+    model_version: int,
+    minibatch_size: int,
+    trace: dict | None = None,
 ) -> TaskResponse:
     return TaskResponse(
         task_id=task_id,
@@ -205,4 +222,5 @@ def task_to_response(
         else model_version,
         minibatch_size=minibatch_size,
         extended=dict(task.extended),
+        trace=dict(trace or {}),
     )

@@ -30,6 +30,14 @@ from elasticdl_tpu.telemetry.events import (
     EventLog,
 )
 from elasticdl_tpu.telemetry.registry import MetricsRegistry
+from elasticdl_tpu.telemetry.tracing import (
+    SPAN_REFORM,
+    SPAN_TASK_LIFECYCLE,
+    SPANS_FILENAME,
+    SpanRecorder,
+    gen_trace_id,
+    sample_rate_from_env,
+)
 
 # family names referenced from more than one code path live here so each
 # is REGISTERED at exactly one call site (scripts/check_telemetry_names.py)
@@ -39,7 +47,12 @@ _WORKER_TIME_MS = "elasticdl_worker_time_ms_total"
 
 
 class MasterTelemetry:
-    def __init__(self, telemetry_dir: str = "", registry=None):
+    def __init__(
+        self,
+        telemetry_dir: str = "",
+        registry=None,
+        trace_sample_rate: float | None = None,
+    ):
         self.registry = registry if registry is not None else MetricsRegistry()
         # async: master emits happen inside TaskDispatcher observer
         # callbacks (under the dispatcher lock) — the control plane must
@@ -50,6 +63,25 @@ class MasterTelemetry:
             else "",
             async_writes=True,
         )
+        # span tracer: buffered in memory (the observer callbacks run
+        # under the dispatcher lock, so spans batch to disk, never write
+        # inline); path='' disables persistence but keeps the object
+        # usable so the reform path never branches
+        self.tracer = SpanRecorder(
+            os.path.join(telemetry_dir, SPANS_FILENAME)
+            if telemetry_dir
+            else "",
+            role="master",
+            sample_rate=trace_sample_rate
+            if trace_sample_rate is not None
+            else sample_rate_from_env(),
+        )
+        # task_id -> open dispatch (root) span; id(task) -> the latest
+        # root span's context so a RECOVERED task's new span links back
+        # into the original trace (the re-queued Task object survives
+        # the re-lease, so identity is stable while the task is alive)
+        self._task_spans: dict[int, object] = {}
+        self._task_trace_links: dict[int, dict] = {}
         r = self.registry
 
         def per_type(name, help_text):
@@ -100,6 +132,7 @@ class MasterTelemetry:
         self._servicer = None
         self._tb_service = None
         self._tb_mirrored_version = -1
+        self._reform_span = None
         r.add_collect_callback(self._collect)
 
     # ---- wiring ------------------------------------------------------------
@@ -111,6 +144,13 @@ class MasterTelemetry:
         task_dispatcher.add_observer(self)
         servicer.add_version_observer(self.on_version_report)
         servicer.set_event_sink(self.events.emit)
+        servicer.set_trace_provider(self.trace_for_task)
+
+    def trace_for_task(self, task_id: int) -> dict:
+        """The dispatch span's trace context for an active lease — what
+        the servicer stamps onto the TaskResponse."""
+        span = self._task_spans.get(task_id)
+        return span.context if span is not None else {}
 
     def _collect(self, _registry):
         """Scrape-time refresh of point-in-time values."""
@@ -168,6 +208,25 @@ class MasterTelemetry:
         self.registry.counter(
             _TASKS_DISPATCHED, labels={"type": type_name}
         ).inc()
+        # one task = one trace.  First lease opens a fresh root trace; a
+        # RE-lease (failure/timeout/worker-death recovery) opens a new
+        # root span INSIDE the original trace, parented to the previous
+        # attempt's span — the Dapper link that lets `trace analyze`
+        # follow a task across a preemption.
+        link = self._task_trace_links.get(id(task))
+        span = self.tracer.start_span(
+            SPAN_TASK_LIFECYCLE,
+            trace_ctx=link
+            if link is not None
+            else {"trace_id": gen_trace_id(), "span_id": ""},
+            task_id=task_id,
+            worker_id=worker_id,
+            type=type_name,
+            shard=task.shard_name,
+            recovered=link is not None,
+        )
+        self._task_spans[task_id] = span
+        self._task_trace_links[id(task)] = span.context
         self.events.emit(
             EVENT_TASK_DISPATCH,
             task_id=task_id,
@@ -175,13 +234,20 @@ class MasterTelemetry:
             type=type_name,
             shard=task.shard_name,
             records=task.num_records,
+            trace_id=span.trace_id,
         )
 
     def on_task_done(
         self, task_id, task, worker_id, success, exec_counters=None
     ):
         type_name = task.type.name.lower()
+        span = self._task_spans.pop(task_id, None)
+        if span is not None:
+            span.end(success=bool(success))
         if success:
+            # the trace is complete: drop the link so the (freed) Task
+            # object's identity can never alias a future task's trace
+            self._task_trace_links.pop(id(task), None)
             self.registry.counter(
                 _TASKS_COMPLETED, labels={"type": type_name}
             ).inc()
@@ -210,6 +276,9 @@ class MasterTelemetry:
             )
 
     def on_task_reclaimed(self, task_id, task):
+        span = self._task_spans.pop(task_id, None)
+        if span is not None:
+            span.end(success=False, reclaimed=True)
         self._tasks_recovered.inc()
         self.events.emit(
             EVENT_TASK_RECOVERED,
@@ -249,6 +318,7 @@ class MasterTelemetry:
     def job_end(self, rc: int):
         self.events.emit(EVENT_JOB_END, rc=rc)
         self.events.flush()
+        self.tracer.flush()
 
     def worker_dead(self, worker_ids, generation: int):
         self._workers_dead.inc(len(worker_ids))
@@ -259,22 +329,50 @@ class MasterTelemetry:
 
     def reform_start(self, generation, dead, reason, old_world_size):
         self._generation.set(generation)
+        # every re-formation is one trace: the root span opens here, the
+        # fence/relaunch child spans bracket the phases in
+        # Master._reform_lockstep, and the relaunched workers' world_join
+        # spans link in via the propagated context (reform_trace_context)
+        self._reform_span = self.tracer.start_span(
+            SPAN_REFORM,
+            trace_ctx={"trace_id": gen_trace_id(), "span_id": ""},
+            generation=generation,
+            reason=reason,
+            dead_workers=sorted(dead),
+        )
         self.events.emit(
             EVENT_REFORM_START,
             generation=generation,
             dead_workers=sorted(dead),
             reason=reason,
             old_world_size=old_world_size,
+            trace_id=self._reform_span.trace_id,
         )
+
+    def reform_trace_context(self) -> dict:
+        """The open re-formation's trace context ({} outside a reform)."""
+        span = self._reform_span
+        return span.context if span is not None else {}
 
     def reform_complete(self, generation, old_world_size, new_world_size):
         self._reforms.inc()
+        span, self._reform_span = self._reform_span, None
+        if span is not None:
+            span.end(new_world_size=new_world_size)
         self.events.emit(
             EVENT_REFORM_COMPLETE,
             generation=generation,
             old_world_size=old_world_size,
             new_world_size=new_world_size,
         )
+
+    def reform_failed(self, generation):
+        """The relaunch gave up (reform budget exhausted): close the
+        reform trace with the failure recorded."""
+        span, self._reform_span = self._reform_span, None
+        if span is not None:
+            span.end(failed=True)
+        self.tracer.flush()
 
     def reform_latency(self, generation, latency_secs: float):
         self._reform_downtime.observe(latency_secs)
@@ -283,3 +381,6 @@ class MasterTelemetry:
             generation=generation,
             latency_secs=latency_secs,
         )
+        # the reform trace is complete once latency resolves: make the
+        # phase spans durable even if the job later dies uncleanly
+        self.tracer.flush()

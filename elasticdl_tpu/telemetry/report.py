@@ -212,11 +212,19 @@ def analyze_events(events: list[dict], faults: list[dict]) -> dict:
 
 
 def build_report(run_dir: str) -> dict:
+    from elasticdl_tpu.telemetry.tracing import SPANS_FILENAME
+    from elasticdl_tpu.telemetry.trace import analyze_telemetry_dir
+
     faults = _load_fault_events(run_dir)
     runs = {}
     for path in _find_files(run_dir, EVENTS_FILENAME):
         rel = os.path.relpath(path, run_dir)
         runs[rel] = analyze_events(read_events(path), faults)
+        # causal-trace view (reform critical path, stragglers) when the
+        # run also wrote a span log
+        telemetry_dir = os.path.dirname(path)
+        if os.path.exists(os.path.join(telemetry_dir, SPANS_FILENAME)):
+            runs[rel]["trace"] = analyze_telemetry_dir(telemetry_dir)
     report = {"run_dir": run_dir, "runs": runs, "faults": faults}
     for path in _find_files(run_dir, "chaos_result.json"):
         try:
@@ -284,6 +292,25 @@ def _format_text(report: dict) -> str:
                     caused_by,
                 )
             )
+        trace = run.get("trace") or {}
+        for gap in trace.get("reform_downtime", []):
+            for phase, secs in gap.get("phases_secs", {}).items():
+                lines.append(
+                    "  phase {:<20s} {:8.3f}s  (gen{}->gen{})".format(
+                        phase,
+                        secs,
+                        gap["from_generation"],
+                        gap["to_generation"],
+                    )
+                )
+        for gen, stats in (trace.get("stragglers") or {}).items():
+            for worker, w in stats.get("workers", {}).items():
+                if w.get("straggler"):
+                    lines.append(
+                        f"straggler: gen {gen} worker {worker}: median "
+                        f"{w['median_step_ms']:.1f}ms "
+                        f"({w['vs_generation_median']}x gen median)"
+                    )
         for worker, rate in run["records_per_sec_by_worker"].items():
             lines.append(f"throughput: worker {worker}: {rate:.1f} records/s")
         if run["worker_time_ms"]:

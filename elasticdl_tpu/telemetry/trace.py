@@ -1,0 +1,612 @@
+"""Trace CLI: Perfetto export + reform critical-path analysis.
+
+::
+
+    python -m elasticdl_tpu.telemetry.trace export <run_dir> [--output f]
+    python -m elasticdl_tpu.telemetry.trace analyze <run_dir> [--json]
+
+``<run_dir>`` is any directory tree holding telemetry logs (the same
+contract as ``telemetry.report``): each ``spans.jsonl`` /
+``events.jsonl`` pair written by one run is analyzed independently.
+
+``export`` emits Chrome trace-event JSON (viewable at ui.perfetto.dev or
+``chrome://tracing``): every span becomes a complete ("X") event, every
+worker ``step`` sample becomes an "X" event on its worker's track, and
+lifecycle events become instants — one track per worker per generation,
+plus a master track, so a re-formation reads as the old generation's
+tracks ending, the master's reform phases, and the new generation's
+tracks starting.
+
+``analyze`` computes:
+
+- the **reform-downtime critical path**: each inter-generation gap (the
+  downtime ``telemetry.report`` measures: last step of generation N to
+  first step of generation N+1) broken into named phases —
+  ``death_detection`` (gap start to the reform root span),
+  ``quiesce_recover`` (fence + task recovery span), ``world_relaunch``
+  (kill + respawn span), ``world_join`` (the new world's
+  ``jax.distributed`` handshake spans), ``checkpoint_restore`` (state
+  restore spans of the new generation) and ``warmup_compile`` (the
+  remainder up to the first step — compile + first dispatch).  Phases
+  are attributed by a boundary sweep over the clamped span intervals
+  (later pipeline stages win overlaps), so the named phases plus
+  ``unattributed`` sum EXACTLY to the downtime and ``coverage`` is the
+  attributed fraction.
+- a per-generation **straggler report**: each worker's median step time
+  vs the generation median (outliers flagged), and the wait-vs-work
+  split at the lockstep barrier — for every step index that multiple
+  workers executed, the slowest worker bounds the barrier, so
+  ``wait = slowest - own`` accumulates the time a worker spent blocked
+  on peers rather than computing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+from elasticdl_tpu.telemetry.events import EVENTS_FILENAME, read_jsonl
+from elasticdl_tpu.telemetry.tracing import (
+    SPAN_CHECKPOINT_RESTORE,
+    SPAN_REFORM,
+    SPAN_REFORM_FENCE,
+    SPAN_REFORM_RELAUNCH,
+    SPAN_TRAINER_BUILD,
+    SPAN_WORLD_INITIALIZE,
+    SPAN_WORLD_JOIN,
+    SPANS_FILENAME,
+)
+
+# a reform span can open marginally before the victim's last step lands
+# in the log (step events stamp step START) — tolerate this much skew
+# when matching a reform trace to a downtime gap
+_GAP_MATCH_SLACK_SECS = 5.0
+
+# a worker whose median step time exceeds the generation median by this
+# factor is a straggler
+_STRAGGLER_FACTOR = 1.5
+
+TRACE_FILENAME = "trace.json"
+
+
+def _find_dirs(run_dir: str) -> list[str]:
+    """Directories holding at least one telemetry log (each is one run)."""
+    found = set()
+    for root, _dirs, files in os.walk(run_dir):
+        if SPANS_FILENAME in files or EVENTS_FILENAME in files:
+            found.add(root)
+    return sorted(found)
+
+
+def _load_run(telemetry_dir: str) -> tuple[list[dict], list[dict]]:
+    spans = read_jsonl(os.path.join(telemetry_dir, SPANS_FILENAME))
+    events = read_jsonl(os.path.join(telemetry_dir, EVENTS_FILENAME))
+    return spans, events
+
+
+# ---- export -----------------------------------------------------------------
+
+
+class _Tracks:
+    """Stable pid assignment: one Chrome 'process' per (run, actor,
+    generation) so Perfetto renders one track per worker per generation."""
+
+    def __init__(self):
+        self._pids: dict[tuple, int] = {}
+        self.metadata: list[dict] = []
+
+    def pid(self, run: str, role: str, worker_id, generation) -> int:
+        if role == "master":
+            key = (run, "master", None)
+            label = f"{run} master" if run else "master"
+        else:
+            key = (run, worker_id, generation)
+            prefix = f"{run} " if run else ""
+            label = f"{prefix}worker {worker_id} gen {generation}"
+        pid = self._pids.get(key)
+        if pid is None:
+            pid = len(self._pids) + 1
+            self._pids[key] = pid
+            self.metadata.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": 0,
+                    "args": {"name": label},
+                }
+            )
+            self.metadata.append(
+                {
+                    "name": "process_sort_index",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": 0,
+                    "args": {"sort_index": pid},
+                }
+            )
+        return pid
+
+
+def build_chrome_trace(run_dir: str) -> dict:
+    """Chrome trace-event JSON for every run under ``run_dir``."""
+    tracks = _Tracks()
+    trace_events: list[dict] = []
+    for telemetry_dir in _find_dirs(run_dir):
+        run = os.path.relpath(telemetry_dir, run_dir)
+        run = "" if run == "." else run
+        spans, events = _load_run(telemetry_dir)
+        for span in spans:
+            start = span.get("start")
+            end = span.get("end")
+            if start is None or end is None:
+                continue
+            role = span.get("role", "worker")
+            pid = tracks.pid(
+                run, role, span.get("worker_id", 0), span.get("generation", 0)
+            )
+            args = {
+                k: v
+                for k, v in span.items()
+                if k not in ("span", "start", "end", "time")
+            }
+            trace_events.append(
+                {
+                    "name": span.get("span", "span"),
+                    "cat": role,
+                    "ph": "X",
+                    "ts": round(start * 1e6, 3),
+                    "dur": round(max(0.0, end - start) * 1e6, 3),
+                    "pid": pid,
+                    "tid": int(span.get("process_id", 0) or 0),
+                    "args": args,
+                }
+            )
+        for event in events:
+            name = event.get("event", "")
+            at = event.get("monotonic")
+            if at is None:
+                continue
+            if name == "step":
+                dur = float(event.get("duration_secs") or 0.0)
+                pid = tracks.pid(
+                    run,
+                    "worker",
+                    event.get("worker_id", 0),
+                    event.get("generation", 0),
+                )
+                trace_events.append(
+                    {
+                        "name": "step",
+                        "cat": "step",
+                        "ph": "X",
+                        # duration measures the PREVIOUS interval; the
+                        # slice ends at this sample's timestamp
+                        "ts": round((at - dur) * 1e6, 3),
+                        "dur": round(dur * 1e6, 3),
+                        "pid": pid,
+                        "tid": int(event.get("process_id", 0) or 0),
+                        "args": {
+                            "step": event.get("step"),
+                            "records": event.get("records"),
+                        },
+                    }
+                )
+            else:
+                pid = tracks.pid(run, "master", None, None)
+                trace_events.append(
+                    {
+                        "name": name,
+                        "cat": "lifecycle",
+                        "ph": "i",
+                        "s": "g",
+                        "ts": round(at * 1e6, 3),
+                        "pid": pid,
+                        "tid": 0,
+                        "args": {
+                            k: v
+                            for k, v in event.items()
+                            if k not in ("event", "time", "monotonic")
+                        },
+                    }
+                )
+    return {
+        "displayTimeUnit": "ms",
+        "traceEvents": tracks.metadata + trace_events,
+    }
+
+
+# ---- analyze ----------------------------------------------------------------
+
+
+def _steps_by_generation(events: list[dict]) -> dict[int, list[dict]]:
+    by_gen: dict[int, list[dict]] = defaultdict(list)
+    for event in events:
+        if event.get("event") == "step" and event.get("monotonic") is not None:
+            by_gen[event.get("generation", 0)].append(event)
+    for steps in by_gen.values():
+        steps.sort(key=lambda e: e["monotonic"])
+    return by_gen
+
+
+def _spans_named(spans: list[dict], *names: str) -> list[dict]:
+    wanted = set(names)
+    return [
+        s
+        for s in spans
+        if s.get("span") in wanted
+        and s.get("start") is not None
+        and s.get("end") is not None
+    ]
+
+
+def _merged_window(spans: list[dict]) -> tuple[float, float] | None:
+    if not spans:
+        return None
+    return (
+        min(s["start"] for s in spans),
+        max(s["end"] for s in spans),
+    )
+
+
+def _phase_intervals(
+    spans: list[dict], gap_start: float, gap_end: float, to_generation: int
+) -> list[tuple[str, float, float]]:
+    """Candidate (phase, start, end) intervals for one downtime gap, in
+    pipeline order.  Boundaries are clamped later by the sweep."""
+    intervals: list[tuple[str, float, float]] = []
+    reform = next(
+        (
+            s
+            for s in sorted(
+                _spans_named(spans, SPAN_REFORM), key=lambda s: s["start"]
+            )
+            if gap_start - _GAP_MATCH_SLACK_SECS <= s["start"] <= gap_end
+        ),
+        None,
+    )
+    if reform is not None:
+        intervals.append(("death_detection", gap_start, reform["start"]))
+        children = [
+            s
+            for s in spans
+            if s.get("trace_id") == reform.get("trace_id")
+            and s.get("span_id") != reform.get("span_id")
+        ]
+        fence = _merged_window(_spans_named(children, SPAN_REFORM_FENCE))
+        if fence:
+            intervals.append(("quiesce_recover", fence[0], fence[1]))
+        relaunch = _merged_window(
+            _spans_named(children, SPAN_REFORM_RELAUNCH)
+        )
+        if relaunch:
+            intervals.append(("world_relaunch", relaunch[0], relaunch[1]))
+    join_spans = [
+        s
+        for s in _spans_named(spans, SPAN_WORLD_JOIN, SPAN_WORLD_INITIALIZE)
+        if s.get("generation", -1) == to_generation
+        and gap_start - _GAP_MATCH_SLACK_SECS <= s["start"] <= gap_end
+    ]
+    join = _merged_window(join_spans)
+    if join:
+        intervals.append(("world_join", join[0], join[1]))
+    for phase, span_name in (
+        ("trainer_build", SPAN_TRAINER_BUILD),
+        ("checkpoint_restore", SPAN_CHECKPOINT_RESTORE),
+    ):
+        window = _merged_window(
+            [
+                s
+                for s in _spans_named(spans, span_name)
+                if s.get("generation", -1) == to_generation
+                and gap_start - _GAP_MATCH_SLACK_SECS
+                <= s["start"]
+                <= gap_end
+            ]
+        )
+        if window:
+            intervals.append((phase, window[0], window[1]))
+    return intervals
+
+
+# uncovered time BETWEEN known phases is named for what the pipeline is
+# doing there: after the relaunch span the master is waiting on process
+# spawn; after the join the worker is re-initializing (model spec, data
+# reader, first lease); after the build/restore it is compiling the step
+_BRIDGE_AFTER = {
+    "world_relaunch": "worker_spawn",
+    "world_join": "worker_init",
+    "trainer_build": "warmup_compile",
+    "checkpoint_restore": "warmup_compile",
+}
+
+
+def _attribute_gap(
+    intervals: list[tuple[str, float, float]],
+    gap_start: float,
+    gap_end: float,
+) -> dict[str, float]:
+    """Boundary sweep: every instant of the gap goes to the LAST listed
+    phase covering it; time after every known phase is the new world
+    warming up (compile + first dispatch); time covered by nothing
+    before that is ``unattributed``.  Values sum to the gap exactly."""
+    clamped = [
+        (name, max(gap_start, lo), min(gap_end, hi))
+        for name, lo, hi in intervals
+        if min(gap_end, hi) > max(gap_start, lo)
+    ]
+    phases: dict[str, float] = defaultdict(float)
+    # the tail after the last KNOWN phase is the new world warming up —
+    # but only when there is at least one known phase; with no span
+    # evidence at all the whole gap is honestly unattributed
+    last_known_end = (
+        max(hi for _n, _lo, hi in clamped) if clamped else None
+    )
+    bounds = sorted(
+        {gap_start, gap_end}
+        | ({last_known_end} if last_known_end is not None else set())
+        | {b for _n, lo, hi in clamped for b in (lo, hi)}
+    )
+    for lo, hi in zip(bounds, bounds[1:]):
+        mid = (lo + hi) / 2.0
+        owner = None
+        for name, ilo, ihi in clamped:  # later pipeline stages win
+            if ilo <= mid < ihi:
+                owner = name
+        if owner is None and last_known_end is not None:
+            if mid >= last_known_end:
+                owner = "warmup_compile"
+            else:
+                # between two known phases: name the segment for what
+                # the pipeline is doing after the preceding phase
+                preceding = None
+                preceding_end = None
+                for name, _ilo, ihi in clamped:
+                    if ihi <= mid and (
+                        preceding_end is None or ihi > preceding_end
+                    ):
+                        preceding, preceding_end = name, ihi
+                owner = _BRIDGE_AFTER.get(preceding)
+        if owner is None:
+            owner = "unattributed"
+        phases[owner] += hi - lo
+    return dict(phases)
+
+
+def _percentile(samples: list[float], q: float) -> float:
+    import math
+
+    ordered = sorted(samples)
+    rank = max(1, math.ceil(q / 100.0 * len(ordered)))
+    return ordered[min(rank, len(ordered)) - 1]
+
+
+def _straggler_report(steps: list[dict]) -> dict:
+    """Per-worker outliers + wait-vs-work split for ONE generation."""
+    durations = [
+        e["duration_secs"]
+        for e in steps
+        if e.get("duration_secs") is not None
+    ]
+    if not durations:
+        return {}
+    gen_median = _percentile(durations, 50)
+    by_worker: dict[int, list[dict]] = defaultdict(list)
+    for e in steps:
+        if e.get("duration_secs") is not None:
+            by_worker[e.get("worker_id", 0)].append(e)
+    workers = {}
+    for worker_id, events in sorted(by_worker.items()):
+        own = [e["duration_secs"] for e in events]
+        median = _percentile(own, 50)
+        workers[worker_id] = {
+            "steps": len(own),
+            "median_step_ms": round(median * 1000.0, 3),
+            "vs_generation_median": round(median / gen_median, 3)
+            if gen_median
+            else None,
+            "straggler": bool(
+                gen_median and median > _STRAGGLER_FACTOR * gen_median
+            ),
+        }
+    # wait-vs-work at the lockstep barrier: for each step index executed
+    # by >1 worker, the slowest bounds the barrier — everyone else waited
+    by_step: dict[int, list[dict]] = defaultdict(list)
+    for e in steps:
+        if e.get("duration_secs") is not None and e.get("step") is not None:
+            by_step[e["step"]].append(e)
+    work: dict[int, float] = defaultdict(float)
+    wait: dict[int, float] = defaultdict(float)
+    barrier_steps = 0
+    for _step, entries in by_step.items():
+        if len(entries) < 2:
+            continue
+        barrier_steps += 1
+        slowest = max(e["duration_secs"] for e in entries)
+        for e in entries:
+            worker = e.get("worker_id", 0)
+            work[worker] += e["duration_secs"]
+            wait[worker] += slowest - e["duration_secs"]
+    for worker_id, stats in workers.items():
+        if worker_id in work:
+            total = work[worker_id] + wait[worker_id]
+            stats["barrier_work_secs"] = round(work[worker_id], 6)
+            stats["barrier_wait_secs"] = round(wait[worker_id], 6)
+            stats["barrier_wait_pct"] = (
+                round(wait[worker_id] / total * 100.0, 2) if total else 0.0
+            )
+    return {
+        "generation_median_step_ms": round(gen_median * 1000.0, 3),
+        "barrier_steps_compared": barrier_steps,
+        "workers": workers,
+    }
+
+
+def analyze_telemetry_dir(telemetry_dir: str) -> dict:
+    """Analysis of ONE run's spans+events pair (pure function of the
+    logs; the unit tests drive it with canned files)."""
+    spans, events = _load_run(telemetry_dir)
+    by_gen = _steps_by_generation(events)
+    ordered = sorted(by_gen)
+
+    reform_downtime = []
+    for prev, nxt in zip(ordered, ordered[1:]):
+        gap_start = by_gen[prev][-1]["monotonic"]
+        gap_end = by_gen[nxt][0]["monotonic"]
+        downtime = max(0.0, gap_end - gap_start)
+        phases = (
+            _attribute_gap(
+                _phase_intervals(spans, gap_start, gap_end, nxt),
+                gap_start,
+                gap_end,
+            )
+            if downtime > 0
+            else {}
+        )
+        attributed = sum(
+            v for k, v in phases.items() if k != "unattributed"
+        )
+        reform_downtime.append(
+            {
+                "from_generation": prev,
+                "to_generation": nxt,
+                "downtime_secs": round(downtime, 6),
+                "phases_secs": {
+                    k: round(v, 6) for k, v in sorted(phases.items())
+                },
+                "coverage": round(attributed / downtime, 4)
+                if downtime
+                else None,
+            }
+        )
+
+    straggler_reports = (
+        (gen, _straggler_report(by_gen[gen])) for gen in ordered
+    )
+    stragglers = {gen: rep for gen, rep in straggler_reports if rep}
+
+    recovered_links = sum(
+        1 for s in spans if s.get("recovered") and s.get("trace_id")
+    )
+    return {
+        "spans_total": len(spans),
+        "traces_total": len({s.get("trace_id") for s in spans}),
+        "recovered_task_spans": recovered_links,
+        "reform_downtime": reform_downtime,
+        "stragglers": stragglers,
+    }
+
+
+def analyze_run_dir(run_dir: str) -> dict:
+    runs = {}
+    for telemetry_dir in _find_dirs(run_dir):
+        rel = os.path.relpath(telemetry_dir, run_dir)
+        runs["." if rel == "." else rel] = analyze_telemetry_dir(
+            telemetry_dir
+        )
+    return {"run_dir": run_dir, "runs": runs}
+
+
+# ---- CLI --------------------------------------------------------------------
+
+
+def _format_analysis(report: dict) -> str:
+    lines = [f"Trace analysis: {report['run_dir']}"]
+    if not report["runs"]:
+        lines.append("no telemetry logs found (spans.jsonl / events.jsonl)")
+    for rel, run in report["runs"].items():
+        lines.append(
+            f"== {rel} ==  spans={run['spans_total']} "
+            f"traces={run['traces_total']} "
+            f"recovered_task_spans={run['recovered_task_spans']}"
+        )
+        for gap in run["reform_downtime"]:
+            lines.append(
+                "reform gen{}->gen{}: downtime {:.2f}s  coverage {}".format(
+                    gap["from_generation"],
+                    gap["to_generation"],
+                    gap["downtime_secs"],
+                    f"{gap['coverage'] * 100:.0f}%"
+                    if gap["coverage"] is not None
+                    else "n/a",
+                )
+            )
+            for phase, secs in gap["phases_secs"].items():
+                lines.append(f"  {phase:<20s} {secs:8.3f}s")
+        for gen, stats in run["stragglers"].items():
+            for worker, w in stats["workers"].items():
+                flag = "  STRAGGLER" if w["straggler"] else ""
+                wait = (
+                    f"  wait {w['barrier_wait_pct']:.0f}%"
+                    if "barrier_wait_pct" in w
+                    else ""
+                )
+                lines.append(
+                    f"gen {gen} worker {worker}: median "
+                    f"{w['median_step_ms']:.1f}ms "
+                    f"({w['vs_generation_median']}x gen median)"
+                    f"{wait}{flag}"
+                )
+    return "\n".join(lines)
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m elasticdl_tpu.telemetry.trace",
+        description="Export (Perfetto) and analyze distributed traces",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    exp = sub.add_parser(
+        "export", help="Emit Chrome trace-event JSON for Perfetto"
+    )
+    exp.add_argument("run_dir")
+    exp.add_argument(
+        "--output",
+        default="",
+        help=f"Output path (default <run_dir>/{TRACE_FILENAME})",
+    )
+    ana = sub.add_parser(
+        "analyze",
+        help="Reform critical path + per-generation straggler report",
+    )
+    ana.add_argument("run_dir")
+    ana.add_argument("--json", action="store_true")
+    ana.add_argument(
+        "--output", default="", help="Also write the JSON report here"
+    )
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    if not os.path.isdir(args.run_dir):
+        print(f"not a directory: {args.run_dir}", file=sys.stderr)
+        return 2
+    if args.command == "export":
+        trace = build_chrome_trace(args.run_dir)
+        out = args.output or os.path.join(args.run_dir, TRACE_FILENAME)
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(trace, f)
+            f.write("\n")
+        print(
+            f"wrote {out} ({len(trace['traceEvents'])} events) — open at "
+            "https://ui.perfetto.dev or chrome://tracing"
+        )
+        return 0
+    report = analyze_run_dir(args.run_dir)
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(_format_analysis(report))
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2, default=str)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

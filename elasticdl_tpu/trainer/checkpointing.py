@@ -101,13 +101,21 @@ class PeriodicCheckpointer:
         chaos_hooks.notify_checkpoint_save(int(version))
         from elasticdl_tpu.telemetry import worker_hooks as telemetry_hooks
         from elasticdl_tpu.telemetry.events import EVENT_CHECKPOINT_SAVE
+        from elasticdl_tpu.telemetry.tracing import (
+            SPAN_CHECKPOINT_SAVE,
+            trace_span,
+        )
 
         telemetry_hooks.emit_event(EVENT_CHECKPOINT_SAVE, step=int(version))
         # non-chiefs only write their table parts: don't pay device->host
-        # copies for replicated leaves they would discard
-        dense, parts = elastic.state_checkpoint_parts(
-            trainer.state, mesh, materialize_dense=self.is_chief
-        )
+        # copies for replicated leaves they would discard.  The span
+        # covers the SYNCHRONOUS cost the training thread actually pays
+        # (snapshot + any gather collective); the async disk write is
+        # off the step critical path by design.
+        with trace_span(SPAN_CHECKPOINT_SAVE, step=int(version)):
+            dense, parts = elastic.state_checkpoint_parts(
+                trainer.state, mesh, materialize_dense=self.is_chief
+            )
         self._last_saved_version = version
         if not self._async:
             self._write(version, dense, parts)
@@ -182,10 +190,6 @@ def restore_trainer_state(trainer, args, process_id: int = 0) -> int | None:
     but reset the step counter (the old-job step count must not trigger
     this job's step-based eval/checkpoint milestones).
     """
-    import jax
-
-    from elasticdl_tpu.trainer.state import checkpoint_to_state
-
     ckpt_dir = getattr(args, "checkpoint_dir", "") or ""
     resume = bool(ckpt_dir) and save_utils.latest_version(ckpt_dir) is not None
     restore_dir = (
@@ -195,6 +199,26 @@ def restore_trainer_state(trainer, args, process_id: int = 0) -> int | None:
     )
     if not restore_dir:
         return None
+    from elasticdl_tpu.telemetry.tracing import (
+        SPAN_CHECKPOINT_RESTORE,
+        trace_span,
+    )
+
+    # reform-phase span: on a relaunched world the restore is a named
+    # term of the downtime critical path (trace analyze)
+    with trace_span(SPAN_CHECKPOINT_RESTORE, resume=bool(resume)):
+        return _restore_trainer_state_traced(
+            trainer, args, process_id, restore_dir, resume
+        )
+
+
+def _restore_trainer_state_traced(
+    trainer, args, process_id, restore_dir, resume
+):
+    import jax
+
+    from elasticdl_tpu.trainer.state import checkpoint_to_state
+
     dense, embeddings, extra = save_utils.restore_checkpoint(
         restore_dir,
         # keep only rows this process's devices hold, per part, so a

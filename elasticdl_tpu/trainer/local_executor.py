@@ -115,12 +115,21 @@ class LocalExecutor:
         # --telemetry_dir or the inherited env enables it
         import os as _os
 
+        from elasticdl_tpu.telemetry import tracing
         from elasticdl_tpu.telemetry import worker_hooks as telemetry_hooks
 
-        self._telemetry = telemetry_hooks.install(
-            getattr(args, "telemetry_dir", "")
-            or _os.environ.get(telemetry_hooks.TELEMETRY_DIR_ENV, "")
+        telemetry_dir = getattr(args, "telemetry_dir", "") or _os.environ.get(
+            telemetry_hooks.TELEMETRY_DIR_ENV, ""
         )
+        self._telemetry = telemetry_hooks.install(telemetry_dir)
+        # span tracer on the same run dir (sampled step spans, checkpoint
+        # and profile-window spans) — the single-process path of the
+        # distributed trace
+        tracing.install(
+            telemetry_dir,
+            sample_rate=getattr(args, "trace_sample_rate", None),
+        )
+        self._tracing = tracing
         self._last_eval_milestone = 0
         from elasticdl_tpu.utils.profiling import StepProfiler
 
@@ -165,25 +174,31 @@ class LocalExecutor:
     def _ensure_trainer(self, sample_features):
         if self._trainer is not None:
             return
-        rules = ()
-        if self._spec.sharding_rules is not None:
-            rules = tuple(self._spec.sharding_rules(self._mesh))
-        compute_dtype = getattr(self._args, "compute_dtype", "float32")
-        self._trainer = SPMDTrainer(
-            self._mesh,
-            self._model,
-            self._spec.loss,
-            self._tx,
-            sample_features,
-            rules=rules,
-            compute_dtype=None
-            if compute_dtype == "float32"
-            else compute_dtype,
-            remat=bool(getattr(self._args, "remat", False)),
-            donate=bool(getattr(self._args, "donate_state", True)),
-            device_parse=self._spec.device_parse,
+        from elasticdl_tpu.telemetry.tracing import (
+            SPAN_TRAINER_BUILD,
+            trace_span,
         )
-        version = restore_trainer_state(self._trainer, self._args)
+
+        with trace_span(SPAN_TRAINER_BUILD):
+            rules = ()
+            if self._spec.sharding_rules is not None:
+                rules = tuple(self._spec.sharding_rules(self._mesh))
+            compute_dtype = getattr(self._args, "compute_dtype", "float32")
+            self._trainer = SPMDTrainer(
+                self._mesh,
+                self._model,
+                self._spec.loss,
+                self._tx,
+                sample_features,
+                rules=rules,
+                compute_dtype=None
+                if compute_dtype == "float32"
+                else compute_dtype,
+                remat=bool(getattr(self._args, "remat", False)),
+                donate=bool(getattr(self._args, "donate_state", True)),
+                device_parse=self._spec.device_parse,
+            )
+            version = restore_trainer_state(self._trainer, self._args)
         if version is not None:
             self._checkpointer.note_restored_version(version)
             if self._args.evaluation_steps:
@@ -214,6 +229,7 @@ class LocalExecutor:
         loop passes one so host decode overlaps device compute); default
         builds the task's pipeline inline (retry paths, tests)."""
         from elasticdl_tpu.trainer.stacking import run_stacked_steps
+        from elasticdl_tpu.telemetry.tracing import record_step_span
         from elasticdl_tpu.telemetry.worker_hooks import record_step
 
         def _pre(features):
@@ -224,6 +240,7 @@ class LocalExecutor:
             # r3 finding 3)
             self._profiler.on_step()
             record_step(self._version, self._args.minibatch_size)
+            record_step_span(self._version)
 
         return run_stacked_steps(
             lambda: self._trainer,
@@ -373,6 +390,7 @@ class LocalExecutor:
                 # flush (or diagnose) the trace even on error — a leaked
                 # active trace poisons later start_trace calls
                 self._profiler.stop()
+                self._tracing.flush()
         logger.info(
             "Training complete: %d records, %d steps", total, self._version
         )

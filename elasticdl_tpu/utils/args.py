@@ -259,6 +259,19 @@ def _add_train_params(parser: argparse.ArgumentParser):
         ),
     )
     parser.add_argument(
+        "--trace_sample_rate",
+        type=float,
+        default=None,
+        required=False,
+        help=(
+            "Keep this fraction of hot-path spans (train_step, "
+            "heartbeat) in the distributed trace; lifecycle/reform "
+            "spans are always recorded.  Default 0.05 (1-in-20) keeps "
+            "steady-state overhead under the telemetry budget; 1.0 "
+            "traces every step.  Requires --telemetry_dir"
+        ),
+    )
+    parser.add_argument(
         "--profile_dir",
         default="",
         help=(
@@ -628,10 +641,12 @@ _MASTER_ONLY_FLAGS = frozenset(
         "yaml",
         "cluster_spec",
         # workers receive the telemetry dir via ELASTICDL_TPU_TELEMETRY_DIR
-        # (master/main.py) and never serve /metrics themselves
+        # and the span sample rate via ELASTICDL_TPU_TRACE_SAMPLE_RATE
+        # (master/main.py); they never serve /metrics themselves
         "telemetry_dir",
         "metrics_port",
         "metrics_host",
+        "trace_sample_rate",
     }
 )
 

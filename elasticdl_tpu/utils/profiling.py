@@ -37,6 +37,7 @@ class StepProfiler:
         self._seen = 0
         self._tracing = False
         self._done = not self._out_dir
+        self._window_span = None
 
     def on_step(self, _step=None):
         """Count one training step (the argument is accepted and ignored
@@ -49,6 +50,25 @@ class StepProfiler:
 
             jax.profiler.start_trace(self._out_dir)
             self._tracing = True
+            # telemetry marker + span so the XLA profiler window can be
+            # located on the SAME timeline as the distributed trace
+            # (both no-ops when telemetry/tracing is not installed)
+            from elasticdl_tpu.telemetry import tracing as _trace
+            from elasticdl_tpu.telemetry import worker_hooks
+            from elasticdl_tpu.telemetry.events import (
+                EVENT_PROFILE_WINDOW_OPEN,
+            )
+
+            worker_hooks.emit_event(
+                EVENT_PROFILE_WINDOW_OPEN,
+                at_call=self._seen,
+                out_dir=self._out_dir,
+            )
+            tracer = _trace.get_tracer()
+            if tracer is not None:
+                self._window_span = tracer.start_span(
+                    _trace.SPAN_PROFILE_WINDOW, out_dir=self._out_dir
+                )
             logger.info(
                 "XLA profiler: tracing %d steps into %s",
                 self._stop - self._start,
@@ -65,6 +85,19 @@ class StepProfiler:
 
             jax.profiler.stop_trace()
             self._tracing = False
+            from elasticdl_tpu.telemetry import worker_hooks
+            from elasticdl_tpu.telemetry.events import (
+                EVENT_PROFILE_WINDOW_CLOSE,
+            )
+
+            worker_hooks.emit_event(
+                EVENT_PROFILE_WINDOW_CLOSE,
+                at_call=self._seen,
+                out_dir=self._out_dir,
+            )
+            if self._window_span is not None:
+                self._window_span.end(steps=self._seen - self._start)
+                self._window_span = None
             logger.info("XLA profiler: trace written to %s", self._out_dir)
         elif not self._done and self._out_dir:
             logger.warning(

@@ -114,6 +114,7 @@ class LockstepWorker:
         # telemetry step sampling (no-op unless the master exported
         # ELASTICDL_TPU_TELEMETRY_DIR): a re-formed world installs a
         # fresh recorder stamped with its generation
+        from elasticdl_tpu.telemetry import tracing
         from elasticdl_tpu.telemetry import worker_hooks as telemetry_hooks
 
         telemetry_hooks.install_from_env(
@@ -121,6 +122,16 @@ class LockstepWorker:
             process_id=self._process_id,
             generation=self._cluster_version,
         )
+        # span tracer (worker/main.py installs it for subprocess entry;
+        # in-process harnesses construct the worker directly, so make
+        # install idempotent here with the same world identity)
+        if tracing.get_tracer() is None:
+            tracing.install_from_env(
+                worker_id=self._worker_id,
+                process_id=self._process_id,
+                generation=self._cluster_version,
+            )
+        self._tracing = tracing
         self._checkpointer = PeriodicCheckpointer(
             getattr(args, "checkpoint_dir", "") or "",
             getattr(args, "checkpoint_steps", 0) or 0,
@@ -146,7 +157,8 @@ class LockstepWorker:
         return self._process_id == 0
 
     def _report_task_result(
-        self, task_id, err_msg="", fail_count=0, include_timing=False
+        self, task_id, err_msg="", fail_count=0, include_timing=False,
+        trace=None,
     ):
         if not self._is_chief:
             return
@@ -155,13 +167,27 @@ class LockstepWorker:
             # chief's buckets; training reports only (same gating as the
             # task-stream Worker so eval/save never absorb train time)
             counters.update(self._timing.exec_counters())
+        from elasticdl_tpu.telemetry.tracing import SPAN_REPORT_TASK
+
+        t0 = time.monotonic()
         self._master.report_task_result(
             msg.ReportTaskResultRequest(
                 task_id=task_id,
                 err_message=err_msg,
                 exec_counters=counters,
+                trace=dict(trace or {}),
             )
         )
+        tracer = self._tracing.get_tracer()
+        if tracer is not None:
+            tracer.record_span(
+                SPAN_REPORT_TASK,
+                t0,
+                time.monotonic(),
+                trace_ctx=trace,
+                task_id=task_id,
+                error=bool(err_msg),
+            )
 
     def _report_version(self):
         if self._is_chief and self._trainer is not None:
@@ -177,28 +203,39 @@ class LockstepWorker:
     def _ensure_trainer(self, sample_features):
         if self._trainer is not None:
             return
-        rules = ()
-        if self._spec.sharding_rules is not None:
-            rules = tuple(self._spec.sharding_rules(self._mesh))
-        tx = build_optimizer(
-            self._spec, getattr(self._args, "learning_rate", None)
+        # reform-phase span: on a relaunched world the trainer build
+        # (state init + placement) is a named downtime term, with the
+        # checkpoint restore span nested inside it
+        from elasticdl_tpu.telemetry.tracing import (
+            SPAN_TRAINER_BUILD,
+            trace_span,
         )
-        compute_dtype = getattr(self._args, "compute_dtype", "float32")
-        self._trainer = SPMDTrainer(
-            self._mesh,
-            self._model,
-            self._spec.loss,
-            tx,
-            sample_features,
-            rules=rules,
-            compute_dtype=None if compute_dtype == "float32" else compute_dtype,
-            remat=bool(getattr(self._args, "remat", False)),
-            donate=bool(getattr(self._args, "donate_state", True)),
-            device_parse=self._spec.device_parse,
-        )
-        version = restore_trainer_state(
-            self._trainer, self._args, self._process_id
-        )
+
+        with trace_span(SPAN_TRAINER_BUILD):
+            rules = ()
+            if self._spec.sharding_rules is not None:
+                rules = tuple(self._spec.sharding_rules(self._mesh))
+            tx = build_optimizer(
+                self._spec, getattr(self._args, "learning_rate", None)
+            )
+            compute_dtype = getattr(self._args, "compute_dtype", "float32")
+            self._trainer = SPMDTrainer(
+                self._mesh,
+                self._model,
+                self._spec.loss,
+                tx,
+                sample_features,
+                rules=rules,
+                compute_dtype=None
+                if compute_dtype == "float32"
+                else compute_dtype,
+                remat=bool(getattr(self._args, "remat", False)),
+                donate=bool(getattr(self._args, "donate_state", True)),
+                device_parse=self._spec.device_parse,
+            )
+            version = restore_trainer_state(
+                self._trainer, self._args, self._process_id
+            )
         if version is not None:
             self._checkpointer.note_restored_version(version)
 
@@ -266,6 +303,12 @@ class LockstepWorker:
         # the scanned dispatch contains the same collectives
         from elasticdl_tpu.trainer.stacking import run_stacked_steps
 
+        from elasticdl_tpu.telemetry.tracing import (
+            SPAN_TASK_EXECUTE,
+            record_step_span,
+            trace_fetches,
+            trace_span,
+        )
         from elasticdl_tpu.telemetry.worker_hooks import record_step
 
         def _pre(features):
@@ -275,16 +318,29 @@ class LockstepWorker:
             # telemetry is not installed); every process steps through
             # the full global batch, so records == global minibatch
             record_step(int(self._trainer.step), self._minibatch_size)
+            # sampled jitted-step span (same early-return contract)
+            record_step_span(int(self._trainer.step))
             if self._chaos is not None:
                 # per-minibatch arming point: step-scheduled faults fire
                 # at the exact model version the plan names
                 self._chaos.on_step(int(self._trainer.step))
 
-        with self._crash_on_error(task):
+        # the task span joins the master's dispatch trace (one task =
+        # one trace across master and workers) and is the implicit
+        # parent of the fetch/step spans recorded inside it
+        with trace_span(
+            SPAN_TASK_EXECUTE,
+            trace_ctx=task.trace,
+            task_id=task.task_id,
+            shard=task.shard_name,
+        ) as task_span, self._crash_on_error(task):
             # build the stream INSIDE the crash protocol: a loud
             # deterministic-choice failure here must report-and-crash
             # like any other lockstep error, not escape unreported
             batches = self._task_batches(task, Modes.TRAINING)
+            batches = trace_fetches(
+                batches, trace_ctx=task.trace, span=task_span
+            )
             if self._chaos is not None:
                 batches = self._chaos.wrap_batches(batches)
             run_stacked_steps(
@@ -299,7 +355,9 @@ class LockstepWorker:
                 # per-process wall-clock probe
                 deterministic_auto=True,
             )
-        self._report_task_result(task.task_id, include_timing=True)
+        self._report_task_result(
+            task.task_id, include_timing=True, trace=task.trace
+        )
         self._timing.report_timing(reset=True)
         self._report_version()
         self._maybe_checkpoint()
@@ -319,7 +377,10 @@ class LockstepWorker:
         except Exception as ex:  # noqa: BLE001
             traceback.print_exc()
             self._report_task_result(
-                task.task_id, str(ex), fail_count=task.end - task.start
+                task.task_id,
+                str(ex),
+                fail_count=task.end - task.start,
+                trace=getattr(task, "trace", None),
             )
             self._stopped = True
             logger.error(
@@ -331,8 +392,19 @@ class LockstepWorker:
             raise
 
     def _eval_task(self, task):
+        from elasticdl_tpu.telemetry.tracing import (
+            SPAN_TASK_EXECUTE,
+            trace_span,
+        )
+
         all_outputs, all_labels = [], []
-        with self._crash_on_error(task):
+        with trace_span(
+            SPAN_TASK_EXECUTE,
+            trace_ctx=task.trace,
+            task_id=task.task_id,
+            shard=task.shard_name,
+            eval=True,
+        ), self._crash_on_error(task):
             for features, labels in self._task_batches(task, Modes.EVALUATION):
                 self._ensure_trainer(features)
                 n = _batch_len(labels)
@@ -350,7 +422,7 @@ class LockstepWorker:
             )
             labels = np.concatenate(all_labels, axis=0)
             self._report_eval_metrics(outputs, labels, task)
-        self._report_task_result(task.task_id)
+        self._report_task_result(task.task_id, trace=task.trace)
 
     def _report_eval_metrics(self, outputs, labels, task):
         from elasticdl_tpu.utils.tensor import ndarray_to_tensor
@@ -438,6 +510,7 @@ class LockstepWorker:
                     # master must see a dead worker
                     time.sleep(interval_secs)
                     continue
+                t0 = time.monotonic()
                 try:
                     self._master.heartbeat(
                         msg.HeartbeatRequest(
@@ -448,6 +521,15 @@ class LockstepWorker:
                     )
                 except Exception:  # noqa: BLE001 — master may be gone
                     pass
+                tracer = self._tracing.get_tracer()
+                if tracer is not None:
+                    from elasticdl_tpu.telemetry.tracing import (
+                        SPAN_HEARTBEAT,
+                    )
+
+                    tracer.record_span(
+                        SPAN_HEARTBEAT, t0, time.monotonic(), sampled=True
+                    )
                 time.sleep(interval_secs)
 
         threading.Thread(target=beat, daemon=True).start()
@@ -458,8 +540,11 @@ class LockstepWorker:
             self._start_heartbeats()
         ok = False
         try:
+            from elasticdl_tpu.telemetry.tracing import SPAN_GET_TASK
+
             seq = 0
             while True:
+                t0 = time.monotonic()
                 task = self._master.get_step_task(
                     msg.GetStepTaskRequest(
                         seq=seq,
@@ -467,6 +552,18 @@ class LockstepWorker:
                         cluster_version=self._cluster_version,
                     )
                 )
+                tracer = self._tracing.get_tracer()
+                if tracer is not None and task.shard_name:
+                    # the lease RPC joins the task's trace (WAIT polls
+                    # are not leases and record nothing)
+                    tracer.record_span(
+                        SPAN_GET_TASK,
+                        t0,
+                        time.monotonic(),
+                        trace_ctx=task.trace,
+                        task_id=task.task_id,
+                        seq=seq,
+                    )
                 if task.is_wait:
                     time.sleep(wait_sleep_secs)
                     continue
@@ -503,6 +600,7 @@ class LockstepWorker:
                 # thread running (it polls self._stopped)
                 self._profiler.stop()
                 self._stopped = True
+                self._tracing.flush()
 
     def _dump_state_if_requested(self):
         out_dir = os.environ.get(_DUMP_STATE_ENV, "")

@@ -123,6 +123,9 @@ def _poll_world_assignment(
                     "num_processes": resp.num_processes,
                     "process_id": resp.process_id,
                     "cluster_version": resp.cluster_version,
+                    # reform trace context: the activated standby's
+                    # world_join span links into the re-formation's trace
+                    "trace": dict(resp.trace),
                 }
             if resp.shutdown:
                 return None
@@ -146,18 +149,36 @@ def main(argv=None) -> int:
         args.master_addr,
     )
     coordinator_addr = getattr(args, "coordinator_addr", "") or ""
+    # distributed tracing: a no-op unless the master exported
+    # ELASTICDL_TPU_TELEMETRY_DIR; on a relaunched world the join span
+    # links into the master's re-formation trace (assignment payload for
+    # standbys, TRACE_PARENT env for cold spawns)
+    from elasticdl_tpu.telemetry import tracing
+
+    tracing.install_from_env(
+        worker_id=args.worker_id,
+        process_id=int(getattr(args, "process_id", 0) or 0),
+        generation=int(getattr(args, "cluster_version", 0) or 0),
+    )
+    reform_parent = getattr(args, "trace", None) or tracing.parent_from_env()
     client = MasterClient(args.master_addr)
     try:
         if coordinator_addr:
             from elasticdl_tpu.parallel import elastic
             from elasticdl_tpu.worker.lockstep import LockstepWorker
 
-            elastic.initialize_world(
-                coordinator_addr,
-                args.num_processes,
-                args.process_id,
-                platform=getattr(args, "jax_platform", "") or None,
-            )
+            with tracing.trace_span(
+                tracing.SPAN_WORLD_JOIN,
+                trace_ctx=reform_parent,
+                coordinator=coordinator_addr,
+            ):
+                elastic.initialize_world(
+                    coordinator_addr,
+                    args.num_processes,
+                    args.process_id,
+                    platform=getattr(args, "jax_platform", "") or None,
+                )
+            tracing.flush()
             try:
                 LockstepWorker(args, client).run()
             finally:
@@ -169,6 +190,7 @@ def main(argv=None) -> int:
             configure_platform(getattr(args, "jax_platform", "") or None)
             Worker(args, client).run()
     finally:
+        tracing.flush()
         client.close()
     return 0
 

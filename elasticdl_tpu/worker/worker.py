@@ -86,6 +86,16 @@ class Worker:
             eval_metrics_fn=getattr(args, "eval_metrics_fn", "eval_metrics_fn"),
         )
         self._model = self._spec.build_model()
+        # distributed tracing (no-op without ELASTICDL_TPU_TELEMETRY_DIR;
+        # worker/main.py installs for subprocess entry, this covers
+        # in-process harnesses).  task_id -> trace context of the lease,
+        # so reports echo the trace the master opened for the task.
+        from elasticdl_tpu.telemetry import tracing
+
+        if tracing.get_tracer() is None:
+            tracing.install_from_env(worker_id=self._worker_id)
+        self._tracing = tracing
+        self._task_traces: dict[int, dict] = {}
 
         data_origin = (
             args.prediction_data
@@ -121,9 +131,26 @@ class Worker:
     # ---- master protocol ---------------------------------------------------
 
     def get_task(self, task_type: int = -1) -> msg.TaskResponse:
-        return self._master.get_task(
+        t0 = time.monotonic()
+        task = self._master.get_task(
             msg.GetTaskRequest(worker_id=self._worker_id, task_type=task_type)
         )
+        tracer = self._tracing.get_tracer()
+        if tracer is not None and task.shard_name:
+            # remember the lease's trace so the eventual report (and the
+            # task-execute span) joins the master's dispatch trace; WAIT
+            # polls are not leases and record nothing
+            self._task_traces[task.task_id] = task.trace
+            from elasticdl_tpu.telemetry.tracing import SPAN_GET_TASK
+
+            tracer.record_span(
+                SPAN_GET_TASK,
+                t0,
+                time.monotonic(),
+                trace_ctx=task.trace,
+                task_id=task.task_id,
+            )
+        return task
 
     def report_task_result(
         self, task_id, err_msg="", exec_counters=None, include_timing=False
@@ -135,13 +162,28 @@ class Worker:
             # stream opts in, so eval/save reports never absorb leftover
             # training buckets
             counters.update(self._timing.exec_counters())
+        trace = self._task_traces.pop(task_id, None)
+        t0 = time.monotonic()
         self._master.report_task_result(
             msg.ReportTaskResultRequest(
                 task_id=task_id,
                 err_message=err_msg,
                 exec_counters=counters,
+                trace=dict(trace or {}),
             )
         )
+        tracer = self._tracing.get_tracer()
+        if tracer is not None:
+            from elasticdl_tpu.telemetry.tracing import SPAN_REPORT_TASK
+
+            tracer.record_span(
+                SPAN_REPORT_TASK,
+                t0,
+                time.monotonic(),
+                trace_ctx=trace,
+                task_id=task_id,
+                error=bool(err_msg),
+            )
 
     def report_version(self):
         if self._trainer is not None:
@@ -184,26 +226,34 @@ class Worker:
     def _ensure_trainer(self, sample_features):
         if self._trainer is not None:
             return
-        rules = ()
-        if self._spec.sharding_rules is not None:
-            rules = tuple(self._spec.sharding_rules(self._mesh))
-        tx = build_optimizer(
-            self._spec, getattr(self._args, "learning_rate", None)
+        from elasticdl_tpu.telemetry.tracing import (
+            SPAN_TRAINER_BUILD,
+            trace_span,
         )
-        compute_dtype = getattr(self._args, "compute_dtype", "float32")
-        self._trainer = SPMDTrainer(
-            self._mesh,
-            self._model,
-            self._spec.loss,
-            tx,
-            sample_features,
-            rules=rules,
-            compute_dtype=None if compute_dtype == "float32" else compute_dtype,
-            remat=bool(getattr(self._args, "remat", False)),
-            donate=bool(getattr(self._args, "donate_state", True)),
-            device_parse=self._spec.device_parse,
-        )
-        version = restore_trainer_state(self._trainer, self._args)
+
+        with trace_span(SPAN_TRAINER_BUILD):
+            rules = ()
+            if self._spec.sharding_rules is not None:
+                rules = tuple(self._spec.sharding_rules(self._mesh))
+            tx = build_optimizer(
+                self._spec, getattr(self._args, "learning_rate", None)
+            )
+            compute_dtype = getattr(self._args, "compute_dtype", "float32")
+            self._trainer = SPMDTrainer(
+                self._mesh,
+                self._model,
+                self._spec.loss,
+                tx,
+                sample_features,
+                rules=rules,
+                compute_dtype=None
+                if compute_dtype == "float32"
+                else compute_dtype,
+                remat=bool(getattr(self._args, "remat", False)),
+                donate=bool(getattr(self._args, "donate_state", True)),
+                device_parse=self._spec.device_parse,
+            )
+            version = restore_trainer_state(self._trainer, self._args)
         if version is not None:
             self._checkpointer.note_restored_version(version)
 
@@ -226,6 +276,13 @@ class Worker:
                 if task_type == int(TaskType.TRAINING):
                     self._ensure_trainer(features)
                     self._profiler.on_step()
+                    # sampled jitted-step span (single early-return when
+                    # tracing is off, like worker_hooks.record_step)
+                    from elasticdl_tpu.telemetry.tracing import (
+                        record_step_span,
+                    )
+
+                    record_step_span(int(self._trainer.step))
                     self._timing.start_record_time("batch_process")
                     self._trainer.train_step(
                         self._place(features), self._place(labels)
@@ -322,36 +379,47 @@ class Worker:
             self._task_batches,
             max_buffered_batches=max(4, 2 * k_bound),
         )
+        from elasticdl_tpu.telemetry.tracing import (
+            SPAN_TASK_EXECUTE,
+            trace_span,
+        )
+
         total = 0
         try:
             for _tid, task, batches in prefetcher:
-                for batch in batches:
-                    if isinstance(batch, PreStacked):
-                        err = self._process_stacked_group(batch)
-                        n = batch.num_records
-                    else:
-                        features, labels = batch
-                        err = self._process_minibatch(
-                            task.type, features, labels
-                        )
-                        n = _batch_len(labels)
-                    total += n
-                    if tds.report_record_done(n, err):
-                        # task boundary: report version (may trigger
-                        # step-based eval) and drain any eval tasks.
-                        # Polling here instead of every batch (reference
-                        # worker.py:982-987) keeps the get_task RPC out
-                        # of the minibatch hot loop.
-                        self._timing.report_timing(reset=True)
-                        self.report_version()
-                        self._checkpointer.maybe_save(
-                            self._trainer, self._mesh
-                        )
-                        if (
-                            self._job_type
-                            == JobType.TRAINING_WITH_EVALUATION
-                        ):
-                            self._evaluate_only()
+                with trace_span(
+                    SPAN_TASK_EXECUTE,
+                    trace_ctx=task.trace,
+                    task_id=task.task_id,
+                    shard=task.shard_name,
+                ):
+                    for batch in batches:
+                        if isinstance(batch, PreStacked):
+                            err = self._process_stacked_group(batch)
+                            n = batch.num_records
+                        else:
+                            features, labels = batch
+                            err = self._process_minibatch(
+                                task.type, features, labels
+                            )
+                            n = _batch_len(labels)
+                        total += n
+                        if tds.report_record_done(n, err):
+                            # task boundary: report version (may trigger
+                            # step-based eval) and drain any eval tasks.
+                            # Polling here instead of every batch
+                            # (reference worker.py:982-987) keeps the
+                            # get_task RPC out of the minibatch hot loop.
+                            self._timing.report_timing(reset=True)
+                            self.report_version()
+                            self._checkpointer.maybe_save(
+                                self._trainer, self._mesh
+                            )
+                            if (
+                                self._job_type
+                                == JobType.TRAINING_WITH_EVALUATION
+                            ):
+                                self._evaluate_only()
         finally:
             prefetcher.close()
         return total
@@ -385,17 +453,24 @@ class Worker:
         stack_k = choose_stack_k(
             getattr(self._args, "steps_per_dispatch", 1), training=True
         )
-        return build_task_batches(
-            reader,
-            task,
-            self._spec,
-            Modes.TRAINING,
-            reader.metadata,
-            self._minibatch_size,
-            shuffle_records=True,
-            prefetch=0,
-            stack_k=stack_k,
-            stack_divisor=batch_divisor(self._mesh),
+        from elasticdl_tpu.telemetry.tracing import trace_fetches
+
+        return trace_fetches(
+            build_task_batches(
+                reader,
+                task,
+                self._spec,
+                Modes.TRAINING,
+                reader.metadata,
+                self._minibatch_size,
+                shuffle_records=True,
+                prefetch=0,
+                stack_k=stack_k,
+                stack_divisor=batch_divisor(self._mesh),
+            ),
+            # runs on the prefetcher's producer thread: the trace context
+            # must travel explicitly, the consumer's span stack doesn't
+            trace_ctx=task.trace,
         )
 
     def _process_stacked_group(self, group) -> str:
@@ -407,6 +482,9 @@ class Worker:
                 self._ensure_trainer(group.sample_features)
                 for _ in range(group.num_steps):
                     self._profiler.on_step()
+                from elasticdl_tpu.telemetry.tracing import record_step_span
+
+                record_step_span(int(self._trainer.step))
                 self._timing.start_record_time("batch_process")
                 self._trainer.train_steps_stacked(
                     self._trainer.place_stacked(group.features),
@@ -444,6 +522,21 @@ class Worker:
         ONCE with the task's lease id just before task completion — a
         retried or lease-reclaimed task therefore can't double-count
         metrics (the master drops reports for inactive leases)."""
+        from elasticdl_tpu.telemetry.tracing import (
+            SPAN_TASK_EXECUTE,
+            trace_span,
+        )
+
+        with trace_span(
+            SPAN_TASK_EXECUTE,
+            trace_ctx=task.trace,
+            task_id=task.task_id,
+            shard=task.shard_name,
+            eval=True,
+        ):
+            self._process_eval_task_inner(task)
+
+    def _process_eval_task_inner(self, task):
         reader = self._task_data_service.data_reader
         from elasticdl_tpu.data.fast_pipeline import build_task_batches
 
@@ -551,6 +644,7 @@ class Worker:
 
         def beat():
             while not self._stopped:
+                t0 = time.monotonic()
                 try:
                     self._master.heartbeat(
                         msg.HeartbeatRequest(
@@ -561,6 +655,15 @@ class Worker:
                     )
                 except Exception:  # noqa: BLE001 — master may be gone
                     pass
+                tracer = self._tracing.get_tracer()
+                if tracer is not None:
+                    from elasticdl_tpu.telemetry.tracing import (
+                        SPAN_HEARTBEAT,
+                    )
+
+                    tracer.record_span(
+                        SPAN_HEARTBEAT, t0, time.monotonic(), sampled=True
+                    )
                 time.sleep(interval_secs)
 
         threading.Thread(target=beat, daemon=True).start()
@@ -590,6 +693,7 @@ class Worker:
                 # thread running (it polls self._stopped)
                 self._profiler.stop()
                 self._stopped = True
+                self._tracing.flush()
 
 
 def _batch_len(tree) -> int:

@@ -4,14 +4,17 @@
 Enforces the contracts docs/designs/telemetry.md relies on:
 
 1. every metric name passed literally to ``.counter(`` / ``.gauge(`` /
-   ``.histogram(`` and every event name passed literally to ``.emit(`` /
-   ``emit_event(`` is snake_case;
+   ``.histogram(``, every event name passed literally to ``.emit(`` /
+   ``emit_event(``, and every span name passed literally to
+   ``.start_span(`` / ``.record_span(`` / ``trace_span(`` is snake_case;
 2. each such name has exactly ONE registration/definition site (names
    used from several modules must live in a shared constant — e.g. the
-   ``EVENT_*`` vocabulary in ``telemetry/events.py`` — so the registry
-   and the event schema each have a single source of truth);
-3. every ``EVENT_*`` constant in ``telemetry/events.py`` is snake_case
-   and defined once;
+   ``EVENT_*`` vocabulary in ``telemetry/events.py`` and the ``SPAN_*``
+   vocabulary in ``telemetry/tracing.py`` — so the registry, the event
+   schema and the span schema each have a single source of truth);
+3. every ``EVENT_*`` constant in ``telemetry/events.py`` and every
+   ``SPAN_*`` constant in ``telemetry/tracing.py`` is snake_case and
+   defined once;
 4. no bare ``print(`` statements inside ``elasticdl_tpu/`` outside the
    allowlisted CLI entry points — runtime output goes through the
    logger or the telemetry spine, where it is structured and greppable.
@@ -34,7 +37,12 @@ METRIC_CALL = re.compile(
     r"\.(?:counter|gauge|histogram)\(\s*[\"']([^\"']+)[\"']", re.S
 )
 EMIT_CALL = re.compile(r"(?:\.emit|emit_event)\(\s*[\"']([^\"']+)[\"']", re.S)
+SPAN_CALL = re.compile(
+    r"(?:\.start_span|\.record_span|trace_span)\(\s*[\"']([^\"']+)[\"']",
+    re.S,
+)
 EVENT_CONST = re.compile(r"^EVENT_\w+\s*=\s*[\"']([^\"']+)[\"']", re.M)
+SPAN_CONST = re.compile(r"^SPAN_\w+\s*=\s*[\"']([^\"']+)[\"']", re.M)
 BARE_PRINT = re.compile(r"^\s*print\(")
 
 # CLI entry points whose stdout IS their product (reports, dataset
@@ -42,6 +50,7 @@ BARE_PRINT = re.compile(r"^\s*print\(")
 PRINT_ALLOWLIST = (
     os.path.join("elasticdl_tpu", "chaos", "runner.py"),
     os.path.join("elasticdl_tpu", "telemetry", "report.py"),
+    os.path.join("elasticdl_tpu", "telemetry", "trace.py"),
     os.path.join("elasticdl_tpu", "client.py"),
     os.path.join("elasticdl_tpu", "data", "recordio", "build.py"),
     os.path.join("elasticdl_tpu", "data", "recordio_gen") + os.sep,
@@ -63,12 +72,14 @@ def main() -> int:
     errors: list[str] = []
     metric_sites: dict[str, list[str]] = {}
     event_sites: dict[str, list[str]] = {}
+    span_sites: dict[str, list[str]] = {}
 
     for rel, text in iter_sources():
         # full-text scan: registration calls wrap across lines
         for pattern, sites in (
             (METRIC_CALL, metric_sites),
             (EMIT_CALL, event_sites),
+            (SPAN_CALL, span_sites),
         ):
             for match in pattern.finditer(text):
                 lineno = text.count("\n", 0, match.start()) + 1
@@ -84,7 +95,11 @@ def main() -> int:
                     "the telemetry event log"
                 )
 
-    for kind, sites in (("metric", metric_sites), ("event", event_sites)):
+    for kind, sites in (
+        ("metric", metric_sites),
+        ("event", event_sites),
+        ("span", span_sites),
+    ):
         for name, where in sorted(sites.items()):
             if not SNAKE_CASE.match(name):
                 errors.append(
@@ -97,21 +112,26 @@ def main() -> int:
                     "constant with one definition site"
                 )
 
-    events_py = os.path.join(PACKAGE, "telemetry", "events.py")
-    with open(events_py, encoding="utf-8") as f:
-        const_values = EVENT_CONST.findall(f.read())
-    for value in const_values:
-        if not SNAKE_CASE.match(value):
+    const_counts = {}
+    for rel_path, pattern, label in (
+        (os.path.join("telemetry", "events.py"), EVENT_CONST, "event"),
+        (os.path.join("telemetry", "tracing.py"), SPAN_CONST, "span"),
+    ):
+        with open(os.path.join(PACKAGE, rel_path), encoding="utf-8") as f:
+            const_values = pattern.findall(f.read())
+        const_counts[label] = len(set(const_values))
+        for value in const_values:
+            if not SNAKE_CASE.match(value):
+                errors.append(
+                    f"telemetry/{os.path.basename(rel_path)}: {label} "
+                    f"constant value {value!r} is not snake_case"
+                )
+        duplicates = {v for v in const_values if const_values.count(v) > 1}
+        for value in sorted(duplicates):
             errors.append(
-                f"telemetry/events.py: EVENT constant value {value!r} "
-                "is not snake_case"
+                f"telemetry/{os.path.basename(rel_path)}: {label} name "
+                f"{value!r} defined more than once"
             )
-    duplicates = {v for v in const_values if const_values.count(v) > 1}
-    for value in sorted(duplicates):
-        errors.append(
-            f"telemetry/events.py: event name {value!r} defined more "
-            "than once"
-        )
 
     if errors:
         for error in errors:
@@ -120,7 +140,8 @@ def main() -> int:
     print(
         "check_telemetry_names: OK "
         f"({len(metric_sites)} metric names, "
-        f"{len(set(const_values)) + len(event_sites)} event names)"
+        f"{const_counts['event'] + len(event_sites)} event names, "
+        f"{const_counts['span'] + len(span_sites)} span names)"
     )
     return 0
 

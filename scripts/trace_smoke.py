@@ -1,0 +1,107 @@
+#!/usr/bin/env python3
+"""Tier-1 trace smoke (wired into scripts/run_tier1.sh).
+
+Runs a tiny LocalExecutor mnist job on the CPU backend with telemetry +
+tracing enabled, then:
+
+1. ``python -m elasticdl_tpu.telemetry.trace export`` must exit 0 and
+   the output must parse as valid Chrome trace-event JSON (dict with a
+   non-empty ``traceEvents`` list; every complete event carries
+   name/ts/dur);
+2. ``python -m elasticdl_tpu.telemetry.trace analyze`` must exit 0.
+
+Fast by construction: 64 records, one epoch, one process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "")
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main() -> int:
+    from elasticdl_tpu.data.recordio_gen import synthetic
+    from elasticdl_tpu.telemetry import trace as trace_cli
+    from elasticdl_tpu.trainer.local_executor import LocalExecutor
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    with tempfile.TemporaryDirectory() as workdir:
+        train = synthetic.gen_mnist(
+            os.path.join(workdir, "train"),
+            num_records=64,
+            num_shards=1,
+            seed=1,
+        )
+        telemetry_dir = os.path.join(workdir, "telemetry")
+        args = parse_master_args(
+            [
+                "--model_def",
+                "mnist_functional_api.mnist_functional_api.custom_model",
+                "--training_data",
+                train,
+                "--minibatch_size",
+                "32",
+                "--records_per_task",
+                "32",
+                "--num_epochs",
+                "1",
+                "--compute_dtype",
+                "float32",
+                "--telemetry_dir",
+                telemetry_dir,
+                "--trace_sample_rate",
+                "1.0",
+            ]
+        )
+        LocalExecutor(args).run()
+
+        out = os.path.join(workdir, "trace.json")
+        rc = trace_cli.main(["export", workdir, "--output", out])
+        if rc != 0:
+            print(f"trace_smoke: export exited {rc}", file=sys.stderr)
+            return 1
+        with open(out, encoding="utf-8") as f:
+            chrome = json.load(f)
+        events = chrome.get("traceEvents")
+        if not isinstance(events, list) or not events:
+            print("trace_smoke: empty traceEvents", file=sys.stderr)
+            return 1
+        for event in events:
+            if "name" not in event or "ph" not in event:
+                print(
+                    f"trace_smoke: malformed trace event {event!r}",
+                    file=sys.stderr,
+                )
+                return 1
+            if event["ph"] == "X" and not (
+                isinstance(event.get("ts"), (int, float))
+                and isinstance(event.get("dur"), (int, float))
+            ):
+                print(
+                    f"trace_smoke: X event missing ts/dur {event!r}",
+                    file=sys.stderr,
+                )
+                return 1
+        if not any(e.get("ph") == "X" for e in events):
+            print("trace_smoke: no span/step slices", file=sys.stderr)
+            return 1
+
+        rc = trace_cli.main(["analyze", workdir])
+        if rc != 0:
+            print(f"trace_smoke: analyze exited {rc}", file=sys.stderr)
+            return 1
+    print(f"trace_smoke: OK ({len(events)} trace events)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
