@@ -34,8 +34,17 @@ _KILL_KINDS = frozenset(
         FaultKind.PREEMPT,
         FaultKind.KILL_COORDINATOR,
         FaultKind.KILL_IN_CHECKPOINT,
+        FaultKind.KILL_DURING_REPLICATION,
         FaultKind.DROP_HEARTBEAT,
     }
+)
+
+# kill kinds a COMPLETE replica set must survive: the victim's shard
+# lives on its ring neighbor, so the resumed generation must restore at
+# the last replicated step (kill_during_replication deliberately leaves
+# coverage incomplete and is therefore excluded)
+_REPLICA_RECOVERABLE_KINDS = frozenset(
+    {FaultKind.PREEMPT, FaultKind.KILL_COORDINATOR}
 )
 
 # deliberate-corruption modes: prove the checker catches what it claims
@@ -63,6 +72,10 @@ class ChaosJobConfig:
     corrupt: str = ""  # one of CORRUPTIONS
     run_timeout_secs: float = 600.0
     extra_master_args: list = field(default_factory=list)
+    # peer state replication: ring-push state into surviving hosts' RAM
+    # so the re-formed world hot-restores without a disk read
+    replication: bool = False
+    replication_steps: int = 0  # 0 = every task boundary
 
 
 def _master_args(config: ChaosJobConfig, train_dir: str, ckpt_dir: str):
@@ -111,6 +124,16 @@ def _master_args(config: ChaosJobConfig, train_dir: str, ckpt_dir: str):
             # it with the chaos artifacts written alongside
             "--telemetry_dir",
             os.path.join(config.workdir, "telemetry"),
+            *(
+                [
+                    "--replication",
+                    "true",
+                    "--replication_steps",
+                    str(config.replication_steps),
+                ]
+                if config.replication
+                else []
+            ),
             *config.extra_master_args,
         ]
     )
@@ -262,6 +285,70 @@ def _read_events(path: str) -> tuple[list[dict], list[dict]]:
                 continue  # torn line from a killed writer
             (observations if "observation" in event else faults).append(event)
     return faults, observations
+
+
+def _replication_stats(telemetry_dir: str) -> dict:
+    """Replica coverage from the run's telemetry event log — the SAME
+    aggregation ``telemetry.report`` embeds, so ``chaos_result.json``
+    and the report can never disagree on schema."""
+    from elasticdl_tpu.telemetry.events import EVENTS_FILENAME, read_jsonl
+    from elasticdl_tpu.telemetry.report import replication_section
+
+    events = read_jsonl(os.path.join(telemetry_dir, EVENTS_FILENAME))
+    return replication_section(events) or {}
+
+
+def _check_no_lost_steps(
+    config: ChaosJobConfig,
+    telemetry_dir: str,
+    fault_events: list[dict],
+) -> dict | None:
+    """The replication contract under a plain preemption: the resumed
+    generation restores FROM PEER RAM at exactly the last replicated
+    step before the kill — not the (older) last disk milestone."""
+    if not config.replication:
+        return None
+    recoverable = [
+        e
+        for e in fault_events
+        if e.get("kind") in _REPLICA_RECOVERABLE_KINDS
+    ]
+    if not recoverable:
+        return None
+    from elasticdl_tpu.telemetry.events import EVENTS_FILENAME, read_jsonl
+
+    events = read_jsonl(os.path.join(telemetry_dir, EVENTS_FILENAME))
+    kill_at = min(e["monotonic"] for e in recoverable)
+    pushed = [
+        int(e.get("step", -1))
+        for e in events
+        if e.get("event") == "replica_push"
+        and e.get("monotonic", 0.0) <= kill_at
+    ]
+    restored = [
+        int(e.get("step", -1))
+        for e in events
+        if e.get("event") == "replica_restore"
+    ]
+    violations = []
+    if not pushed:
+        violations.append("no replica_push before the kill")
+    if not restored:
+        violations.append(
+            "no replica_restore event — the re-formed world did not "
+            "restore from peer RAM"
+        )
+    elif pushed and max(restored) < max(pushed):
+        violations.append(
+            f"restored at step {max(restored)} but step {max(pushed)} "
+            "was replicated before the kill — steps lost despite a "
+            "complete replica set"
+        )
+    return {
+        "name": "replication_no_lost_steps",
+        "status": "FAIL" if violations else "PASS",
+        "violations": violations,
+    }
 
 
 def run_chaos_job(config: ChaosJobConfig) -> dict:
@@ -426,6 +513,16 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
     if fault_violations:
         invariants["ok"] = False
 
+    telemetry_dir = os.path.join(config.workdir, "telemetry")
+    replication_stats = (
+        _replication_stats(telemetry_dir) if config.replication else None
+    )
+    lost_steps = _check_no_lost_steps(config, telemetry_dir, fault_events)
+    if lost_steps is not None:
+        invariants["invariants"].append(lost_steps)
+        if lost_steps["status"] == "FAIL":
+            invariants["ok"] = False
+
     report = {
         "plan": config.plan.name,
         "seed": config.plan.seed,
@@ -462,6 +559,8 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
             master.instance_manager, "standby_activations", 0
         ),
     }
+    if replication_stats is not None:
+        report["replication"] = replication_stats
     if not records_ok:
         report["total_records"] = counters.total_records
 

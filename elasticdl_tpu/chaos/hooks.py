@@ -121,16 +121,23 @@ class ChaosInjector:
 
     # ---- hook points -------------------------------------------------------
 
+    # faults that fire from their own dedicated hook point, never at a
+    # step boundary
+    _HOOK_FIRED = frozenset(
+        {FaultKind.KILL_IN_CHECKPOINT, FaultKind.KILL_DURING_REPLICATION}
+    )
+
     def on_step(self, step: int):
         """Called once per minibatch with the trainer's current step.
-        KILL_IN_CHECKPOINT is excluded: it fires from the checkpoint
-        hook (``on_checkpoint_save``), never at a step boundary."""
+        KILL_IN_CHECKPOINT / KILL_DURING_REPLICATION are excluded: they
+        fire from the checkpoint-save / replica-push hooks, never at a
+        step boundary."""
         if not self._pending:
             return
         due = [
             f
             for f in self._pending
-            if step >= f.at_step and f.kind != FaultKind.KILL_IN_CHECKPOINT
+            if step >= f.at_step and f.kind not in self._HOOK_FIRED
         ]
         for fault in due:
             self._pending.remove(fault)
@@ -186,6 +193,24 @@ class ChaosInjector:
         """Restore is an observation point only (the event log is how the
         harness proves a re-formed world actually resumed from state)."""
         self._record_observation("checkpoint_restore", version=version)
+
+    def on_replica_push(self, version: int):
+        """Replication hook: fires after the local snapshot commit,
+        before the ring-neighbor push — the exact window where a
+        preemption leaves the replica set incomplete."""
+        for fault in list(self._pending):
+            if (
+                fault.kind == FaultKind.KILL_DURING_REPLICATION
+                and version >= fault.at_step
+            ):
+                self._pending.remove(fault)
+                self._record(fault, step=version, phase="replica_push")
+                os.kill(os.getpid(), signal.SIGKILL)
+
+    def on_replica_restore(self, version: int):
+        """Observation point: a re-formed world resumed from peer RAM
+        (vs the disk observation ``checkpoint_restore``)."""
+        self._record_observation("replica_restore", version=version)
 
     def _record_observation(self, what: str, **extra):
         append_event(
@@ -252,3 +277,15 @@ def notify_checkpoint_save(version: int):
 def notify_checkpoint_restore(version: int):
     if _active is not None:
         _active.on_checkpoint_restore(version)
+
+
+def notify_replica_push(version: int):
+    """Replica-push hook (replication.replicator); no-op without an
+    installed injector."""
+    if _active is not None:
+        _active.on_replica_push(version)
+
+
+def notify_replica_restore(version: int):
+    if _active is not None:
+        _active.on_replica_restore(version)

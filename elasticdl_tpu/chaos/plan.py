@@ -30,6 +30,10 @@ class FaultKind:
     DROP_HEARTBEAT = "drop_heartbeat"  # suppress heartbeats for a window
     DELAY_BATCHES = "delay_batches"  # sleep per host-pipeline batch
     KILL_IN_CHECKPOINT = "kill_in_checkpoint"  # die entering a save
+    # die mid-replication: after the local snapshot, before the ring
+    # neighbor holds the new version — the torn/incomplete replica set
+    # must be detected and skipped at harvest time
+    KILL_DURING_REPLICATION = "kill_during_replication"
     # master-side
     REDUCE_CAPACITY = "reduce_capacity"  # shrink the world by `count`
     RESTORE_CAPACITY = "restore_capacity"  # back to full size
@@ -41,6 +45,7 @@ class FaultKind:
             DROP_HEARTBEAT,
             DELAY_BATCHES,
             KILL_IN_CHECKPOINT,
+            KILL_DURING_REPLICATION,
         }
     )
     MASTER_SIDE = frozenset({REDUCE_CAPACITY, RESTORE_CAPACITY})
@@ -229,6 +234,41 @@ def builtin_plans(num_workers: int = 2) -> dict[str, FaultPlan]:
             ],
             notes="a second preemption after the first re-formation "
             "(generation-fenced: gen-1 fault arms only in gen 1)",
+        ),
+        "preempt_after_replication": FaultPlan(
+            name="preempt_after_replication",
+            faults=[
+                Fault(
+                    kind=FaultKind.PREEMPT,
+                    fault_id="preempt-post-replica-p%d" % last,
+                    # one step after a task-boundary replica push (tasks
+                    # are 2 steps in the harness, so pushes land on even
+                    # versions; _KILL_STEP is even): the resumed
+                    # generation must restore from peer RAM at EXACTLY
+                    # the pushed version — zero steps lost to the
+                    # preemption beyond the one in flight
+                    at_step=_KILL_STEP + 1,
+                    process_id=last,
+                )
+            ],
+            notes="SIGKILL a non-chief one step after a replica push; "
+            "with replication on, restore must come from peer RAM at "
+            "the pushed version (no disk read, no lost steps)",
+        ),
+        "kill_during_replication": FaultPlan(
+            name="kill_during_replication",
+            faults=[
+                Fault(
+                    kind=FaultKind.KILL_DURING_REPLICATION,
+                    fault_id="replica-kill-p%d" % last,
+                    at_step=4,
+                    process_id=last,
+                )
+            ],
+            notes="die mid-replication (snapshot committed locally, "
+            "neighbor never receives it): the incomplete replica set "
+            "must be skipped — restore from an older complete set or "
+            "fall back to disk",
         ),
         "shrink_then_restore": FaultPlan(
             name="shrink_then_restore",

@@ -37,6 +37,12 @@ os.environ.setdefault("XLA_FLAGS", "")
 # task interleaving after re-formation), not lost learning
 TRAJECTORY_TOLERANCE = 0.15
 
+# plans that exist to exercise peer state replication: --replication auto
+# turns the subsystem on for exactly these
+REPLICATION_PLANS = frozenset(
+    {"preempt_after_replication", "kill_during_replication"}
+)
+
 
 def build_arg_parser() -> argparse.ArgumentParser:
     from elasticdl_tpu.chaos.harness import CORRUPTIONS
@@ -81,6 +87,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "when it should",
     )
     parser.add_argument(
+        "--replication",
+        choices=["auto", "on", "off"],
+        default="auto",
+        help=(
+            "Peer state replication for the chaos'd job; 'auto' enables "
+            "it for the replication plans (preempt_after_replication, "
+            "kill_during_replication) and leaves every other plan "
+            "byte-identical to a replication-less run"
+        ),
+    )
+    parser.add_argument(
         "--workdir",
         default="",
         help="Keep artifacts (plan, event log, checkpoints) here; "
@@ -98,6 +115,9 @@ def _run(args, workdir: str) -> dict:
     from elasticdl_tpu.chaos.plan import resolve_plan
 
     plan = resolve_plan(args.plan, num_workers=args.num_workers)
+    replication = args.replication == "on" or (
+        args.replication == "auto" and plan.name in REPLICATION_PLANS
+    )
     report = run_chaos_job(
         ChaosJobConfig(
             plan=plan,
@@ -108,6 +128,7 @@ def _run(args, workdir: str) -> dict:
             evaluate=True,
             corrupt=args.corrupt,
             run_timeout_secs=args.run_timeout_secs,
+            replication=replication,
         )
     )
     if args.baseline and not args.corrupt:
@@ -179,6 +200,10 @@ def write_result_json(report: dict, workdir: str) -> str:
         "detect_secs": report.get("detect_secs"),
         "kill_to_step_secs": report.get("kill_to_step_secs"),
     }
+    # replica-coverage stats (pushes per generation, hosts covered,
+    # shard versions, restores) ride into the same CI artifact
+    if report.get("replication") is not None:
+        result["replication"] = report["replication"]
     # causal-trace summary (reform phase breakdown + stragglers) so CI
     # reads the critical path from the same artifact as the verdicts
     try:

@@ -150,6 +150,15 @@ class Master:
         )
         self._telemetry_server = None
 
+        # ---- peer state replication (off by default: behavior and wire
+        # payloads are then byte-identical to a replication-less build)
+        self.replica_directory = None
+        if bool(getattr(args, "replication", False)):
+            from elasticdl_tpu.replication.directory import ReplicaDirectory
+
+            self.replica_directory = ReplicaDirectory()
+            self.servicer.set_replica_directory(self.replica_directory)
+
     # ---- lifecycle ---------------------------------------------------------
 
     @property
@@ -341,6 +350,13 @@ class Master:
             SPAN_REFORM_RELAUNCH,
         )
 
+        # harvest the survivors' replica shards BEFORE the fence loop
+        # forgets them (the directory loses their addresses there) and
+        # before the relaunch kills them (their RAM dies there).  Stale
+        # task leases are already fenced by the version bump above.
+        self._stage_replica_restore(
+            new_version, dead, old_world_size, reform_trace
+        )
         with self.telemetry.tracer.span(
             SPAN_REFORM_FENCE, trace_ctx=reform_trace, generation=new_version
         ):
@@ -388,6 +404,57 @@ class Master:
                 callback(new_version, sorted(dead), reason)
             except Exception:  # noqa: BLE001 — observers never break recovery
                 logger.exception("Reform callback failed")
+
+    def _stage_replica_restore(
+        self, new_version: int, dead: list[int], old_world_size: int,
+        reform_trace: dict,
+    ):
+        """Harvest the freshest complete replica set from surviving
+        workers' RAM and stage it for the relaunched generation; stages
+        None (disk fallback) when replication is off or coverage is
+        incomplete."""
+        if self.replica_directory is None:
+            return
+        from elasticdl_tpu.telemetry.tracing import SPAN_REPLICA_HARVEST
+
+        live = [
+            w
+            for w in self.instance_manager.worker_ids()
+            if w not in set(dead)
+        ]
+        stage = None
+        with self.telemetry.tracer.span(
+            SPAN_REPLICA_HARVEST,
+            trace_ctx=reform_trace,
+            generation=new_version,
+        ) as span:
+            try:
+                stage = self.replica_directory.harvest(
+                    live_worker_ids=live,
+                    num_sources=old_world_size,
+                    generation=new_version - 1,
+                    staged_for=new_version,
+                )
+            except Exception:  # noqa: BLE001 — harvest must never take
+                # down recovery; disk restore is always available
+                logger.exception("Replica harvest failed; disk fallback")
+            span.set(
+                complete=stage is not None,
+                version=stage["version"] if stage else None,
+            )
+        if stage is not None:
+            # how many processes will fetch this stage — once all have,
+            # the servicer releases the payload from master RAM
+            stage["world_size"] = getattr(
+                self.instance_manager, "world_size", old_world_size
+            )
+        self.servicer.set_restore_stage(stage)
+        self.telemetry.replica_harvest(
+            generation=new_version,
+            complete=stage is not None,
+            version=stage["version"] if stage else None,
+            sources=old_world_size,
+        )
 
     def request_reform(self, reason: str = "elective"):
         """Ask the run loop to re-form the lockstep world at its next
@@ -449,6 +516,8 @@ class Master:
         summary = getattr(self.evaluation_service, "latest_summary", None)
         if summary:
             out["evaluation_metrics"] = summary
+        if self.replica_directory is not None:
+            out["replication"] = self.replica_directory.coverage_stats()
         events = getattr(self, "reform_events", None)
         if events:
             out["reforms"] = [

@@ -68,6 +68,11 @@ class MasterServicer:
         # dispatch span's {"trace_id", "span_id"} so every TaskResponse
         # carries the trace it belongs to (telemetry/tracing.py)
         self._trace_provider = None
+        # peer state replication (elasticdl_tpu.replication): heartbeat
+        # advertisements feed the directory; the harvested restore stage
+        # is served to the generation it was staged for
+        self._replica_directory = None
+        self._restore_stage: dict | None = None
         if evaluation_service is not None:
             evaluation_service.set_master_servicer(self)
 
@@ -83,6 +88,11 @@ class MasterServicer:
     def set_trace_provider(self, provider):
         """``provider(task_id) -> dict`` — the task's trace context."""
         self._trace_provider = provider
+
+    def set_replica_directory(self, directory):
+        """Attach the replication subsystem's master-side directory;
+        heartbeats then carry advertisements up and peer maps down."""
+        self._replica_directory = directory
 
     def _trace_for(self, task_id: int) -> dict:
         if self._trace_provider is None:
@@ -272,12 +282,57 @@ class MasterServicer:
     def heartbeat(self, request: msg.HeartbeatRequest) -> msg.HeartbeatResponse:
         with self._lock:
             self._heartbeats[request.worker_id] = time.monotonic()
+            generation = self._cluster_version
         if self._instance_manager is not None:
             self._instance_manager.on_heartbeat(request.worker_id)
+        replica_peers: dict = {}
+        if self._replica_directory is not None:
+            if request.replica:
+                self._replica_directory.update(
+                    request.worker_id, request.replica
+                )
+            replica_peers = self._replica_directory.peers(generation)
         return msg.HeartbeatResponse(
             should_quiesce=self._quiesce,
-            cluster_version=self._cluster_version,
+            cluster_version=generation,
+            replica_peers=replica_peers,
         )
+
+    # ---- replica restore stage ---------------------------------------------
+
+    def set_restore_stage(self, stage: dict | None):
+        """Install (or clear, with None) the harvested replica state the
+        NEXT generation restores from (Master._reform_lockstep)."""
+        with self._lock:
+            self._restore_stage = stage
+
+    def get_restore_state(
+        self, request: msg.GetRestoreStateRequest
+    ) -> msg.RestoreStateResponse:
+        """Serve the staged replica set — only to the generation it was
+        harvested FOR (any other asker gets the disk-fallback answer).
+        Once every process of that generation has fetched its copy, the
+        stage is released: the payload is a full model-state copy and
+        must not sit in master RAM for the rest of the run."""
+        with self._lock:
+            stage = self._restore_stage
+            if (
+                stage is None
+                or stage["generation"] != request.cluster_version
+            ):
+                return msg.RestoreStateResponse()
+            response = msg.RestoreStateResponse(
+                has=True,
+                version=stage["version"],
+                checksum=stage["checksum"],
+                payload=stage["payload"],
+            )
+            served = stage.setdefault("served", set())
+            served.add(request.process_id)
+            world_size = stage.get("world_size", 0)
+            if world_size and len(served) >= world_size:
+                self._restore_stage = None
+        return response
 
     # ---- hot-standby world assignments ------------------------------------
 
@@ -338,6 +393,8 @@ class MasterServicer:
         with self._lock:
             self._heartbeats.pop(worker_id, None)
             self._marked_dead.discard(worker_id)
+        if self._replica_directory is not None:
+            self._replica_directory.forget_worker(worker_id)
 
     def live_workers(self) -> list[int]:
         """Workers with a recorded heartbeat that are not marked dead

@@ -379,7 +379,23 @@ class TaskDispatcher:
 
     def finished(self) -> bool:
         with self._lock:
-            return not (self._pending or self._pending_eval or self._active)
+            # epochs are opened LAZILY by get() — an un-started epoch is
+            # still pending work.  Without this term, a worker death at
+            # the last task of an epoch lets the master's poll loop see
+            # empty queues (the survivor reported the task, then blocked
+            # in a dead collective and never pulled again) and declare a
+            # multi-epoch job complete one epoch early, skipping the
+            # re-formation entirely.
+            epochs_pending = bool(
+                self._shards[TaskType.TRAINING]
+                and self._epoch < self._num_epochs - 1
+            )
+            return not (
+                self._pending
+                or self._pending_eval
+                or self._active
+                or epochs_pending
+            )
 
     def invoke_deferred_callback(self) -> bool:
         """Pop and run one all-tasks-done callback in registration order

@@ -1,0 +1,24 @@
+"""Peer state replication: in-memory hot restore for re-formed worlds.
+
+Every ``replication_steps`` model versions (default: every task
+boundary) each lockstep process snapshots its share of the trainer
+state host-side — the SAME split ``elastic.state_checkpoint_parts``
+uses for disk checkpoints (replicated leaves from the chief's local
+replica, vocab-sharded table rows per owning host) — keeps the
+snapshot in its own RAM (:mod:`.store`) and pushes it to its ring
+neighbor ``(i + 1) % n`` over the job's RPC transport (:mod:`.service`),
+so every piece of state lives in at least two hosts' RAM.
+
+On re-formation the master harvests the freshest COMPLETE replica set
+from the survivors' stores (:mod:`.directory`), stages the merged state
+in its own RAM, and the relaunched generation restores from that stage
+(:func:`.replicator.restore_from_replica`) at the exact step of the
+last replication — reform downtime no longer pays a disk read, and the
+lost-work window shrinks from ``checkpoint_steps`` to
+``replication_steps``.  Disk checkpoints remain the durable fallback:
+incomplete coverage (adjacent hosts lost, torn pushes, a cold master)
+falls back to ``trainer.checkpointing.restore_trainer_state``
+unchanged.
+
+Design doc: ``docs/designs/replication.md``.
+"""

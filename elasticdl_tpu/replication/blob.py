@@ -1,0 +1,93 @@
+"""State-shard wire format: one host's checkpoint split as bytes.
+
+A shard is the ``(dense, parts)`` pair ``elastic.state_checkpoint_parts``
+produces — ``dense``: name -> full array (chief only), ``parts``:
+table name -> ``(ids, rows)`` for the rows this host owns.  Encoding is
+msgpack with raw array bytes (dtype + shape + C-contiguous data), the
+same zero-dependency discipline as :mod:`elasticdl_tpu.rpc.messages`.
+
+Torn-transfer detection: a shard travels with its CRC32
+(:func:`blob_checksum`); receivers (the peer store on push, the master
+on harvest, the worker on restore) verify before committing, so a
+truncated or bit-flipped payload is detected and skipped rather than
+restored.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import msgpack
+import numpy as np
+
+
+def _pack_array(arr: np.ndarray) -> dict:
+    arr = np.ascontiguousarray(arr)
+    return {
+        "dtype": arr.dtype.str,
+        "shape": list(arr.shape),
+        "data": arr.tobytes(),
+    }
+
+
+def _unpack_array(raw: dict) -> np.ndarray:
+    return np.frombuffer(
+        raw["data"], dtype=np.dtype(raw["dtype"])
+    ).reshape(raw["shape"])
+
+
+def encode_snapshot(dense: dict, parts: dict) -> bytes:
+    """Serialize one host's state shard to bytes."""
+    return msgpack.packb(
+        {
+            "dense": {k: _pack_array(v) for k, v in dense.items()},
+            "parts": {
+                k: {"ids": _pack_array(ids), "rows": _pack_array(rows)}
+                for k, (ids, rows) in parts.items()
+            },
+        },
+        use_bin_type=True,
+    )
+
+
+def decode_snapshot(blob: bytes) -> tuple[dict, dict]:
+    """Inverse of :func:`encode_snapshot`."""
+    raw = msgpack.unpackb(blob, raw=False)
+    dense = {k: _unpack_array(v) for k, v in raw["dense"].items()}
+    parts = {
+        k: (_unpack_array(v["ids"]), _unpack_array(v["rows"]))
+        for k, v in raw["parts"].items()
+    }
+    return dense, parts
+
+
+def blob_checksum(blob: bytes) -> str:
+    """CRC32 as 8 hex chars — cheap enough for every push, strong enough
+    to catch truncation and torn writes (not an integrity MAC)."""
+    return f"{zlib.crc32(blob) & 0xFFFFFFFF:08x}"
+
+
+def merge_snapshots(snapshots: list[tuple[dict, dict]]) -> tuple[dict, dict]:
+    """Union per-host shards into one full checkpoint view.
+
+    Dense leaves are replicated, so shards either agree or only one
+    (the chief's) carries them — last writer wins.  Table parts carry
+    disjoint row ranges per owning host (the writer-election in
+    ``elastic._owned_row_ranges``), so same-name parts concatenate.
+    """
+    dense: dict = {}
+    ids_acc: dict[str, list[np.ndarray]] = {}
+    rows_acc: dict[str, list[np.ndarray]] = {}
+    for shard_dense, shard_parts in snapshots:
+        dense.update(shard_dense)
+        for name, (ids, rows) in shard_parts.items():
+            ids_acc.setdefault(name, []).append(ids)
+            rows_acc.setdefault(name, []).append(rows)
+    parts = {
+        name: (
+            np.concatenate(ids_acc[name]),
+            np.concatenate(rows_acc[name], axis=0),
+        )
+        for name in ids_acc
+    }
+    return dense, parts

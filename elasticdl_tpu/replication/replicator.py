@@ -1,0 +1,289 @@
+"""Worker-side replication: snapshot cadence, ring push, hot restore.
+
+:class:`PeerReplicator` runs inside the lockstep worker at task
+boundaries only (the same collective-safety rule as the periodic
+checkpointer: every process decides identically from the shared step,
+so any gather inside the snapshot lines up).  The snapshot reuses
+``elastic.state_checkpoint_parts`` — the chief's shard carries the
+replicated dense leaves, every host's shard carries the table rows it
+owns — so replication and disk checkpointing can never disagree about
+what "this host's share of the state" means.
+
+:func:`restore_from_replica` is the other half: a relaunched process
+asks the master for the harvested replica stage of ITS generation and,
+when present, re-places the state at the exact step of the last
+replication — no disk read on the reform critical path.
+"""
+
+from __future__ import annotations
+
+import os
+
+from elasticdl_tpu.parallel import elastic
+from elasticdl_tpu.replication.blob import (
+    blob_checksum,
+    decode_snapshot,
+    encode_snapshot,
+)
+from elasticdl_tpu.replication.service import ReplicaClient
+from elasticdl_tpu.replication.store import ReplicaShard, ReplicaStore
+from elasticdl_tpu.rpc import messages as msg
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+# a push is host-RAM to host-RAM over the local network: seconds, not
+# minutes — a hung neighbor must not stall the training thread forever
+PUSH_TIMEOUT_SECS = 30.0
+
+REPLICA_HOST_ENV = "MY_POD_IP"  # k8s pods advertise their pod IP
+
+
+def replica_host() -> str:
+    return os.environ.get(REPLICA_HOST_ENV, "") or "127.0.0.1"
+
+
+class PeerReplicator:
+    def __init__(
+        self,
+        store: ReplicaStore,
+        process_id: int,
+        num_processes: int,
+        generation: int,
+        addr: str,
+        replication_steps: int = 0,
+    ):
+        self._store = store
+        self._process_id = process_id
+        self._num_processes = num_processes
+        self._generation = generation
+        self._addr = addr
+        # 0 = replicate at EVERY task boundary (the default cadence);
+        # N > 0 = milestone-crossing every N steps, like the checkpointer
+        self._steps = max(0, int(replication_steps or 0))
+        self._last_milestone = 0
+        self._last_version = -1
+        # process_id -> replica addr, learned from heartbeat responses
+        # (written by the heartbeat thread, read at task boundaries)
+        self._peers: dict[int, str] = {}
+        self._client: ReplicaClient | None = None
+        self._client_addr = ""
+        self.pushes = 0
+        self.push_failures = 0
+
+    @property
+    def neighbor(self) -> int:
+        return (self._process_id + 1) % self._num_processes
+
+    # ---- peer discovery (heartbeat thread) ---------------------------------
+
+    def advertisement(self) -> dict:
+        """The ``replica`` field of every heartbeat: where this process
+        serves shards and what its RAM holds right now."""
+        return {
+            "addr": self._addr,
+            "process_id": self._process_id,
+            "generation": self._generation,
+            "holdings": self._store.holdings(),
+        }
+
+    def set_peers(self, peers: dict):
+        if peers:
+            self._peers = {int(k): v for k, v in peers.items()}
+
+    # ---- replication cadence (training thread, task boundaries) ------------
+
+    def note_restored_version(self, version: int):
+        if self._steps:
+            self._last_milestone = version // self._steps
+        self._last_version = version
+
+    def maybe_replicate(self, trainer, mesh) -> bool:
+        """Replicate if due.  Call at task boundaries on EVERY process —
+        the decision is a pure function of the shared step, and the
+        snapshot may contain a gather collective."""
+        if trainer is None:
+            return False
+        version = int(trainer.step)
+        if self._steps:
+            milestone = version // self._steps
+            if milestone <= self._last_milestone:
+                return False
+            self._last_milestone = milestone
+        elif version <= self._last_version:
+            return False
+        self.replicate_now(trainer, mesh)
+        return True
+
+    def replicate_now(self, trainer, mesh):
+        from elasticdl_tpu.telemetry import worker_hooks as telemetry_hooks
+        from elasticdl_tpu.telemetry.events import EVENT_REPLICA_PUSH
+        from elasticdl_tpu.telemetry.tracing import (
+            SPAN_REPLICA_PUSH,
+            trace_span,
+        )
+
+        version = int(trainer.step)
+        self._last_version = version
+        with trace_span(
+            SPAN_REPLICA_PUSH, step=version, target=self.neighbor
+        ):
+            # same dense/parts split as the disk checkpoint: the chief's
+            # shard carries replicated leaves, every shard its own rows
+            dense, parts = elastic.state_checkpoint_parts(
+                trainer.state, mesh, materialize_dense=self._process_id == 0
+            )
+            blob = encode_snapshot(dense, parts)
+            shard = ReplicaShard(
+                source=self._process_id,
+                version=version,
+                generation=self._generation,
+                checksum=blob_checksum(blob),
+                payload=blob,
+            )
+            # local commit FIRST: this process is a harvest source for
+            # its own shard even if the neighbor push below fails
+            self._store.put(shard)
+            # chaos hook: a KILL_DURING_REPLICATION fault dies HERE —
+            # after the local snapshot, before the neighbor holds the new
+            # version — so harvest must detect the incomplete coverage
+            # and fall back to an older complete set (or to disk)
+            from elasticdl_tpu.chaos import hooks as chaos_hooks
+
+            chaos_hooks.notify_replica_push(version)
+            ok = self._push(shard)
+        telemetry_hooks.emit_event(
+            EVENT_REPLICA_PUSH,
+            step=version,
+            source=self._process_id,
+            target=self.neighbor,
+            ok=bool(ok),
+        )
+
+    def _push(self, shard: ReplicaShard) -> bool:
+        if self._num_processes < 2:
+            return False
+        addr = self._peers.get(self.neighbor, "")
+        if not addr:
+            # peers not discovered yet (first heartbeat round-trip still
+            # in flight); the local commit above keeps this version
+            # harvestable from ONE host in the meantime
+            self.push_failures += 1
+            return False
+        try:
+            if self._client is None or self._client_addr != addr:
+                if self._client is not None:
+                    self._client.close()
+                self._client = ReplicaClient(addr)
+                self._client_addr = addr
+            resp = self._client.push_replica(
+                msg.PushReplicaRequest(
+                    source=shard.source,
+                    version=shard.version,
+                    generation=shard.generation,
+                    checksum=shard.checksum,
+                    payload=shard.payload,
+                ),
+                timeout=PUSH_TIMEOUT_SECS,
+            )
+            accepted = bool(resp is not None and resp.accepted)
+        except Exception as ex:  # noqa: BLE001 — a dead neighbor must
+            # not crash the pusher; the master's failure detection owns
+            # declaring it dead
+            logger.warning(
+                "Replica push to process %d (%s) failed: %s",
+                self.neighbor,
+                addr,
+                ex,
+            )
+            accepted = False
+        if accepted:
+            self.pushes += 1
+        else:
+            self.push_failures += 1
+        return accepted
+
+    def stats(self) -> dict:
+        return {
+            "pushes": self.pushes,
+            "push_failures": self.push_failures,
+            "rejected": self._store.rejected,
+        }
+
+    def close(self):
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+
+def restore_from_replica(
+    trainer,
+    master,
+    cluster_version: int,
+    process_id: int = 0,
+    min_version: int | None = None,
+) -> int | None:
+    """Restore the trainer from the master's harvested replica stage.
+
+    Returns the restored step, or None when no stage exists for this
+    generation (caller falls back to the disk path).  Every process of
+    the generation sees the same answer — the stage is set before the
+    relaunch and fenced by ``cluster_version`` — so the restore-source
+    decision is identical everywhere (the lockstep invariant).
+
+    ``min_version``: the newest DISK milestone available (the caller's
+    fallback).  A staged replica older than it is declined — possible
+    only when ``replication_steps`` is coarser than ``checkpoint_steps``
+    — so the replica path can never lose work relative to disk.  The
+    floor is read from the shared checkpoint directory, so every
+    process computes the same one.
+    """
+    try:
+        resp = master.get_restore_state(
+            msg.GetRestoreStateRequest(
+                cluster_version=cluster_version, process_id=process_id
+            )
+        )
+    except Exception as ex:  # noqa: BLE001 — an old master without the
+        # RPC (rolling upgrade) must degrade to the disk path, not crash
+        logger.warning("Replica restore-state query failed: %s", ex)
+        return None
+    if resp is None or not resp.has:
+        return None
+    if min_version is not None and int(resp.version) < min_version:
+        logger.warning(
+            "Replica stage at version %d is older than the disk "
+            "milestone %d; restoring from disk instead",
+            int(resp.version),
+            min_version,
+        )
+        return None
+    if blob_checksum(resp.payload) != resp.checksum:
+        logger.warning(
+            "Replica restore stage failed checksum; falling back to disk"
+        )
+        return None
+    from elasticdl_tpu.telemetry import worker_hooks as telemetry_hooks
+    from elasticdl_tpu.telemetry.events import EVENT_REPLICA_RESTORE
+    from elasticdl_tpu.telemetry.tracing import (
+        SPAN_REPLICA_RESTORE,
+        trace_span,
+    )
+    from elasticdl_tpu.trainer.checkpointing import apply_restored_values
+
+    version = int(resp.version)
+    # reform-phase span: on a replica-served reform this REPLACES the
+    # checkpoint_restore_state disk read in the downtime critical path
+    with trace_span(SPAN_REPLICA_RESTORE, step=version):
+        dense, parts = decode_snapshot(resp.payload)
+        apply_restored_values(trainer, dense, parts, version)
+    from elasticdl_tpu.chaos import hooks as chaos_hooks
+
+    chaos_hooks.notify_replica_restore(version)
+    telemetry_hooks.emit_event(EVENT_REPLICA_RESTORE, step=version)
+    logger.info(
+        "Process %d restored state at version %d from peer replica "
+        "(generation %d)",
+        process_id,
+        version,
+        cluster_version,
+    )
+    return version

@@ -1,0 +1,109 @@
+"""Worker-side replica service: the ring-push receiver + harvest source.
+
+Rides the job's existing RPC transport (``rpc.service`` generic server,
+msgpack frames of ``rpc.messages``) under its own service name, so a
+replica push is wire-identical in discipline to every other control-
+plane call.  The servicer is transport-agnostic like ``MasterServicer``
+— unit tests call it directly with zero transport.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from elasticdl_tpu.replication.store import ReplicaShard, ReplicaStore
+from elasticdl_tpu.rpc import messages as msg
+from elasticdl_tpu.rpc.service import RpcClient, create_server
+
+REPLICA_SERVICE_NAME = "elasticdl_tpu.Replica"
+
+REPLICA_METHODS = (
+    "push_replica",
+    "fetch_replica",
+)
+
+
+class ReplicaServicer:
+    """Serves one process's :class:`ReplicaStore`.
+
+    ``fetch_replica`` answers with whatever the store CURRENTLY holds
+    for the requested source — the master's harvest trusts fetched
+    metadata, not heartbeat-lagged advertisements, so a push that
+    completed milliseconds before a preemption is still harvestable.
+    """
+
+    def __init__(self, store: ReplicaStore):
+        self._store = store
+
+    @property
+    def store(self) -> ReplicaStore:
+        return self._store
+
+    def push_replica(
+        self, request: msg.PushReplicaRequest
+    ) -> msg.PushReplicaResponse:
+        accepted, reason = self._store.put(
+            ReplicaShard(
+                source=request.source,
+                version=request.version,
+                generation=request.generation,
+                checksum=request.checksum,
+                payload=request.payload,
+            )
+        )
+        return msg.PushReplicaResponse(accepted=accepted, reason=reason)
+
+    def fetch_replica(
+        self, request: msg.FetchReplicaRequest
+    ) -> msg.FetchReplicaResponse:
+        version = None if request.version < 0 else request.version
+        shard = self._store.get(request.source, version=version)
+        if shard is None:
+            return msg.FetchReplicaResponse(source=request.source)
+        return msg.FetchReplicaResponse(
+            has=True,
+            source=shard.source,
+            version=shard.version,
+            generation=shard.generation,
+            checksum=shard.checksum,
+            payload=b"" if request.probe else shard.payload,
+            versions=self._store.versions(request.source),
+        )
+
+
+def start_replica_server(
+    store: ReplicaStore, port: int = 0
+) -> tuple[grpc.Server, int]:
+    """Bind a replica server on an ephemeral port; returns
+    ``(server, bound_port)``.  Few threads: the only callers are one
+    ring neighbor and (during reform) the master's harvester."""
+    server = create_server(
+        ReplicaServicer(store),
+        port,
+        max_workers=4,
+        methods=REPLICA_METHODS,
+        service_name=REPLICA_SERVICE_NAME,
+    )
+    server.start()
+    return server, server._edl_bound_port
+
+
+class ReplicaClient(RpcClient):
+    """Stub for one peer's replica server (ring push / harvest pull)."""
+
+    def __init__(self, addr: str):
+        super().__init__(
+            addr,
+            methods=REPLICA_METHODS,
+            service_name=REPLICA_SERVICE_NAME,
+        )
+
+    def push_replica(
+        self, request: msg.PushReplicaRequest, timeout: float | None = None
+    ) -> msg.PushReplicaResponse:
+        return self._call("push_replica", request, timeout=timeout)
+
+    def fetch_replica(
+        self, request: msg.FetchReplicaRequest, timeout: float | None = None
+    ) -> msg.FetchReplicaResponse:
+        return self._call("fetch_replica", request, timeout=timeout)

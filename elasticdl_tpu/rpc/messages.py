@@ -118,6 +118,12 @@ class HeartbeatRequest:
     worker_id: int
     step: int = 0
     timestamp: float = 0.0
+    # peer-replication advertisement (elasticdl_tpu.replication): the
+    # worker's replica-server address plus the shards its RAM currently
+    # holds ({"addr", "process_id", "generation", "holdings": [...]}).
+    # Empty when replication is off; old payloads decode to {} so the
+    # field is wire-compatible
+    replica: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -126,6 +132,10 @@ class HeartbeatResponse:
     # master may instruct the worker to quiesce for mesh re-formation
     should_quiesce: bool = False
     cluster_version: int = 0
+    # process_id -> replica-server addr of the current generation (the
+    # ring-push targets, from the master's replica directory); empty
+    # when replication is off or peers have not advertised yet
+    replica_peers: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -153,6 +163,73 @@ class WorldAssignmentResponse:
     trace: dict = field(default_factory=dict)
 
 
+@dataclass
+class PushReplicaRequest:
+    """Ring-neighbor state push (worker -> worker, replica service).
+
+    ``payload`` is one encoded state shard (:mod:`..replication.blob`);
+    ``checksum`` lets the receiver detect a torn transfer and refuse to
+    commit it; ``generation`` fences pushes from stale worlds.
+    """
+
+    source: int  # process index whose state shard this is
+    version: int  # model version the shard was snapshotted at
+    generation: int = 0
+    checksum: str = ""
+    payload: bytes = b""
+
+
+@dataclass
+class PushReplicaResponse:
+    accepted: bool = False
+    reason: str = ""
+
+
+@dataclass
+class FetchReplicaRequest:
+    """Master-side harvest pull (master -> worker, replica service).
+    ``probe=True`` returns metadata only (version/generation/checksum
+    plus every retained version), so the harvester can pick a complete
+    replica set before moving any payload bytes.  ``version=-1`` means
+    the newest retained shard; a specific version fetches exactly that
+    one (an older shard may be the only COMPLETE set left)."""
+
+    source: int
+    probe: bool = False
+    version: int = -1
+
+
+@dataclass
+class FetchReplicaResponse:
+    has: bool = False
+    source: int = -1
+    version: int = -1
+    generation: int = -1
+    checksum: str = ""
+    payload: bytes = b""
+    # every version the store retains for this source (probe responses;
+    # the store keeps more than the advertised newest — see ReplicaStore)
+    versions: list = field(default_factory=list)
+
+
+@dataclass
+class GetRestoreStateRequest:
+    """A re-formed world asks the master for the harvested in-memory
+    replica set.  ``cluster_version`` fences the stage: only the
+    generation the harvest was staged FOR may restore from it."""
+
+    cluster_version: int
+    process_id: int = 0
+
+
+@dataclass
+class RestoreStateResponse:
+    has: bool = False
+    version: int = -1
+    checksum: str = ""
+    payload: bytes = b""
+
+
 _SIMPLE_TYPES = {
     "GetTaskRequest": GetTaskRequest,
     "GetStepTaskRequest": GetStepTaskRequest,
@@ -163,6 +240,12 @@ _SIMPLE_TYPES = {
     "HeartbeatResponse": HeartbeatResponse,
     "GetWorldAssignmentRequest": GetWorldAssignmentRequest,
     "WorldAssignmentResponse": WorldAssignmentResponse,
+    "PushReplicaRequest": PushReplicaRequest,
+    "PushReplicaResponse": PushReplicaResponse,
+    "FetchReplicaRequest": FetchReplicaRequest,
+    "FetchReplicaResponse": FetchReplicaResponse,
+    "GetRestoreStateRequest": GetRestoreStateRequest,
+    "RestoreStateResponse": RestoreStateResponse,
 }
 
 

@@ -34,6 +34,7 @@ _METHODS = (
     "report_evaluation_metrics",
     "heartbeat",
     "get_world_assignment",
+    "get_restore_state",
 )
 
 _CHANNEL_OPTIONS = [
@@ -54,47 +55,67 @@ def _handler(servicer, name):
 
 
 def create_server(
-    servicer, port: int, max_workers: int = 64
+    servicer,
+    port: int,
+    max_workers: int = 64,
+    methods: tuple[str, ...] = _METHODS,
+    service_name: str = SERVICE_NAME,
 ) -> grpc.Server:
-    """Bind a MasterServicer behind gRPC (reference master.py:301-324:
-    64-thread pool, 256MB messages)."""
+    """Bind a servicer behind gRPC (reference master.py:301-324:
+    64-thread pool, 256MB messages).  The default method table is the
+    master control plane; the replication subsystem binds its own
+    worker-side service through the same transport with its own table."""
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=_CHANNEL_OPTIONS,
     )
-    handlers = {name: _handler(servicer, name) for name in _METHODS}
+    handlers = {name: _handler(servicer, name) for name in methods}
     server.add_generic_rpc_handlers(
-        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+        (grpc.method_handlers_generic_handler(service_name, handlers),)
     )
     bound = server.add_insecure_port(f"[::]:{port}")
     if bound == 0:
-        raise RuntimeError(f"could not bind master port {port}")
-    logger.info("Master control-plane server bound to port %d", bound)
+        raise RuntimeError(f"could not bind {service_name} port {port}")
+    logger.info("%s server bound to port %d", service_name, bound)
     server._edl_bound_port = bound  # for port=0 ephemeral binds in tests
     return server
 
 
-class MasterClient:
+class RpcClient:
+    """Generic stub over a msgpack-framed unary channel — the shared
+    base of :class:`MasterClient` and the replication subsystem's
+    worker-to-worker client."""
+
+    def __init__(
+        self,
+        addr: str,
+        methods: tuple[str, ...] = _METHODS,
+        service_name: str = SERVICE_NAME,
+    ):
+        self._channel = grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
+        self._calls = {
+            name: self._channel.unary_unary(
+                f"/{service_name}/{name}",
+                request_serializer=None,
+                response_deserializer=None,
+            )
+            for name in methods
+        }
+
+    def _call(self, name, request, timeout: float | None = None):
+        payload = self._calls[name](msg.encode(request), timeout=timeout)
+        return msg.decode(payload) if payload else None
+
+    def close(self):
+        self._channel.close()
+
+
+class MasterClient(RpcClient):
     """Worker-side stub implementing the servicer protocol over a channel.
 
     Drop-in for the in-process ``MasterServicer`` object (same method
     names, same dataclasses), so ``Worker`` code is transport-blind.
     """
-
-    def __init__(self, addr: str):
-        self._channel = grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
-        self._calls = {
-            name: self._channel.unary_unary(
-                f"/{SERVICE_NAME}/{name}",
-                request_serializer=None,
-                response_deserializer=None,
-            )
-            for name in _METHODS
-        }
-
-    def _call(self, name, request):
-        payload = self._calls[name](msg.encode(request))
-        return msg.decode(payload) if payload else None
 
     def get_task(self, request: msg.GetTaskRequest) -> msg.TaskResponse:
         return self._call("get_task", request)
@@ -120,8 +141,10 @@ class MasterClient:
     ) -> msg.WorldAssignmentResponse:
         return self._call("get_world_assignment", request)
 
+    def get_restore_state(
+        self, request: msg.GetRestoreStateRequest
+    ) -> msg.RestoreStateResponse:
+        return self._call("get_restore_state", request)
+
     def heartbeat(self, request: msg.HeartbeatRequest) -> msg.HeartbeatResponse:
         return self._call("heartbeat", request)
-
-    def close(self):
-        self._channel.close()

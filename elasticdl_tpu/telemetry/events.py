@@ -44,6 +44,12 @@ EVENT_CHECKPOINT_RESTORE = "checkpoint_restore"
 EVENT_FAULT_INJECTED = "fault_injected"
 EVENT_PROFILE_WINDOW_OPEN = "profile_window_open"
 EVENT_PROFILE_WINDOW_CLOSE = "profile_window_close"
+# peer state replication (elasticdl_tpu.replication): a worker pushed its
+# state shard to its ring neighbor / the master harvested a complete
+# replica set during reform / a re-formed world restored from peer RAM
+EVENT_REPLICA_PUSH = "replica_push"
+EVENT_REPLICA_HARVEST = "replica_harvest"
+EVENT_REPLICA_RESTORE = "replica_restore"
 
 EVENTS_FILENAME = "events.jsonl"
 

@@ -374,6 +374,21 @@ class MasterTelemetry:
             span.end(failed=True)
         self.tracer.flush()
 
+    def replica_harvest(
+        self, generation, complete: bool, version, sources: int
+    ):
+        """Reform-time replica harvest outcome (replication subsystem):
+        ``complete=False`` means the new generation falls back to disk."""
+        from elasticdl_tpu.telemetry.events import EVENT_REPLICA_HARVEST
+
+        self.events.emit(
+            EVENT_REPLICA_HARVEST,
+            generation=generation,
+            complete=bool(complete),
+            version=version,
+            sources=sources,
+        )
+
     def reform_latency(self, generation, latency_secs: float):
         self._reform_downtime.observe(latency_secs)
         self.events.emit(

@@ -200,7 +200,7 @@ def analyze_events(events: list[dict], faults: list[dict]) -> dict:
     for (_worker, key), value in cumulative.items():
         time_buckets[key[len("time_") : -len("_ms")]] += value
 
-    return {
+    out = {
         "generations": generations,
         "reform_downtime": downtimes,
         "records_per_sec_by_worker": _worker_throughput(steps),
@@ -208,6 +208,55 @@ def analyze_events(events: list[dict], faults: list[dict]) -> dict:
         "reform_event_count": len(reform_events),
         "worker_time_ms": dict(time_buckets),
         "events_total": len(events),
+    }
+    replication = replication_section(events)
+    if replication is not None:
+        out["replication"] = replication
+    return out
+
+
+def replication_section(events: list[dict]) -> dict | None:
+    """Replica-coverage stats (peer state replication): pushes and hosts
+    covered per generation, the freshest shard versions, harvest
+    outcomes, and restores served from peer RAM.  None (key absent) when
+    the run never replicated, so replication-less reports are unchanged."""
+    pushes: dict[int, int] = defaultdict(int)
+    hosts: dict[int, set] = defaultdict(set)
+    versions: dict[int, int] = {}
+    restores = []
+    harvests = []
+    for event in events:
+        kind = event.get("event")
+        gen = event.get("generation", 0)
+        if kind == "replica_push":
+            pushes[gen] += 1
+            if event.get("source") is not None:
+                hosts[gen].add(event["source"])
+            versions[gen] = max(
+                versions.get(gen, -1), event.get("step", -1)
+            )
+        elif kind == "replica_restore":
+            restores.append(
+                {"generation": gen, "step": event.get("step")}
+            )
+        elif kind == "replica_harvest":
+            harvests.append(
+                {
+                    "generation": gen,
+                    "complete": event.get("complete"),
+                    "version": event.get("version"),
+                }
+            )
+    if not (pushes or restores or harvests):
+        return None
+    return {
+        "pushes_by_generation": dict(pushes),
+        "hosts_covered_by_generation": {
+            g: sorted(h) for g, h in hosts.items()
+        },
+        "shard_versions_by_generation": versions,
+        "restores": restores,
+        "harvests": harvests,
     }
 
 
@@ -311,6 +360,26 @@ def _format_text(report: dict) -> str:
                         f"{w['median_step_ms']:.1f}ms "
                         f"({w['vs_generation_median']}x gen median)"
                     )
+        replication = run.get("replication")
+        if replication:
+            for gen, n in sorted(replication["pushes_by_generation"].items()):
+                hosts = replication["hosts_covered_by_generation"].get(
+                    gen, []
+                )
+                version = replication["shard_versions_by_generation"].get(
+                    gen
+                )
+                lines.append(
+                    f"replication gen {gen}: {n} pushes, hosts {hosts}, "
+                    f"freshest shard version {version}"
+                )
+            for restore in replication["restores"]:
+                lines.append(
+                    "replica restore: gen {} resumed at step {} "
+                    "(peer RAM, no disk read)".format(
+                        restore["generation"], restore["step"]
+                    )
+                )
         for worker, rate in run["records_per_sec_by_worker"].items():
             lines.append(f"throughput: worker {worker}: {rate:.1f} records/s")
         if run["worker_time_ms"]:

@@ -54,6 +54,8 @@ from elasticdl_tpu.telemetry.tracing import (
     SPAN_REFORM,
     SPAN_REFORM_FENCE,
     SPAN_REFORM_RELAUNCH,
+    SPAN_REPLICA_HARVEST,
+    SPAN_REPLICA_RESTORE,
     SPAN_TRAINER_BUILD,
     SPAN_WORLD_INITIALIZE,
     SPAN_WORLD_JOIN,
@@ -276,6 +278,14 @@ def _phase_intervals(
             if s.get("trace_id") == reform.get("trace_id")
             and s.get("span_id") != reform.get("span_id")
         ]
+        # the replica harvest runs between the generation bump and the
+        # fence loop (Master._stage_replica_restore), so it slots before
+        # quiesce_recover in pipeline order
+        harvest = _merged_window(
+            _spans_named(children, SPAN_REPLICA_HARVEST)
+        )
+        if harvest:
+            intervals.append(("replica_harvest", harvest[0], harvest[1]))
         fence = _merged_window(_spans_named(children, SPAN_REFORM_FENCE))
         if fence:
             intervals.append(("quiesce_recover", fence[0], fence[1]))
@@ -296,6 +306,10 @@ def _phase_intervals(
     for phase, span_name in (
         ("trainer_build", SPAN_TRAINER_BUILD),
         ("checkpoint_restore", SPAN_CHECKPOINT_RESTORE),
+        # a replica-served reform has this phase INSTEAD of the disk
+        # checkpoint_restore — restore came from the master's staged
+        # peer-RAM harvest, not from a checkpoint read
+        ("replica_restore", SPAN_REPLICA_RESTORE),
     ):
         window = _merged_window(
             [
@@ -317,10 +331,12 @@ def _phase_intervals(
 # spawn; after the join the worker is re-initializing (model spec, data
 # reader, first lease); after the build/restore it is compiling the step
 _BRIDGE_AFTER = {
+    "replica_harvest": "quiesce_recover",
     "world_relaunch": "worker_spawn",
     "world_join": "worker_init",
     "trainer_build": "warmup_compile",
     "checkpoint_restore": "warmup_compile",
+    "replica_restore": "warmup_compile",
 }
 
 

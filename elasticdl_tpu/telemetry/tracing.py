@@ -69,6 +69,9 @@ SPAN_TRAINER_BUILD = "trainer_build"  # worker: SPMDTrainer construction
 SPAN_CHECKPOINT_SAVE = "checkpoint_save_snapshot"  # device->host snapshot
 SPAN_CHECKPOINT_RESTORE = "checkpoint_restore_state"  # restore + re-place
 SPAN_PROFILE_WINDOW = "profile_window"  # XLA profiler capture window
+SPAN_REPLICA_PUSH = "replica_push"  # worker: snapshot + ring-neighbor push
+SPAN_REPLICA_HARVEST = "replica_harvest"  # master: fetch peer shards on reform
+SPAN_REPLICA_RESTORE = "replica_restore"  # worker: restore from peer RAM
 
 
 def gen_trace_id() -> str:

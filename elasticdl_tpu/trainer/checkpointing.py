@@ -215,10 +215,6 @@ def restore_trainer_state(trainer, args, process_id: int = 0) -> int | None:
 def _restore_trainer_state_traced(
     trainer, args, process_id, restore_dir, resume
 ):
-    import jax
-
-    from elasticdl_tpu.trainer.state import checkpoint_to_state
-
     dense, embeddings, extra = save_utils.restore_checkpoint(
         restore_dir,
         # keep only rows this process's devices hold, per part, so a
@@ -227,6 +223,39 @@ def _restore_trainer_state_traced(
             trainer.state, trainer.mesh
         ),
     )
+    version = int(extra.get("model_version", 0) or 0)
+    restored_step = version if resume else 0
+    from elasticdl_tpu.chaos import hooks as chaos_hooks
+
+    chaos_hooks.notify_checkpoint_restore(restored_step)
+    from elasticdl_tpu.telemetry import worker_hooks as telemetry_hooks
+    from elasticdl_tpu.telemetry.events import EVENT_CHECKPOINT_RESTORE
+
+    telemetry_hooks.emit_event(
+        EVENT_CHECKPOINT_RESTORE, step=restored_step, resume=bool(resume)
+    )
+    apply_restored_values(trainer, dense, embeddings, restored_step)
+    logger.info(
+        "Process %d restored state at version %d from %s%s",
+        process_id,
+        version,
+        restore_dir,
+        "" if resume else " (warm start; step reset to 0)",
+    )
+    return restored_step
+
+
+def apply_restored_values(trainer, dense, embeddings, restored_step: int):
+    """Re-place restored values onto the trainer's CURRENT mesh — the
+    shared back half of the disk restore and the peer-replica hot
+    restore (replication.replicator): ``dense`` values go in whole
+    (replicated), table ``(ids, rows)`` parts are filtered to the rows
+    this process's devices own, and the step counter lands at
+    ``restored_step`` exactly."""
+    import jax
+
+    from elasticdl_tpu.trainer.state import checkpoint_to_state
+
     values = dict(dense)
     if embeddings:
         flat_state = elastic.flat_state_arrays(trainer.state)
@@ -240,27 +269,8 @@ def _restore_trainer_state_traced(
                 continue
             values[name] = _place_table_rows(target, ids, rows, trainer.mesh)
     state = checkpoint_to_state(trainer.state, values)
-    version = int(extra.get("model_version", 0) or 0)
-    restored_step = version if resume else 0
-    from elasticdl_tpu.chaos import hooks as chaos_hooks
-
-    chaos_hooks.notify_checkpoint_restore(restored_step)
-    from elasticdl_tpu.telemetry import worker_hooks as telemetry_hooks
-    from elasticdl_tpu.telemetry.events import EVENT_CHECKPOINT_RESTORE
-
-    telemetry_hooks.emit_event(
-        EVENT_CHECKPOINT_RESTORE, step=restored_step, resume=bool(resume)
-    )
     state = state.replace(step=np.asarray(restored_step, dtype=np.int32))
     trainer.state = jax.device_put(state, trainer.state_shardings)
-    logger.info(
-        "Process %d restored state at version %d from %s%s",
-        process_id,
-        version,
-        restore_dir,
-        "" if resume else " (warm start; step reset to 0)",
-    )
-    return restored_step
 
 
 def _place_table_rows(target, ids, rows, mesh):

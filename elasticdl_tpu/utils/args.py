@@ -227,6 +227,31 @@ def _add_train_params(parser: argparse.ArgumentParser):
         help="Restore initial model state from this checkpoint directory",
     )
     parser.add_argument("--keep_checkpoint_max", type=non_neg_int, default=3)
+    # defaults are None (not False/0) so an unset flag is absent from the
+    # reconstructed argv: with replication off, worker command lines and
+    # the k8s golden manifests stay byte-identical to a build without it
+    parser.add_argument(
+        "--replication",
+        type=parse_bool,
+        default=None,
+        required=False,
+        help=(
+            "Replicate trainer state into peer host RAM (ring push at "
+            "task boundaries) so a re-formed world hot-restores from "
+            "peers instead of disk; lockstep jobs (num_workers > 1) "
+            "only.  Disk checkpoints remain the durable fallback"
+        ),
+    )
+    parser.add_argument(
+        "--replication_steps",
+        type=non_neg_int,
+        default=None,
+        required=False,
+        help=(
+            "Replicate every N steps (milestone-crossing, like "
+            "--checkpoint_steps); 0 or unset = every task boundary"
+        ),
+    )
     parser.add_argument(
         "--output", default="", help="Directory for the exported model"
     )

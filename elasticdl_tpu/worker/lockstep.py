@@ -139,6 +139,34 @@ class LockstepWorker:
             process_id=self._process_id,
             num_parts=self._num_processes,
         )
+        # peer state replication (elasticdl_tpu.replication): a replica
+        # server + ring pusher per process, lockstep worlds only — a
+        # single process has no surviving peer to restore from
+        self._replicator = None
+        self._replica_server = None
+        if (
+            bool(getattr(args, "replication", False))
+            and self._num_processes > 1
+        ):
+            from elasticdl_tpu.replication.replicator import (
+                PeerReplicator,
+                replica_host,
+            )
+            from elasticdl_tpu.replication.service import (
+                start_replica_server,
+            )
+            from elasticdl_tpu.replication.store import ReplicaStore
+
+            store = ReplicaStore(generation=self._cluster_version)
+            self._replica_server, replica_port = start_replica_server(store)
+            self._replicator = PeerReplicator(
+                store,
+                process_id=self._process_id,
+                num_processes=self._num_processes,
+                generation=self._cluster_version,
+                addr=f"{replica_host()}:{replica_port}",
+                replication_steps=getattr(args, "replication_steps", 0) or 0,
+            )
         from elasticdl_tpu.utils.profiling import StepProfiler
 
         # per-process trace subdir: each host profiles its own devices
@@ -233,11 +261,40 @@ class LockstepWorker:
                 donate=bool(getattr(self._args, "donate_state", True)),
                 device_parse=self._spec.device_parse,
             )
-            version = restore_trainer_state(
-                self._trainer, self._args, self._process_id
-            )
+            version = self._restore_state()
         if version is not None:
             self._checkpointer.note_restored_version(version)
+            if self._replicator is not None:
+                self._replicator.note_restored_version(version)
+
+    def _restore_state(self) -> int | None:
+        """Peer-RAM replica stage first (a reform the master harvested
+        for), disk second.  The stage is fenced by generation and set
+        before relaunch, so every process of this world resolves the
+        same source — the restore itself stays process-local either
+        way (lockstep invariant preserved)."""
+        if self._replicator is not None:
+            from elasticdl_tpu.replication.replicator import (
+                restore_from_replica,
+            )
+            from elasticdl_tpu.utils import save_utils
+
+            ckpt_dir = getattr(self._args, "checkpoint_dir", "") or ""
+            disk_floor = (
+                save_utils.latest_version(ckpt_dir) if ckpt_dir else None
+            )
+            version = restore_from_replica(
+                self._trainer,
+                self._master,
+                self._cluster_version,
+                self._process_id,
+                min_version=disk_floor,
+            )
+            if version is not None:
+                return version
+        return restore_trainer_state(
+            self._trainer, self._args, self._process_id
+        )
 
     def _maybe_checkpoint(self):
         """Periodic checkpoint every ``checkpoint_steps`` (reference
@@ -245,6 +302,11 @@ class LockstepWorker:
         writes its own part).  Runs at task boundaries only, so every
         process agrees on when any gather collective happens."""
         self._checkpointer.maybe_save(self._trainer, self._mesh)
+        if self._replicator is not None:
+            # same boundary-only rule, same reason: the snapshot's
+            # dense/parts split may contain a gather collective, and the
+            # cadence decision is a pure function of the shared step
+            self._replicator.maybe_replicate(self._trainer, self._mesh)
 
     # ---- batching ----------------------------------------------------------
 
@@ -512,13 +574,22 @@ class LockstepWorker:
                     continue
                 t0 = time.monotonic()
                 try:
-                    self._master.heartbeat(
+                    # the heartbeat doubles as the replica directory's
+                    # advertisement channel (up: addr + holdings; down:
+                    # the ring-push peer map) — no extra RPC, no extra
+                    # failure mode
+                    resp = self._master.heartbeat(
                         msg.HeartbeatRequest(
                             worker_id=self._worker_id,
                             step=self._trainer.step if self._trainer else 0,
                             timestamp=time.time(),
+                            replica=self._replicator.advertisement()
+                            if self._replicator is not None
+                            else {},
                         )
                     )
+                    if self._replicator is not None and resp is not None:
+                        self._replicator.set_peers(resp.replica_peers)
                 except Exception:  # noqa: BLE001 — master may be gone
                     pass
                 tracer = self._tracing.get_tracer()
@@ -601,6 +672,44 @@ class LockstepWorker:
                 self._profiler.stop()
                 self._stopped = True
                 self._tracing.flush()
+                if self._replicator is not None:
+                    self._replicator.close()
+                if self._replica_server is not None:
+                    if ok:
+                        self._replica_server.stop(grace=0)
+                    else:
+                        # a lockstep crash means the world is about to
+                        # re-form — LINGER with the replica server up so
+                        # the master can harvest this RAM's shards for
+                        # the restoring generation.  On TPU a survivor
+                        # naturally hangs in the dead collective and
+                        # keeps serving; on the CPU backend gloo errors
+                        # propagate fast and this process would exit
+                        # before the harvest arrives.  reform_world's
+                        # SIGKILL (or job-stop SIGTERM) ends the wait;
+                        # the cap bounds orphaned lingerers when the
+                        # master itself is gone.
+                        self._linger_for_harvest()
+
+    _LINGER_ENV = "ELASTICDL_TPU_REPLICA_LINGER_SECS"
+
+    def _linger_for_harvest(self):
+        try:
+            linger_secs = float(os.environ.get(self._LINGER_ENV, 300.0))
+        except ValueError:
+            linger_secs = 300.0
+        if linger_secs <= 0:
+            self._replica_server.stop(grace=0)
+            return
+        logger.warning(
+            "Process %d crashed with replication on: serving replica "
+            "shards for up to %.0fs so the re-forming master can "
+            "harvest them",
+            self._process_id,
+            linger_secs,
+        )
+        time.sleep(linger_secs)
+        self._replica_server.stop(grace=0)
 
     def _dump_state_if_requested(self):
         out_dir = os.environ.get(_DUMP_STATE_ENV, "")

@@ -45,6 +45,17 @@ EVENT_CONST = re.compile(r"^EVENT_\w+\s*=\s*[\"']([^\"']+)[\"']", re.M)
 SPAN_CONST = re.compile(r"^SPAN_\w+\s*=\s*[\"']([^\"']+)[\"']", re.M)
 BARE_PRINT = re.compile(r"^\s*print\(")
 
+# the replication subsystem's vocabulary (ISSUE 4): each name must have
+# exactly ONE definition site in the shared constants, so the event
+# schema, the span schema and the analyzers can never drift — a
+# replica_* name used anywhere outside these lists is a lint error
+REQUIRED_EVENT_NAMES = frozenset(
+    {"replica_push", "replica_restore", "replica_harvest"}
+)
+REQUIRED_SPAN_NAMES = frozenset(
+    {"replica_push", "replica_restore", "replica_harvest"}
+)
+
 # CLI entry points whose stdout IS their product (reports, dataset
 # paths); everything else logs
 PRINT_ALLOWLIST = (
@@ -113,9 +124,19 @@ def main() -> int:
                 )
 
     const_counts = {}
-    for rel_path, pattern, label in (
-        (os.path.join("telemetry", "events.py"), EVENT_CONST, "event"),
-        (os.path.join("telemetry", "tracing.py"), SPAN_CONST, "span"),
+    for rel_path, pattern, label, required in (
+        (
+            os.path.join("telemetry", "events.py"),
+            EVENT_CONST,
+            "event",
+            REQUIRED_EVENT_NAMES,
+        ),
+        (
+            os.path.join("telemetry", "tracing.py"),
+            SPAN_CONST,
+            "span",
+            REQUIRED_SPAN_NAMES,
+        ),
     ):
         with open(os.path.join(PACKAGE, rel_path), encoding="utf-8") as f:
             const_values = pattern.findall(f.read())
@@ -131,6 +152,12 @@ def main() -> int:
             errors.append(
                 f"telemetry/{os.path.basename(rel_path)}: {label} name "
                 f"{value!r} defined more than once"
+            )
+        for value in sorted(required - set(const_values)):
+            errors.append(
+                f"telemetry/{os.path.basename(rel_path)}: required "
+                f"{label} name {value!r} missing from the shared "
+                "vocabulary (replication subsystem contract)"
             )
 
     if errors:

@@ -1,0 +1,134 @@
+"""PeriodicCheckpointer edge paths the replication fallback depends on.
+
+The replica subsystem's disk-fallback rule leans on two previously
+untested contracts of the checkpoint path:
+
+1. an async background WRITE error surfaces on the training thread at
+   ``flush()`` (a job must never report complete — or a restore trust a
+   directory — with a silently failed write behind it);
+2. ``keep_checkpoint_max`` retention actually garbage-collects old
+   versions, and ``latest_version`` keeps answering from the survivors.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.parallel import elastic
+from elasticdl_tpu.trainer.checkpointing import PeriodicCheckpointer
+from elasticdl_tpu.utils import save_utils
+
+
+class _FakeTrainer:
+    def __init__(self, step: int):
+        self.step = step
+        self.state = None
+
+
+@pytest.fixture()
+def _host_snapshot(monkeypatch):
+    """Bypass the device snapshot: these tests pin the WRITE machinery,
+    not the sharding split (tests/test_checkpoint_sharded.py owns that)."""
+    monkeypatch.setattr(
+        elastic,
+        "state_checkpoint_parts",
+        lambda state, mesh, materialize_dense=True: (
+            {"params/w": np.ones((2, 2), np.float32)},
+            {},
+        ),
+    )
+
+
+def test_flush_reraises_background_write_error(
+    tmp_path, _host_snapshot, monkeypatch
+):
+    ckpt = PeriodicCheckpointer(str(tmp_path / "ckpt"), checkpoint_steps=1)
+
+    def boom(*args, **kwargs):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(ckpt._saver, "save", boom)
+    ckpt.save_now(_FakeTrainer(step=3), mesh=None)
+    # the failure happened on the writer thread; the training thread
+    # must see it at the next flush — and exactly once
+    with pytest.raises(OSError, match="disk full"):
+        ckpt.flush()
+    ckpt.flush()  # error was consumed; a second flush is clean
+
+
+def test_flush_on_unwind_logs_instead_of_masking(
+    tmp_path, _host_snapshot, monkeypatch
+):
+    """On an error unwind the flush failure must NOT replace the root
+    cause; on a clean exit it must raise exactly like flush()."""
+    ckpt = PeriodicCheckpointer(str(tmp_path / "ckpt"), checkpoint_steps=1)
+    monkeypatch.setattr(
+        ckpt._saver,
+        "save",
+        lambda *a, **k: (_ for _ in ()).throw(OSError("torn")),
+    )
+    ckpt.save_now(_FakeTrainer(step=1), mesh=None)
+    ckpt.flush_on_unwind(clean_exit=False)  # swallowed (logged)
+    ckpt.save_now(_FakeTrainer(step=2), mesh=None)
+    with pytest.raises(OSError, match="torn"):
+        ckpt.flush_on_unwind(clean_exit=True)
+
+
+def test_save_waits_for_inflight_write_error(
+    tmp_path, _host_snapshot, monkeypatch
+):
+    """The next save joins the previous in-flight write first, so a
+    write error can never be dropped between two saves."""
+    ckpt = PeriodicCheckpointer(str(tmp_path / "ckpt"), checkpoint_steps=1)
+    monkeypatch.setattr(
+        ckpt._saver,
+        "save",
+        lambda *a, **k: (_ for _ in ()).throw(OSError("late")),
+    )
+    ckpt.save_now(_FakeTrainer(step=1), mesh=None)
+    with pytest.raises(OSError, match="late"):
+        ckpt.save_now(_FakeTrainer(step=2), mesh=None)
+
+
+def test_keep_checkpoint_max_garbage_collection(tmp_path):
+    root = str(tmp_path / "ckpt")
+    saver = save_utils.CheckpointSaver(root, keep_checkpoint_max=2)
+    for version in (2, 4, 6, 8):
+        saver.save(
+            version,
+            dense={"params/w": np.full((2, 2), float(version))},
+            extra={"model_version": version},
+        )
+    assert save_utils._list_versions(root) == [6, 8]
+    assert save_utils.latest_version(root) == 8
+    dense, _embeddings, extra = save_utils.restore_checkpoint(root)
+    assert extra["model_version"] == 8
+    np.testing.assert_array_equal(
+        dense["params/w"], np.full((2, 2), 8.0)
+    )
+
+
+def test_keep_checkpoint_max_zero_keeps_everything(tmp_path):
+    root = str(tmp_path / "ckpt")
+    saver = save_utils.CheckpointSaver(root, keep_checkpoint_max=0)
+    for version in (1, 2, 3, 4, 5):
+        saver.save(version, dense={}, extra={})
+    assert save_utils._list_versions(root) == [1, 2, 3, 4, 5]
+
+
+def test_milestone_crossing_schedule(tmp_path, _host_snapshot):
+    """Task boundaries are not step multiples: a boundary that JUMPS
+    over a milestone must still save, and restoring realigns the
+    milestone so the next boundary does not double-save."""
+    saved = []
+
+    ckpt = PeriodicCheckpointer(str(tmp_path / "ckpt"), checkpoint_steps=4)
+    ckpt.save_now = lambda trainer, mesh: saved.append(trainer.step)
+    assert not ckpt.maybe_save(_FakeTrainer(3), mesh=None)
+    assert ckpt.maybe_save(_FakeTrainer(6), mesh=None)  # crossed 4
+    assert not ckpt.maybe_save(_FakeTrainer(7), mesh=None)
+    ckpt.note_restored_version(6)
+    assert not ckpt.maybe_save(_FakeTrainer(7), mesh=None)
+    assert ckpt.maybe_save(_FakeTrainer(12), mesh=None)
+    assert saved == [6, 12]

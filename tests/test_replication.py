@@ -1,0 +1,832 @@
+"""Peer state replication (ISSUE 4): store/wire/directory units, the
+heartbeat advertisement loop, generation-fenced restore staging, the
+hot-restore path on a real trainer, chaos falsification hooks, and the
+trace/report surfaces that prove restore came from peer RAM.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.replication import blob as blob_mod
+from elasticdl_tpu.replication.directory import ReplicaDirectory
+from elasticdl_tpu.replication.replicator import (
+    PeerReplicator,
+    restore_from_replica,
+)
+from elasticdl_tpu.replication.service import (
+    ReplicaClient,
+    ReplicaServicer,
+    start_replica_server,
+)
+from elasticdl_tpu.replication.store import ReplicaShard, ReplicaStore
+from elasticdl_tpu.rpc import messages as msg
+
+
+def _shard(
+    source: int,
+    version: int,
+    dense: dict | None = None,
+    parts: dict | None = None,
+    generation: int = 0,
+) -> ReplicaShard:
+    payload = blob_mod.encode_snapshot(dense or {}, parts or {})
+    return ReplicaShard(
+        source=source,
+        version=version,
+        generation=generation,
+        checksum=blob_mod.blob_checksum(payload),
+        payload=payload,
+    )
+
+
+# ---- blob codec -------------------------------------------------------------
+
+
+def test_blob_round_trip_and_merge():
+    dense = {"params/w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    parts_a = {
+        "params/emb": (
+            np.arange(0, 4, dtype=np.int64),
+            np.full((4, 2), 1.0, np.float32),
+        )
+    }
+    parts_b = {
+        "params/emb": (
+            np.arange(4, 8, dtype=np.int64),
+            np.full((4, 2), 2.0, np.float32),
+        )
+    }
+    a = blob_mod.decode_snapshot(blob_mod.encode_snapshot(dense, parts_a))
+    np.testing.assert_array_equal(a[0]["params/w"], dense["params/w"])
+    merged_dense, merged_parts = blob_mod.merge_snapshots(
+        [a, blob_mod.decode_snapshot(blob_mod.encode_snapshot({}, parts_b))]
+    )
+    assert set(merged_dense) == {"params/w"}
+    ids, rows = merged_parts["params/emb"]
+    assert sorted(ids.tolist()) == list(range(8))
+    # disjoint ranges concatenate; values per range preserved
+    assert rows[list(ids).index(0)][0] == 1.0
+    assert rows[list(ids).index(7)][0] == 2.0
+
+
+def test_blob_checksum_detects_truncation():
+    payload = blob_mod.encode_snapshot(
+        {"w": np.ones((4, 4), np.float32)}, {}
+    )
+    checksum = blob_mod.blob_checksum(payload)
+    assert blob_mod.blob_checksum(payload[:-1]) != checksum
+
+
+# ---- store ------------------------------------------------------------------
+
+
+def test_store_commit_and_holdings():
+    store = ReplicaStore(generation=2)
+    ok, _reason = store.put(_shard(0, 6, generation=2))
+    assert ok
+    assert store.get(0).version == 6
+    holdings = store.holdings()
+    assert holdings[0]["source"] == 0 and holdings[0]["generation"] == 2
+
+
+def test_store_refuses_torn_stale_and_cross_generation():
+    store = ReplicaStore(generation=0)
+    good = _shard(1, 6)
+    torn = ReplicaShard(1, 8, 0, good.checksum, good.payload[:-2])
+    ok, reason = store.put(torn)
+    assert (ok, reason) == (False, "checksum_mismatch")
+    assert store.put(good)[0]
+    ok, reason = store.put(_shard(1, 6))  # duplicate of held version
+    assert (ok, reason) == (False, "stale_version")
+    ok, reason = store.put(_shard(1, 8, generation=1))  # stale world
+    assert (ok, reason) == (False, "generation_mismatch")
+    assert store.get(1).version == 6  # last good shard untouched
+    assert store.rejected == 3
+
+
+def test_store_retains_previous_version_for_older_complete_sets():
+    """A host commits its own new snapshot BEFORE the neighbor ack: the
+    previous version must survive the commit, or a death in that window
+    destroys the last COMPLETE replica set (review finding)."""
+    store = ReplicaStore(generation=0)
+    for version in (2, 4, 6):
+        assert store.put(_shard(0, version))[0]
+    assert store.versions(0) == [4, 6]  # keeps the two newest
+    assert store.get(0).version == 6  # default = newest
+    assert store.get(0, version=4).version == 4
+    assert store.get(0, version=2) is None  # pruned
+    # older than everything retained at capacity: refused
+    ok, reason = store.put(_shard(0, 1))
+    assert (ok, reason) == (False, "stale_version")
+    # advertisement stays newest-per-source
+    assert store.holdings()[0]["version"] == 6
+
+
+# ---- replica service (wire) -------------------------------------------------
+
+
+def test_replica_service_push_fetch_probe_round_trip():
+    store = ReplicaStore(generation=0)
+    server, port = start_replica_server(store)
+    client = ReplicaClient(f"127.0.0.1:{port}")
+    try:
+        shard = _shard(0, 4, {"w": np.ones((2, 2), np.float32)})
+        resp = client.push_replica(
+            msg.PushReplicaRequest(
+                source=shard.source,
+                version=shard.version,
+                generation=shard.generation,
+                checksum=shard.checksum,
+                payload=shard.payload,
+            )
+        )
+        assert resp.accepted
+        probe = client.fetch_replica(
+            msg.FetchReplicaRequest(source=0, probe=True)
+        )
+        assert probe.has and probe.version == 4 and probe.payload == b""
+        full = client.fetch_replica(msg.FetchReplicaRequest(source=0))
+        assert full.payload == shard.payload
+        assert not client.fetch_replica(
+            msg.FetchReplicaRequest(source=3)
+        ).has
+    finally:
+        client.close()
+        server.stop(grace=0)
+
+
+def test_replica_servicer_rejects_torn_push_in_process():
+    servicer = ReplicaServicer(ReplicaStore(generation=0))
+    shard = _shard(0, 4)
+    resp = servicer.push_replica(
+        msg.PushReplicaRequest(
+            source=0,
+            version=4,
+            generation=0,
+            checksum=shard.checksum,
+            payload=shard.payload[:-1],
+        )
+    )
+    assert not resp.accepted and resp.reason == "checksum_mismatch"
+    assert servicer.store.get(0) is None
+
+
+# ---- directory + harvest ----------------------------------------------------
+
+
+def _serve(store: ReplicaStore):
+    server, port = start_replica_server(store)
+    return server, f"127.0.0.1:{port}"
+
+
+def test_directory_harvest_picks_freshest_complete_set():
+    # survivor holds its own shard at v6 and the victim's pushed v6
+    store = ReplicaStore(generation=0)
+    store.put(_shard(0, 6, {"w": np.full((2, 2), 6.0, np.float32)}))
+    store.put(_shard(1, 6))
+    server, addr = _serve(store)
+    try:
+        directory = ReplicaDirectory()
+        directory.update(
+            0,
+            {
+                "addr": addr,
+                "process_id": 0,
+                "generation": 0,
+                "holdings": store.holdings(),
+            },
+        )
+        stage = directory.harvest(
+            live_worker_ids=[0], num_sources=2, generation=0, staged_for=1
+        )
+        assert stage is not None
+        assert stage["version"] == 6 and stage["generation"] == 1
+        dense, _parts = blob_mod.decode_snapshot(stage["payload"])
+        np.testing.assert_array_equal(
+            dense["w"], np.full((2, 2), 6.0, np.float32)
+        )
+        assert directory.harvests == 1
+    finally:
+        server.stop(grace=0)
+
+
+def test_directory_harvest_uses_older_complete_set_after_torn_push():
+    """kill_during_replication window: the survivor's own shard
+    advanced to v6 but the victim's v6 push never landed — harvest must
+    assemble the OLDER complete set (v4) from the retained versions
+    instead of falling back to disk."""
+    store = ReplicaStore(generation=0)
+    store.put(_shard(0, 4, {"w": np.full((2, 2), 4.0, np.float32)}))
+    store.put(_shard(0, 6, {"w": np.full((2, 2), 6.0, np.float32)}))
+    store.put(_shard(1, 4))  # victim's last complete push
+    server, addr = _serve(store)
+    try:
+        directory = ReplicaDirectory()
+        directory.update(
+            0,
+            {
+                "addr": addr,
+                "process_id": 0,
+                "generation": 0,
+                "holdings": store.holdings(),
+            },
+        )
+        stage = directory.harvest(
+            live_worker_ids=[0], num_sources=2, generation=0, staged_for=1
+        )
+        assert stage is not None and stage["version"] == 4
+        dense, _parts = blob_mod.decode_snapshot(stage["payload"])
+        np.testing.assert_array_equal(
+            dense["w"], np.full((2, 2), 4.0, np.float32)
+        )
+    finally:
+        server.stop(grace=0)
+
+
+def test_directory_harvest_incomplete_coverage_falls_back():
+    """No version of the victim's shard was ever received: there is no
+    complete set at ANY version — harvest must return None (the
+    disk-fallback rule), never a torn mix."""
+    store = ReplicaStore(generation=0)
+    store.put(_shard(0, 6))  # own shard advanced to 6...
+    # ...but the victim's shard (source 1) was never received at all
+    server, addr = _serve(store)
+    try:
+        directory = ReplicaDirectory()
+        directory.update(
+            0,
+            {
+                "addr": addr,
+                "process_id": 0,
+                "generation": 0,
+                "holdings": store.holdings(),
+            },
+        )
+        assert (
+            directory.harvest(
+                live_worker_ids=[0],
+                num_sources=2,
+                generation=0,
+                staged_for=1,
+            )
+            is None
+        )
+        assert directory.harvest_failures == 1
+    finally:
+        server.stop(grace=0)
+
+
+def test_directory_harvest_ignores_dead_and_stale_generation():
+    directory = ReplicaDirectory()
+    directory.update(
+        5, {"addr": "127.0.0.1:1", "process_id": 0, "generation": 0,
+            "holdings": []},
+    )
+    # dead worker excluded -> no addrs -> disk fallback
+    assert directory.harvest([], 1, 0, 1) is None
+    # stale-generation advertisement excluded the same way
+    assert directory.harvest([5], 1, 3, 4) is None
+    directory.forget_worker(5)
+    assert directory.peers(0) == {}
+
+
+def test_directory_peers_are_string_keyed_for_the_wire():
+    """msgpack decode (strict_map_key) rejects int map keys — the peer
+    map rides a HeartbeatResponse, so keys must be strings end to end."""
+    directory = ReplicaDirectory()
+    directory.update(
+        0, {"addr": "127.0.0.1:9", "process_id": 1, "generation": 0,
+            "holdings": []},
+    )
+    peers = directory.peers(0)
+    assert peers == {"1": "127.0.0.1:9"}
+    decoded = msg.decode(
+        msg.encode(msg.HeartbeatResponse(replica_peers=peers))
+    )
+    assert decoded.replica_peers == {"1": "127.0.0.1:9"}
+
+
+def test_directory_coverage_stats_counts_pushes():
+    directory = ReplicaDirectory()
+    for version in (2, 4):
+        directory.update(
+            0,
+            {
+                "addr": "a",
+                "process_id": 0,
+                "generation": 0,
+                "holdings": [
+                    {"source": 0, "version": version, "generation": 0,
+                     "checksum": "x"}
+                ],
+            },
+        )
+    stats = directory.coverage_stats()
+    assert stats["pushes_by_generation"] == {"0": 2}
+    gen0 = stats["generations"][0]
+    assert gen0["hosts_covered"] == [0]
+    assert gen0["shard_versions"] == {"0": 4}
+
+
+# ---- master servicer integration --------------------------------------------
+
+
+def _servicer() -> MasterServicer:
+    dispatcher = TaskDispatcher({"s": (0, 64)}, records_per_task=64)
+    return MasterServicer(32, dispatcher)
+
+
+def test_heartbeat_carries_advertisement_up_and_peers_down():
+    servicer = _servicer()
+    directory = ReplicaDirectory()
+    servicer.set_replica_directory(directory)
+    resp = servicer.heartbeat(
+        msg.HeartbeatRequest(
+            worker_id=0,
+            step=4,
+            replica={
+                "addr": "127.0.0.1:7", "process_id": 0, "generation": 0,
+                "holdings": [],
+            },
+        )
+    )
+    assert resp.replica_peers == {"0": "127.0.0.1:7"}
+    # a replication-less worker's heartbeat is unchanged
+    resp = servicer.heartbeat(msg.HeartbeatRequest(worker_id=1))
+    assert resp.accepted
+    servicer.forget_worker(0)
+    assert directory.peers(0) == {}
+
+
+def test_heartbeat_wire_compat_with_pre_replication_payloads():
+    """Old payloads lack the replica fields entirely; decode must fill
+    defaults (same contract as the PR-3 trace fields)."""
+    import msgpack
+
+    old_request = msgpack.packb(
+        {
+            "kind": "HeartbeatRequest",
+            "body": {"worker_id": 3, "step": 1, "timestamp": 0.0},
+        },
+        use_bin_type=True,
+    )
+    decoded = msg.decode(old_request)
+    assert decoded.replica == {}
+    old_response = msgpack.packb(
+        {
+            "kind": "HeartbeatResponse",
+            "body": {"accepted": True, "should_quiesce": False,
+                     "cluster_version": 0},
+        },
+        use_bin_type=True,
+    )
+    assert msg.decode(old_response).replica_peers == {}
+
+
+def test_restore_stage_is_generation_fenced():
+    servicer = _servicer()
+    assert not servicer.get_restore_state(
+        msg.GetRestoreStateRequest(cluster_version=1)
+    ).has
+    servicer.set_restore_stage(
+        {"generation": 2, "version": 6, "checksum": "c", "payload": b"x"}
+    )
+    assert not servicer.get_restore_state(
+        msg.GetRestoreStateRequest(cluster_version=1)
+    ).has
+    staged = servicer.get_restore_state(
+        msg.GetRestoreStateRequest(cluster_version=2)
+    )
+    assert staged.has and staged.version == 6 and staged.payload == b"x"
+    servicer.set_restore_stage(None)
+    assert not servicer.get_restore_state(
+        msg.GetRestoreStateRequest(cluster_version=2)
+    ).has
+
+
+def test_restore_stage_released_after_all_processes_fetch():
+    """The staged payload is a full model-state copy; once every
+    process of the restoring generation has its copy it must leave
+    master RAM (review finding)."""
+    servicer = _servicer()
+    servicer.set_restore_stage(
+        {"generation": 1, "version": 6, "checksum": "c", "payload": b"x",
+         "world_size": 2}
+    )
+    first = servicer.get_restore_state(
+        msg.GetRestoreStateRequest(cluster_version=1, process_id=0)
+    )
+    assert first.has
+    # the same process asking again does NOT release the stage
+    assert servicer.get_restore_state(
+        msg.GetRestoreStateRequest(cluster_version=1, process_id=0)
+    ).has
+    assert servicer.get_restore_state(
+        msg.GetRestoreStateRequest(cluster_version=1, process_id=1)
+    ).has
+    # every process served: the payload is gone
+    assert not servicer.get_restore_state(
+        msg.GetRestoreStateRequest(cluster_version=1, process_id=0)
+    ).has
+
+
+# ---- replicator cadence ------------------------------------------------------
+
+
+class _StepTrainer:
+    def __init__(self, step):
+        self.step = step
+        self.state = None
+
+
+@pytest.fixture()
+def _fake_snapshot(monkeypatch):
+    from elasticdl_tpu.parallel import elastic
+
+    monkeypatch.setattr(
+        elastic,
+        "state_checkpoint_parts",
+        lambda state, mesh, materialize_dense=True: (
+            {"w": np.ones((1,), np.float32)} if materialize_dense else {},
+            {},
+        ),
+    )
+
+
+def _replicator(steps: int = 0, process_id: int = 0) -> PeerReplicator:
+    return PeerReplicator(
+        ReplicaStore(generation=0),
+        process_id=process_id,
+        num_processes=2,
+        generation=0,
+        addr="127.0.0.1:0",
+        replication_steps=steps,
+    )
+
+
+def test_replicator_every_boundary_cadence(_fake_snapshot):
+    rep = _replicator(steps=0)
+    assert rep.maybe_replicate(_StepTrainer(2), mesh=None)
+    assert not rep.maybe_replicate(_StepTrainer(2), mesh=None)  # no new step
+    assert rep.maybe_replicate(_StepTrainer(4), mesh=None)
+    # local commit happened even with no peer discovered yet
+    assert rep._store.get(0).version == 4
+    assert rep.push_failures == 2 and rep.pushes == 0
+
+
+def test_replicator_milestone_cadence_and_restore_alignment(_fake_snapshot):
+    rep = _replicator(steps=4)
+    assert not rep.maybe_replicate(_StepTrainer(3), mesh=None)
+    assert rep.maybe_replicate(_StepTrainer(6), mesh=None)  # crossed 4
+    assert not rep.maybe_replicate(_StepTrainer(7), mesh=None)
+    rep.note_restored_version(6)
+    assert not rep.maybe_replicate(_StepTrainer(7), mesh=None)
+    assert rep.maybe_replicate(_StepTrainer(12), mesh=None)
+
+
+def test_replicator_ring_push_delivers_to_neighbor(_fake_snapshot):
+    neighbor_store = ReplicaStore(generation=0)
+    server, addr = _serve(neighbor_store)
+    try:
+        rep = _replicator(process_id=0)
+        assert rep.neighbor == 1
+        rep.set_peers({"1": addr})
+        rep.replicate_now(_StepTrainer(6), mesh=None)
+        assert rep.pushes == 1
+        delivered = neighbor_store.get(0)
+        assert delivered is not None and delivered.version == 6
+    finally:
+        rep.close()
+        server.stop(grace=0)
+
+
+def test_replicator_advertisement_shape(_fake_snapshot):
+    rep = _replicator()
+    rep.replicate_now(_StepTrainer(2), mesh=None)
+    ad = rep.advertisement()
+    assert ad["addr"] == "127.0.0.1:0" and ad["process_id"] == 0
+    assert ad["holdings"][0]["version"] == 2
+
+
+# ---- hot restore on a real trainer ------------------------------------------
+
+
+class _StageMaster:
+    """In-process master stub serving one staged restore payload."""
+
+    def __init__(self, stage: dict | None):
+        self._stage = stage
+
+    def get_restore_state(self, request):
+        if (
+            self._stage is None
+            or self._stage["generation"] != request.cluster_version
+        ):
+            return msg.RestoreStateResponse()
+        return msg.RestoreStateResponse(
+            has=True,
+            version=self._stage["version"],
+            checksum=self._stage["checksum"],
+            payload=self._stage["payload"],
+        )
+
+
+def _tiny_trainer():
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import optax
+
+    from elasticdl_tpu.parallel.distributed import SPMDTrainer
+    from elasticdl_tpu.parallel.mesh import MeshConfig
+
+    class _M(nn.Module):
+        @nn.compact
+        def __call__(self, features, training: bool = False):
+            return nn.Dense(2)(features["x"])
+
+    mesh = MeshConfig.from_string("dp=2").create()
+    feats = {"x": np.ones((4, 3), np.float32)}
+    trainer = SPMDTrainer(
+        mesh,
+        _M(),
+        lambda labels, outputs: jnp.mean(outputs**2),
+        optax.sgd(0.1),
+        feats,
+    )
+    return trainer, mesh
+
+
+def test_restore_from_replica_lands_at_replicated_step():
+    from elasticdl_tpu.parallel import elastic
+
+    trainer, mesh = _tiny_trainer()
+    # snapshot the current state as the replicated version 6
+    dense, parts = elastic.state_checkpoint_parts(trainer.state, mesh)
+    payload = blob_mod.encode_snapshot(dense, parts)
+    stage = {
+        "generation": 1,
+        "version": 6,
+        "checksum": blob_mod.blob_checksum(payload),
+        "payload": payload,
+    }
+    # scramble the live state so the restore is observable
+    import jax
+
+    scrambled = jax.tree_util.tree_map(
+        lambda a: a * 0.0, trainer.state.params
+    )
+    trainer.state = trainer.state.replace(params=scrambled)
+    version = restore_from_replica(
+        trainer, _StageMaster(stage), cluster_version=1, process_id=0
+    )
+    assert version == 6
+    assert int(trainer.state.step) == 6
+    restored, _ = elastic.state_checkpoint_parts(trainer.state, mesh)
+    for name, value in dense.items():
+        np.testing.assert_array_equal(restored[name], value)
+
+
+def test_restore_from_replica_declines_stage_older_than_disk():
+    """replication_steps coarser than checkpoint_steps can leave the
+    staged replica BEHIND the newest disk milestone — the replica path
+    must decline so restore never loses work relative to disk."""
+    trainer, mesh = _tiny_trainer()
+    from elasticdl_tpu.parallel import elastic
+
+    dense, parts = elastic.state_checkpoint_parts(trainer.state, mesh)
+    payload = blob_mod.encode_snapshot(dense, parts)
+    stage = {
+        "generation": 1,
+        "version": 4,
+        "checksum": blob_mod.blob_checksum(payload),
+        "payload": payload,
+    }
+    master = _StageMaster(stage)
+    assert restore_from_replica(trainer, master, 1, min_version=8) is None
+    assert restore_from_replica(trainer, master, 1, min_version=4) == 4
+
+
+def test_restore_from_replica_falls_through_without_stage():
+    trainer, _mesh = _tiny_trainer()
+    assert (
+        restore_from_replica(
+            trainer, _StageMaster(None), cluster_version=1
+        )
+        is None
+    )
+    # wrong generation (fenced) and torn payload both fall through
+    payload = b"not-a-snapshot"
+    stage = {
+        "generation": 1,
+        "version": 6,
+        "checksum": "00000000",
+        "payload": payload,
+    }
+    assert (
+        restore_from_replica(trainer, _StageMaster(stage), 1) is None
+    )
+
+
+# ---- chaos integration -------------------------------------------------------
+
+
+def test_injector_kill_during_replication_fires_from_push_hook(
+    tmp_path, monkeypatch
+):
+    from elasticdl_tpu.chaos.hooks import ChaosInjector
+    from elasticdl_tpu.chaos.plan import Fault, FaultKind, FaultPlan
+
+    killed = []
+    monkeypatch.setattr(os, "kill", lambda pid, sig: killed.append(sig))
+    fault = Fault(
+        kind=FaultKind.KILL_DURING_REPLICATION,
+        fault_id="rk",
+        at_step=4,
+        process_id=0,
+    )
+    inj = ChaosInjector(
+        FaultPlan(name="t", faults=[fault]),
+        process_id=0,
+        cluster_version=0,
+        worker_id=0,
+        events_path=str(tmp_path / "e.jsonl"),
+    )
+    inj.on_step(4)  # arms only; never fires at a step boundary
+    assert not killed
+    inj.on_replica_push(2)  # below at_step
+    assert not killed
+    inj.on_replica_push(4)
+    assert killed
+    events = [
+        json.loads(line)
+        for line in open(tmp_path / "e.jsonl", encoding="utf-8")
+    ]
+    assert events[0]["phase"] == "replica_push"
+
+
+def test_replication_plans_registered():
+    from elasticdl_tpu.chaos.plan import FaultKind, builtin_plans
+    from elasticdl_tpu.chaos.runner import REPLICATION_PLANS
+
+    plans = builtin_plans(2)
+    assert plans["preempt_after_replication"].faults[0].kind == (
+        FaultKind.PREEMPT
+    )
+    assert plans["kill_during_replication"].faults[0].kind == (
+        FaultKind.KILL_DURING_REPLICATION
+    )
+    assert REPLICATION_PLANS <= set(plans)
+
+
+def test_harness_no_lost_steps_checker(tmp_path):
+    from elasticdl_tpu.chaos.harness import (
+        ChaosJobConfig,
+        _check_no_lost_steps,
+    )
+    from elasticdl_tpu.chaos.plan import FaultPlan
+
+    telemetry = tmp_path / "telemetry"
+    telemetry.mkdir()
+
+    def _write(events):
+        with open(telemetry / "events.jsonl", "w", encoding="utf-8") as f:
+            for event in events:
+                f.write(json.dumps(event) + "\n")
+
+    config = ChaosJobConfig(
+        plan=FaultPlan(name="t"), workdir=str(tmp_path), replication=True
+    )
+    kill = [{"kind": "preempt_worker", "monotonic": 100.0}]
+    _write(
+        [
+            {"event": "replica_push", "step": 6, "monotonic": 99.0},
+            {"event": "replica_restore", "step": 6, "monotonic": 105.0},
+        ]
+    )
+    verdict = _check_no_lost_steps(config, str(telemetry), kill)
+    assert verdict["status"] == "PASS"
+    # restoring below the replicated step = lost steps
+    _write(
+        [
+            {"event": "replica_push", "step": 6, "monotonic": 99.0},
+            {"event": "replica_restore", "step": 4, "monotonic": 105.0},
+        ]
+    )
+    assert _check_no_lost_steps(config, str(telemetry), kill)["status"] == (
+        "FAIL"
+    )
+    # no restore at all = FAIL; replication off = not applicable
+    _write([{"event": "replica_push", "step": 6, "monotonic": 99.0}])
+    assert _check_no_lost_steps(config, str(telemetry), kill)["status"] == (
+        "FAIL"
+    )
+    config.replication = False
+    assert _check_no_lost_steps(config, str(telemetry), kill) is None
+
+
+# ---- dispatcher liveness (found by the replication smoke) -------------------
+
+
+def test_finished_accounts_for_unopened_epochs():
+    """A multi-epoch job whose current epoch drained is NOT finished:
+    epoch N+1 opens lazily on the next get().  Without this, a worker
+    death at the last task of an epoch ended the job one epoch early
+    with no re-formation."""
+    dispatcher = TaskDispatcher(
+        {"s": (0, 64)}, records_per_task=64, num_epochs=2
+    )
+    task_id, task = dispatcher.get(0)
+    assert task is not None
+    dispatcher.report(task_id, success=True)
+    # epoch 0 drained, epoch 1 not yet opened: still not finished
+    assert not dispatcher.finished()
+    task_id, task = dispatcher.get(0)  # opens epoch 1
+    assert task is not None
+    dispatcher.report(task_id, success=True)
+    assert dispatcher.finished()
+
+
+# ---- trace analyzer + report surfaces ---------------------------------------
+
+
+def _write_jsonl(path, records):
+    with open(path, "w", encoding="utf-8") as f:
+        for record in records:
+            f.write(json.dumps(record) + "\n")
+
+
+def test_trace_analyze_attributes_replica_phases(tmp_path):
+    from elasticdl_tpu.telemetry.trace import analyze_telemetry_dir
+
+    run = tmp_path / "telemetry"
+    run.mkdir()
+    _write_jsonl(
+        run / "events.jsonl",
+        [
+            {"event": "step", "generation": 0, "monotonic": 10.0,
+             "step": 6, "worker_id": 0},
+            {"event": "step", "generation": 1, "monotonic": 20.0,
+             "step": 7, "worker_id": 2},
+        ],
+    )
+    _write_jsonl(
+        run / "spans.jsonl",
+        [
+            {"span": "reform", "trace_id": "t", "span_id": "r",
+             "parent_span_id": "", "start": 11.0, "end": 16.0,
+             "generation": 1, "role": "master"},
+            {"span": "replica_harvest", "trace_id": "t", "span_id": "h",
+             "parent_span_id": "r", "start": 11.2, "end": 12.0,
+             "generation": 1, "role": "master"},
+            {"span": "reform_fence_recover", "trace_id": "t",
+             "span_id": "f", "parent_span_id": "r", "start": 12.0,
+             "end": 12.5, "generation": 1, "role": "master"},
+            {"span": "reform_relaunch", "trace_id": "t", "span_id": "l",
+             "parent_span_id": "r", "start": 12.5, "end": 14.0,
+             "generation": 1, "role": "master"},
+            {"span": "replica_restore", "trace_id": "u", "span_id": "x",
+             "parent_span_id": "", "start": 16.0, "end": 18.0,
+             "generation": 1, "role": "worker", "step": 6},
+        ],
+    )
+    analysis = analyze_telemetry_dir(str(run))
+    gap = analysis["reform_downtime"][0]
+    phases = gap["phases_secs"]
+    assert phases["replica_harvest"] == pytest.approx(0.8)
+    assert phases["replica_restore"] == pytest.approx(2.0)
+    assert "checkpoint_restore" not in phases
+    # phase attribution still sums EXACTLY to the measured downtime
+    assert sum(phases.values()) == pytest.approx(gap["downtime_secs"])
+
+
+def test_report_embeds_replica_coverage(tmp_path):
+    from elasticdl_tpu.telemetry.report import analyze_events
+
+    events = [
+        {"event": "step", "generation": 0, "monotonic": 1.0, "step": 1,
+         "worker_id": 0, "records": 32},
+        {"event": "replica_push", "generation": 0, "monotonic": 1.5,
+         "step": 2, "source": 0, "target": 1, "ok": True},
+        {"event": "replica_push", "generation": 0, "monotonic": 1.6,
+         "step": 2, "source": 1, "target": 0, "ok": True},
+        {"event": "replica_harvest", "generation": 1, "monotonic": 2.0,
+         "complete": True, "version": 2},
+        {"event": "replica_restore", "generation": 1, "monotonic": 2.5,
+         "step": 2},
+    ]
+    run = analyze_events(events, faults=[])
+    replication = run["replication"]
+    assert replication["pushes_by_generation"] == {0: 2}
+    assert replication["hosts_covered_by_generation"] == {0: [0, 1]}
+    assert replication["shard_versions_by_generation"] == {0: 2}
+    assert replication["restores"] == [{"generation": 1, "step": 2}]
+    assert replication["harvests"][0]["complete"] is True
+    # replication-less runs keep their schema unchanged
+    assert "replication" not in analyze_events(events[:1], faults=[])
