@@ -282,6 +282,24 @@ class TaskDispatcher:
             assignment = self._active.pop(task_id, None)
             if assignment is None:
                 logger.warning("Unknown or already-reclaimed task id: %d", task_id)
+                from elasticdl_tpu.telemetry.compile_tracker import (
+                    COMPILE_COUNT_KEY,
+                )
+
+                if exec_counters and COMPILE_COUNT_KEY in exec_counters:
+                    # the compile counter is PROCESS-level, not
+                    # task-scoped: a stale (reclaimed-lease) report's
+                    # delta is still a real recompile, and the worker's
+                    # watermark advances on RPC success — dropping it
+                    # here would hide the recompile from the
+                    # elasticdl_compile_total mirror forever
+                    stale = self._counters.setdefault(
+                        TaskType.TRAINING, JobCounters()
+                    )
+                    stale.exec_metrics[COMPILE_COUNT_KEY] = (
+                        stale.exec_metrics.get(COMPILE_COUNT_KEY, 0)
+                        + exec_counters[COMPILE_COUNT_KEY]
+                    )
                 # counted=False: a stale report was (correctly) dropped
                 self._notify(
                     "on_task_reported", task_id, None, success, False

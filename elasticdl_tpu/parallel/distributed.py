@@ -196,10 +196,58 @@ class SPMDTrainer:
         return jax.tree_util.tree_map(_pad, tree), div
 
     def place_padded(self, tree):
-        """pad_batch + place_batch — THE way runtimes feed host batches
-        whose leading dim may not divide the data axes."""
+        """pad_batch + place_batch — the legacy minimal-padding feed for
+        host batches whose leading dim may not divide the data axes.
+        The runtimes' hot paths use :meth:`pad_to` + :meth:`row_mask`
+        instead (shape-canonical batching: ONE program shape per step
+        kind, padded rows exactly zero-weighted)."""
         padded, _ = self.pad_batch(tree)
         return self.place_batch(padded)
+
+    # ---- shape-canonical batching ------------------------------------------
+    # THE canonical row count itself is a pure function of static config
+    # (stacking.canonical_batch_rows over the mesh's batch divisor) —
+    # the runtimes compute it at build time, before this trainer exists.
+
+    def pad_to(self, tree, rows: int):
+        """Pad the batch's leading dim to EXACTLY ``rows`` (repeating the
+        last row; padded rows carry zero weight via :meth:`row_mask`, so
+        the fill only has to be shape/dtype-valid, not meaningful)."""
+
+        def _pad(x):
+            x = np.asarray(x)
+            n = x.shape[0]
+            if n == rows:
+                return x
+            if n > rows:
+                raise ValueError(
+                    f"batch of {n} rows exceeds the canonical shape "
+                    f"({rows} rows)"
+                )
+            return np.concatenate(
+                [x, np.repeat(x[-1:], rows - n, axis=0)], axis=0
+            )
+
+        return jax.tree_util.tree_map(_pad, tree)
+
+    def row_mask(self, n_real: int, rows: int) -> np.ndarray:
+        """``(rows,)`` float32 sample weights: 1 for the real rows, 0 for
+        the padding :meth:`pad_to` appended."""
+        mask = np.zeros(rows, np.float32)
+        mask[:n_real] = 1.0
+        return mask
+
+    def place_canonical(self, tree, rows: int):
+        """pad_to + place_batch — THE canonical-shape feed all three
+        runtimes use (one body, so their dispatch shapes cannot
+        diverge); outputs are trimmed back by :func:`trim_pad`, and the
+        loss side carries :meth:`place_mask` weights so the padding is
+        weightless."""
+        return self.place_batch(self.pad_to(tree, rows))
+
+    def place_mask(self, n_real: int, rows: int):
+        """:meth:`row_mask` placed like any 1-D batch leaf."""
+        return self.place_batch(self.row_mask(n_real, rows))
 
     # ---- steps ------------------------------------------------------------
 
@@ -214,33 +262,42 @@ class SPMDTrainer:
         self._state = value
         self._step_cache = None
 
-    def train_step(self, features, labels):
+    def train_step(self, features, labels, weights=None):
         with self.mesh, attention_mesh_scope(self.mesh):
             self._state, metrics = self._train_step(
-                self._state, features, labels
+                self._state, features, labels, weights
             )
         if self._step_cache is not None:
             self._step_cache += 1
         return metrics
 
-    def train_steps_stacked(self, stacked_features, stacked_labels):
+    def train_steps_stacked(
+        self, stacked_features, stacked_labels, stacked_weights=None
+    ):
         """K optimizer steps in ONE dispatch: a jitted ``lax.scan`` over
         batches stacked on a leading axis (semantically identical to K
         sequential ``train_step`` calls).  Amortizes per-dispatch
         overhead — decisive on high-latency links (tunneled dev TPUs,
         remote hosts), a free ~2x even on local hosts.  Returns the last
-        step's metrics."""
+        step's metrics.  ``stacked_weights``: optional ``(K, rows)``
+        per-row sample weights (shape-canonical batching), scanned
+        alongside the batches."""
         num_steps = jax.tree_util.tree_leaves(stacked_features)[0].shape[0]
-        scan_fn = self._stacked_scan_cache.get(num_steps)
+        key = (num_steps, stacked_weights is not None)
+        scan_fn = self._stacked_scan_cache.get(key)
         if scan_fn is None:
             step_fn = self._train_step
+            weighted = stacked_weights is not None
 
-            def scan_steps(state, feats, labels):
+            def scan_steps(state, feats, labels, weights=None):
                 def body(s, xs):
-                    s2, metrics = step_fn(s, xs[0], xs[1])
+                    s2, metrics = step_fn(
+                        s, xs[0], xs[1], xs[2] if weighted else None
+                    )
                     return s2, metrics
 
-                return jax.lax.scan(body, state, (feats, labels))
+                xs = (feats, labels, weights) if weighted else (feats, labels)
+                return jax.lax.scan(body, state, xs)
 
             # pin the updated state to the mesh layout exactly like
             # build_train_step does — without it the scan output's
@@ -251,11 +308,19 @@ class SPMDTrainer:
                 donate_argnums=(0,),
                 out_shardings=(self.state_shardings, None),
             )
-            self._stacked_scan_cache[num_steps] = scan_fn
+            self._stacked_scan_cache[key] = scan_fn
         with self.mesh, attention_mesh_scope(self.mesh):
-            self._state, metrics = scan_fn(
-                self._state, stacked_features, stacked_labels
-            )
+            if stacked_weights is None:
+                self._state, metrics = scan_fn(
+                    self._state, stacked_features, stacked_labels
+                )
+            else:
+                self._state, metrics = scan_fn(
+                    self._state,
+                    stacked_features,
+                    stacked_labels,
+                    stacked_weights,
+                )
         if self._step_cache is not None:
             self._step_cache += int(num_steps)
         return jax.tree_util.tree_map(lambda m: m[-1], metrics)
@@ -279,9 +344,9 @@ class SPMDTrainer:
 
         return jax.tree_util.tree_map(_place, tree)
 
-    def eval_step(self, features, labels):
+    def eval_step(self, features, labels, weights=None):
         with self.mesh, attention_mesh_scope(self.mesh):
-            return self._eval_step(self.state, features, labels)
+            return self._eval_step(self.state, features, labels, weights)
 
     def predict_step(self, features):
         with self.mesh, attention_mesh_scope(self.mesh):

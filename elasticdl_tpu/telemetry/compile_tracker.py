@@ -1,0 +1,156 @@
+"""Process-wide XLA compile counting (shape-canonical batching's gauge).
+
+The whole point of canonicalizing batch shapes
+(docs/designs/shape_canonicalization.md) is that the steady-state step
+stream executes exactly ONE train-step program (plus one stacked-scan
+variant) — so the number of backend compiles is the regression signal
+worth watching.  This module makes it observable:
+
+- a **counter**: every XLA backend compile in this process increments a
+  process-wide total (:func:`compile_count`); the master mirrors it —
+  plus the ``compile_count`` exec counters lockstep chiefs ship with
+  task reports — onto ``/metrics`` as ``elasticdl_compile_total``.
+- a **span**: each compile lands in the trace timeline as a ``compile``
+  span (duration = the backend compile), so ``trace analyze``'s
+  ``warmup_compile`` reform phase shows measured compile time instead of
+  inferring it from the uncovered remainder.
+
+Mechanism: :func:`install` registers a ``jax.monitoring`` duration
+listener for the ``/jax/core/compile/backend_compile_duration`` event
+(one firing per program actually handed to XLA — cache hits and traces
+don't fire it).  When the monitoring API is unavailable the installer
+falls back to wrapping ``jax._src.compiler.compile_or_get_cached`` (the
+funnel every jitted lower/compile path goes through) — an APPROXIMATION:
+unlike the monitoring event, the wrap also counts persistent-compile-
+cache lookups that hit, so wrap-mode totals are an upper bound.  If
+neither hook exists the tracker stays disabled and
+:func:`compile_count` returns 0.
+
+Install is idempotent and the disabled cost is zero: nothing here sits
+on the step path — compiles are the rare event being counted.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+# the exec-counter key lockstep chiefs report compile DELTAS under
+# (summed by the TaskDispatcher, mirrored by MasterTelemetry._collect)
+COMPILE_COUNT_KEY = "compile_count"
+
+_BACKEND_COMPILE_SUFFIX = "backend_compile_duration"
+
+_lock = threading.Lock()
+_count = 0
+_secs_total = 0.0
+_installed = False
+_mode: str | None = None
+
+
+def _record(duration_secs: float):
+    global _count, _secs_total
+    with _lock:
+        _count += 1
+        _secs_total += max(0.0, float(duration_secs))
+    # retroactive trace span: recorded on whatever thread compiled; the
+    # tracer is thread-safe and lifecycle spans are never sampled away
+    from elasticdl_tpu.telemetry import tracing
+
+    tracer = tracing.get_tracer()
+    if tracer is not None:
+        now = time.monotonic()
+        tracer.record_span(
+            tracing.SPAN_COMPILE, now - max(0.0, float(duration_secs)), now
+        )
+
+
+def _on_event_duration(event: str, duration_secs: float, **_kwargs):
+    if event.endswith(_BACKEND_COMPILE_SUFFIX):
+        _record(duration_secs)
+
+
+def install() -> bool:
+    """Register the compile listener once per process; returns whether a
+    hook was installed (False only on a JAX without monitoring or a
+    compile funnel to wrap)."""
+    global _installed, _mode
+    with _lock:
+        if _installed:
+            return _mode is not None
+        _installed = True
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(_on_event_duration)
+        _mode = "monitoring"
+        return True
+    except Exception:  # noqa: BLE001 — fall through to the wrap
+        pass
+    try:  # fallback: wrap the one funnel every lower/compile path uses
+        from jax._src import compiler as _jax_compiler
+
+        wrapped = _jax_compiler.compile_or_get_cached
+
+        def counting(*args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                return wrapped(*args, **kwargs)
+            finally:
+                _record(time.perf_counter() - t0)
+
+        _jax_compiler.compile_or_get_cached = counting
+        _mode = "wrap"
+        return True
+    except Exception:  # noqa: BLE001 — tracker stays disabled
+        _mode = None
+        return False
+
+
+def compile_count() -> int:
+    """XLA programs compiled by THIS process since install (0 before)."""
+    return _count
+
+
+def compile_secs_total() -> float:
+    """Total seconds this process spent in backend compiles."""
+    return _secs_total
+
+
+def installed_mode() -> str | None:
+    """``'monitoring'`` / ``'wrap'`` / ``None`` (diagnostics only)."""
+    return _mode
+
+
+class ExecCounterReporter:
+    """THE one implementation of shipping compile deltas with task
+    reports (both worker runtimes use it, so the contract cannot drift):
+    :meth:`attach` stages the unreported delta into the report's exec
+    counters, and the watermark advances only in :meth:`commit` AFTER
+    the report RPC succeeded — a failed report re-ships the delta with
+    the next one instead of silently dropping it."""
+
+    def __init__(self):
+        self._reported = compile_count()
+
+    def attach(self, counters: dict) -> int:
+        """Stage the pending delta under ``COMPILE_COUNT_KEY`` (when
+        nonzero); returns the total to pass to :meth:`commit` once the
+        report went through."""
+        total = compile_count()
+        delta = total - self._reported
+        if delta > 0:
+            counters[COMPILE_COUNT_KEY] = delta
+        return total
+
+    def commit(self, total: int):
+        self._reported = max(self._reported, total)
+
+
+def _reset_for_tests():
+    """Zero the totals (tests simulating a fresh process / generation;
+    the listener registration itself is process-permanent)."""
+    global _count, _secs_total
+    with _lock:
+        _count = 0
+        _secs_total = 0.0

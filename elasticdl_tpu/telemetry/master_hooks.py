@@ -127,6 +127,18 @@ class MasterTelemetry:
             "elasticdl_tasks_active", "Tasks currently leased"
         )
         self._epoch = r.gauge("elasticdl_epoch", "Current training epoch")
+        # shape-canonical batching's regression gauge: XLA programs
+        # compiled (this process + worker-reported exec-counter deltas);
+        # steady state should be flat after warmup — see
+        # telemetry/compile_tracker.py and scripts/compile_smoke.py
+        self._compiles = r.counter(
+            "elasticdl_compile_total",
+            "XLA backend compiles (master process + worker-reported)",
+        )
+        from elasticdl_tpu.telemetry import compile_tracker
+
+        compile_tracker.install()
+        self._compile_tracker = compile_tracker
 
         self._task_d = None
         self._servicer = None
@@ -154,22 +166,37 @@ class MasterTelemetry:
 
     def _collect(self, _registry):
         """Scrape-time refresh of point-in-time values."""
+        compiles = self._compile_tracker.compile_count()
         if self._task_d is not None:
             snap = self._task_d.snapshot()
             self._tasks_pending.set(snap["pending"] + snap["pending_eval"])
             self._tasks_active.set(len(snap["active"]))
             self._epoch.set(snap["epoch"])
+            from elasticdl_tpu.telemetry.compile_tracker import (
+                COMPILE_COUNT_KEY,
+            )
             from elasticdl_tpu.utils.constants import TaskType
 
-            for key, value in self._task_d.exec_metrics_snapshot(
-                TaskType.TRAINING
-            ).items():
+            # workers ship compile deltas with EVERY report kind, so the
+            # mirror sums the exec counters of all task types (keeping
+            # the TRAINING snapshot for the time buckets below — one
+            # dispatcher-lock copy per type per scrape)
+            exec_metrics = {}
+            for task_type in TaskType:
+                snapshot = self._task_d.exec_metrics_snapshot(task_type)
+                compiles += snapshot.get(COMPILE_COUNT_KEY, 0)
+                if task_type == TaskType.TRAINING:
+                    exec_metrics = snapshot
+            for key, value in exec_metrics.items():
                 if key.startswith("time_") and key.endswith("_ms"):
                     self.registry.counter(
                         _WORKER_TIME_MS,
                         "Worker wall-clock buckets (utils.timing_utils)",
                         labels={"bucket": key[len("time_") : -len("_ms")]},
                     ).set_total(value)
+        # set_total is monotone (max), so a re-formed generation's fresh
+        # per-process counters can never walk the exposed total backward
+        self._compiles.set_total(compiles)
         if self._servicer is not None:
             self._workers_live.set(len(self._servicer.live_workers()))
             self._generation.set(self._servicer.cluster_version)

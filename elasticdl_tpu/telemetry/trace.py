@@ -51,6 +51,7 @@ from collections import defaultdict
 from elasticdl_tpu.telemetry.events import EVENTS_FILENAME, read_jsonl
 from elasticdl_tpu.telemetry.tracing import (
     SPAN_CHECKPOINT_RESTORE,
+    SPAN_COMPILE,
     SPAN_REFORM,
     SPAN_REFORM_FENCE,
     SPAN_REFORM_RELAUNCH,
@@ -310,6 +311,11 @@ def _phase_intervals(
         # checkpoint_restore — restore came from the master's staged
         # peer-RAM harvest, not from a checkpoint read
         ("replica_restore", SPAN_REPLICA_RESTORE),
+        # measured backend compiles (telemetry/compile_tracker.py):
+        # listed LAST so the sweep attributes real compile time to
+        # warmup_compile even where it overlaps trainer_build/restore —
+        # the phase stops being a mere inferred remainder
+        ("warmup_compile", SPAN_COMPILE),
     ):
         window = _merged_window(
             [
@@ -337,6 +343,7 @@ _BRIDGE_AFTER = {
     "trainer_build": "warmup_compile",
     "checkpoint_restore": "warmup_compile",
     "replica_restore": "warmup_compile",
+    "warmup_compile": "warmup_compile",
 }
 
 

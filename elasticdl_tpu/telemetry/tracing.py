@@ -72,6 +72,7 @@ SPAN_PROFILE_WINDOW = "profile_window"  # XLA profiler capture window
 SPAN_REPLICA_PUSH = "replica_push"  # worker: snapshot + ring-neighbor push
 SPAN_REPLICA_HARVEST = "replica_harvest"  # master: fetch peer shards on reform
 SPAN_REPLICA_RESTORE = "replica_restore"  # worker: restore from peer RAM
+SPAN_COMPILE = "compile"  # any process: one XLA backend compile
 
 
 def gen_trace_id() -> str:

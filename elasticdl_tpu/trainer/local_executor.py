@@ -103,6 +103,22 @@ class LocalExecutor:
             getattr(args, "mesh_shape", "") or ""
         ).create()
         self._trainer: SPMDTrainer | None = None
+        # shape-canonical batching: every train/eval/predict batch is
+        # padded to this fixed row count (mask-weighted), so each step
+        # kind compiles exactly once — ragged tails reuse the program
+        from elasticdl_tpu.parallel.mesh import batch_divisor
+        from elasticdl_tpu.trainer.stacking import (
+            canonical_batch_rows,
+            warm_dispatch_overhead_async,
+        )
+
+        self._canonical_rows = canonical_batch_rows(
+            args.minibatch_size, batch_divisor(self._mesh)
+        )
+        if getattr(args, "steps_per_dispatch", 1) == "auto":
+            # measure the link overhead off the first dispatch's
+            # critical path (the probe result feeds the auto-k sizing)
+            warm_dispatch_overhead_async()
         self._checkpointer = PeriodicCheckpointer(
             getattr(args, "checkpoint_dir", "") or "",
             getattr(args, "checkpoint_steps", 0) or 0,
@@ -122,6 +138,11 @@ class LocalExecutor:
             telemetry_hooks.TELEMETRY_DIR_ENV, ""
         )
         self._telemetry = telemetry_hooks.install(telemetry_dir)
+        # process-wide compile counter (+ `compile` trace spans): the
+        # observable face of the compile-once guarantee
+        from elasticdl_tpu.telemetry import compile_tracker
+
+        compile_tracker.install()
         # span tracer on the same run dir (sampled step spans, checkpoint
         # and profile-window spans) — the single-process path of the
         # distributed trace
@@ -209,8 +230,8 @@ class LocalExecutor:
                     version // self._args.evaluation_steps
                 )
 
-    def _place(self, tree):
-        return self._trainer.place_padded(tree)
+    def _place_canonical(self, tree):
+        return self._trainer.place_canonical(tree, self._canonical_rows)
 
     @property
     def _version(self) -> int:
@@ -251,6 +272,7 @@ class LocalExecutor:
             pre_batch=_pre,
             post_group=self._post_step_hooks,
             dispatch_ctx=lambda: self._timing.record("batch_process"),
+            canonical_rows=self._canonical_rows,
         )
 
     def _post_step_hooks(self):
@@ -288,21 +310,19 @@ class LocalExecutor:
                 self._eval_reader, task, Modes.EVALUATION
             ):
                 n = _batch_size(labels)
-                outputs, _padded_loss = self._trainer.eval_step(
-                    self._place(features), self._place(labels)
+                # mask-weighted in-step loss: exact over the REAL rows,
+                # so no host-side loss recompute is needed — and the
+                # canonical shape means the eval program compiles once
+                outputs, loss = self._trainer.eval_step(
+                    self._place_canonical(features),
+                    self._place_canonical(labels),
+                    self._trainer.place_mask(n, self._canonical_rows),
                 )
                 outputs = trim_pad(jax.device_get(outputs), n)
                 metrics_lib.update_metric_tree(
                     eval_metrics, np.asarray(labels), outputs
                 )
-                # exact loss over the REAL rows (the in-step loss would
-                # count the rows pad_batch added for shard divisibility)
-                loss_mean.update_value(
-                    float(
-                        np.asarray(self._spec.loss(labels, outputs))
-                    ),
-                    n,
-                )
+                loss_mean.update_value(float(jax.device_get(loss)), n)
             dispatcher.report(tid, True)
         results = metrics_lib.metric_tree_results(eval_metrics)
         results["loss"] = loss_mean.result()
@@ -328,7 +348,9 @@ class LocalExecutor:
             ):
                 self._ensure_trainer(features)
                 n = _batch_size(features)
-                outputs = self._trainer.predict_step(self._place(features))
+                outputs = self._trainer.predict_step(
+                    self._place_canonical(features)
+                )
                 processed = trim_pad(jax.device_get(outputs), n)
                 if self._spec.prediction_outputs_processor is not None:
                     self._spec.prediction_outputs_processor.process(

@@ -27,6 +27,17 @@ def _batch_size(tree) -> int:
     return int(np.shape(leaves[0])[0]) if leaves else 0
 
 
+def canonical_batch_rows(minibatch_size: int, divisor: int) -> int:
+    """THE canonical per-step batch shape (shape-canonical batching,
+    docs/designs/shape_canonicalization.md): ``minibatch_size`` rounded
+    up to the mesh's batch divisor, so one padded-and-masked shape
+    serves full batches, ragged tails AND shard divisibility — the
+    jitted step compiles once per step kind instead of once per tail
+    length."""
+    div = max(1, int(divisor))
+    return max(div, -(-int(minibatch_size) // div) * div)
+
+
 class PreStacked:
     """A ready-made dispatch group: ``(k, B, ...)`` feature/label trees
     (typically zero-copy reshapes of a decode window —
@@ -108,6 +119,28 @@ def measured_dispatch_overhead() -> float:
         return _DISPATCH_OVERHEAD[0]
 
 
+def warm_dispatch_overhead_async():
+    """Warm the per-process dispatch-overhead cache on a background
+    thread, so the first ``'auto'`` sizing (on the TaskPrefetcher's
+    producer thread) finds the probe already measured instead of paying
+    its compile + 3 round trips on the first dispatch's critical path.
+    Runtimes call this at BUILD time — before data flows — so the probe
+    normally finishes while the host is otherwise reading its first
+    shard; if a trainer-build compile does overlap the tail of the
+    probe, best-of-3 sheds most of the contention (the same exposure
+    the old on-demand probe had on the producer thread).  A no-op once
+    the cache is hot."""
+    if _DISPATCH_OVERHEAD[0] is not None:
+        return None
+    thread = threading.Thread(
+        target=measured_dispatch_overhead,
+        name="dispatch-probe-warm",
+        daemon=True,
+    )
+    thread.start()
+    return thread
+
+
 def auto_steps_per_dispatch(
     batch_bytes: int, dispatch_overhead_secs: float
 ) -> int:
@@ -184,6 +217,7 @@ def run_stacked_steps(
     post_group: Callable | None = None,
     dispatch_ctx: Callable | None = None,
     deterministic_auto: bool = False,
+    canonical_rows: int | None = None,
 ) -> int:
     """Drive ``batches`` of ``(features, labels)`` through the trainer in
     groups of ``k`` steps per dispatch; returns records processed.
@@ -191,17 +225,74 @@ def run_stacked_steps(
     ``get_trainer``: called lazily (the runtimes create their trainer on
     the first batch — ``pre_batch`` is where that happens).
     ``pre_batch(features)``: per incoming batch (ensure-trainer,
-    profiler hooks).  ``post_group()``: after every dispatch (milestone
-    hooks run at dispatch granularity, deviation D9a).
+    profiler hooks).  ``post_group()``: after every dispatch group
+    (milestone hooks run at dispatch granularity, deviation D9a).
     ``dispatch_ctx()``: context manager wrapping each device dispatch
     (timing buckets).
+
+    ``canonical_rows`` (the runtimes pass
+    :func:`canonical_batch_rows`): SHAPE-CANONICAL mode — every batch is
+    padded to that fixed row count with a per-row zero/one weight mask
+    threaded through the jitted step, so a task's ragged tail batch is
+    just another masked group member instead of a new input shape.  The
+    group never flushes on a shape change, the program cache holds
+    exactly two entries (the weighted step + one scan-k variant), and in
+    lockstep worlds every process dispatches identical shapes by
+    construction — a tail shape disagreement can no longer deadlock the
+    collectives.  A trailing partial group (fewer than k leftovers) runs
+    its members through the already-compiled single-step program rather
+    than compiling a third scan length.  ``None`` preserves the legacy
+    pad-to-divisor behavior (tails flush the group early).
     """
     ctx = dispatch_ctx or contextlib.nullcontext
     group: list = []
     first_shape = None
     processed = 0
+    canonical = canonical_rows is not None
 
-    def _flush():
+    def _flush_canonical():
+        nonlocal processed
+        if not group:
+            return
+        trainer = get_trainer()
+        padded = [
+            (
+                trainer.pad_to(f, canonical_rows),
+                trainer.pad_to(l, canonical_rows),
+                trainer.row_mask(n, canonical_rows),
+            )
+            for f, l, n in group
+        ]
+        if len(padded) >= 2 and len(padded) == k:
+            stacked_f = jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs), *[p[0] for p in padded]
+            )
+            stacked_l = jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs), *[p[1] for p in padded]
+            )
+            stacked_w = np.stack([p[2] for p in padded])
+            with ctx():
+                trainer.train_steps_stacked(
+                    trainer.place_stacked(stacked_f),
+                    trainer.place_stacked(stacked_l),
+                    trainer.place_stacked(stacked_w),
+                )
+        else:
+            # trailing partial group: k' single weighted steps through
+            # the one compiled program — never a scan-k' compile
+            for features, labels, mask in padded:
+                with ctx():
+                    trainer.train_step(
+                        trainer.place_batch(features),
+                        trainer.place_batch(labels),
+                        trainer.place_batch(mask),
+                    )
+        processed += sum(n for _f, _l, n in group)
+        group.clear()
+        if post_group is not None:
+            post_group()
+
+    def _flush_legacy():
         nonlocal processed
         if not group:
             return
@@ -235,10 +326,12 @@ def run_stacked_steps(
         if post_group is not None:
             post_group()
 
+    _flush = _flush_canonical if canonical else _flush_legacy
+
     for item in batches:
         if isinstance(item, PreStacked):
-            # a ready-made group: flush any pending plain batches (it
-            # may precede a ragged tail), then dispatch directly
+            # a ready-made group: flush any pending plain batches (they
+            # must dispatch in stream order), then dispatch directly
             _flush()
             first_shape = None
             if pre_batch is not None:
@@ -248,10 +341,22 @@ def run_stacked_steps(
                     pre_batch(item.sample_features)
             trainer = get_trainer()
             with ctx():
-                trainer.train_steps_stacked(
-                    trainer.place_stacked(item.features),
-                    trainer.place_stacked(item.labels),
-                )
+                if canonical:
+                    # PreStacked groups hold full batches only — an
+                    # all-ones mask keeps the ONE weighted scan shape
+                    leaf = jax.tree_util.tree_leaves(item.features)[0]
+                    trainer.train_steps_stacked(
+                        trainer.place_stacked(item.features),
+                        trainer.place_stacked(item.labels),
+                        trainer.place_stacked(
+                            np.ones(leaf.shape[:2], np.float32)
+                        ),
+                    )
+                else:
+                    trainer.train_steps_stacked(
+                        trainer.place_stacked(item.features),
+                        trainer.place_stacked(item.labels),
+                    )
             processed += item.num_records
             if post_group is not None:
                 post_group()
@@ -263,14 +368,17 @@ def run_stacked_steps(
             k = resolve_steps_per_dispatch(
                 k, (features, labels), deterministic=deterministic_auto
             )
-        shape = jax.tree_util.tree_leaves(features)[0].shape
-        if first_shape is None:
-            first_shape = shape
-        if shape != first_shape:
-            # ragged tail batch: flush the group, start a fresh one
-            _flush()
-            first_shape = shape
-        group.append((features, labels))
+        if canonical:
+            group.append((features, labels, _batch_size(labels)))
+        else:
+            shape = jax.tree_util.tree_leaves(features)[0].shape
+            if first_shape is None:
+                first_shape = shape
+            if shape != first_shape:
+                # ragged tail batch: flush the group, start a fresh one
+                _flush()
+                first_shape = shape
+            group.append((features, labels))
         if len(group) == k:
             _flush()
             first_shape = None

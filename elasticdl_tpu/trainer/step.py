@@ -61,6 +61,40 @@ def _cast_floats(tree, dtype):
     )
 
 
+def weighted_mean_loss(loss_fn, labels, outputs, weights):
+    """``sum(w_i * loss_i) / sum(w_i)`` with per-row losses obtained by
+    vmapping ``loss_fn`` over singleton batches.
+
+    This is THE mask semantics of shape-canonical batching
+    (docs/designs/shape_canonicalization.md): rows with weight 0 (the
+    padding ``pad_to`` appends to reach the canonical batch shape)
+    contribute exactly zero to THIS loss and therefore exactly zero
+    gradient through it — unlike the old repeat-last-row padding, which
+    silently over-weighted the repeated row.  For a ``loss_fn`` that is
+    a mean of independent per-row terms (every zoo loss is), an all-ones
+    weight vector reproduces ``loss_fn(labels, outputs)`` exactly up to
+    reduction order.
+
+    Scope: the exactness claim covers the primary loss path only.
+    Batch-composition-dependent terms — sown auxiliary losses (MoE load
+    balancing, regularizers; added to the total in ``forward_loss``) and
+    batch statistics (BatchNorm) — still observe the padded fill rows of
+    a tail batch, as they did under the legacy divisor padding (the
+    canonical shape pads further; see the design doc's limits section).
+    """
+
+    def one_row(labels_row, outputs_row):
+        labels_1 = jax.tree_util.tree_map(lambda x: x[None], labels_row)
+        outputs_1 = jax.tree_util.tree_map(lambda x: x[None], outputs_row)
+        return loss_fn(labels_1, outputs_1)
+
+    per_row = jax.vmap(one_row)(labels, outputs)
+    weights = weights.astype(per_row.dtype)
+    # max(sum, 1) guards the (never-dispatched) all-zero mask; a real
+    # dispatch always carries >= 1 real row
+    return jnp.sum(weights * per_row) / jnp.maximum(jnp.sum(weights), 1.0)
+
+
 def build_train_step(
     loss_fn: Callable,
     compute_dtype=None,
@@ -70,9 +104,15 @@ def build_train_step(
     state_shardings=None,
     device_parse: Callable | None = None,
 ) -> Callable:
-    """Build ``(state, features, labels) -> (state, step_metrics)``.
+    """Build ``(state, features, labels[, weights]) -> (state, step_metrics)``.
 
     loss_fn: the model module's ``loss(labels, predictions)``.
+    weights: optional per-row ``(batch,)`` sample weights — the loss
+        becomes :func:`weighted_mean_loss`, so rows canonical-shape
+        padding appended (weight 0) contribute zero gradient.  Omitting
+        it (``None``) keeps the reference semantics bit-for-bit; the two
+        call patterns are distinct jit cache entries, and the runtimes
+        always pass a weight vector so they hold exactly one.
     compute_dtype: cast float inputs (e.g. bfloat16) before the forward;
         parameters and optimizer state stay float32 (mixed precision on the
         MXU without loss-scale bookkeeping, since bf16 keeps fp32 range).
@@ -89,12 +129,15 @@ def build_train_step(
         -> f32/255), so the host->device transfer ships the small form.
     """
 
-    def forward_loss(params, state, features, labels):
+    def forward_loss(params, state, features, labels, weights):
         if device_parse is not None:
             features = device_parse(features)
         features = _cast_floats(features, compute_dtype)
         outputs, new_model_state = _apply(state, params, features, True)
-        loss = loss_fn(labels, outputs)
+        if weights is None:
+            loss = loss_fn(labels, outputs)
+        else:
+            loss = weighted_mean_loss(loss_fn, labels, outputs, weights)
         # layer-contributed losses (MoE load balancing, regularizers):
         # any value sown into the "losses" collection joins the training
         # loss — the reference adds Keras model reg losses the same way
@@ -110,10 +153,10 @@ def build_train_step(
             forward_loss, static_argnums=(), policy=None
         )
 
-    def train_step(state: TrainState, features, labels):
+    def train_step(state: TrainState, features, labels, weights=None):
         grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
         (loss, (_, new_model_state)), grads = grad_fn(
-            state.params, state, features, labels
+            state.params, state, features, labels, weights
         )
         if extra_grad_fn is not None:
             grads = extra_grad_fn(grads, state)
@@ -135,20 +178,26 @@ def build_eval_step(
     loss_fn: Callable | None = None,
     device_parse: Callable | None = None,
 ) -> Callable:
-    """Build ``(state, features, labels) -> outputs_or_(outputs, loss)``.
+    """Build ``(state, features, labels[, weights]) ->
+    outputs_or_(outputs, loss)``.
 
     Outputs are returned to the host and reported to the master for metric
     accumulation (reference worker.py:552-565 report_evaluation_metrics) —
-    metrics themselves never run on device.
+    metrics themselves never run on device.  With per-row ``weights`` the
+    returned loss is :func:`weighted_mean_loss` — exact over the REAL
+    rows of a canonical-shape batch, so callers need no host-side loss
+    recompute for padded tails.
     """
 
-    def eval_step(state: TrainState, features, labels):
+    def eval_step(state: TrainState, features, labels, weights=None):
         if device_parse is not None:
             features = device_parse(features)
         outputs, _ = _apply(state, state.params, features, False)
         if loss_fn is None:
             return outputs
-        return outputs, loss_fn(labels, outputs)
+        if weights is None:
+            return outputs, loss_fn(labels, outputs)
+        return outputs, weighted_mean_loss(loss_fn, labels, outputs, weights)
 
     return jax.jit(eval_step)
 

@@ -17,12 +17,15 @@ no host-to-host data transfer, identical global batches to a
 single-process run, and any process can be lost without losing data (the
 task re-queues).
 
-Per-task batching: each task's records are batched independently (the
-final short batch padded), so the number of steps per task is a pure
-function of the task — every process agrees on it without communication.
-This deviates from the task-stream Worker's batches-straddle-tasks
-pipelining (task_data_service.py), trading a few padded rows for a
-communication-free lockstep schedule.
+Per-task batching: each task's records are batched independently, every
+batch padded to ONE canonical shape with a per-row weight mask (padded
+rows contribute exactly zero gradient — ``trainer/stacking.py``), so the
+number of steps per task AND the shape of every dispatch are pure
+functions of the task — every process agrees on both without
+communication, and a ragged tail can neither recompile the step nor
+desync the collectives.  This deviates from the task-stream Worker's
+batches-straddle-tasks pipelining (task_data_service.py), trading a few
+zero-weighted rows for a communication-free lockstep schedule.
 """
 
 from __future__ import annotations
@@ -104,6 +107,17 @@ class LockstepWorker:
         )
         self._trainer: SPMDTrainer | None = None
         self._stopped = False
+        # shape-canonical batching: one dispatch shape per step kind, a
+        # pure function of (minibatch_size, mesh) — identical on every
+        # process, so the lockstep schedule AND shapes agree by
+        # construction (a tail shape disagreement was a collective-
+        # deadlock hazard)
+        from elasticdl_tpu.parallel.mesh import batch_divisor
+        from elasticdl_tpu.trainer.stacking import canonical_batch_rows
+
+        self._canonical_rows = canonical_batch_rows(
+            self._minibatch_size, batch_divisor(self._mesh)
+        )
         # deterministic fault injection (chaos subsystem): a no-op unless
         # the master exported a plan into this process's environment
         from elasticdl_tpu.chaos import hooks as chaos_hooks
@@ -122,6 +136,12 @@ class LockstepWorker:
             process_id=self._process_id,
             generation=self._cluster_version,
         )
+        # process-wide compile counter; the chief ships deltas to the
+        # master as a `compile_count` exec counter with task reports
+        from elasticdl_tpu.telemetry import compile_tracker
+
+        compile_tracker.install()
+        self._compile_deltas = compile_tracker.ExecCounterReporter()
         # span tracer (worker/main.py installs it for subprocess entry;
         # in-process harnesses construct the worker directly, so make
         # install idempotent here with the same world identity)
@@ -195,6 +215,11 @@ class LockstepWorker:
             # chief's buckets; training reports only (same gating as the
             # task-stream Worker so eval/save never absorb train time)
             counters.update(self._timing.exec_counters())
+        # compile DELTA since the last SUCCESSFUL report (every report
+        # kind — eval/predict compiles count too): the master's
+        # elasticdl_compile_total mirror sums these, so a mid-task
+        # recompile shows up on /metrics within one task report
+        compile_mark = self._compile_deltas.attach(counters)
         from elasticdl_tpu.telemetry.tracing import SPAN_REPORT_TASK
 
         t0 = time.monotonic()
@@ -206,6 +231,7 @@ class LockstepWorker:
                 trace=dict(trace or {}),
             )
         )
+        self._compile_deltas.commit(compile_mark)
         tracer = self._tracing.get_tracer()
         if tracer is not None:
             tracer.record_span(
@@ -354,7 +380,7 @@ class LockstepWorker:
         )
 
     def _place(self, tree):
-        return self._trainer.place_padded(tree)
+        return self._trainer.place_canonical(tree, self._canonical_rows)
 
     # ---- task execution ----------------------------------------------------
 
@@ -416,6 +442,7 @@ class LockstepWorker:
                 # deadlocks the collectives): byte rule only, no
                 # per-process wall-clock probe
                 deterministic_auto=True,
+                canonical_rows=self._canonical_rows,
             )
         self._report_task_result(
             task.task_id, include_timing=True, trace=task.trace
@@ -471,7 +498,9 @@ class LockstepWorker:
                 self._ensure_trainer(features)
                 n = _batch_len(labels)
                 outputs, _ = self._trainer.eval_step(
-                    self._place(features), self._place(labels)
+                    self._place(features),
+                    self._place(labels),
+                    self._trainer.place_mask(n, self._canonical_rows),
                 )
                 # collective gather so the chief holds full outputs, in
                 # global batch order (matches the labels read host-side)

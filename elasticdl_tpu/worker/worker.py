@@ -120,6 +120,26 @@ class Worker:
         )
         self._trainer: SPMDTrainer | None = None
         self._eval_metrics = None
+        # shape-canonical batching: one fixed dispatch shape per step
+        # kind, so ragged tails reuse the compiled program (mask-
+        # weighted; trainer/stacking.py) — plus the process-wide compile
+        # counter that makes the guarantee observable
+        from elasticdl_tpu.parallel.mesh import batch_divisor
+        from elasticdl_tpu.telemetry import compile_tracker
+        from elasticdl_tpu.trainer.stacking import (
+            canonical_batch_rows,
+            warm_dispatch_overhead_async,
+        )
+
+        compile_tracker.install()
+        self._compile_deltas = compile_tracker.ExecCounterReporter()
+        self._canonical_rows = canonical_batch_rows(
+            self._minibatch_size, batch_divisor(self._mesh)
+        )
+        if getattr(args, "steps_per_dispatch", 1) == "auto":
+            # measure the link overhead off the first dispatch's
+            # critical path (feeds the pipeline's auto-k sizing)
+            warm_dispatch_overhead_async()
         # periodic checkpointing (reference ps/servicer.py:216-231 — the
         # PS saved its shard; here the worker saves, sharding-aware)
         self._checkpointer = PeriodicCheckpointer(
@@ -162,6 +182,11 @@ class Worker:
             # stream opts in, so eval/save reports never absorb leftover
             # training buckets
             counters.update(self._timing.exec_counters())
+        # compile DELTA since the last SUCCESSFUL report (every report
+        # kind — eval/predict compiles count too), mirrored onto the
+        # master's elasticdl_compile_total; the shared reporter advances
+        # its watermark only after the RPC returns
+        compile_mark = self._compile_deltas.attach(counters)
         trace = self._task_traces.pop(task_id, None)
         t0 = time.monotonic()
         self._master.report_task_result(
@@ -172,6 +197,7 @@ class Worker:
                 trace=dict(trace or {}),
             )
         )
+        self._compile_deltas.commit(compile_mark)
         tracer = self._tracing.get_tracer()
         if tracer is not None:
             from elasticdl_tpu.telemetry.tracing import SPAN_REPORT_TASK
@@ -264,7 +290,7 @@ class Worker:
     # ---- minibatch processing ----------------------------------------------
 
     def _place(self, tree):
-        return self._trainer.place_padded(tree)
+        return self._trainer.place_canonical(tree, self._canonical_rows)
 
     def _process_minibatch(self, task_type, features, labels):
         """One minibatch with retry (reference worker.py:800-840; retries
@@ -284,8 +310,11 @@ class Worker:
 
                     record_step_span(int(self._trainer.step))
                     self._timing.start_record_time("batch_process")
+                    n = _batch_len(labels)
                     self._trainer.train_step(
-                        self._place(features), self._place(labels)
+                        self._place(features),
+                        self._place(labels),
+                        self._trainer.place_mask(n, self._canonical_rows),
                     )
                     self._timing.end_record_time("batch_process")
                 elif task_type == int(TaskType.PREDICTION):
@@ -486,9 +515,16 @@ class Worker:
 
                 record_step_span(int(self._trainer.step))
                 self._timing.start_record_time("batch_process")
+                # all-ones mask: PreStacked groups hold only full
+                # batches, and the weights keep the ONE weighted scan
+                # shape shared with canonical plain groups
+                leaf = jax.tree_util.tree_leaves(group.features)[0]
                 self._trainer.train_steps_stacked(
                     self._trainer.place_stacked(group.features),
                     self._trainer.place_stacked(group.labels),
+                    self._trainer.place_stacked(
+                        np.ones(leaf.shape[:2], np.float32)
+                    ),
                 )
                 self._timing.end_record_time("batch_process")
                 return ""
@@ -560,7 +596,9 @@ class Worker:
                     self._ensure_trainer(features)
                     n = _batch_len(labels)
                     outputs, _ = self._trainer.eval_step(
-                        self._place(features), self._place(labels)
+                        self._place(features),
+                        self._place(labels),
+                        self._trainer.place_mask(n, self._canonical_rows),
                     )
                     all_outputs.append(trim_pad(jax.device_get(outputs), n))
                     all_labels.append(np.asarray(labels))

@@ -45,16 +45,20 @@ EVENT_CONST = re.compile(r"^EVENT_\w+\s*=\s*[\"']([^\"']+)[\"']", re.M)
 SPAN_CONST = re.compile(r"^SPAN_\w+\s*=\s*[\"']([^\"']+)[\"']", re.M)
 BARE_PRINT = re.compile(r"^\s*print\(")
 
-# the replication subsystem's vocabulary (ISSUE 4): each name must have
-# exactly ONE definition site in the shared constants, so the event
-# schema, the span schema and the analyzers can never drift — a
-# replica_* name used anywhere outside these lists is a lint error
+# the replication subsystem's vocabulary (ISSUE 4) plus the compile
+# span shape-canonical batching relies on (ISSUE 5): each name must
+# have exactly ONE definition site in the shared constants, so the
+# event schema, the span schema and the analyzers can never drift
 REQUIRED_EVENT_NAMES = frozenset(
     {"replica_push", "replica_restore", "replica_harvest"}
 )
 REQUIRED_SPAN_NAMES = frozenset(
-    {"replica_push", "replica_restore", "replica_harvest"}
+    {"replica_push", "replica_restore", "replica_harvest", "compile"}
 )
+# metric families other tooling depends on (the compile-count regression
+# gate scrapes elasticdl_compile_total): must be registered somewhere,
+# at exactly one site (the single-site rule above)
+REQUIRED_METRIC_NAMES = frozenset({"elasticdl_compile_total"})
 
 # CLI entry points whose stdout IS their product (reports, dataset
 # paths); everything else logs
@@ -122,6 +126,12 @@ def main() -> int:
                     f"sites ({', '.join(where)}); hoist it into a shared "
                     "constant with one definition site"
                 )
+
+    for name in sorted(REQUIRED_METRIC_NAMES - set(metric_sites)):
+        errors.append(
+            f"required metric {name!r} is not registered anywhere "
+            "(compile-count regression gate contract)"
+        )
 
     const_counts = {}
     for rel_path, pattern, label, required in (
