@@ -48,8 +48,18 @@ _REPLICA_RECOVERABLE_KINDS = frozenset(
 )
 
 # deliberate-corruption modes: prove the checker catches what it claims
-# to catch (a checker that cannot fail is not a checker)
-CORRUPTIONS = ("", "double_report", "lose_task", "version_rollback")
+# to catch (a checker that cannot fail is not a checker).
+# ``journal_rollback`` forges a DECREASING generation-record pair into
+# the control-plane journal between master lives — the master_recovery
+# invariant must flag the fence rollback (replay's monotone guard keeps
+# the run itself alive, so the trip is the checker's, not the job's).
+CORRUPTIONS = (
+    "",
+    "double_report",
+    "lose_task",
+    "version_rollback",
+    "journal_rollback",
+)
 
 
 @dataclass
@@ -76,6 +86,13 @@ class ChaosJobConfig:
     # so the re-formed world hot-restores without a disk read
     replication: bool = False
     replication_steps: int = 0  # 0 = every task boundary
+    # master high availability: journal the control plane so MASTER_KILL
+    # faults can relaunch the master from it (workers re-home instead of
+    # dying with it).  Standbys are disabled in HA runs: a killed
+    # master's warm pool would outlive it as orphans the relaunched
+    # master cannot drain.
+    master_ha: bool = False
+    rehome_grace_secs: float = 5.0
 
 
 def _master_args(config: ChaosJobConfig, train_dir: str, ckpt_dir: str):
@@ -132,6 +149,18 @@ def _master_args(config: ChaosJobConfig, train_dir: str, ckpt_dir: str):
                     str(config.replication_steps),
                 ]
                 if config.replication
+                else []
+            ),
+            *(
+                [
+                    "--master_journal_dir",
+                    os.path.join(config.workdir, "journal"),
+                    "--rehome_grace_secs",
+                    str(config.rehome_grace_secs),
+                    "--standby_workers",
+                    "0",
+                ]
+                if config.master_ha
                 else []
             ),
             *config.extra_master_args,
@@ -202,10 +231,23 @@ class _CapacityDriver(threading.Thread):
     master-observed model version and re-form the world at the new
     size."""
 
-    def __init__(self, master, plan: FaultPlan, events_path: str):
+    def __init__(
+        self,
+        master,
+        plan: FaultPlan,
+        events_path: str,
+        fired: set | None = None,
+    ):
         super().__init__(name="chaos-capacity-driver", daemon=True)
         self._master = master
-        self._pending = list(plan.master_faults())
+        # `fired` is shared across master lives: the journal-restored
+        # model version is already past an executed fault's at_step, so
+        # without it every capacity fault would re-fire after a
+        # MASTER_KILL relaunch
+        self._fired = fired if fired is not None else set()
+        self._pending = [
+            f for f in plan.master_faults() if f.fault_id not in self._fired
+        ]
         self._events_path = events_path
         self._stop = threading.Event()
 
@@ -232,6 +274,7 @@ class _CapacityDriver(threading.Thread):
             # faults would be logged as executed
             fault = due[0]
             self._pending.remove(fault)
+            self._fired.add(fault.fault_id)
             if fault.kind == FaultKind.REDUCE_CAPACITY:
                 im.set_world_size(im.world_size - fault.count)
             else:
@@ -266,6 +309,159 @@ class _CapacityDriver(threading.Thread):
                 "monotonic": time.monotonic(),
             },
         )
+
+
+class _MasterKillWatcher(threading.Thread):
+    """Arms a step-triggered MASTER_KILL: when the master-observed model
+    version reaches the fault's ``at_step``, ask the run loop to die at
+    its next tick (reform-triggered kills are armed up front via
+    ``request_crash("reform")`` and need no watcher)."""
+
+    def __init__(self, master, fault):
+        super().__init__(name="chaos-master-kill-watcher", daemon=True)
+        self._master = master
+        self._fault = fault
+        self._stop = threading.Event()
+
+    def stop(self):
+        self._stop.set()
+
+    def run(self):
+        while not self._stop.is_set():
+            version = self._master.servicer.get_model_version()
+            if version >= self._fault.at_step:
+                logger.warning(
+                    "CHAOS arming master kill %s at version %d",
+                    self._fault.fault_id,
+                    version,
+                )
+                self._master.request_crash("tick")
+                return
+            self._stop.wait(0.1)
+
+
+def _record_master_kill(events_path: str, fault, crashed_at: float):
+    """MASTER_KILL firings are recorded by the harness (the victim IS
+    the process that owns the event log machinery), stamped with the
+    master's own crash time so downtime metrics are exact."""
+    chaos_hooks.append_event(
+        events_path,
+        {
+            "fault_id": fault.fault_id,
+            "kind": fault.kind,
+            "process_id": None,
+            "trigger": fault.trigger,
+            "time": time.time(),
+            "monotonic": crashed_at,
+        },
+        fsync=True,
+    )
+
+
+def _corrupt_journal_rollback(journal_dir: str):
+    """``--corrupt journal_rollback``: forge a decreasing generation
+    pair into the journal between master lives.  Replay's monotone
+    guard absorbs it (the job must still complete); the master_recovery
+    invariant must still FLAG the rolled-back fence record."""
+    from elasticdl_tpu.master.journal import journal_path
+
+    with open(journal_path(journal_dir), "a", encoding="utf-8") as f:
+        for version in (1, 0):
+            f.write(
+                json.dumps(
+                    {
+                        "seq": 10**9,
+                        "kind": "generation",
+                        "cluster_version": version,
+                        "time": time.time(),
+                        "monotonic": time.monotonic(),
+                        "forged": True,
+                    }
+                )
+                + "\n"
+            )
+
+
+def _check_master_recovery(
+    config: ChaosJobConfig,
+    telemetry_dir: str,
+    master_lives: int,
+    events: list | None = None,
+) -> dict | None:
+    """The master-HA contract under a MASTER_KILL: the relaunched
+    master must have restored from the journal (a ``master_restart``
+    event per extra life), and the journal's generation-fence records
+    must be monotone — a rolled-back fence would let a restored master
+    resurrect a fenced generation."""
+    kills = config.plan.master_kill_faults()
+    if not kills or not config.master_ha:
+        return None
+    from elasticdl_tpu.master.journal import journal_path
+    from elasticdl_tpu.telemetry.events import (
+        EVENT_MASTER_RESTART,
+        EVENTS_FILENAME,
+        read_jsonl,
+    )
+
+    violations = []
+    if events is None:
+        events = read_jsonl(os.path.join(telemetry_dir, EVENTS_FILENAME))
+    restarts = [
+        e for e in events if e.get("event") == EVENT_MASTER_RESTART
+    ]
+    # realization first: the plan's kills must actually have fired —
+    # deriving expected_restarts from the observed life count alone
+    # would let a never-triggered MASTER_KILL (at_step beyond the job,
+    # or a lost race with completion) pass this invariant vacuously
+    if master_lives - 1 < len(kills):
+        violations.append(
+            f"plan demands {len(kills)} master kill(s) but only "
+            f"{master_lives - 1} fired — the MASTER_KILL fault was "
+            "never realized"
+        )
+    expected_restarts = master_lives - 1
+    if len(restarts) < expected_restarts:
+        violations.append(
+            f"{expected_restarts} master relaunch(es) but only "
+            f"{len(restarts)} master_restart event(s) — a relaunched "
+            "master did not restore from the journal"
+        )
+    records = read_jsonl(
+        journal_path(os.path.join(config.workdir, "journal"))
+    )
+    if not records:
+        violations.append("control-plane journal is empty or unreadable")
+    fences = [
+        int(r["cluster_version"])
+        for r in records
+        if r.get("kind") == "generation"
+    ]
+    for prev, nxt in zip(fences, fences[1:]):
+        if nxt < prev:
+            violations.append(
+                f"journal generation fence rolled back: {nxt} recorded "
+                f"after {prev} — a restored master could resurrect a "
+                "fenced generation"
+            )
+    return {
+        "name": "master_recovery",
+        "status": "FAIL" if violations else "PASS",
+        "violations": violations,
+    }
+
+
+def _master_ha_stats(
+    telemetry_dir: str, events: list | None = None
+) -> dict | None:
+    """Master-downtime stats from the run's telemetry event log — the
+    SAME aggregation ``telemetry.report`` embeds, so
+    ``chaos_result.json`` and the report can never disagree on schema."""
+    from elasticdl_tpu.telemetry.events import EVENTS_FILENAME, read_jsonl
+    from elasticdl_tpu.telemetry.report import master_ha_section
+
+    if events is None:
+        events = read_jsonl(os.path.join(telemetry_dir, EVENTS_FILENAME))
+    return master_ha_section(events)
 
 
 def _read_events(path: str) -> tuple[list[dict], list[dict]]:
@@ -378,6 +574,11 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
     shutil.rmtree(
         os.path.join(config.workdir, "telemetry"), ignore_errors=True
     )
+    # and for the control-plane journal: a stale journal would make the
+    # FIRST master of this run restore a previous run's dispatch state
+    shutil.rmtree(
+        os.path.join(config.workdir, "journal"), ignore_errors=True
+    )
 
     train = synthetic.gen_mnist(
         os.path.join(config.workdir, "train"),
@@ -391,29 +592,92 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
     expected_records = config.num_epochs * config.num_records
     checker = InvariantChecker(expected_records=expected_records)
 
-    master = build_master(args)
-    master.task_d.add_observer(checker)
-    master.servicer.add_version_observer(checker.on_version_report)
-    master.reform_callbacks.append(checker.on_reform)
-    _install_corruption(master, checker, config.corrupt)
+    from elasticdl_tpu.master.master import SimulatedMasterCrash
 
-    driver = _CapacityDriver(master, config.plan, events_path)
-    master.prepare()
-    rc: list[int] = []
-    runner = threading.Thread(
-        target=lambda: rc.append(master.run()), name="chaos-master-run"
-    )
+    kills = config.plan.master_kill_faults()
+    if kills and not config.master_ha:
+        # refuse rather than silently drop the kills: the run would
+        # complete green with the plan's MASTER_KILL never armed and no
+        # invariant recording the unrealized fault
+        raise ValueError(
+            f"plan {config.plan.name!r} contains MASTER_KILL faults "
+            "but master_ha is off — enable ChaosJobConfig.master_ha "
+            "(the runner does this for the master_kill_* plans)"
+        )
+    if config.corrupt == "journal_rollback" and not kills:
+        # the forgery happens between master lives; without a MASTER_KILL
+        # fault it would inject NOTHING and the "corrupted runs must exit
+        # non-zero" contract would silently pass green
+        raise ValueError(
+            "--corrupt journal_rollback requires a master_kill plan "
+            "with master HA enabled (the forgery lands between master "
+            "lives)"
+        )
     started_at = time.monotonic()
-    runner.start()
-    driver.start()
+    deadline = started_at + config.run_timeout_secs
+    reform_events: list[dict] = []
     timed_out = False
-    try:
-        runner.join(timeout=config.run_timeout_secs)
-        timed_out = runner.is_alive()
-    finally:
-        driver.stop()
-        master.request_stop()
-        runner.join(timeout=30)
+    rc: list[int] = []
+    life = 0
+    fired_capacity: set[str] = set()
+    while True:
+        master = build_master(args)
+        # the SAME checker spans every master life: task identity is the
+        # journaled uid, so the restored dispatcher's backlog replay
+        # dedups onto the pre-outage records instead of resetting them
+        master.task_d.add_observer(checker)
+        master.servicer.add_version_observer(checker.on_version_report)
+        master.reform_callbacks.append(checker.on_reform)
+        if life == 0:
+            _install_corruption(master, checker, config.corrupt)
+        kill = kills[life] if life < len(kills) else None
+        watcher = None
+        if kill is not None:
+            if kill.trigger == "reform":
+                master.request_crash("reform")
+            else:
+                watcher = _MasterKillWatcher(master, kill)
+        driver = _CapacityDriver(
+            master, config.plan, events_path, fired=fired_capacity
+        )
+        master.prepare()
+        crashed: list[bool] = []
+
+        def run_master(m=master):
+            try:
+                rc.append(m.run())
+            except SimulatedMasterCrash:
+                crashed.append(True)
+
+        runner = threading.Thread(
+            target=run_master, name=f"chaos-master-run-{life}"
+        )
+        runner.start()
+        driver.start()
+        if watcher is not None:
+            watcher.start()
+        try:
+            runner.join(timeout=max(1.0, deadline - time.monotonic()))
+            timed_out = runner.is_alive()
+        finally:
+            driver.stop()
+            if watcher is not None:
+                watcher.stop()
+            if timed_out or not crashed:
+                master.request_stop()
+                runner.join(timeout=30)
+        reform_events.extend(master.reform_events)
+        if crashed and not timed_out:
+            life += 1
+            _record_master_kill(events_path, kill, master.crashed_at)
+            if config.corrupt == "journal_rollback":
+                _corrupt_journal_rollback(
+                    os.path.join(config.workdir, "journal")
+                )
+            # the master-down window: workers retry/back off in here
+            time.sleep(kill.duration_secs or 2.0)
+            continue
+        break
 
     counters = master.task_d.counters(TaskType.TRAINING)
     fault_events, observations = _read_events(events_path)
@@ -432,10 +696,10 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
     reform = next(
         (
             e
-            for e in master.reform_events
+            for e in reform_events
             if kill_at is None or e["detected_at"] >= kill_at
         ),
-        master.reform_events[0] if master.reform_events else {},
+        reform_events[0] if reform_events else {},
     )
     pull_at = master.servicer.first_stream_pull_at()
     detect_secs = (
@@ -477,7 +741,7 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
         for f in config.plan.faults
         if f.cluster_version == 0 and f.kind in _KILL_KINDS
     ]
-    if gen0_kills and not master.reform_events:
+    if gen0_kills and not reform_events:
         fault_violations.append(
             "plan kills a generation-0 worker but no re-formation "
             "occurred"
@@ -487,7 +751,7 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
     # (or the run loop stop) before the reform runs.  Accept either the
     # matching chaos-reason reform or any reform at/after the firing
     # (a racing failure-reform coalesces the resize into itself).
-    reform_reasons = {e.get("reason") for e in master.reform_events}
+    reform_reasons = {e.get("reason") for e in reform_events}
     for event in fault_events:
         if event.get("kind") not in (
             FaultKind.REDUCE_CAPACITY,
@@ -496,7 +760,7 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
             continue
         realized = f"chaos:{event['fault_id']}" in reform_reasons or any(
             e["detected_at"] >= event["monotonic"] - 2.0
-            for e in master.reform_events
+            for e in reform_events
         )
         if not realized:
             fault_violations.append(
@@ -522,6 +786,30 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
         invariants["invariants"].append(lost_steps)
         if lost_steps["status"] == "FAIL":
             invariants["ok"] = False
+    # one shared parse of the (possibly multi-shard) telemetry event
+    # log for both HA consumers below
+    ha_events = None
+    if config.master_ha:
+        from elasticdl_tpu.telemetry.events import (
+            EVENTS_FILENAME,
+            read_jsonl,
+        )
+
+        ha_events = read_jsonl(
+            os.path.join(telemetry_dir, EVENTS_FILENAME)
+        )
+    master_recovery = _check_master_recovery(
+        config, telemetry_dir, master_lives=life + 1, events=ha_events
+    )
+    if master_recovery is not None:
+        invariants["invariants"].append(master_recovery)
+        if master_recovery["status"] == "FAIL":
+            invariants["ok"] = False
+    master_ha_stats = (
+        _master_ha_stats(telemetry_dir, events=ha_events)
+        if config.master_ha
+        else None
+    )
 
     report = {
         "plan": config.plan.name,
@@ -549,7 +837,7 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
                 for k, v in e.items()
                 if k != "detected_at"
             }
-            for e in master.reform_events
+            for e in reform_events
         ],
         "reform_latency_secs": round(reform.get("latency_secs", -1.0), 3),
         "detect_secs": detect_secs,
@@ -561,6 +849,10 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
     }
     if replication_stats is not None:
         report["replication"] = replication_stats
+    if master_ha_stats is not None:
+        report["master_ha"] = master_ha_stats
+    if config.master_ha:
+        report["master_lives"] = life + 1
     if not records_ok:
         report["total_records"] = counters.total_records
 

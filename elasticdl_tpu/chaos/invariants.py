@@ -7,10 +7,12 @@ after the job what elasticity promises during it:
 - **exactly_once** — every created TRAINING task completes successfully
   exactly once: a count of 0 is a LOST shard (records silently dropped
   from the gradient stream), >1 is a DOUBLE-TRAINED shard (records
-  double-counted).  Task identity is the Task *object* — the dispatcher
-  re-queues the same object on failure/reclaim, so retries of one shard
-  collapse onto one identity while each epoch's re-slicing creates
-  fresh ones.
+  double-counted).  Task identity is the dispatcher-assigned ``uid`` —
+  stable across lease/requeue cycles AND across a journaled master
+  restart (a restored master rebuilds equivalent Task objects, so the
+  object id cannot span the outage) — with ``id(task)`` as the
+  fallback for uid-less tasks; each epoch's re-slicing creates fresh
+  uids.
 - **records_accounted** — successful task record sums match the
   expected total (``num_epochs × dataset size``) when the caller knows
   it, and always match the dispatcher's own counters.
@@ -66,27 +68,41 @@ class InvariantChecker:
     def __init__(self, expected_records: int | None = None):
         self._lock = threading.Lock()
         self._expected_records = expected_records
-        # id(task) -> record; the task object is held here, so CPython
-        # cannot recycle the id while the checker is alive
+        # task key -> record; the task object is held here, so a
+        # fallback id(task) key cannot be recycled while the checker
+        # lives
         self._tasks: dict[int, _TaskRecord] = {}
         self._version_floor: dict[int, int] = {}  # worker -> last version
         self._max_version = 0
         self._reforms: list[dict] = []
         self._violations: list[Violation] = []
 
+    @staticmethod
+    def _key(task) -> int:
+        """uid when the dispatcher assigned one (stable across a master
+        restart), negated so the uid key space can never collide with
+        the id(task) fallback (CPython ids are positive)."""
+        uid = getattr(task, "uid", -1)
+        return -uid if uid > 0 else id(task)
+
     # ---- dispatcher observer ----------------------------------------------
 
     def on_tasks_created(self, tasks):
         with self._lock:
             for task in tasks:
-                if task.type == TaskType.TRAINING:
-                    self._tasks[id(task)] = _TaskRecord(
-                        task, task.num_records
-                    )
+                if task.type != TaskType.TRAINING:
+                    continue
+                key = self._key(task)
+                if key in self._tasks:
+                    # a journal-restored dispatcher replays its pending
+                    # backlog on observer re-attach: same uid = same
+                    # shard — keep the pre-outage history
+                    continue
+                self._tasks[key] = _TaskRecord(task, task.num_records)
 
     def on_task_leased(self, task_id: int, worker_id: int, task):
         with self._lock:
-            rec = self._tasks.get(id(task))
+            rec = self._tasks.get(self._key(task))
             if rec is not None:
                 rec.workers.append(worker_id)
 
@@ -96,7 +112,7 @@ class InvariantChecker:
         with self._lock:
             if task is None or not counted:
                 return
-            rec = self._tasks.get(id(task))
+            rec = self._tasks.get(self._key(task))
             if rec is None:
                 return
             if success:
@@ -106,7 +122,7 @@ class InvariantChecker:
 
     def on_task_reclaimed(self, task_id: int, task):
         with self._lock:
-            rec = self._tasks.get(id(task))
+            rec = self._tasks.get(self._key(task))
             if rec is not None:
                 rec.reclaims += 1
 

@@ -37,6 +37,10 @@ class FaultKind:
     # master-side
     REDUCE_CAPACITY = "reduce_capacity"  # shrink the world by `count`
     RESTORE_CAPACITY = "restore_capacity"  # back to full size
+    # kill the master process itself (SIGKILL semantics: no cleanup, no
+    # journal flush) and relaunch it from --master_journal_dir after
+    # `duration_secs` of downtime — the master-HA closure fault
+    MASTER_KILL = "master_kill"
 
     WORKER_SIDE = frozenset(
         {
@@ -48,7 +52,7 @@ class FaultKind:
             KILL_DURING_REPLICATION,
         }
     )
-    MASTER_SIDE = frozenset({REDUCE_CAPACITY, RESTORE_CAPACITY})
+    MASTER_SIDE = frozenset({REDUCE_CAPACITY, RESTORE_CAPACITY, MASTER_KILL})
     ALL = WORKER_SIDE | MASTER_SIDE
 
 
@@ -60,8 +64,15 @@ class Fault:
     on master-side faults); ``cluster_version`` is the world generation
     the fault belongs to; ``at_step`` is the model version that arms it.
     ``duration_secs`` bounds window faults (heartbeat drop, batch
-    delay); ``delay_ms`` is the per-batch sleep of DELAY_BATCHES;
-    ``count`` is the shrink amount of REDUCE_CAPACITY.
+    delay) and is the master-down window of MASTER_KILL; ``delay_ms``
+    is the per-batch sleep of DELAY_BATCHES; ``count`` is the shrink
+    amount of REDUCE_CAPACITY.
+
+    ``trigger`` arms MASTER_KILL: ``"step"`` fires when the
+    master-observed model version reaches ``at_step``; ``"reform"``
+    fires inside the NEXT re-formation, after the generation fence and
+    task recovery but before the relaunch — the nastiest window (the
+    fence is journaled, no new world exists).
     """
 
     kind: str
@@ -72,12 +83,18 @@ class Fault:
     duration_secs: float = 0.0
     delay_ms: float = 0.0
     count: int = 1
+    trigger: str = "step"
 
     def __post_init__(self):
         if self.kind not in FaultKind.ALL:
             raise ValueError(
                 f"unknown fault kind {self.kind!r}; valid: "
                 f"{sorted(FaultKind.ALL)}"
+            )
+        if self.trigger not in ("step", "reform"):
+            raise ValueError(
+                f"unknown fault trigger {self.trigger!r}; valid: "
+                "('step', 'reform')"
             )
 
 
@@ -122,7 +139,15 @@ class FaultPlan:
         return [f for f in self.faults if f.kind in FaultKind.WORKER_SIDE]
 
     def master_faults(self) -> list[Fault]:
-        return [f for f in self.faults if f.kind in FaultKind.MASTER_SIDE]
+        return [
+            f
+            for f in self.faults
+            if f.kind in FaultKind.MASTER_SIDE
+            and f.kind != FaultKind.MASTER_KILL
+        ]
+
+    def master_kill_faults(self) -> list[Fault]:
+        return [f for f in self.faults if f.kind == FaultKind.MASTER_KILL]
 
 
 # ---- built-in plans ---------------------------------------------------------
@@ -269,6 +294,43 @@ def builtin_plans(num_workers: int = 2) -> dict[str, FaultPlan]:
             "neighbor never receives it): the incomplete replica set "
             "must be skipped — restore from an older complete set or "
             "fall back to disk",
+        ),
+        "master_kill_mid_epoch": FaultPlan(
+            name="master_kill_mid_epoch",
+            faults=[
+                Fault(
+                    kind=FaultKind.MASTER_KILL,
+                    fault_id="master-kill-mid-epoch",
+                    at_step=_KILL_STEP,
+                    duration_secs=2.0,
+                )
+            ],
+            notes="SIGKILL the master mid-epoch (workers healthy): the "
+            "relaunched master must replay its journal, the workers "
+            "must re-home, and the job must complete with exactly-once "
+            "accounting spanning the outage",
+        ),
+        "master_kill_during_reform": FaultPlan(
+            name="master_kill_during_reform",
+            faults=[
+                Fault(
+                    kind=FaultKind.PREEMPT,
+                    fault_id="preempt-before-master-kill",
+                    at_step=_KILL_STEP,
+                    process_id=last,
+                ),
+                Fault(
+                    kind=FaultKind.MASTER_KILL,
+                    fault_id="master-kill-in-reform",
+                    trigger="reform",
+                    duration_secs=2.0,
+                ),
+            ],
+            notes="kill the master INSIDE the re-formation the "
+            "preemption caused (after the fence, before the relaunch): "
+            "the relaunched master owns a fenced, half-recovered world "
+            "— the journaled fence must hold and the job must still "
+            "complete",
         ),
         "shrink_then_restore": FaultPlan(
             name="shrink_then_restore",

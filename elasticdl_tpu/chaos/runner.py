@@ -43,6 +43,13 @@ REPLICATION_PLANS = frozenset(
     {"preempt_after_replication", "kill_during_replication"}
 )
 
+# plans that kill the master: they require the journaled-HA control
+# plane (--master_journal_dir), which the harness turns on for exactly
+# these — every other plan stays byte-identical to an HA-less run
+MASTER_HA_PLANS = frozenset(
+    {"master_kill_mid_epoch", "master_kill_during_reform"}
+)
+
 
 def build_arg_parser() -> argparse.ArgumentParser:
     from elasticdl_tpu.chaos.harness import CORRUPTIONS
@@ -129,6 +136,8 @@ def _run(args, workdir: str) -> dict:
             corrupt=args.corrupt,
             run_timeout_secs=args.run_timeout_secs,
             replication=replication,
+            master_ha=plan.name in MASTER_HA_PLANS
+            or bool(plan.master_kill_faults()),
         )
     )
     if args.baseline and not args.corrupt:
@@ -204,6 +213,11 @@ def write_result_json(report: dict, workdir: str) -> str:
     # shard versions, restores) ride into the same CI artifact
     if report.get("replication") is not None:
         result["replication"] = report["replication"]
+    # master-HA downtime stats (journal replay, re-homes, measured
+    # master-down gap) — the same section telemetry.report computes
+    if report.get("master_ha") is not None:
+        result["master_ha"] = report["master_ha"]
+        result["master_lives"] = report.get("master_lives")
     # causal-trace summary (reform phase breakdown + stragglers) so CI
     # reads the critical path from the same artifact as the verdicts
     try:
@@ -214,6 +228,13 @@ def write_result_json(report: dict, workdir: str) -> str:
             rel: {
                 "reform_downtime": run["reform_downtime"],
                 "recovered_task_spans": run["recovered_task_spans"],
+                # master-outage phase attribution, only when the run
+                # actually had one (HA-less artifacts stay unchanged)
+                **(
+                    {"master_outage": run["master_outage"]}
+                    if run.get("master_outage")
+                    else {}
+                ),
             }
             for rel, run in analysis["runs"].items()
         }

@@ -52,6 +52,33 @@ def build_master(args) -> Master:
             sample_rate = getattr(args, "trace_sample_rate", None)
             if sample_rate is not None:
                 envs.setdefault(TRACE_SAMPLE_RATE_ENV, str(sample_rate))
+        journal_dir = getattr(args, "master_journal_dir", None) or ""
+        if journal_dir:
+            # master HA: workers learn (a) where to re-resolve the
+            # control-plane address after a master restart and (b) the
+            # RPC retry budget that carries them across the outage —
+            # both by env, like the telemetry dir (never argv)
+            from elasticdl_tpu.master.journal import (
+                MASTER_ADDR_FILE_ENV,
+                addr_file_path,
+            )
+            from elasticdl_tpu.rpc.retry import (
+                DEFAULT_RETRY_SECS,
+                RETRY_SECS_ENV,
+            )
+
+            envs.setdefault(
+                MASTER_ADDR_FILE_ENV, addr_file_path(journal_dir)
+            )
+            retry_secs = getattr(args, "rpc_retry_secs", None)
+            envs.setdefault(
+                RETRY_SECS_ENV,
+                str(
+                    retry_secs
+                    if retry_secs is not None
+                    else DEFAULT_RETRY_SECS
+                ),
+            )
         if backend == "k8s":
             import os
 

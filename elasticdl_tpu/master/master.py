@@ -34,6 +34,13 @@ from elasticdl_tpu.utils.log_utils import default_logger as logger
 from elasticdl_tpu.utils.model_utils import get_model_spec
 
 
+class SimulatedMasterCrash(BaseException):
+    """Raised by the chaos harness's in-process master kill: unwinds the
+    run loop PAST every cleanup path (``stop()`` is never reached), the
+    in-process analogue of SIGKILL.  BaseException so blanket
+    ``except Exception`` recovery code cannot accidentally survive it."""
+
+
 class Master:
     def __init__(self, args, instance_manager_factory=None):
         self._args = args
@@ -159,6 +166,233 @@ class Master:
             self.replica_directory = ReplicaDirectory()
             self.servicer.set_replica_directory(self.replica_directory)
 
+        # ---- master high availability (off by default: with no
+        # --master_journal_dir every path below is dormant and behavior
+        # is byte-identical to a journal-less build)
+        self.journal = None
+        self._journal_dir = getattr(args, "master_journal_dir", None) or ""
+        # the pending set is mutated by gRPC handler threads (a re-home
+        # discards) while the run loop iterates it — every access goes
+        # through the lock or CPython raises mid-``sorted()``
+        self._rehome_lock = threading.Lock()
+        self._rehome_pending: set[int] = set()
+        self._rehome_deadline: float | None = None
+        self._restored_world: dict | None = None
+        self._restored = False
+        self._restart_at: float | None = None
+        # chaos kill hook (harness MASTER_KILL): the armed site name, or
+        # None.  Checked only at two explicit points, so a non-chaos
+        # master pays one attribute read per run-loop tick.
+        self._crash_armed: str | None = None
+        self.crashed_at: float | None = None
+        if self._journal_dir:
+            from elasticdl_tpu.master import journal as journal_mod
+
+            restored = journal_mod.load_state(self._journal_dir)
+            restored_callbacks = 0
+            if restored is not None and not restored.get("clean_shutdown"):
+                restored_callbacks = self._restore_from_journal(restored)
+            self.journal = journal_mod.MasterJournal(self._journal_dir)
+            self.journal.set_callbacks_invoked(restored_callbacks)
+            self.servicer.set_journal(self.journal)
+            self.servicer.set_rehome_sink(self._on_worker_rehomed)
+            self.servicer.set_stage_released_sink(
+                self.journal.record_stage_released
+            )
+            import uuid
+
+            self.servicer.set_boot_id(uuid.uuid4().hex)
+            # attach UNARMED (the backlog replay below is state the
+            # initial snapshot already carries), then snapshot + arm
+            self.task_d.add_observer(self.journal)
+            self.servicer.add_version_observer(
+                self.journal.on_version_report
+            )
+            self.journal.set_snapshot_provider(self._journal_snapshot)
+            self.journal.start()
+
+    # ---- master high availability ------------------------------------------
+
+    def _restore_from_journal(self, state: dict) -> int:
+        """Install the journal-replayed control plane: dispatcher
+        todo/doing sets, generation fence, model-version floor, the
+        memoized lockstep step-stream, and consumed deferred callbacks.
+        Returns the consumed-callback count (the journal writer resumes
+        from it)."""
+        from elasticdl_tpu.telemetry.tracing import SPAN_JOURNAL_REPLAY
+
+        control = state.get("servicer", {})
+        generation = int(control.get("cluster_version", 0))
+        self._restart_at = time.monotonic()
+        self._restored = True
+        self.telemetry.master_restart(generation)
+        with self.telemetry.tracer.span(
+            SPAN_JOURNAL_REPLAY, generation=generation
+        ):
+            self.task_d.restore_state(state["dispatcher"])
+            self.servicer.restore_control_state(
+                cluster_version=generation,
+                model_version=int(control.get("model_version", 0)),
+                stream=control.get("stream"),
+            )
+            consumed = int(state.get("callbacks_invoked", 0))
+            self.task_d.drop_deferred_callbacks(consumed)
+        world = state.get("world")
+        if world:
+            self._restored_world = world
+            self._rehome_pending = set(world["worker_ids"])
+        # replica-stage metadata: the staged payload was the previous
+        # life's RAM and died with it — a complete stage for a still-
+        # restoring generation means those workers now take the disk
+        # fallback, which the outage report should attribute
+        stage = state.get("stage")
+        stage_lost = bool(
+            stage and stage.get("complete") and stage["generation"] >= generation
+        )
+        if stage_lost:
+            logger.warning(
+                "Journal records a staged replica set (generation %d, "
+                "version %s) lost with the previous master; restoring "
+                "workers fall back to disk",
+                stage["generation"],
+                stage.get("version"),
+            )
+        snap = self.task_d.snapshot()
+        self.telemetry.journal_replay(
+            generation=generation,
+            duration_secs=time.monotonic() - self._restart_at,
+            pending=snap["pending"] + snap["pending_eval"],
+            active=len(snap["active"]),
+            epoch=snap["epoch"],
+            stage_lost=stage_lost,
+        )
+        logger.warning(
+            "Master restored from journal: generation %d, epoch %d, "
+            "%d pending / %d active task(s), expecting %s to re-home",
+            generation,
+            snap["epoch"],
+            snap["pending"] + snap["pending_eval"],
+            len(snap["active"]),
+            sorted(self._rehome_pending) or "no workers",
+        )
+        return consumed
+
+    def _journal_snapshot(self, append):
+        """Assemble the full control-plane state and ``append`` it as a
+        journal ``snapshot`` record (run loop only, never from an
+        observer).  The dispatcher capture and the append happen under
+        the dispatcher transition lock (``atomic_state_snapshot``), so
+        no lease/report/callback delta can land between the capture and
+        the record's file position.  The servicer fields captured just
+        before are safe: replay applies generation/version deltas with
+        monotone (max) guards, and the stream field is superseded by the
+        ``stream_snapshot`` record journaled right after — under the
+        stream lock, so ITS position is exact too."""
+        servicer_state = {
+            "cluster_version": self.servicer.cluster_version,
+            "model_version": self.servicer.get_model_version(),
+            "stream": self.servicer.stream_snapshot(),
+        }
+        world = self._restored_world
+        self.task_d.atomic_state_snapshot(
+            lambda dispatcher_state: append(
+                {
+                    "dispatcher": dispatcher_state,
+                    "servicer": servicer_state,
+                    "callbacks_invoked": self.journal.callbacks_invoked
+                    if self.journal is not None
+                    else 0,
+                    "world": world,
+                }
+            )
+        )
+        self.servicer.journal_stream_snapshot()
+
+    def _record_world(self):
+        """Journal the live worker-world composition — what a restarted
+        master waits on for re-homing."""
+        im = self.instance_manager
+        if im is None:
+            return
+        ids = im.worker_ids()
+        world = {
+            "cluster_version": self.servicer.cluster_version,
+            "worker_ids": sorted(ids),
+            "world_size": getattr(im, "world_size", len(ids)),
+        }
+        self._restored_world = world
+        if self.journal is not None:
+            self.journal.record_world(
+                world["cluster_version"], world["worker_ids"],
+                world["world_size"],
+            )
+
+    def _on_worker_rehomed(
+        self,
+        worker_id: int,
+        pid: int,
+        kept: list,
+        requeued: list,
+        started_at: float,
+    ):
+        """Servicer rehome sink: adopt the orphaned process (the dead
+        master spawned it; this one holds no handle) and settle the
+        re-home wait.  ``started_at`` is the servicer's handshake entry
+        time, so the worker_rehome span covers the fence check and
+        lease reconciliation, not just this adoption tail."""
+        im = self.instance_manager
+        adopt = getattr(im, "adopt_worker", None) if im is not None else None
+        if adopt is not None and pid:
+            adopt(worker_id, pid)
+        with self._rehome_lock:
+            self._rehome_pending.discard(worker_id)
+        self.telemetry.worker_rehome(
+            worker_id,
+            self.servicer.cluster_version,
+            kept=len(kept),
+            requeued=len(requeued),
+            started_at=started_at,
+        )
+
+    def _check_rehome_deadline(self):
+        """Run-loop tick: a restored master waits a bounded grace for
+        its journaled world to re-home; workers that never do are dead —
+        recover their leases and re-form."""
+        if self._rehome_deadline is None:
+            return
+        with self._rehome_lock:
+            if not self._rehome_pending:
+                self._rehome_deadline = None
+                logger.info("All restored workers re-homed")
+                return
+            if time.monotonic() < self._rehome_deadline:
+                return
+            pending = sorted(self._rehome_pending)
+            self._rehome_pending = set()
+        self._rehome_deadline = None
+        # a pending worker that heartbeated THIS life is alive even if
+        # it never presented the handshake (it may never have seen the
+        # previous boot id — spawned just before the outage): its
+        # journaled leases stay valid and its reports ride normally, so
+        # settle it rather than requeue a live worker's tasks
+        alive = set(self.servicer.live_workers())
+        settled = [w for w in pending if w in alive]
+        missing = [w for w in pending if w not in alive]
+        if settled:
+            logger.info(
+                "Workers %s heartbeated without re-homing; settled",
+                settled,
+            )
+        if not missing:
+            return
+        logger.warning(
+            "Workers %s never re-homed after the master restart; "
+            "recovering their tasks",
+            missing,
+        )
+        self.telemetry.worker_dead(missing, self.servicer.cluster_version)
+        self._handle_dead_workers(missing)
+
     # ---- lifecycle ---------------------------------------------------------
 
     @property
@@ -186,6 +420,12 @@ class Master:
         self._server = create_server(self.servicer, port)
         self._server.start()
         self._port = self._server._edl_bound_port
+        if self.journal is not None:
+            # publish the (possibly new) control-plane address: workers
+            # that outlived a previous master re-resolve from this file
+            from elasticdl_tpu.master.journal import write_master_addr
+
+            write_master_addr(self._journal_dir, f"localhost:{self._port}")
         metrics_port = getattr(self._args, "metrics_port", 0)
         if metrics_port is not None and metrics_port >= 0:
             from elasticdl_tpu.telemetry.httpd import TelemetryHTTPServer
@@ -206,7 +446,44 @@ class Master:
         if self.tb_service is not None:
             self.tb_service.start()
         if self.instance_manager is not None:
-            self.instance_manager.start_workers()
+            with self._rehome_lock:
+                rehome_wait = sorted(self._rehome_pending)
+            if self._restored and rehome_wait:
+                # the journaled world may still be alive (the workers
+                # outlived the dead master): do NOT spawn a second world
+                # on top of it — wait for re-homing instead; the grace
+                # deadline recovers whatever never comes back
+                im = self.instance_manager
+                if self._restored_world is not None and hasattr(
+                    im, "set_world_size"
+                ):
+                    im.set_world_size(self._restored_world["world_size"])
+                grace = getattr(self._args, "rehome_grace_secs", None)
+                if grace is None:
+                    heartbeat = (
+                        getattr(self._args, "heartbeat_timeout_secs", 0)
+                        or 0
+                    )
+                    grace = max(10.0, 3.0 * heartbeat)
+                self._rehome_deadline = time.monotonic() + grace
+                logger.warning(
+                    "Waiting up to %.1fs for workers %s to re-home",
+                    grace,
+                    rehome_wait,
+                )
+            else:
+                self.instance_manager.start_workers()
+                self._record_world()
+        if self._restart_at is not None:
+            from elasticdl_tpu.telemetry.tracing import SPAN_MASTER_RESTART
+
+            self.telemetry.tracer.record_span(
+                SPAN_MASTER_RESTART,
+                self._restart_at,
+                time.monotonic(),
+                generation=self.servicer.cluster_version,
+            )
+            self.telemetry.tracer.flush()
 
     def run(self, poll_secs: float = 1.0) -> int:
         """Poll until all tasks (incl. deferred SAVE_MODEL) are done
@@ -214,12 +491,18 @@ class Master:
         finish in seconds)."""
         try:
             while True:
+                self._crash_if_armed("tick")
                 if self.task_d.finished() and not (
                     self.task_d.invoke_deferred_callback()
                 ):
                     break
                 if self._stop_requested:
                     break
+                # a restored master first waits for its journaled world
+                # to re-home (bounded by the grace deadline)
+                self._check_rehome_deadline()
+                if self.journal is not None:
+                    self.journal.maybe_snapshot()
                 if self.instance_manager is not None:
                     # local process-exit events (the subprocess analogue
                     # of the k8s pod watch): an abnormal exit is detected
@@ -336,6 +619,11 @@ class Master:
         # and burn a unit of the reform budget for nothing
         with self._reform_request_lock:
             self._reform_requested = None
+        # a re-formation supersedes any outstanding re-home wait: the
+        # world being fenced and relaunched IS the recovery
+        self._rehome_deadline = None
+        with self._rehome_lock:
+            self._rehome_pending = set()
         # fence FIRST: from here every stale worker's get_step_task is
         # rejected, so none can re-lease a task we are about to recover
         new_version = self.servicer.bump_cluster_version()
@@ -364,6 +652,10 @@ class Master:
                 self.task_d.recover_tasks(worker_id)
                 self.servicer.forget_worker(worker_id)
             self.servicer.reset_step_stream()
+        # MASTER_KILL trigger="reform": die in the nastiest window —
+        # generation bumped and journaled, old world fenced and its
+        # tasks recovered, no new world launched yet
+        self._crash_if_armed("reform")
         # the relaunched world's workers link their world_join spans
         # into this re-formation's trace (argv spawns get it by env,
         # standbys in the stdin/RPC assignment payload)
@@ -391,6 +683,7 @@ class Master:
             old_world_size,
             getattr(im, "world_size", old_world_size),
         )
+        self._record_world()
         self.reform_events.append(
             {
                 "detected_at": t0,
@@ -449,12 +742,47 @@ class Master:
                 self.instance_manager, "world_size", old_world_size
             )
         self.servicer.set_restore_stage(stage)
+        if self.journal is not None:
+            # metadata only: the staged payload is master RAM and dies
+            # with the process — a restarted master serves disk fallback
+            self.journal.record_stage(
+                new_version,
+                stage["version"] if stage else None,
+                complete=stage is not None,
+            )
         self.telemetry.replica_harvest(
             generation=new_version,
             complete=stage is not None,
             version=stage["version"] if stage else None,
             sources=old_world_size,
         )
+
+    def request_crash(self, site: str = "tick"):
+        """Chaos hook (MASTER_KILL): arm an in-process master kill at a
+        named site — ``"tick"`` dies at the next run-loop tick,
+        ``"reform"`` dies inside the next re-formation after the fence
+        (generation journaled, world fenced, no new world launched).
+        The kill has SIGKILL semantics: the gRPC server stops instantly,
+        the journal's unflushed tail is dropped, and no cleanup runs."""
+        self._crash_armed = site
+
+    def _crash_if_armed(self, site: str):
+        if self._crash_armed != site:
+            return
+        self._crash_armed = None
+        logger.warning(
+            "CHAOS: simulating master kill at %r (SIGKILL semantics)", site
+        )
+        self.crashed_at = time.monotonic()
+        if self._server is not None:
+            self._server.stop(grace=0)
+            self._server = None
+        if self.journal is not None:
+            self.journal.abort()
+        if self._telemetry_server is not None:
+            self._telemetry_server.stop()
+            self._telemetry_server = None
+        raise SimulatedMasterCrash(site)
 
     def request_reform(self, reason: str = "elective"):
         """Ask the run loop to re-form the lockstep world at its next
@@ -485,6 +813,10 @@ class Master:
         if self._server is not None:
             self._server.stop(grace=2)
             self._server = None
+        if self.journal is not None:
+            # a clean end is journaled so a relaunch-from-journal knows
+            # there is nothing to recover (and doesn't wait for re-homes)
+            self.journal.record_job_end(1 if self._job_failed else 0)
         self.telemetry.job_end(1 if self._job_failed else 0)
         if self._telemetry_server is not None:
             self._telemetry_server.stop()
@@ -535,6 +867,67 @@ class Master:
                 for event in events
             ]
         return out
+
+
+class _AdoptedProcess:
+    """Popen-alike handle for a worker process THIS master did not spawn:
+    it survived a previous master's death (orphaned, re-parented to
+    init) and re-homed with its pid.  Implements the subset of the Popen
+    surface the instance manager uses (poll/kill/terminate/wait), signal
+    based — the restarted master cannot ``waitpid`` a non-child.
+
+    ``poll`` cannot observe the true exit code of a non-child; a
+    vanished pid reports -1 (treated as failure).  A clean worker exit
+    races the master's own ``finished()`` check exactly like spawned
+    workers' rc-0 exits do, and the run loop breaks on ``finished()``
+    before consulting ``poll_failed_workers``."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self._rc: int | None = None
+
+    def poll(self):
+        if self._rc is not None:
+            return self._rc
+        try:
+            os.kill(self.pid, 0)
+        except ProcessLookupError:
+            self._rc = -1
+            return self._rc
+        except PermissionError:
+            # pid exists but belongs to someone else now (reuse): the
+            # worker is gone
+            self._rc = -1
+            return self._rc
+        return None
+
+    def _signal(self, sig):
+        try:
+            os.kill(self.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            self._rc = self._rc if self._rc is not None else -1
+
+    def terminate(self):
+        import signal
+
+        self._signal(signal.SIGTERM)
+
+    def kill(self):
+        import signal
+
+        self._signal(signal.SIGKILL)
+
+    def wait(self, timeout: float | None = None):
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"adopted worker pid {self.pid} still running"
+                )
+            time.sleep(0.05)
+        return self._rc
 
 
 class LocalInstanceManager:
@@ -609,6 +1002,19 @@ class LocalInstanceManager:
     def worker_ids(self) -> list[int]:
         with self._lock:
             return list(self._procs)
+
+    def adopt_worker(self, worker_id: int, pid: int):
+        """Track a worker a PREVIOUS master spawned (it re-homed after a
+        master restart): from here it is polled, fenced and killed like
+        any spawned worker, so post-restart failure handling works."""
+        with self._lock:
+            if worker_id in self._procs:
+                return
+            self._procs[worker_id] = _AdoptedProcess(pid)
+            self._next_worker_id = max(self._next_worker_id, worker_id + 1)
+        logger.info(
+            "Adopted re-homed worker %d (pid %d)", worker_id, pid
+        )
 
     def start_workers(self):
         if self.lockstep:

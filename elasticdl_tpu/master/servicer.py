@@ -73,6 +73,15 @@ class MasterServicer:
         # is served to the generation it was staged for
         self._replica_directory = None
         self._restore_stage: dict | None = None
+        # master high availability (master/journal.py): the journal sink
+        # records generation bumps and step-stream memo resolutions; the
+        # boot id identifies THIS master process so re-homing workers
+        # can tell a restart from a blip; the rehome sink lets the
+        # Master adopt re-homed orphans
+        self._journal = None
+        self._boot_id = ""
+        self._rehome_sink = None
+        self._stage_released_sink = None
         if evaluation_service is not None:
             evaluation_service.set_master_servicer(self)
 
@@ -93,6 +102,29 @@ class MasterServicer:
         """Attach the replication subsystem's master-side directory;
         heartbeats then carry advertisements up and peer maps down."""
         self._replica_directory = directory
+
+    def set_journal(self, journal):
+        """Attach the control-plane journal (master/journal.py):
+        generation bumps and lockstep stream resolutions are recorded
+        from here — the two transitions only the servicer sees."""
+        self._journal = journal
+
+    def set_boot_id(self, boot_id: str):
+        self._boot_id = boot_id
+
+    @property
+    def boot_id(self) -> str:
+        return self._boot_id
+
+    def set_stage_released_sink(self, sink):
+        """``sink(generation)`` fires once when a staged replica set has
+        been fetched by every process of its generation (journal hook)."""
+        self._stage_released_sink = sink
+
+    def set_rehome_sink(self, sink):
+        """``sink(worker_id, pid, kept, requeued)`` after a successful
+        re-home — the Master adopts the orphan and emits telemetry."""
+        self._rehome_sink = sink
 
     def _trace_for(self, task_id: int) -> dict:
         if self._trace_provider is None:
@@ -182,6 +214,16 @@ class MasterServicer:
                 )
             self._heartbeats[request.worker_id] = time.monotonic()
         with self._stream_lock:
+            if request.cluster_version != self._cluster_version:
+                # re-checked here because the fence test above runs under
+                # a DIFFERENT lock: a reform landing in the gap would let
+                # this stale request lease from the just-recovered queue
+                # and memoize into the new world's stream (the int read
+                # is GIL-atomic; _lock is not needed to compare it)
+                return msg.TaskResponse(
+                    model_version=self._version,
+                    minibatch_size=self._minibatch_size,
+                )
             if self._first_stream_pull_at is None:
                 self._first_stream_pull_at = time.monotonic()
             memo = self._step_stream.get(request.seq)
@@ -198,7 +240,9 @@ class MasterServicer:
                     self._minibatch_size,
                     trace=self._trace_for(task_id),
                 )
-                self._step_stream[request.seq] = resp
+                self._memoize_stream(
+                    request.seq, resp, request.cluster_version
+                )
                 return resp
             if (not self._task_d.finished()) or (
                 self._task_d.invoke_deferred_callback()
@@ -212,8 +256,79 @@ class MasterServicer:
                 model_version=self._version,
                 minibatch_size=self._minibatch_size,
             )
-            self._step_stream[request.seq] = resp
+            self._memoize_stream(request.seq, resp, request.cluster_version)
             return resp
+
+    # keep this many newest memoized seqs (RAM and journal snapshots).
+    # Lockstep processes cannot diverge by more than one dispatch group
+    # — every step's collectives need all of them — so hundreds of seqs
+    # of slack is unreachable; without a bound a long single-generation
+    # run makes each journal snapshot O(steps) (quadratic on disk)
+    STREAM_MEMO_KEEP = 512
+
+    def _memoize_stream(
+        self, seq: int, resp: msg.TaskResponse, generation: int
+    ):
+        """Memoize + journal one stream resolution (under _stream_lock),
+        pruning memos far behind the frontier.  ``generation`` is the
+        fence the request passed — journaled with the record so replay
+        can drop a resolution that raced a reform's generation bump
+        (its record may land AFTER the ``generation`` record, where the
+        live master's reset no longer has a replay analogue)."""
+        self._step_stream[seq] = resp
+        self._journal_stream(seq, resp, generation)
+        if len(self._step_stream) > self.STREAM_MEMO_KEEP + 64:
+            for old in sorted(self._step_stream)[
+                : len(self._step_stream) - self.STREAM_MEMO_KEEP
+            ]:
+                del self._step_stream[old]
+
+    def _journal_stream(
+        self, seq: int, resp: msg.TaskResponse, generation: int
+    ):
+        """Journal a memoized stream resolution: a restarted master must
+        answer already-resolved seqs identically or the lockstep worlds
+        desync across the outage."""
+        if self._journal is None:
+            return
+        from dataclasses import asdict
+
+        try:
+            self._journal.record_stream(seq, asdict(resp), generation)
+        except Exception:  # noqa: BLE001 — journaling never breaks RPCs
+            logger.exception("Journal stream record failed")
+
+    def stream_snapshot(self) -> dict:
+        """JSON-safe copy of the memoized step stream (journal
+        snapshots; keys stringified — JSON would coerce them anyway and
+        replay expects str)."""
+        with self._stream_lock:
+            return self._stream_snapshot_locked()
+
+    def _stream_snapshot_locked(self) -> dict:
+        from dataclasses import asdict
+
+        return {
+            str(seq): asdict(resp)
+            for seq, resp in self._step_stream.items()
+        }
+
+    def journal_stream_snapshot(self):
+        """Journal a full stream-memo capture from UNDER the stream lock,
+        so the record's file position IS its capture point.  The master
+        writes one right after each main snapshot: the main snapshot's
+        stream field was captured before the (dispatcher-atomic) append,
+        and a memo resolved in that window would otherwise replay as
+        ordered-before-the-snapshot and be lost."""
+        if self._journal is None:
+            return
+        with self._stream_lock:
+            try:
+                self._journal.record_stream_snapshot(
+                    self._stream_snapshot_locked()
+                )
+            except Exception:  # noqa: BLE001 — journaling never breaks RPCs
+                logger.exception("Journal stream snapshot failed")
 
     def reset_step_stream(self):
         """Drop all memoized stream state (mesh re-formation: the new
@@ -227,7 +342,13 @@ class MasterServicer:
         the step stream from this point on."""
         with self._lock:
             self._cluster_version += 1
-            return self._cluster_version
+            version = self._cluster_version
+        if self._journal is not None:
+            # the fence record is flushed inline: a restarted master
+            # resurrecting a fenced generation would un-fence stale
+            # workers (version monotonicity would break silently)
+            self._journal.record_generation(version)
+        return version
 
     def first_stream_pull_at(self) -> float | None:
         """Monotonic time of the first step-task resolution since the last
@@ -296,7 +417,79 @@ class MasterServicer:
             should_quiesce=self._quiesce,
             cluster_version=generation,
             replica_peers=replica_peers,
+            boot_id=self._boot_id,
         )
+
+    # ---- master high availability: the re-homing handshake -----------------
+
+    def rehome_worker(
+        self, request: msg.RehomeRequest
+    ) -> msg.RehomeResponse:
+        """A worker that outlived a master outage reconnects: fence its
+        generation, reconcile its in-flight leases against the
+        journal-restored active set (re-accept what it presents, requeue
+        what it does not), and hand it to the master for adoption."""
+        started_at = time.monotonic()
+        with self._lock:
+            generation = self._cluster_version
+        if request.cluster_version != generation:
+            # stale world: reject WITHOUT recording a heartbeat, exactly
+            # like the step-stream fence
+            return msg.RehomeResponse(
+                accepted=False,
+                cluster_version=generation,
+                boot_id=self._boot_id,
+            )
+        presented = {int(t) for t in request.lease_ids}
+        kept, requeued = self._task_d.reconcile_leases(
+            request.worker_id, presented
+        )
+        with self._lock:
+            self._heartbeats[request.worker_id] = time.monotonic()
+        if self._rehome_sink is not None:
+            try:
+                self._rehome_sink(
+                    request.worker_id, request.pid, kept, requeued,
+                    started_at,
+                )
+            except Exception:  # noqa: BLE001 — adoption/telemetry must
+                # not fail the handshake the worker depends on
+                logger.exception("Rehome sink failed")
+        return msg.RehomeResponse(
+            accepted=True,
+            cluster_version=generation,
+            boot_id=self._boot_id,
+            accepted_leases=sorted(kept),
+        )
+
+    def restore_control_state(
+        self,
+        cluster_version: int,
+        model_version: int,
+        stream: dict | None = None,
+    ):
+        """Install journal-replayed control state (master restart):
+        the generation fence, the model-version floor, and the memoized
+        lockstep step-stream (so already-resolved seqs replay
+        identically to the pre-outage answers)."""
+        with self._lock:
+            self._cluster_version = int(cluster_version)
+            self._version = max(self._version, int(model_version))
+        memos = {}
+        for seq, resp in (stream or {}).items():
+            try:
+                memos[int(seq)] = msg.TaskResponse(**resp)
+            except TypeError:
+                logger.warning(
+                    "Dropping unreplayable stream memo for seq %s", seq
+                )
+        if len(memos) > self.STREAM_MEMO_KEEP:
+            # same bound the live memo keeps (journals written before the
+            # bound existed can replay more)
+            for old in sorted(memos)[: len(memos) - self.STREAM_MEMO_KEEP]:
+                del memos[old]
+        with self._stream_lock:
+            self._step_stream = memos
 
     # ---- replica restore stage ---------------------------------------------
 
@@ -330,8 +523,18 @@ class MasterServicer:
             served = stage.setdefault("served", set())
             served.add(request.process_id)
             world_size = stage.get("world_size", 0)
-            if world_size and len(served) >= world_size:
+            released = bool(world_size and len(served) >= world_size)
+            if released:
                 self._restore_stage = None
+        if released and self._stage_released_sink is not None:
+            # outside the lock: the sink appends to the journal so a
+            # later restart doesn't report this fully-served stage as a
+            # lost replica set (a false disk-fallback)
+            try:
+                self._stage_released_sink(stage["generation"])
+            except Exception:  # noqa: BLE001 — bookkeeping must not
+                # fail the restore RPC the worker depends on
+                logger.exception("Stage-released sink failed")
         return response
 
     # ---- hot-standby world assignments ------------------------------------
@@ -425,6 +628,8 @@ class MasterServicer:
             self._quiesce = False
             self._cluster_version += 1
             generation = self._cluster_version
+        if self._journal is not None:
+            self._journal.record_generation(generation)
         from elasticdl_tpu.telemetry.events import EVENT_QUIESCE_END
 
         self._emit(EVENT_QUIESCE_END, generation=generation)

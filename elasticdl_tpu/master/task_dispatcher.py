@@ -41,10 +41,40 @@ class Task:
     type: TaskType
     model_version: int = -1
     extended: dict = field(default_factory=dict)
+    # stable identity across lease/requeue cycles AND across a journaled
+    # master restart (id(task) is process-local; the control-plane
+    # journal needs an identity that survives serialization)
+    uid: int = -1
 
     @property
     def num_records(self) -> int:
         return self.end - self.start
+
+    def to_dict(self) -> dict:
+        """JSON-safe form for the control-plane journal (str keys only —
+        the journal is JSONL and reconnect payloads ride msgpack with
+        strict_map_key)."""
+        return {
+            "shard_name": self.shard_name,
+            "start": self.start,
+            "end": self.end,
+            "type": int(self.type),
+            "model_version": self.model_version,
+            "extended": dict(self.extended),
+            "uid": self.uid,
+        }
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "Task":
+        return cls(
+            shard_name=raw["shard_name"],
+            start=int(raw["start"]),
+            end=int(raw["end"]),
+            type=TaskType(raw["type"]),
+            model_version=int(raw.get("model_version", -1)),
+            extended=dict(raw.get("extended", {})),
+            uid=int(raw.get("uid", -1)),
+        )
 
 
 @dataclass
@@ -96,6 +126,7 @@ class TaskDispatcher:
         self._pending_eval: list[Task] = []
         self._active: dict[int, _Assignment] = {}
         self._next_task_id = 0
+        self._next_task_uid = 0
 
         self._counters: dict[TaskType, JobCounters] = {}
         self._done_callbacks: list[Callable[[], None]] = []
@@ -122,7 +153,9 @@ class TaskDispatcher:
         counted)``, ``on_task_done(task_id, task, worker_id, success,
         exec_counters)`` (counted reports only — carries the reporter
         and its exec counters for telemetry), ``on_task_reclaimed(
-        task_id, task)``.  Callbacks may
+        task_id, task)``, ``on_epoch_opened(epoch)`` (lazy epoch
+        advance), ``on_callback_invoked()`` (a deferred all-tasks-done
+        callback was consumed).  Callbacks may
         run under the dispatcher lock — observers must not re-enter.
 
         Tasks created before attach (the constructor slices epoch 0) are
@@ -163,6 +196,7 @@ class TaskDispatcher:
             counters.total_records += count
             limit = first + count
             for lo in range(first, limit, self._records_per_task):
+                self._next_task_uid += 1
                 tasks.append(
                     Task(
                         shard_name=shard_name,
@@ -171,6 +205,7 @@ class TaskDispatcher:
                         type=task_type,
                         model_version=model_version,
                         extended=dict(extended or {}),
+                        uid=self._next_task_uid,
                     )
                 )
         return tasks
@@ -215,6 +250,9 @@ class TaskDispatcher:
             self._reclaim_expired_locked()
             if not self._pending and self._epoch < self._num_epochs - 1:
                 self._epoch += 1
+                # journal observers need the epoch-cursor advance BEFORE
+                # the created tasks so replay applies them in order
+                self._notify("on_epoch_opened", self._epoch)
                 self.create_tasks(TaskType.TRAINING)
                 logger.info("Starting epoch %d", self._epoch)
             if not self._pending:
@@ -436,7 +474,21 @@ class TaskDispatcher:
                     return True
                 callback = self._done_callbacks.pop(0)
             callback()
+            # journaled AFTER the callback runs: consumption recorded
+            # before execution would make deferred work (final
+            # evaluation, SAVE_MODEL creation) at-MOST-once across a
+            # master crash — replay would drop the callback with its
+            # tasks never created.  The reverse crash window re-runs
+            # the callback, which report dedup and path-overwrite
+            # tolerate.
+            self._notify("on_callback_invoked")
         return True
+
+    def drop_deferred_callbacks(self, count: int):
+        """Journal-replay hook: discard the first ``count`` registered
+        callbacks — the ones a previous master life already consumed."""
+        for _ in range(max(0, min(count, len(self._done_callbacks)))):
+            self._done_callbacks.pop(0)
 
     def add_deferred_callback(self, callback: Callable[[], None]):
         """Run ``callback`` once all current tasks drain (FIFO order)."""
@@ -457,15 +509,21 @@ class TaskDispatcher:
         shard_name, (first, count) = next(iter(shards.items()))
         with self._lock:
             self._counters[TaskType.SAVE_MODEL] = JobCounters()
-            self._pending.append(
-                Task(
-                    shard_name=shard_name,
-                    start=first,
-                    end=first + min(self._records_per_task, count),
-                    type=TaskType.SAVE_MODEL,
-                    extended={"saved_model_path": saved_model_path},
-                )
+            self._next_task_uid += 1
+            task = Task(
+                shard_name=shard_name,
+                start=first,
+                end=first + min(self._records_per_task, count),
+                type=TaskType.SAVE_MODEL,
+                extended={"saved_model_path": saved_model_path},
+                uid=self._next_task_uid,
             )
+            self._pending.append(task)
+        # observers (journal, invariant checker) must see this creation
+        # like any other: without it a master killed between the
+        # SAVE_MODEL creation and the next snapshot replays a dispatcher
+        # that silently never exports the final model
+        self._notify("on_tasks_created", [task])
 
     def set_evaluation_service(self, evaluation_service):
         with self._lock:
@@ -503,3 +561,119 @@ class TaskDispatcher:
                     for tid, a in self._active.items()
                 },
             }
+
+    # ---- durable control-plane state (master/journal.py) -------------------
+
+    def state_snapshot(self) -> dict:
+        """FULL dispatcher state, JSON-safe (dict keys str-typed):
+        everything :meth:`restore_state` needs to reconstruct an
+        equivalent dispatcher after a master restart.  Lease wall-clocks
+        are deliberately absent — a restored lease gets a fresh clock,
+        and the re-homing handshake requeues leases nobody claims."""
+        with self._lock:
+            return self._state_snapshot_locked()
+
+    def atomic_state_snapshot(self, sink):
+        """Capture state and hand it to ``sink`` WITHOUT releasing the
+        transition lock in between.  Observers journal every transition
+        from inside this same lock, so whatever journal position ``sink``
+        appends at is atomic w.r.t. dispatcher deltas — no lease/report
+        can land between the capture and its record (a delta journaled
+        there would be ordered before the snapshot and dropped by
+        replay).  ``sink`` must not re-enter dispatcher methods."""
+        with self._lock:
+            sink(self._state_snapshot_locked())
+
+    def _state_snapshot_locked(self) -> dict:
+        return {
+            "epoch": self._epoch,
+            "next_task_id": self._next_task_id,
+            "next_task_uid": self._next_task_uid,
+            "pending": [t.to_dict() for t in self._pending],
+            "pending_eval": [t.to_dict() for t in self._pending_eval],
+            "active": {
+                str(tid): {
+                    "worker_id": a.worker_id,
+                    "task": a.task.to_dict(),
+                }
+                for tid, a in self._active.items()
+            },
+            "counters": {
+                task_type.name: {
+                    "total_records": c.total_records,
+                    "failed_records": c.failed_records,
+                    "exec_metrics": dict(c.exec_metrics),
+                }
+                for task_type, c in self._counters.items()
+            },
+        }
+
+    def restore_state(self, state: dict):
+        """Install a replayed :meth:`state_snapshot` — REPLACES the
+        constructor-sliced epoch 0 wholesale (counters included), so a
+        journal-restored master never double-counts the initial slice.
+        Restored leases get a fresh clock: a lease that survived the
+        outage must not be reclaimed the instant the master is back."""
+        now = time.monotonic()
+        with self._lock:
+            self._epoch = int(state["epoch"])
+            self._next_task_id = int(state["next_task_id"])
+            self._next_task_uid = int(state.get("next_task_uid", 0))
+            self._pending = [Task.from_dict(t) for t in state["pending"]]
+            self._pending_eval = [
+                Task.from_dict(t) for t in state["pending_eval"]
+            ]
+            self._active = {
+                int(tid): _Assignment(
+                    int(entry["worker_id"]),
+                    Task.from_dict(entry["task"]),
+                    now,
+                )
+                for tid, entry in state["active"].items()
+            }
+            self._counters = {
+                TaskType[name]: JobCounters(
+                    total_records=int(c.get("total_records", 0)),
+                    failed_records=int(c.get("failed_records", 0)),
+                    exec_metrics=dict(c.get("exec_metrics", {})),
+                )
+                for name, c in state.get("counters", {}).items()
+            }
+
+    def reconcile_leases(
+        self, worker_id: int, presented: set[int]
+    ) -> tuple[list[int], list[int]]:
+        """Re-homing handshake (worker reconnecting after a master
+        outage): the worker presents its in-flight lease ids; leases
+        this dispatcher holds for the worker that are presented are
+        re-accepted (fresh clock), the rest are requeued — the worker
+        dropped them, died holding them, or the journal recorded a lease
+        the worker never learned of.  Presented ids the dispatcher does
+        not know stay unaccepted: their eventual report is dropped and
+        the task (still pending here) trains exactly once."""
+        kept: list[int] = []
+        requeued: list[tuple[int, Task]] = []
+        now = time.monotonic()
+        with self._lock:
+            for tid, a in list(self._active.items()):
+                if a.worker_id != worker_id:
+                    continue
+                if tid in presented:
+                    a.leased_at = now
+                    kept.append(tid)
+                    continue
+                del self._active[tid]
+                if a.task.type == TaskType.EVALUATION:
+                    self._pending_eval.append(a.task)
+                else:
+                    self._pending.append(a.task)
+                requeued.append((tid, a.task))
+                self._notify("on_task_reclaimed", tid, a.task)
+        if kept or requeued:
+            logger.info(
+                "Re-homed worker %d: %d lease(s) re-accepted, %d requeued",
+                worker_id,
+                len(kept),
+                len(requeued),
+            )
+        return kept, [tid for tid, _t in requeued]

@@ -136,6 +136,42 @@ class HeartbeatResponse:
     # ring-push targets, from the master's replica directory); empty
     # when replication is off or peers have not advertised yet
     replica_peers: dict = field(default_factory=dict)
+    # identity of the master PROCESS serving this response (non-empty
+    # only when the journaled-HA control plane is on).  A worker that
+    # sees the boot id CHANGE has outlived a master: it re-homes —
+    # presents its generation and in-flight leases so the restarted
+    # master reconciles accounting (master/journal.py).  Old payloads
+    # decode to "" — wire-compatible
+    boot_id: str = ""
+
+
+@dataclass
+class RehomeRequest:
+    """Worker -> restarted master: the re-homing handshake.
+
+    ``lease_ids`` are the task leases this worker still holds in
+    flight; ``cluster_version`` is the world generation it belongs to
+    (the fence — a stale generation is rejected); ``pid`` lets a local
+    master ADOPT the orphaned process (the previous master spawned it,
+    so the restarted one holds no handle)."""
+
+    worker_id: int
+    cluster_version: int = 0
+    pid: int = 0
+    lease_ids: list = field(default_factory=list)
+
+
+@dataclass
+class RehomeResponse:
+    # False = generation fence rejected the worker (stale world): it
+    # must exit like any fenced worker
+    accepted: bool = False
+    cluster_version: int = 0
+    boot_id: str = ""
+    # the presented leases the master re-accepted; the worker must drop
+    # any lease NOT in this list (its eventual report would be dropped
+    # and the task re-trains from the queue exactly once)
+    accepted_leases: list = field(default_factory=list)
 
 
 @dataclass
@@ -238,6 +274,8 @@ _SIMPLE_TYPES = {
     "ReportVersionRequest": ReportVersionRequest,
     "HeartbeatRequest": HeartbeatRequest,
     "HeartbeatResponse": HeartbeatResponse,
+    "RehomeRequest": RehomeRequest,
+    "RehomeResponse": RehomeResponse,
     "GetWorldAssignmentRequest": GetWorldAssignmentRequest,
     "WorldAssignmentResponse": WorldAssignmentResponse,
     "PushReplicaRequest": PushReplicaRequest,

@@ -15,6 +15,7 @@ Tensor payloads (eval outputs/labels) ride inside the same frames; the
 
 from __future__ import annotations
 
+import threading
 from concurrent import futures
 
 import grpc
@@ -35,7 +36,28 @@ _METHODS = (
     "heartbeat",
     "get_world_assignment",
     "get_restore_state",
+    "rehome_worker",
 )
+
+# every master control-plane method is retry-safe (see rpc/retry.py:
+# memoized, monotone, or task_id-deduplicated server side), so the
+# MasterClient opts them all in when a retry policy is installed
+MASTER_RETRYABLE_METHODS = frozenset(_METHODS)
+
+# grpc status codes worth backing off on: the server is down,
+# restarting, or the deadline raced a restart.  Anything else
+# (UNIMPLEMENTED, INVALID_ARGUMENT, ...) is a bug, not an outage.
+_RETRYABLE_CODES = frozenset(
+    {
+        grpc.StatusCode.UNAVAILABLE,
+        grpc.StatusCode.DEADLINE_EXCEEDED,
+    }
+)
+
+
+def _retryable_grpc_error(ex) -> bool:
+    code = getattr(ex, "code", None)
+    return callable(code) and code() in _RETRYABLE_CODES
 
 _CHANNEL_OPTIONS = [
     ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
@@ -84,29 +106,117 @@ def create_server(
 class RpcClient:
     """Generic stub over a msgpack-framed unary channel — the shared
     base of :class:`MasterClient` and the replication subsystem's
-    worker-to-worker client."""
+    worker-to-worker client.
+
+    ``retry`` (a :class:`~elasticdl_tpu.rpc.retry.RetryPolicy`) makes
+    outage-class failures (UNAVAILABLE / DEADLINE_EXCEEDED) back off
+    and re-send instead of raising — but only for methods named in
+    ``retryable_methods`` (default: the naturally idempotent subset;
+    see rpc/retry.py for the safety contract).  ``resolve_addr`` is the
+    re-resolve hook: called after repeated failures, and a changed
+    address rebuilds the channel — how a worker follows a master that
+    restarted on a new port.  With ``retry=None`` (the default) every
+    code path is byte-identical to the retry-less client."""
+
+    # failed attempts between re-resolve probes (the first probe fires
+    # early so a fast master relaunch is caught within ~2 backoffs)
+    _RERESOLVE_EVERY = 2
 
     def __init__(
         self,
         addr: str,
         methods: tuple[str, ...] = _METHODS,
         service_name: str = SERVICE_NAME,
+        retry=None,
+        retryable_methods: frozenset[str] | set[str] | None = None,
+        resolve_addr=None,
     ):
+        self._addr = addr
+        self._methods = tuple(methods)
+        self._service_name = service_name
+        self._retry = retry
+        if retryable_methods is None:
+            from elasticdl_tpu.rpc.retry import DEFAULT_IDEMPOTENT
+
+            retryable_methods = DEFAULT_IDEMPOTENT
+        self._retryable = frozenset(retryable_methods) & set(methods)
+        self._resolve_addr = resolve_addr
+        self._channel_lock = threading.Lock()
+        self._stale_channels: list = []
+        self._connect(addr)
+
+    def _connect(self, addr: str):
         self._channel = grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
         self._calls = {
             name: self._channel.unary_unary(
-                f"/{service_name}/{name}",
+                f"/{self._service_name}/{name}",
                 request_serializer=None,
                 response_deserializer=None,
             )
-            for name in methods
+            for name in self._methods
         }
 
+    def _maybe_reresolve(self, attempt: int, _ex):
+        """on_retry hook: every few failures, re-read the master address
+        and rebuild the channel if it moved."""
+        if self._resolve_addr is None:
+            return
+        if attempt % self._RERESOLVE_EVERY != 0:
+            return
+        try:
+            addr = self._resolve_addr()
+        except Exception:  # noqa: BLE001 — a broken resolver must not
+            # end the retry loop; the old channel may still come back
+            logger.exception("Master address re-resolution failed")
+            return
+        with self._channel_lock:
+            if not addr or addr == self._addr:
+                return
+            logger.warning(
+                "Master address changed %s -> %s; reconnecting",
+                self._addr,
+                addr,
+            )
+            old, self._addr = self._channel, addr
+            self._connect(addr)
+            # do NOT close the old channel here: another thread's retry
+            # attempt may have read its call object and be invoking it
+            # right now — close() would turn that into a non-retryable
+            # ValueError that escapes the retry loop.  Park it until
+            # client close; re-resolves only happen on an address
+            # change, so the parked set is bounded by master restarts.
+            self._stale_channels.append(old)
+
     def _call(self, name, request, timeout: float | None = None):
-        payload = self._calls[name](msg.encode(request), timeout=timeout)
-        return msg.decode(payload) if payload else None
+        payload = msg.encode(request)
+        if self._retry is None or name not in self._retryable:
+            out = self._calls[name](payload, timeout=timeout)
+            return msg.decode(out) if out else None
+        from elasticdl_tpu.rpc.retry import call_with_retry
+
+        def attempt():
+            # re-read the call table each attempt: a re-resolve may have
+            # swapped the channel under us
+            with self._channel_lock:
+                call = self._calls[name]
+            return call(payload, timeout=timeout)
+
+        out = call_with_retry(
+            attempt,
+            self._retry,
+            is_retryable=_retryable_grpc_error,
+            on_retry=self._maybe_reresolve,
+        )
+        return msg.decode(out) if out else None
 
     def close(self):
+        with self._channel_lock:
+            stale, self._stale_channels = self._stale_channels, []
+        for ch in stale:
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001
+                pass
         self._channel.close()
 
 
@@ -148,3 +258,8 @@ class MasterClient(RpcClient):
 
     def heartbeat(self, request: msg.HeartbeatRequest) -> msg.HeartbeatResponse:
         return self._call("heartbeat", request)
+
+    def rehome_worker(
+        self, request: msg.RehomeRequest
+    ) -> msg.RehomeResponse:
+        return self._call("rehome_worker", request)

@@ -50,6 +50,12 @@ EVENT_PROFILE_WINDOW_CLOSE = "profile_window_close"
 EVENT_REPLICA_PUSH = "replica_push"
 EVENT_REPLICA_HARVEST = "replica_harvest"
 EVENT_REPLICA_RESTORE = "replica_restore"
+# master high availability (master/journal.py): a master process came up
+# restored from the control-plane journal / finished replaying it / a
+# worker that outlived the outage re-homed onto the restarted master
+EVENT_MASTER_RESTART = "master_restart"
+EVENT_JOURNAL_REPLAY = "journal_replay"
+EVENT_WORKER_REHOME = "worker_rehome"
 
 EVENTS_FILENAME = "events.jsonl"
 

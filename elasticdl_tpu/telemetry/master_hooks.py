@@ -15,6 +15,7 @@ run loop pays nothing for ``/metrics`` being up.
 from __future__ import annotations
 
 import os
+import time
 
 from elasticdl_tpu.telemetry.events import (
     EVENT_JOB_END,
@@ -400,6 +401,70 @@ class MasterTelemetry:
         if span is not None:
             span.end(failed=True)
         self.tracer.flush()
+
+    def master_restart(self, generation: int):
+        """The master process is starting RESTORED from the control-plane
+        journal (master high availability).  Emitted at restore START so
+        the event's timestamp marks the end of the master-down phase in
+        downtime attribution."""
+        from elasticdl_tpu.telemetry.events import EVENT_MASTER_RESTART
+
+        self.events.emit(EVENT_MASTER_RESTART, generation=generation)
+
+    def journal_replay(
+        self,
+        generation: int,
+        duration_secs: float,
+        pending: int,
+        active: int,
+        epoch: int,
+        stage_lost: bool = False,
+    ):
+        """Journal replay finished; ``duration_secs`` lets event-only
+        consumers (telemetry.report) reconstruct the replay interval
+        without reading the span log.  ``stage_lost`` marks a staged
+        replica set that died with the previous master's RAM."""
+        from elasticdl_tpu.telemetry.events import EVENT_JOURNAL_REPLAY
+
+        self.events.emit(
+            EVENT_JOURNAL_REPLAY,
+            generation=generation,
+            duration_secs=duration_secs,
+            pending=pending,
+            active=active,
+            epoch=epoch,
+            stage_lost=stage_lost,
+        )
+
+    def worker_rehome(
+        self,
+        worker_id: int,
+        generation: int,
+        kept: int,
+        requeued: int,
+        started_at: float,
+    ):
+        """One worker re-homed onto the restarted master (lease
+        reconciliation outcome included)."""
+        from elasticdl_tpu.telemetry.events import EVENT_WORKER_REHOME
+        from elasticdl_tpu.telemetry.tracing import SPAN_WORKER_REHOME
+
+        self.events.emit(
+            EVENT_WORKER_REHOME,
+            worker_id=worker_id,
+            generation=generation,
+            kept=kept,
+            requeued=requeued,
+        )
+        self.tracer.record_span(
+            SPAN_WORKER_REHOME,
+            started_at,
+            time.monotonic(),
+            generation=generation,
+            worker_id=worker_id,
+            kept=kept,
+            requeued=requeued,
+        )
 
     def replica_harvest(
         self, generation, complete: bool, version, sources: int

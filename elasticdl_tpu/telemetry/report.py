@@ -212,7 +212,88 @@ def analyze_events(events: list[dict], faults: list[dict]) -> dict:
     replication = replication_section(events)
     if replication is not None:
         out["replication"] = replication
+    master_ha = master_ha_section(events)
+    if master_ha is not None:
+        out["master_ha"] = master_ha
     return out
+
+
+def master_ha_section(events: list[dict]) -> dict | None:
+    """Master-downtime stats (master high availability): one entry per
+    ``master_restart`` event — the measured step gap the outage caused
+    (last worker ``step`` before the restore began to the first after
+    the master served again, mirroring the reform-downtime definition),
+    the journal-replay cost, and the lease-reconciliation outcome of
+    every ``worker_rehome``.  None (key absent) when the run never
+    restarted a master, so HA-less reports are unchanged."""
+    restarts = sorted(
+        (
+            e
+            for e in events
+            if e.get("event") == "master_restart"
+            and e.get("monotonic") is not None
+        ),
+        key=lambda e: e["monotonic"],
+    )
+    if not restarts:
+        return None
+    steps = [
+        e["monotonic"]
+        for e in events
+        if e.get("event") == "step" and e.get("monotonic") is not None
+    ]
+    replays = sorted(
+        (e for e in events if e.get("event") == "journal_replay"),
+        key=lambda e: e.get("monotonic", 0.0),
+    )
+    rehomes = sorted(
+        (e for e in events if e.get("event") == "worker_rehome"),
+        key=lambda e: e.get("monotonic", 0.0),
+    )
+    entries = []
+    bounds = [r["monotonic"] for r in restarts[1:]] + [float("inf")]
+    for restart, until in zip(restarts, bounds):
+        at = restart["monotonic"]
+        last_before = max((t for t in steps if t <= at), default=None)
+        first_after = min((t for t in steps if t >= at), default=None)
+        replay = next(
+            (e for e in replays if at <= e.get("monotonic", 0.0) < until),
+            None,
+        )
+        mine = [
+            e for e in rehomes if at <= e.get("monotonic", 0.0) < until
+        ]
+        entries.append(
+            {
+                "generation": restart.get("generation"),
+                "downtime_secs": round(first_after - last_before, 6)
+                if last_before is not None and first_after is not None
+                else None,
+                "journal_replay_secs": replay.get("duration_secs")
+                if replay
+                else None,
+                "pending_tasks_restored": replay.get("pending")
+                if replay
+                else None,
+                "active_leases_restored": replay.get("active")
+                if replay
+                else None,
+                "workers_rehomed": sorted(
+                    e.get("worker_id") for e in mine
+                ),
+                "leases_kept": sum(e.get("kept", 0) for e in mine),
+                "leases_requeued": sum(e.get("requeued", 0) for e in mine),
+            }
+        )
+    measured = [
+        e["downtime_secs"]
+        for e in entries
+        if e["downtime_secs"] is not None
+    ]
+    return {
+        "restarts": entries,
+        "total_downtime_secs": round(sum(measured), 6) if measured else None,
+    }
 
 
 def replication_section(events: list[dict]) -> dict | None:
@@ -360,6 +441,25 @@ def _format_text(report: dict) -> str:
                         f"{w['median_step_ms']:.1f}ms "
                         f"({w['vs_generation_median']}x gen median)"
                     )
+        master_ha = run.get("master_ha")
+        if master_ha:
+            for restart in master_ha["restarts"]:
+                downtime = restart["downtime_secs"]
+                replay = restart["journal_replay_secs"]
+                lines.append(
+                    "master restart (gen {}): downtime {}  journal "
+                    "replay {}  re-homed workers {}  leases kept {} / "
+                    "requeued {}".format(
+                        restart["generation"],
+                        f"{downtime:.2f}s" if downtime is not None else "n/a",
+                        f"{replay * 1000:.0f}ms"
+                        if replay is not None
+                        else "n/a",
+                        restart["workers_rehomed"],
+                        restart["leases_kept"],
+                        restart["leases_requeued"],
+                    )
+                )
         replication = run.get("replication")
         if replication:
             for gen, n in sorted(replication["pushes_by_generation"].items()):

@@ -52,12 +52,15 @@ from elasticdl_tpu.telemetry.events import EVENTS_FILENAME, read_jsonl
 from elasticdl_tpu.telemetry.tracing import (
     SPAN_CHECKPOINT_RESTORE,
     SPAN_COMPILE,
+    SPAN_JOURNAL_REPLAY,
+    SPAN_MASTER_RESTART,
     SPAN_REFORM,
     SPAN_REFORM_FENCE,
     SPAN_REFORM_RELAUNCH,
     SPAN_REPLICA_HARVEST,
     SPAN_REPLICA_RESTORE,
     SPAN_TRAINER_BUILD,
+    SPAN_WORKER_REHOME,
     SPAN_WORLD_INITIALIZE,
     SPAN_WORLD_JOIN,
     SPANS_FILENAME,
@@ -351,11 +354,16 @@ def _attribute_gap(
     intervals: list[tuple[str, float, float]],
     gap_start: float,
     gap_end: float,
+    tail_name: str = "warmup_compile",
+    bridge: dict[str, str] | None = None,
 ) -> dict[str, float]:
     """Boundary sweep: every instant of the gap goes to the LAST listed
-    phase covering it; time after every known phase is the new world
-    warming up (compile + first dispatch); time covered by nothing
-    before that is ``unattributed``.  Values sum to the gap exactly."""
+    phase covering it; time after every known phase is ``tail_name``
+    (for a reform gap: the new world warming up); time covered by
+    nothing before that is bridged via ``bridge`` or ``unattributed``.
+    Values sum to the gap exactly."""
+    if bridge is None:
+        bridge = _BRIDGE_AFTER
     clamped = [
         (name, max(gap_start, lo), min(gap_end, hi))
         for name, lo, hi in intervals
@@ -381,7 +389,7 @@ def _attribute_gap(
                 owner = name
         if owner is None and last_known_end is not None:
             if mid >= last_known_end:
-                owner = "warmup_compile"
+                owner = tail_name
             else:
                 # between two known phases: name the segment for what
                 # the pipeline is doing after the preceding phase
@@ -392,11 +400,105 @@ def _attribute_gap(
                         preceding_end is None or ihi > preceding_end
                     ):
                         preceding, preceding_end = name, ihi
-                owner = _BRIDGE_AFTER.get(preceding)
+                owner = bridge.get(preceding)
         if owner is None:
             owner = "unattributed"
         phases[owner] += hi - lo
     return dict(phases)
+
+
+# uncovered time inside a master outage: after the restore span the
+# master is serving but workers have not noticed the new boot id yet
+# (heartbeat cadence); after the last re-home the world is re-leasing
+# and dispatching again
+_MASTER_OUTAGE_BRIDGE = {
+    "master_restore": "rehome_wait",
+    "journal_replay": "rehome_wait",
+    "worker_rehome": "resume_dispatch",
+}
+
+
+def _master_outages(spans: list[dict], events: list[dict]) -> list[dict]:
+    """Master-downtime attribution (master high availability): each
+    ``master_restart`` span (restore start -> serving) anchors one
+    outage.  The measured gap is the worker step stall around it — last
+    ``step`` event at/before the restore began to the first at/after
+    the master served again, the same measure ``telemetry.report`` uses
+    — broken into named phases: ``master_down`` (death to relaunch),
+    ``journal_replay``, ``master_restore`` (the rest of coming up),
+    ``worker_rehome`` (lease-reconciliation handshakes), ``rehome_wait``
+    / ``resume_dispatch`` (bridged idle).  The boundary sweep guarantees
+    the phases sum EXACTLY to the measured gap."""
+    restarts = sorted(
+        _spans_named(spans, SPAN_MASTER_RESTART), key=lambda s: s["start"]
+    )
+    if not restarts:
+        return []
+    step_times = sorted(
+        e["monotonic"]
+        for e in events
+        if e.get("event") == "step" and e.get("monotonic") is not None
+    )
+    outages = []
+    for restart in restarts:
+        gap_start = next(
+            (
+                t
+                for t in reversed(step_times)
+                if t <= restart["start"]
+            ),
+            restart["start"],
+        )
+        gap_end = next(
+            (t for t in step_times if t >= restart["end"]), restart["end"]
+        )
+        intervals: list[tuple[str, float, float]] = [
+            ("master_down", gap_start, restart["start"]),
+            ("master_restore", restart["start"], restart["end"]),
+        ]
+        for phase, name in (
+            ("journal_replay", SPAN_JOURNAL_REPLAY),
+            ("worker_rehome", SPAN_WORKER_REHOME),
+        ):
+            window = _merged_window(
+                [
+                    s
+                    for s in _spans_named(spans, name)
+                    if restart["start"] - _GAP_MATCH_SLACK_SECS
+                    <= s["start"]
+                    <= gap_end
+                ]
+            )
+            if window:
+                intervals.append((phase, window[0], window[1]))
+        downtime = max(0.0, gap_end - gap_start)
+        phases = (
+            _attribute_gap(
+                intervals,
+                gap_start,
+                gap_end,
+                tail_name="resume_dispatch",
+                bridge=_MASTER_OUTAGE_BRIDGE,
+            )
+            if downtime > 0
+            else {}
+        )
+        attributed = sum(
+            v for k, v in phases.items() if k != "unattributed"
+        )
+        outages.append(
+            {
+                "generation": restart.get("generation"),
+                "downtime_secs": round(downtime, 6),
+                "phases_secs": {
+                    k: round(v, 6) for k, v in sorted(phases.items())
+                },
+                "coverage": round(attributed / downtime, 4)
+                if downtime
+                else None,
+            }
+        )
+    return outages
 
 
 def _percentile(samples: list[float], q: float) -> float:
@@ -519,6 +621,7 @@ def analyze_telemetry_dir(telemetry_dir: str) -> dict:
         "traces_total": len({s.get("trace_id") for s in spans}),
         "recovered_task_spans": recovered_links,
         "reform_downtime": reform_downtime,
+        "master_outage": _master_outages(spans, events),
         "stragglers": stragglers,
     }
 
@@ -558,6 +661,19 @@ def _format_analysis(report: dict) -> str:
                 )
             )
             for phase, secs in gap["phases_secs"].items():
+                lines.append(f"  {phase:<20s} {secs:8.3f}s")
+        for outage in run.get("master_outage", []):
+            lines.append(
+                "master outage (gen {}): downtime {:.2f}s  coverage "
+                "{}".format(
+                    outage["generation"],
+                    outage["downtime_secs"],
+                    f"{outage['coverage'] * 100:.0f}%"
+                    if outage["coverage"] is not None
+                    else "n/a",
+                )
+            )
+            for phase, secs in outage["phases_secs"].items():
                 lines.append(f"  {phase:<20s} {secs:8.3f}s")
         for gen, stats in run["stragglers"].items():
             for worker, w in stats["workers"].items():

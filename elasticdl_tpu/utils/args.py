@@ -500,6 +500,45 @@ def _add_master_params(parser: argparse.ArgumentParser):
             "of submitting the job (k8s backend only)"
         ),
     )
+    # master high availability.  Defaults are None (not "") so an unset
+    # flag is absent from any reconstructed argv: with HA off, worker
+    # command lines and the k8s golden manifests stay byte-identical to
+    # a journal-less build (same rule as the replication flags)
+    parser.add_argument(
+        "--master_journal_dir",
+        default=None,
+        required=False,
+        help=(
+            "Write-ahead journal of the master's control-plane state "
+            "(dispatcher transitions, generation fences, lockstep "
+            "stream).  A master relaunched with the same directory "
+            "replays it, workers re-home onto the restarted master, and "
+            "the job survives master death.  Unset disables HA"
+        ),
+    )
+    parser.add_argument(
+        "--rpc_retry_secs",
+        type=non_neg_float,
+        default=None,
+        required=False,
+        help=(
+            "Worker RPC retry budget (full-jitter backoff) carried "
+            "across a master outage; forwarded to workers by env.  "
+            "Default 60 when --master_journal_dir is set, else retries "
+            "are off"
+        ),
+    )
+    parser.add_argument(
+        "--rehome_grace_secs",
+        type=non_neg_float,
+        default=None,
+        required=False,
+        help=(
+            "How long a journal-restored master waits for the previous "
+            "world's workers to re-home before declaring the silent "
+            "ones dead; default max(10, 3x heartbeat timeout)"
+        ),
+    )
     parser.add_argument(
         "--standby_workers",
         type=int,
@@ -665,6 +704,11 @@ _MASTER_ONLY_FLAGS = frozenset(
         "standby_workers",
         "yaml",
         "cluster_spec",
+        # master HA is the master's business: workers receive the addr
+        # file and retry budget via env (master/main.py), never argv
+        "master_journal_dir",
+        "rpc_retry_secs",
+        "rehome_grace_secs",
         # workers receive the telemetry dir via ELASTICDL_TPU_TELEMETRY_DIR
         # and the span sample rate via ELASTICDL_TPU_TRACE_SAMPLE_RATE
         # (master/main.py); they never serve /metrics themselves

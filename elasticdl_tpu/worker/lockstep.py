@@ -107,6 +107,12 @@ class LockstepWorker:
         )
         self._trainer: SPMDTrainer | None = None
         self._stopped = False
+        # master HA: the lease currently in flight (presented in the
+        # re-homing handshake) and the last master boot id seen on a
+        # heartbeat — a CHANGED boot id means this process outlived a
+        # master and must re-home
+        self._current_task_id: int | None = None
+        self._master_boot_id: str | None = None
         # shape-canonical batching: one dispatch shape per step kind, a
         # pure function of (minibatch_size, mesh) — identical on every
         # process, so the lockstep schedule AND shapes agree by
@@ -619,6 +625,10 @@ class LockstepWorker:
                     )
                     if self._replicator is not None and resp is not None:
                         self._replicator.set_peers(resp.replica_peers)
+                    if resp is not None:
+                        self._note_master_boot(
+                            getattr(resp, "boot_id", "")
+                        )
                 except Exception:  # noqa: BLE001 — master may be gone
                     pass
                 tracer = self._tracing.get_tracer()
@@ -633,6 +643,60 @@ class LockstepWorker:
                 time.sleep(interval_secs)
 
         threading.Thread(target=beat, daemon=True).start()
+
+    def _note_master_boot(self, boot_id: str):
+        """Heartbeat-thread hook: a changed master boot id means the
+        master restarted from its journal — re-home by presenting this
+        process's generation and in-flight lease so the restarted
+        dispatcher reconciles accounting (re-accept or requeue)."""
+        if not boot_id:
+            return
+        previous = self._master_boot_id
+        if previous is None or previous == boot_id:
+            self._master_boot_id = boot_id
+            return
+        # NOTE: this deliberately diverges from the task-stream
+        # Worker._note_master_boot — a lockstep process's generation is
+        # fixed at spawn, so a fence rejection is terminal (no
+        # adopt-and-retry) and the boot id advances even then.
+        # _master_boot_id commits AFTER the handshake so any exception
+        # (training thread racing _current_task_id, master flapping)
+        # retries on the next beat instead of skipping re-home forever.
+        try:
+            task = self._current_task_id  # one read: the training
+            # thread clears it concurrently
+            leases = [task] if task is not None else []
+            logger.warning(
+                "Master restarted (boot %s -> %s); re-homing worker %d "
+                "(generation %d, in-flight leases %s)",
+                previous[:8],
+                boot_id[:8],
+                self._worker_id,
+                self._cluster_version,
+                leases,
+            )
+            resp = self._master.rehome_worker(
+                msg.RehomeRequest(
+                    worker_id=self._worker_id,
+                    cluster_version=self._cluster_version,
+                    pid=os.getpid(),
+                    lease_ids=leases,
+                )
+            )
+        except Exception:  # noqa: BLE001 — the next heartbeat's boot id
+            # still differs from nothing new, but re-home retries ride
+            # the normal beat cadence via the comparison below
+            logger.exception("Re-home RPC failed; will retry")
+            return
+        self._master_boot_id = boot_id
+        if resp is not None and not resp.accepted:
+            # generation fence: this world is stale — exit like any
+            # fenced worker (the step-stream pull confirms and ends us)
+            logger.warning(
+                "Re-home rejected: generation %d is fenced (master at %d)",
+                self._cluster_version,
+                resp.cluster_version,
+            )
 
     def run(self, wait_sleep_secs: float = 1.0):
         self._stopped = False
@@ -675,18 +739,22 @@ class LockstepWorker:
                     )
                     break
                 seq += 1
-                if task.type == int(TaskType.TRAINING):
-                    self._train_task(task)
-                elif task.type == int(TaskType.EVALUATION):
-                    self._eval_task(task)
-                elif task.type == int(TaskType.PREDICTION):
-                    self._predict_task(task)
-                elif task.type == int(TaskType.SAVE_MODEL):
-                    self._save_model_task(task)
-                else:
-                    self._report_task_result(
-                        task.task_id, f"unknown task type {task.type}"
-                    )
+                self._current_task_id = task.task_id
+                try:
+                    if task.type == int(TaskType.TRAINING):
+                        self._train_task(task)
+                    elif task.type == int(TaskType.EVALUATION):
+                        self._eval_task(task)
+                    elif task.type == int(TaskType.PREDICTION):
+                        self._predict_task(task)
+                    elif task.type == int(TaskType.SAVE_MODEL):
+                        self._save_model_task(task)
+                    else:
+                        self._report_task_result(
+                            task.task_id, f"unknown task type {task.type}"
+                        )
+                finally:
+                    self._current_task_id = None
             self._dump_state_if_requested()
             ok = True
         finally:
@@ -703,24 +771,32 @@ class LockstepWorker:
                 self._tracing.flush()
                 if self._replicator is not None:
                     self._replicator.close()
-                if self._replica_server is not None:
-                    if ok:
+                if ok:
+                    if self._replica_server is not None:
                         self._replica_server.stop(grace=0)
-                    else:
-                        # a lockstep crash means the world is about to
-                        # re-form — LINGER with the replica server up so
-                        # the master can harvest this RAM's shards for
-                        # the restoring generation.  On TPU a survivor
-                        # naturally hangs in the dead collective and
-                        # keeps serving; on the CPU backend gloo errors
-                        # propagate fast and this process would exit
-                        # before the harvest arrives.  reform_world's
-                        # SIGKILL (or job-stop SIGTERM) ends the wait;
-                        # the cap bounds orphaned lingerers when the
-                        # master itself is gone.
-                        self._linger_for_harvest()
+                elif self._replica_server is not None or self._ha_mode():
+                    # a lockstep crash means the world is about to
+                    # re-form — LINGER rather than exit.  With
+                    # replication on, the replica server stays up so the
+                    # master can harvest this RAM's shards for the
+                    # restoring generation.  With master HA on, the
+                    # master may itself be MID-OUTAGE: gloo fails fast on
+                    # CPU when a collective partner dies, and exiting now
+                    # would beat the relaunched master to the fence — so
+                    # stay until reform_world's SIGKILL (or the linger
+                    # cap) ends the wait.  On TPU a survivor naturally
+                    # hangs in the dead collective and gets both for
+                    # free.
+                    self._linger_for_harvest()
 
     _LINGER_ENV = "ELASTICDL_TPU_REPLICA_LINGER_SECS"
+
+    def _ha_mode(self) -> bool:
+        """Master HA is on for this job (the master exported the addr
+        file the re-resolve hook reads)."""
+        from elasticdl_tpu.master.journal import MASTER_ADDR_FILE_ENV
+
+        return bool(os.environ.get(MASTER_ADDR_FILE_ENV, ""))
 
     def _linger_for_harvest(self):
         try:
@@ -728,17 +804,24 @@ class LockstepWorker:
         except ValueError:
             linger_secs = 300.0
         if linger_secs <= 0:
-            self._replica_server.stop(grace=0)
+            if self._replica_server is not None:
+                self._replica_server.stop(grace=0)
             return
         logger.warning(
-            "Process %d crashed with replication on: serving replica "
-            "shards for up to %.0fs so the re-forming master can "
-            "harvest them",
+            "Process %d crashed (%s): lingering up to %.0fs so the "
+            "(re-launched) master can fence this world%s",
             self._process_id,
+            "replication on"
+            if self._replica_server is not None
+            else "master HA on",
             linger_secs,
+            " and harvest replica shards"
+            if self._replica_server is not None
+            else "",
         )
         time.sleep(linger_secs)
-        self._replica_server.stop(grace=0)
+        if self._replica_server is not None:
+            self._replica_server.stop(grace=0)
 
     def _dump_state_if_requested(self):
         out_dir = os.environ.get(_DUMP_STATE_ENV, "")

@@ -24,6 +24,41 @@ from elasticdl_tpu.utils.args import parse_worker_args
 from elasticdl_tpu.utils.log_utils import default_logger as logger
 
 
+def build_master_client(master_addr: str) -> MasterClient:
+    """Master-HA-aware client: when the master exported a retry budget
+    (it does exactly when ``--master_journal_dir`` is set), RPCs back
+    off across a master outage and re-resolve the control-plane address
+    from the journal dir's addr file.  Without the env, the client is
+    the plain fail-fast one — byte-identical behavior."""
+    from elasticdl_tpu.master.journal import (
+        MASTER_ADDR_FILE_ENV,
+        read_master_addr,
+    )
+    from elasticdl_tpu.rpc.retry import (
+        DEFAULT_RETRY_SECS,
+        RETRY_SECS_ENV,
+        RetryPolicy,
+    )
+    from elasticdl_tpu.rpc.service import MASTER_RETRYABLE_METHODS
+
+    budget = os.environ.get(RETRY_SECS_ENV, "")
+    addr_file = os.environ.get(MASTER_ADDR_FILE_ENV, "")
+    if not budget and not addr_file:
+        return MasterClient(master_addr)
+    try:
+        budget_secs = float(budget) if budget else DEFAULT_RETRY_SECS
+    except ValueError:
+        budget_secs = DEFAULT_RETRY_SECS
+    return MasterClient(
+        master_addr,
+        retry=RetryPolicy.from_budget(budget_secs),
+        retryable_methods=MASTER_RETRYABLE_METHODS,
+        resolve_addr=(
+            (lambda: read_master_addr(addr_file)) if addr_file else None
+        ),
+    )
+
+
 def _standby_wait(args) -> bool:
     """Hot-standby mode: pay the cold-start cost NOW (imports dominate
     worker spawn latency), then block until the master writes a world
@@ -161,7 +196,7 @@ def main(argv=None) -> int:
         generation=int(getattr(args, "cluster_version", 0) or 0),
     )
     reform_parent = getattr(args, "trace", None) or tracing.parent_from_env()
-    client = MasterClient(args.master_addr)
+    client = build_master_client(args.master_addr)
     try:
         if coordinator_addr:
             from elasticdl_tpu.parallel import elastic

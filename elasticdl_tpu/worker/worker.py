@@ -96,6 +96,11 @@ class Worker:
             tracing.install_from_env(worker_id=self._worker_id)
         self._tracing = tracing
         self._task_traces: dict[int, dict] = {}
+        # the lease ledger the re-home handshake presents: every lease
+        # this worker holds an unreported task for.  Tracked
+        # UNCONDITIONALLY (the trace memo above exists only when tracing
+        # is on — re-homing must not depend on telemetry flags)
+        self._inflight_leases: set[int] = set()
 
         data_origin = (
             args.prediction_data
@@ -155,11 +160,13 @@ class Worker:
         task = self._master.get_task(
             msg.GetTaskRequest(worker_id=self._worker_id, task_type=task_type)
         )
+        if task.shard_name:
+            # WAIT polls are not leases and record nothing
+            self._inflight_leases.add(task.task_id)
         tracer = self._tracing.get_tracer()
         if tracer is not None and task.shard_name:
             # remember the lease's trace so the eventual report (and the
-            # task-execute span) joins the master's dispatch trace; WAIT
-            # polls are not leases and record nothing
+            # task-execute span) joins the master's dispatch trace
             self._task_traces[task.task_id] = task.trace
             from elasticdl_tpu.telemetry.tracing import SPAN_GET_TASK
 
@@ -198,6 +205,9 @@ class Worker:
             )
         )
         self._compile_deltas.commit(compile_mark)
+        # only after the report RPC returned: a lease whose report died
+        # with the master is still in flight and must be re-presented
+        self._inflight_leases.discard(task_id)
         tracer = self._tracing.get_tracer()
         if tracer is not None:
             from elasticdl_tpu.telemetry.tracing import SPAN_REPORT_TASK
@@ -674,6 +684,80 @@ class Worker:
         self.report_task_result(task.task_id, err)
         return True
 
+    def _note_master_boot(self, boot_id: str) -> bool:
+        """Master-HA re-homing for the task-stream runtime: a changed
+        master boot id means a restart — present the leases this worker
+        still holds unreported tasks for (its in-flight window) so the
+        restarted dispatcher re-accepts them and requeues the rest.
+
+        Returns True when the caller may adopt the heartbeat's
+        cluster_version: adopting it BEFORE the re-home handshake
+        completes would make the servicer's generation fence compare
+        the restarted master's generation to itself — vacuously
+        accepted — so while a re-home is pending (failed RPC, or
+        fence-rejected) the worker keeps presenting the generation it
+        held before it noticed the restart."""
+        if not boot_id:
+            return True
+        previous = getattr(self, "_master_boot_id", None)
+        if previous is None or previous == boot_id:
+            self._master_boot_id = boot_id
+            return True
+        import os
+
+        generation = getattr(self, "_master_cluster_version", 0)
+        # _master_boot_id is advanced ONLY on acceptance below: this
+        # whole body runs on the heartbeat thread, and the task thread
+        # mutates _inflight_leases concurrently — a mid-iteration
+        # RuntimeError (or any other surprise) must leave the boot id
+        # unchanged so the next beat retries instead of silently
+        # skipping the handshake forever
+        try:
+            leases = sorted(self._inflight_leases)
+            logger.warning(
+                "Master restarted; re-homing worker %d (generation %d, "
+                "leases %s)",
+                self._worker_id,
+                generation,
+                leases,
+            )
+            resp = self._master.rehome_worker(
+                msg.RehomeRequest(
+                    worker_id=self._worker_id,
+                    cluster_version=generation,
+                    pid=os.getpid(),
+                    lease_ids=leases,
+                )
+            )
+        except Exception:  # noqa: BLE001 — retried on the next beat's
+            # boot-id comparison
+            logger.exception("Re-home RPC failed; will retry")
+            return False
+        if resp is not None and not getattr(resp, "accepted", True):
+            # generation fence: adopt the master's fence and retry on
+            # the next beat instead of re-presenting the stale one
+            self._master_cluster_version = int(
+                getattr(resp, "cluster_version", generation)
+            )
+            logger.warning(
+                "Re-home rejected (stale generation %d -> %d); retrying",
+                generation,
+                self._master_cluster_version,
+            )
+            return False
+        # drop presented leases the restored master did NOT re-accept
+        # (e.g. leased in the journal's unflushed batch tail): their
+        # eventual reports would be dropped server-side and the task
+        # re-trains from the queue, so a later re-home must not present
+        # them again.  Only PRESENTED leases are dropped — the task
+        # thread may have added new ones while the RPC was in flight.
+        if resp is not None:
+            accepted = set(getattr(resp, "accepted_leases", None) or [])
+            for lease in set(leases) - accepted:
+                self._inflight_leases.discard(lease)
+        self._master_boot_id = boot_id
+        return True
+
     def _start_heartbeats(self, interval_secs: float = 5.0):
         """Background liveness pings so the master's failure detector works
         across long compute gaps (the TPU-build replacement for the k8s
@@ -684,13 +768,24 @@ class Worker:
             while not self._stopped:
                 t0 = time.monotonic()
                 try:
-                    self._master.heartbeat(
+                    resp = self._master.heartbeat(
                         msg.HeartbeatRequest(
                             worker_id=self._worker_id,
                             step=self._trainer.step if self._trainer else 0,
                             timestamp=time.time(),
                         )
                     )
+                    if resp is not None:
+                        # re-home BEFORE adopting the beat's generation:
+                        # the rehome fence must see the generation this
+                        # worker held across the outage, not the
+                        # restarted master's own
+                        if self._note_master_boot(
+                            getattr(resp, "boot_id", "")
+                        ):
+                            self._master_cluster_version = int(
+                                getattr(resp, "cluster_version", 0)
+                            )
                 except Exception:  # noqa: BLE001 — master may be gone
                     pass
                 tracer = self._tracing.get_tracer()

@@ -45,15 +45,31 @@ EVENT_CONST = re.compile(r"^EVENT_\w+\s*=\s*[\"']([^\"']+)[\"']", re.M)
 SPAN_CONST = re.compile(r"^SPAN_\w+\s*=\s*[\"']([^\"']+)[\"']", re.M)
 BARE_PRINT = re.compile(r"^\s*print\(")
 
-# the replication subsystem's vocabulary (ISSUE 4) plus the compile
-# span shape-canonical batching relies on (ISSUE 5): each name must
-# have exactly ONE definition site in the shared constants, so the
-# event schema, the span schema and the analyzers can never drift
+# the replication subsystem's vocabulary (ISSUE 4), the compile span
+# shape-canonical batching relies on (ISSUE 5), and the master-HA
+# vocabulary (ISSUE 6): each name must have exactly ONE definition site
+# in the shared constants, so the event schema, the span schema and the
+# analyzers can never drift
 REQUIRED_EVENT_NAMES = frozenset(
-    {"replica_push", "replica_restore", "replica_harvest"}
+    {
+        "replica_push",
+        "replica_restore",
+        "replica_harvest",
+        "master_restart",
+        "journal_replay",
+        "worker_rehome",
+    }
 )
 REQUIRED_SPAN_NAMES = frozenset(
-    {"replica_push", "replica_restore", "replica_harvest", "compile"}
+    {
+        "replica_push",
+        "replica_restore",
+        "replica_harvest",
+        "compile",
+        "master_restart",
+        "journal_replay",
+        "worker_rehome",
+    }
 )
 # metric families other tooling depends on (the compile-count regression
 # gate scrapes elasticdl_compile_total): must be registered somewhere,
