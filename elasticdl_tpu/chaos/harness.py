@@ -36,15 +36,18 @@ _KILL_KINDS = frozenset(
         FaultKind.KILL_IN_CHECKPOINT,
         FaultKind.KILL_DURING_REPLICATION,
         FaultKind.DROP_HEARTBEAT,
+        FaultKind.SLICE_LOSS,
     }
 )
 
 # kill kinds a COMPLETE replica set must survive: the victim's shard
 # lives on its ring neighbor, so the resumed generation must restore at
 # the last replicated step (kill_during_replication deliberately leaves
-# coverage incomplete and is therefore excluded)
+# coverage incomplete and is therefore excluded).  SLICE_LOSS qualifies
+# BECAUSE the ring is slice-aware: every dead process's shard lives on
+# a surviving slice — exactly what --corrupt same_slice_ring breaks.
 _REPLICA_RECOVERABLE_KINDS = frozenset(
-    {FaultKind.PREEMPT, FaultKind.KILL_COORDINATOR}
+    {FaultKind.PREEMPT, FaultKind.KILL_COORDINATOR, FaultKind.SLICE_LOSS}
 )
 
 # deliberate-corruption modes: prove the checker catches what it claims
@@ -53,12 +56,17 @@ _REPLICA_RECOVERABLE_KINDS = frozenset(
 # the control-plane journal between master lives — the master_recovery
 # invariant must flag the fence rollback (replay's monotone guard keeps
 # the run itself alive, so the trip is the checker's, not the job's).
+# ``same_slice_ring`` forces the slice-BLIND (i+1)%n replica ring onto a
+# multi-slice world (worker-side, via env): a slice loss then takes a
+# shard and its only replica together — cross_slice_replica_coverage
+# must flag the same-slice pushes and the restore degrades to disk.
 CORRUPTIONS = (
     "",
     "double_report",
     "lose_task",
     "version_rollback",
     "journal_rollback",
+    "same_slice_ring",
 )
 
 
@@ -93,6 +101,13 @@ class ChaosJobConfig:
     # master cannot drain.
     master_ha: bool = False
     rehome_grace_secs: float = 5.0
+    # slice-granular elasticity: split the worker fleet into this many
+    # forced TPU slices (hybrid ICI/DCN mesh on the CPU backend via the
+    # canonical process->slice map); 1 = classic single-slice reform
+    num_slices: int = 1
+    # start the job on fewer slices than the fleet (grow_under_load:
+    # a capacity grant then grows the world mid-training)
+    initial_slices: int | None = None
 
 
 def _master_args(config: ChaosJobConfig, train_dir: str, ckpt_dir: str):
@@ -104,6 +119,10 @@ def _master_args(config: ChaosJobConfig, train_dir: str, ckpt_dir: str):
         f"{chaos_hooks.PLAN_ENV}={os.path.join(config.workdir, 'chaos_plan.json')}",
         f"{chaos_hooks.EVENTS_ENV}={os.path.join(config.workdir, 'chaos_events.jsonl')}",
     ]
+    if config.corrupt == "same_slice_ring":
+        from elasticdl_tpu.replication.replicator import SAME_SLICE_RING_ENV
+
+        envs.append(f"{SAME_SLICE_RING_ENV}=1")
     return parse_master_args(
         [
             "--model_def",
@@ -161,6 +180,15 @@ def _master_args(config: ChaosJobConfig, train_dir: str, ckpt_dir: str):
                     "0",
                 ]
                 if config.master_ha
+                else []
+            ),
+            *(
+                # forced multi-slice fleet (standbys off: a standby is
+                # sliceless until activated, and slice plans re-form
+                # into RESIZED worlds the warm pool was not sized for)
+                ["--num_slices", str(config.num_slices),
+                 "--standby_workers", "0"]
+                if config.num_slices > 1
                 else []
             ),
             *config.extra_master_args,
@@ -258,7 +286,10 @@ class _CapacityDriver(threading.Thread):
         im = self._master.instance_manager
         if im is None or not getattr(im, "lockstep", False):
             return
-        full_size = im.world_size
+        # the size a RESTORE_CAPACITY grows back to: the configured
+        # fleet, not the CURRENT world — grow_under_load starts the job
+        # deliberately smaller than the fleet
+        full_size = getattr(im, "max_world_size", im.world_size)
         while self._pending and not self._stop.is_set():
             version = self._master.servicer.get_model_version()
             due = sorted(
@@ -483,20 +514,27 @@ def _read_events(path: str) -> tuple[list[dict], list[dict]]:
     return faults, observations
 
 
-def _replication_stats(telemetry_dir: str) -> dict:
+def _load_telemetry_events(telemetry_dir: str) -> list[dict]:
+    """ONE parse of the (possibly multi-shard, rotated) telemetry event
+    log per run — every post-run checker/stats consumer below shares
+    the returned list instead of re-reading the file."""
+    from elasticdl_tpu.telemetry.events import EVENTS_FILENAME, read_jsonl
+
+    return read_jsonl(os.path.join(telemetry_dir, EVENTS_FILENAME))
+
+
+def _replication_stats(events: list[dict]) -> dict:
     """Replica coverage from the run's telemetry event log — the SAME
     aggregation ``telemetry.report`` embeds, so ``chaos_result.json``
     and the report can never disagree on schema."""
-    from elasticdl_tpu.telemetry.events import EVENTS_FILENAME, read_jsonl
     from elasticdl_tpu.telemetry.report import replication_section
 
-    events = read_jsonl(os.path.join(telemetry_dir, EVENTS_FILENAME))
     return replication_section(events) or {}
 
 
 def _check_no_lost_steps(
     config: ChaosJobConfig,
-    telemetry_dir: str,
+    events: list[dict],
     fault_events: list[dict],
 ) -> dict | None:
     """The replication contract under a plain preemption: the resumed
@@ -511,9 +549,6 @@ def _check_no_lost_steps(
     ]
     if not recoverable:
         return None
-    from elasticdl_tpu.telemetry.events import EVENTS_FILENAME, read_jsonl
-
-    events = read_jsonl(os.path.join(telemetry_dir, EVENTS_FILENAME))
     kill_at = min(e["monotonic"] for e in recoverable)
     pushed = [
         int(e.get("step", -1))
@@ -542,6 +577,59 @@ def _check_no_lost_steps(
         )
     return {
         "name": "replication_no_lost_steps",
+        "status": "FAIL" if violations else "PASS",
+        "violations": violations,
+    }
+
+
+def check_cross_slice_coverage(
+    events: list[dict], num_slices: int
+) -> list[str]:
+    """The slice-aware replica-ring contract, as a pure function of the
+    telemetry event log (unit-testable against synthetic events): on a
+    multi-slice world every replica push must land on a DIFFERENT slice
+    than its source — otherwise a whole-slice preemption takes a shard
+    and its only replica together and the hot restore silently degrades
+    to disk.  Returns the violations (empty = PASS)."""
+    violations: list[str] = []
+    pushes = [
+        e
+        for e in events
+        if e.get("event") == "replica_push"
+        # only pushes made FROM a multi-slice world are in contract
+        # (a post-shrink single-slice world has no off-slice to push to)
+        and int(e.get("num_slices", 1) or 1) > 1
+    ]
+    if num_slices > 1 and not pushes:
+        violations.append(
+            "no replica_push events from a multi-slice world — ring "
+            "coverage unproven"
+        )
+    for e in pushes:
+        src, dst = e.get("source_slice"), e.get("target_slice")
+        if src is None or dst is None:
+            violations.append(
+                f"replica_push at step {e.get('step')} carries no slice "
+                "placement (source_slice/target_slice missing)"
+            )
+        elif src == dst:
+            violations.append(
+                f"replica_push at step {e.get('step')}: process "
+                f"{e.get('source')} pushed to process {e.get('target')} "
+                f"on its OWN slice {src} — a slice loss takes shard and "
+                "replica together"
+            )
+    return violations
+
+
+def _check_cross_slice_coverage(
+    config: ChaosJobConfig, events: list[dict]
+) -> dict | None:
+    if not config.replication or config.num_slices <= 1:
+        return None
+    violations = check_cross_slice_coverage(events, config.num_slices)
+    return {
+        "name": "cross_slice_replica_coverage",
         "status": "FAIL" if violations else "PASS",
         "violations": violations,
     }
@@ -613,6 +701,27 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
             "with master HA enabled (the forgery lands between master "
             "lives)"
         )
+    if config.corrupt == "same_slice_ring" and not (
+        config.replication and config.num_slices > 1
+    ):
+        # the corruption swaps the replica ring's neighbor function:
+        # without replication AND a multi-slice world it would corrupt
+        # nothing and the run would pass green
+        raise ValueError(
+            "--corrupt same_slice_ring requires replication on and "
+            "num_slices > 1 (it forces the slice-blind replica ring)"
+        )
+    slice_faults = [
+        f for f in config.plan.faults if f.kind == FaultKind.SLICE_LOSS
+    ]
+    if slice_faults and config.num_slices <= 1:
+        # a SLICE_LOSS on a single-slice world arms nothing (no process
+        # carries the target slice_id) — refuse rather than pass green
+        raise ValueError(
+            f"plan {config.plan.name!r} contains SLICE_LOSS faults but "
+            "num_slices is 1 — configure ChaosJobConfig.num_slices (the "
+            "runner does this for the slice plans)"
+        )
     started_at = time.monotonic()
     deadline = started_at + config.run_timeout_secs
     reform_events: list[dict] = []
@@ -622,6 +731,12 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
     fired_capacity: set[str] = set()
     while True:
         master = build_master(args)
+        if config.initial_slices is not None and hasattr(
+            master.instance_manager, "set_world_slices"
+        ):
+            # grow_under_load: the job STARTS on fewer slices than the
+            # fleet; the capacity-grant fault grows it mid-training
+            master.instance_manager.set_world_slices(config.initial_slices)
         # the SAME checker spans every master life: task identity is the
         # journaled uid, so the restored dispatcher's backlog replay
         # dedups onto the pre-outage records instead of resetting them
@@ -778,35 +893,51 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
         invariants["ok"] = False
 
     telemetry_dir = os.path.join(config.workdir, "telemetry")
-    replication_stats = (
-        _replication_stats(telemetry_dir) if config.replication else None
+    # ONE shared parse of the (possibly multi-shard) telemetry event log
+    # for every post-run checker and stats section below
+    telemetry_events = (
+        _load_telemetry_events(telemetry_dir)
+        if (
+            config.replication
+            or config.num_slices > 1
+            or config.master_ha
+        )
+        else []
     )
-    lost_steps = _check_no_lost_steps(config, telemetry_dir, fault_events)
+    replication_stats = (
+        _replication_stats(telemetry_events)
+        if config.replication
+        else None
+    )
+    lost_steps = _check_no_lost_steps(
+        config, telemetry_events, fault_events
+    )
     if lost_steps is not None:
         invariants["invariants"].append(lost_steps)
         if lost_steps["status"] == "FAIL":
             invariants["ok"] = False
-    # one shared parse of the (possibly multi-shard) telemetry event
-    # log for both HA consumers below
-    ha_events = None
-    if config.master_ha:
-        from elasticdl_tpu.telemetry.events import (
-            EVENTS_FILENAME,
-            read_jsonl,
-        )
+    cross_slice = _check_cross_slice_coverage(config, telemetry_events)
+    if cross_slice is not None:
+        invariants["invariants"].append(cross_slice)
+        if cross_slice["status"] == "FAIL":
+            invariants["ok"] = False
+    multislice_stats = None
+    if config.num_slices > 1:
+        from elasticdl_tpu.telemetry.report import multislice_section
 
-        ha_events = read_jsonl(
-            os.path.join(telemetry_dir, EVENTS_FILENAME)
-        )
+        multislice_stats = multislice_section(telemetry_events)
     master_recovery = _check_master_recovery(
-        config, telemetry_dir, master_lives=life + 1, events=ha_events
+        config,
+        telemetry_dir,
+        master_lives=life + 1,
+        events=telemetry_events if config.master_ha else None,
     )
     if master_recovery is not None:
         invariants["invariants"].append(master_recovery)
         if master_recovery["status"] == "FAIL":
             invariants["ok"] = False
     master_ha_stats = (
-        _master_ha_stats(telemetry_dir, events=ha_events)
+        _master_ha_stats(telemetry_dir, events=telemetry_events)
         if config.master_ha
         else None
     )
@@ -849,6 +980,8 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
     }
     if replication_stats is not None:
         report["replication"] = replication_stats
+    if multislice_stats is not None:
+        report["multislice"] = multislice_stats
     if master_ha_stats is not None:
         report["master_ha"] = master_ha_stats
     if config.master_ha:

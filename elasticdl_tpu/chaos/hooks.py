@@ -69,17 +69,23 @@ class ChaosInjector:
         cluster_version: int,
         worker_id: int,
         events_path: str = "",
+        slice_id: int = 0,
     ):
         self._process_id = process_id
         self._cluster_version = cluster_version
         self._worker_id = worker_id
+        self._slice_id = slice_id
         self._events_path = events_path
-        # faults this process may fire in this world generation
+        # faults this process may fire in this world generation; a
+        # SLICE_LOSS fault arms on every process OF ITS SLICE (the
+        # whole-slice preemption: they all reach at_step together and
+        # die together)
         self._pending: list[Fault] = [
             f
             for f in plan.worker_faults()
             if f.cluster_version == cluster_version
             and (f.process_id is None or f.process_id == process_id)
+            and (f.slice_id is None or f.slice_id == slice_id)
         ]
         # open windows: fault -> monotonic deadline
         self._heartbeat_block_until = 0.0
@@ -144,10 +150,20 @@ class ChaosInjector:
             self._fire(fault, step)
 
     def _fire(self, fault: Fault, step: int):
-        if fault.kind in (FaultKind.PREEMPT, FaultKind.KILL_COORDINATOR):
-            self._record(fault, step=step)
+        if fault.kind in (
+            FaultKind.PREEMPT,
+            FaultKind.KILL_COORDINATOR,
+            FaultKind.SLICE_LOSS,
+        ):
+            extra = (
+                {"slice_id": self._slice_id}
+                if fault.kind == FaultKind.SLICE_LOSS
+                else {}
+            )
+            self._record(fault, step=step, **extra)
             # a preemption gives no grace: no atexit, no finally blocks,
-            # no checkpoint flush — exactly what SIGKILL delivers
+            # no checkpoint flush — exactly what SIGKILL delivers (a
+            # SLICE_LOSS is the same death on every process of the slice)
             os.kill(os.getpid(), signal.SIGKILL)
         elif fault.kind == FaultKind.DROP_HEARTBEAT:
             self._record(fault, step=step)
@@ -231,7 +247,10 @@ class ChaosInjector:
 
 
 def install_from_env(
-    process_id: int, cluster_version: int, worker_id: int
+    process_id: int,
+    cluster_version: int,
+    worker_id: int,
+    slice_id: int = 0,
 ) -> ChaosInjector | None:
     """Install the process-wide injector if a plan is in the
     environment; returns it (or None).  Called by the worker runtime
@@ -251,6 +270,7 @@ def install_from_env(
         cluster_version=cluster_version,
         worker_id=worker_id,
         events_path=os.environ.get(EVENTS_ENV, ""),
+        slice_id=slice_id,
     )
     logger.warning(
         "Chaos plan %r installed (process %d, generation %d): %d fault(s) "

@@ -34,6 +34,11 @@ class FaultKind:
     # neighbor holds the new version — the torn/incomplete replica set
     # must be detected and skipped at harvest time
     KILL_DURING_REPLICATION = "kill_during_replication"
+    # whole-slice preemption: EVERY process whose slice_id matches the
+    # fault's dies at the armed step (atomically — lockstep worlds reach
+    # the step together).  The master must shrink the next world to the
+    # surviving slices (slice-granular reform), not crash the job
+    SLICE_LOSS = "slice_loss"
     # master-side
     REDUCE_CAPACITY = "reduce_capacity"  # shrink the world by `count`
     RESTORE_CAPACITY = "restore_capacity"  # back to full size
@@ -50,6 +55,7 @@ class FaultKind:
             DELAY_BATCHES,
             KILL_IN_CHECKPOINT,
             KILL_DURING_REPLICATION,
+            SLICE_LOSS,
         }
     )
     MASTER_SIDE = frozenset({REDUCE_CAPACITY, RESTORE_CAPACITY, MASTER_KILL})
@@ -84,6 +90,9 @@ class Fault:
     delay_ms: float = 0.0
     count: int = 1
     trigger: str = "step"
+    # SLICE_LOSS target: every process of this slice dies at at_step
+    # (None on every other kind)
+    slice_id: int | None = None
 
     def __post_init__(self):
         if self.kind not in FaultKind.ALL:
@@ -331,6 +340,38 @@ def builtin_plans(num_workers: int = 2) -> dict[str, FaultPlan]:
             "the relaunched master owns a fenced, half-recovered world "
             "— the journaled fence must hold and the job must still "
             "complete",
+        ),
+        "slice_loss_mid_epoch": FaultPlan(
+            name="slice_loss_mid_epoch",
+            faults=[
+                Fault(
+                    kind=FaultKind.SLICE_LOSS,
+                    fault_id="slice-loss-s1",
+                    at_step=_KILL_STEP,
+                    # the LAST slice (keeps slice 0's chief alive so the
+                    # surviving ring holds a full replica set); requires
+                    # a >=2-slice world (the runner configures one)
+                    slice_id=1,
+                )
+            ],
+            notes="whole-slice preemption mid-epoch: every process of "
+            "slice 1 dies atomically; reform must shrink the dp axis to "
+            "the surviving slices and (with replication) hot-restore "
+            "from the cross-slice replica ring",
+        ),
+        "grow_under_load": FaultPlan(
+            name="grow_under_load",
+            faults=[
+                Fault(
+                    kind=FaultKind.RESTORE_CAPACITY,
+                    fault_id="capacity-grant",
+                    at_step=_KILL_STEP,
+                )
+            ],
+            notes="capacity grant mid-training: the job starts on one "
+            "slice, a grant arrives under load, and reform grows the "
+            "dp axis across slices without losing or double-training "
+            "a record",
         ),
         "shrink_then_restore": FaultPlan(
             name="shrink_then_restore",

@@ -38,10 +38,47 @@ os.environ.setdefault("XLA_FLAGS", "")
 TRAJECTORY_TOLERANCE = 0.15
 
 # plans that exist to exercise peer state replication: --replication auto
-# turns the subsystem on for exactly these
+# turns the subsystem on for exactly these (slice_loss_mid_epoch rides
+# along: the point of the slice-aware ring is surviving a slice loss)
 REPLICATION_PLANS = frozenset(
-    {"preempt_after_replication", "kill_during_replication"}
+    {
+        "preempt_after_replication",
+        "kill_during_replication",
+        "slice_loss_mid_epoch",
+    }
 )
+
+# slice-granular plans need a multi-slice fleet; the harness forces the
+# layout onto CPU devices via the canonical process->slice map.
+# grow_under_load additionally STARTS the job on one slice so the
+# capacity grant has somewhere to grow.
+MULTISLICE_PLANS = {
+    "slice_loss_mid_epoch": {"num_slices": 2},
+    "grow_under_load": {"num_slices": 2, "initial_slices": 1},
+}
+
+# one-line descriptions of every invariant the checker can emit, for
+# --list discoverability (the checker itself owns the semantics)
+INVARIANT_DESCRIPTIONS = {
+    "exactly_once": "every training task completes successfully exactly "
+    "once (0 = lost shard, >1 = double-trained)",
+    "records_accounted": "successful task record sums match num_epochs x "
+    "dataset size and the dispatcher's own counters",
+    "version_monotonic": "no worker's reported model version decreases "
+    "within one world generation",
+    "reform_progress": "training advances PAST the highest pre-reform "
+    "version (no completing by looping restored state)",
+    "trajectory_parity": "|accuracy - fault-free baseline| within "
+    "tolerance (exactly-once data + resume correctness)",
+    "faults_injected": "the plan actually executed (a fault-free run "
+    "must not pass a fault-injection gate)",
+    "replication_no_lost_steps": "the re-formed world restored from peer "
+    "RAM at exactly the last replicated step before the kill",
+    "cross_slice_replica_coverage": "on a multi-slice world every "
+    "replica push lands on a DIFFERENT slice than its source",
+    "master_recovery": "a relaunched master restored from its journal "
+    "and the generation fence never rolled back",
+}
 
 # plans that kill the master: they require the journaled-HA control
 # plane (--master_journal_dir), which the harness turns on for exactly
@@ -66,7 +103,20 @@ def build_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--list-plans", action="store_true", help="List plans and exit"
     )
+    parser.add_argument(
+        "--list",
+        action="store_true",
+        help="List every registered plan AND invariant with one-line "
+        "descriptions, then exit 0",
+    )
     parser.add_argument("--num-workers", type=int, default=2)
+    parser.add_argument(
+        "--num-slices",
+        type=int,
+        default=None,
+        help="Force a multi-slice fleet for the chaos'd job; default: "
+        "what the plan needs (2 for the slice plans, else 1)",
+    )
     parser.add_argument("--num-records", type=int, default=1024)
     parser.add_argument("--num-epochs", type=int, default=2)
     parser.add_argument(
@@ -125,6 +175,12 @@ def _run(args, workdir: str) -> dict:
     replication = args.replication == "on" or (
         args.replication == "auto" and plan.name in REPLICATION_PLANS
     )
+    slice_config = MULTISLICE_PLANS.get(plan.name, {})
+    num_slices = (
+        args.num_slices
+        if args.num_slices is not None
+        else slice_config.get("num_slices", 1)
+    )
     report = run_chaos_job(
         ChaosJobConfig(
             plan=plan,
@@ -138,6 +194,8 @@ def _run(args, workdir: str) -> dict:
             replication=replication,
             master_ha=plan.name in MASTER_HA_PLANS
             or bool(plan.master_kill_faults()),
+            num_slices=num_slices,
+            initial_slices=slice_config.get("initial_slices"),
         )
     )
     if args.baseline and not args.corrupt:
@@ -213,6 +271,10 @@ def write_result_json(report: dict, workdir: str) -> str:
     # shard versions, restores) ride into the same CI artifact
     if report.get("replication") is not None:
         result["replication"] = report["replication"]
+    # slice-topology timeline (slice losses, mesh resizes, autoscale
+    # decisions) — the multislice smoke and CI read it from here
+    if report.get("multislice") is not None:
+        result["multislice"] = report["multislice"]
     # master-HA downtime stats (journal replay, re-homes, measured
     # master-down gap) — the same section telemetry.report computes
     if report.get("master_ha") is not None:
@@ -249,13 +311,19 @@ def write_result_json(report: dict, workdir: str) -> str:
 
 def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
-    if args.list_plans:
+    if args.list or args.list_plans:
         from elasticdl_tpu.chaos.plan import builtin_plans
 
+        print("Plans:")
         for name, plan in sorted(
             builtin_plans(args.num_workers).items()
         ):
-            print(f"{name:24s} {plan.notes}")
+            note = " ".join(plan.notes.split())
+            print(f"  {name:26s} {note}")
+        if args.list:
+            print("Invariants:")
+            for name, desc in sorted(INVARIANT_DESCRIPTIONS.items()):
+                print(f"  {name:26s} {desc}")
         return 0
 
     if args.workdir:

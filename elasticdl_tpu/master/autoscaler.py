@@ -1,0 +1,208 @@
+"""Telemetry-driven autoscaler: grow/shrink the slice count on SLOs.
+
+The master's run loop ticks :meth:`Autoscaler.evaluate` once per poll;
+the decision inputs ride telemetry channels the control plane already
+has — p95 step time derived from chief version reports (the servicer's
+version-observer seam, no new RPC), and the pending-task backlog from
+the dispatcher snapshot.  A decision is a REQUEST, not an action: the
+master resizes the next world (``set_world_slices``) and asks its own
+run loop to re-form (``request_reform``), exactly the path capacity
+faults and chaos already take — so an autoscale resize is
+indistinguishable from any other elective re-formation downstream
+(fence, replica harvest, hot restore, exactly-once accounting).
+
+All thresholds default to None/off; with no ``--autoscale_*`` flag set
+the master never constructs this object and behavior is byte-identical
+to an autoscaler-less build.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+DEFAULT_COOLDOWN_SECS = 30.0
+# shrink only when every configured SLO sits under this fraction of its
+# threshold (plus an empty backlog): hysteresis against flapping
+SHRINK_HEADROOM = 0.25
+# p95 window: enough samples to be a percentile, few enough to track a
+# regime change within a handful of tasks
+_WINDOW = 128
+
+
+class StepTimeTracker:
+    """Master-side step-time estimator riding the version-report channel.
+
+    The chief reports ``trainer.step`` after every task; consecutive
+    reports ``(t1, v1) -> (t2, v2)`` bound the mean per-step wall time of
+    the ``v2 - v1`` steps between them at ``(t2 - t1) / (v2 - v1)``.
+    Coarser than worker-side step spans, but master-local (no log reads
+    on the control path) and it tracks exactly the quantity the dp axis
+    changes: wall time per optimizer step."""
+
+    def __init__(self, window: int = _WINDOW):
+        self._lock = threading.Lock()
+        self._window = window
+        self._samples_ms: list[float] = []
+        self._last: tuple[float, int] | None = None
+
+    def note_version(self, worker_id: int, version: int):
+        now = time.monotonic()
+        with self._lock:
+            last = self._last
+            if last is not None and version > last[1]:
+                per_step_ms = (now - last[0]) * 1000.0 / (version - last[1])
+                self._samples_ms.append(per_step_ms)
+                if len(self._samples_ms) > self._window:
+                    del self._samples_ms[: -self._window]
+            if last is None or version >= last[1]:
+                self._last = (now, version)
+
+    def reset(self):
+        """A re-formation invalidates the baseline: the first report of
+        the new world would otherwise span the whole outage."""
+        with self._lock:
+            self._last = None
+            self._samples_ms.clear()
+
+    def p95_ms(self) -> float | None:
+        with self._lock:
+            samples = sorted(self._samples_ms)
+        if len(samples) < 4:
+            return None
+        idx = min(len(samples) - 1, int(round(0.95 * (len(samples) - 1))))
+        return samples[idx]
+
+
+class Autoscaler:
+    def __init__(
+        self,
+        p95_step_ms: float | None = None,
+        backlog_tasks: int | None = None,
+        cooldown_secs: float | None = None,
+        shrink: bool = False,
+        min_slices: int = 1,
+        max_slices: int = 1,
+        tracker: StepTimeTracker | None = None,
+    ):
+        self.p95_step_ms = p95_step_ms
+        self.backlog_tasks = backlog_tasks
+        self.cooldown_secs = (
+            cooldown_secs
+            if cooldown_secs is not None
+            else DEFAULT_COOLDOWN_SECS
+        )
+        self.shrink_enabled = bool(shrink)
+        self.min_slices = max(1, int(min_slices or 1))
+        self.max_slices = max(self.min_slices, int(max_slices or 1))
+        self.tracker = tracker if tracker is not None else StepTimeTracker()
+        self._last_decision_at: float | None = None
+        self.decisions: list[dict] = []
+
+    # the servicer version-observer hook (wired by Master.__init__)
+    def note_version(self, worker_id: int, version: int):
+        self.tracker.note_version(worker_id, version)
+
+    def note_reform(self):
+        """Any re-formation (autoscale-driven or not) restarts the
+        cooldown AND the step-time baseline: the new world must produce
+        fresh evidence before the next decision."""
+        self._last_decision_at = time.monotonic()
+        self.tracker.reset()
+
+    def evaluate(
+        self, backlog: int, current_slices: int, now: float | None = None
+    ) -> dict | None:
+        """One tick: returns a decision dict ``{"action", "from_slices",
+        "to_slices", "reason", "p95_step_ms", "backlog"}`` or None.  The
+        caller owns acting on it (resize + request_reform)."""
+        now = now if now is not None else time.monotonic()
+        if (
+            self._last_decision_at is not None
+            and now - self._last_decision_at < self.cooldown_secs
+        ):
+            return None
+        p95 = self.tracker.p95_ms()
+        decision = None
+        if (
+            self.backlog_tasks is not None
+            and backlog >= self.backlog_tasks
+            and current_slices < self.max_slices
+        ):
+            decision = self._decide(
+                "grow",
+                current_slices,
+                current_slices + 1,
+                f"backlog {backlog} >= {self.backlog_tasks}",
+                p95,
+                backlog,
+            )
+        elif (
+            self.p95_step_ms is not None
+            and p95 is not None
+            and p95 >= self.p95_step_ms
+            and current_slices < self.max_slices
+        ):
+            decision = self._decide(
+                "grow",
+                current_slices,
+                current_slices + 1,
+                f"p95 step {p95:.1f}ms >= {self.p95_step_ms:.1f}ms",
+                p95,
+                backlog,
+            )
+        elif self.shrink_enabled and current_slices > self.min_slices:
+            # shrinking needs POSITIVE evidence of over-provisioning: a
+            # MEASURED p95 under the headroom fraction of its SLO.  An
+            # empty backlog alone is not evidence — pending counts only
+            # UNLEASED tasks, so it reads 0 precisely while every worker
+            # is busy mid-lease, and shrinking then would requeue the
+            # leased tasks, spike the backlog over the grow threshold,
+            # and flap shrink/grow every cooldown period.
+            under_p95 = (
+                self.p95_step_ms is not None
+                and p95 is not None
+                and p95 <= SHRINK_HEADROOM * self.p95_step_ms
+            )
+            under_backlog = backlog == 0
+            if under_p95 and under_backlog:
+                decision = self._decide(
+                    "shrink",
+                    current_slices,
+                    current_slices - 1,
+                    "all SLOs under headroom with empty backlog",
+                    p95,
+                    backlog,
+                )
+        if decision is not None:
+            self._last_decision_at = now
+        return decision
+
+    def _decide(self, action, from_slices, to_slices, reason, p95, backlog):
+        decision = {
+            "action": action,
+            "from_slices": from_slices,
+            "to_slices": to_slices,
+            "reason": reason,
+            "p95_step_ms": round(p95, 3) if p95 is not None else None,
+            "backlog": backlog,
+        }
+        self.decisions.append(decision)
+        return decision
+
+
+def build_autoscaler(args, fleet_slices: int) -> Autoscaler | None:
+    """An Autoscaler when any ``--autoscale_*`` SLO is configured, else
+    None (the dormant default — no observer, no tick, no state)."""
+    p95 = getattr(args, "autoscale_p95_step_ms", None)
+    backlog = getattr(args, "autoscale_backlog_tasks", None)
+    if p95 is None and backlog is None:
+        return None
+    return Autoscaler(
+        p95_step_ms=p95,
+        backlog_tasks=backlog,
+        cooldown_secs=getattr(args, "autoscale_cooldown_secs", None),
+        shrink=bool(getattr(args, "autoscale_shrink", None)),
+        min_slices=getattr(args, "min_slices", None) or 1,
+        max_slices=fleet_slices,
+    )

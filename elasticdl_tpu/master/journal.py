@@ -339,14 +339,29 @@ class MasterJournal:
         )
 
     def record_world(
-        self, cluster_version: int, worker_ids: list[int], world_size: int
+        self,
+        cluster_version: int,
+        worker_ids: list[int],
+        world_size: int,
+        num_slices: int = 1,
+        slices: dict | None = None,
+        parked: bool = False,
     ):
+        """``num_slices``/``slices`` (worker_id -> slice_id, STRING keys
+        — JSON would coerce them anyway) carry the slice topology so a
+        restarted master keeps slice-granular reform working for the
+        re-homed world; ``parked`` marks a world gracefully degraded
+        below --min_slices (the restarted master must stay parked, not
+        relaunch a fleet the capacity cannot run)."""
         self._append(
             "world",
             critical=True,
             cluster_version=int(cluster_version),
             worker_ids=sorted(int(w) for w in worker_ids),
             world_size=int(world_size),
+            num_slices=int(num_slices),
+            slices={str(k): int(v) for k, v in (slices or {}).items()},
+            parked=bool(parked),
         )
 
     def record_stage(self, generation: int, version, complete: bool):
@@ -514,6 +529,13 @@ def replay(records: list[dict]) -> dict | None:
                 "cluster_version": int(rec["cluster_version"]),
                 "worker_ids": [int(w) for w in rec["worker_ids"]],
                 "world_size": int(rec["world_size"]),
+                # slice topology (absent on pre-multislice journals)
+                "num_slices": int(rec.get("num_slices", 1) or 1),
+                "slices": {
+                    str(k): int(v)
+                    for k, v in (rec.get("slices") or {}).items()
+                },
+                "parked": bool(rec.get("parked")),
             }
         elif kind == "stage":
             state["stage"] = {

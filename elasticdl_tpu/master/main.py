@@ -126,6 +126,9 @@ def build_master(args) -> Master:
             lockstep=lockstep,
             max_reforms=max_reforms,
             standby_workers=getattr(args, "standby_workers", -1),
+            # slice-granular elasticity: split the fleet into TPU slices
+            # (forced layout on sliceless backends); None = 1
+            num_slices=getattr(args, "num_slices", None) or 1,
         )
 
     return Master(args, instance_manager_factory=im_factory)

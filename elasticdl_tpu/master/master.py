@@ -145,6 +145,27 @@ class Master:
             instance_manager_factory(self) if instance_manager_factory else None
         )
 
+        # ---- slice-granular elasticity + autoscaler (off by default:
+        # with no --num_slices/--autoscale_* flag every path below is
+        # dormant and behavior is byte-identical to a slice-blind build)
+        self._min_slices = getattr(args, "min_slices", None) or 1
+        # parked = gracefully degraded below --min_slices: tasks are
+        # re-queued and fenced, no world runs, the job waits quiesced
+        # for a capacity grant (or autoscale grow) instead of crashing
+        self._parked = False
+        # the replica stage harvested when parking, held so the
+        # eventual unpark world can still hot-restore from peer RAM
+        self._parked_stage: dict | None = None
+        from elasticdl_tpu.master.autoscaler import build_autoscaler
+
+        self.autoscaler = build_autoscaler(
+            args, getattr(self.instance_manager, "fleet_slices", 1)
+        )
+        if self.autoscaler is not None:
+            # p95 step time rides the version-report channel the chaos
+            # checker and telemetry already observe — no new RPC
+            self.servicer.add_version_observer(self.autoscaler.note_version)
+
         # ---- telemetry (registry + event log + /metrics endpoint)
         from elasticdl_tpu.telemetry.master_hooks import MasterTelemetry
 
@@ -241,6 +262,13 @@ class Master:
         if world:
             self._restored_world = world
             self._rehome_pending = set(world["worker_ids"])
+            if world.get("parked"):
+                # the previous life parked below --min_slices: this one
+                # must come back parked too (prepare() skips the world
+                # launch; the parked replica stage died with the old
+                # master's RAM, so the eventual unpark restores from
+                # disk)
+                self._parked = True
         # replica-stage metadata: the staged payload was the previous
         # life's RAM and died with it — a complete stage for a still-
         # restoring generation means those workers now take the disk
@@ -315,16 +343,25 @@ class Master:
         if im is None:
             return
         ids = im.worker_ids()
+        slices = im.worker_slices() if hasattr(im, "worker_slices") else {}
         world = {
             "cluster_version": self.servicer.cluster_version,
             "worker_ids": sorted(ids),
             "world_size": getattr(im, "world_size", len(ids)),
+            "num_slices": getattr(im, "world_num_slices", 1),
+            "slices": {str(k): int(v) for k, v in slices.items()},
+            # graceful degradation: a restarted master must come back
+            # PARKED, not relaunch a fleet the capacity cannot run
+            "parked": self._parked,
         }
         self._restored_world = world
         if self.journal is not None:
             self.journal.record_world(
                 world["cluster_version"], world["worker_ids"],
                 world["world_size"],
+                num_slices=world["num_slices"],
+                slices=world["slices"],
+                parked=world["parked"],
             )
 
     def _on_worker_rehomed(
@@ -457,7 +494,19 @@ class Master:
                 if self._restored_world is not None and hasattr(
                     im, "set_world_size"
                 ):
-                    im.set_world_size(self._restored_world["world_size"])
+                    restored = self._restored_world
+                    if restored.get("num_slices", 1) > 1 and hasattr(
+                        im, "set_world_slices"
+                    ):
+                        im.set_world_slices(restored["num_slices"])
+                    else:
+                        im.set_world_size(restored["world_size"])
+                    if restored.get("slices") and hasattr(
+                        im, "restore_worker_slices"
+                    ):
+                        # the re-homed world keeps its slice map so a
+                        # post-restart slice loss still shrinks correctly
+                        im.restore_worker_slices(restored["slices"])
                 grace = getattr(self._args, "rehome_grace_secs", None)
                 if grace is None:
                     heartbeat = (
@@ -470,6 +519,22 @@ class Master:
                     "Waiting up to %.1fs for workers %s to re-home",
                     grace,
                     rehome_wait,
+                )
+            elif self._restored and self._parked:
+                # restored PARKED: capacity was below --min_slices when
+                # the previous master died — relaunching the fleet would
+                # crash-loop on hardware that is not there.  Stay
+                # quiesced; a capacity grant / autoscale grow unparks.
+                im = self.instance_manager
+                restored = self._restored_world or {}
+                if hasattr(im, "set_world_slices"):
+                    im.set_world_slices(restored.get("num_slices", 1))
+                self.servicer.begin_quiesce()
+                logger.warning(
+                    "Master restored PARKED (capacity below "
+                    "--min_slices %d); waiting quiesced for a capacity "
+                    "grant",
+                    self._min_slices,
                 )
             else:
                 self.instance_manager.start_workers()
@@ -556,6 +621,11 @@ class Master:
                             )
                         else:
                             self._reform_lockstep([], reason=reason)
+                if self.autoscaler is not None and not dead:
+                    # telemetry-driven elasticity: the autoscaler only
+                    # REQUESTS a resize; the run loop (above, next tick)
+                    # performs it through the same elective-reform path
+                    self._autoscale_tick()
                 if (
                     self.reform_events
                     and "latency_secs" not in self.reform_events[-1]
@@ -605,9 +675,28 @@ class Master:
 
     def _reform_lockstep(self, dead: list[int], reason: str):
         """Fence, recover, relaunch — the whole-world re-formation.
-        ``dead`` may be empty (elective re-formation: capacity change)."""
+        ``dead`` may be empty (elective re-formation: capacity change).
+
+        Slice-granular: when the fleet spans TPU slices, a WHOLE-slice
+        death shrinks the next world to the surviving slice set (the
+        dp axis contracts across DCN), a capacity grant grows it back,
+        and a shrink below ``--min_slices`` parks the job quiesced
+        instead of crashing."""
         im = self.instance_manager
         t0 = time.monotonic()
+        if self._parked and not dead:
+            target = getattr(im, "world_num_slices", 1)
+            if target < self._min_slices:
+                # parked below the floor: only a request that restores
+                # at least --min_slices may relaunch a world
+                logger.warning(
+                    "Job parked below --min_slices %d; ignoring "
+                    "re-formation request (%s) targeting %d slice(s)",
+                    self._min_slices,
+                    reason,
+                    target,
+                )
+                return
         logger.warning(
             "Re-forming the distributed world (%s; dead workers: %s)",
             reason,
@@ -629,6 +718,14 @@ class Master:
         new_version = self.servicer.bump_cluster_version()
         all_ids = set(dead) | set(im.worker_ids())
         old_world_size = len(all_ids)
+        worker_slices = (
+            im.worker_slices() if hasattr(im, "worker_slices") else {}
+        )
+        # the LIVE world's slice count comes from its worker->slice map
+        # ({} = single slice): ``world_num_slices`` is the NEXT world's
+        # target, which a capacity grant / autoscale decision already
+        # moved before requesting this re-formation
+        old_slices = len(set(worker_slices.values())) or 1
         self.telemetry.reform_start(
             new_version, dead, reason, old_world_size
         )
@@ -638,11 +735,17 @@ class Master:
             SPAN_REFORM_RELAUNCH,
         )
 
+        # slice-granular re-plan: a fully-dead slice is LOST CAPACITY —
+        # the next world shrinks to the surviving slice set (and parks
+        # when that drops below --min_slices)
+        park = self._plan_slice_topology(
+            new_version, dead, old_slices, worker_slices, reform_trace, t0
+        )
         # harvest the survivors' replica shards BEFORE the fence loop
         # forgets them (the directory loses their addresses there) and
         # before the relaunch kills them (their RAM dies there).  Stale
         # task leases are already fenced by the version bump above.
-        self._stage_replica_restore(
+        stage = self._stage_replica_restore(
             new_version, dead, old_world_size, reform_trace
         )
         with self.telemetry.tracer.span(
@@ -656,6 +759,29 @@ class Master:
         # generation bumped and journaled, old world fenced and its
         # tasks recovered, no new world launched yet
         self._crash_if_armed("reform")
+        if park:
+            self._park(new_version, old_world_size, stage, reason)
+            for callback in self.reform_callbacks:
+                try:
+                    callback(new_version, sorted(dead), reason)
+                except Exception:  # noqa: BLE001 — observers never
+                    # break recovery
+                    logger.exception("Reform callback failed")
+            return
+        new_world_size = getattr(im, "world_size", old_world_size)
+        new_slices = getattr(im, "world_num_slices", old_slices)
+        if new_world_size != old_world_size or new_slices != old_slices:
+            # re-plan the hybrid mesh for the new slice set (the workers
+            # re-derive the same layout from their slice coordinates at
+            # join — this is the master's validation + telemetry record)
+            self._announce_mesh_resize(
+                new_version,
+                old_world_size,
+                new_world_size,
+                old_slices,
+                new_slices,
+                reform_trace,
+            )
         # the relaunched world's workers link their world_join spans
         # into this re-formation's trace (argv spawns get it by env,
         # standbys in the stdin/RPC assignment payload)
@@ -678,6 +804,17 @@ class Master:
             self._job_failed = True
             self.request_stop()
             return
+        if self._parked:
+            # a world is running again: the graceful-degradation park is
+            # over (capacity grant or autoscale grow realized)
+            self._parked = False
+            self.servicer.clear_quiesce()
+            logger.warning(
+                "Job UNPARKED: world relaunched with %d slice(s)",
+                new_slices,
+            )
+        if self.autoscaler is not None:
+            self.autoscaler.note_reform()
         self.telemetry.reform_complete(
             new_version,
             old_world_size,
@@ -698,16 +835,223 @@ class Master:
             except Exception:  # noqa: BLE001 — observers never break recovery
                 logger.exception("Reform callback failed")
 
+    def _plan_slice_topology(
+        self,
+        new_version: int,
+        dead: list[int],
+        old_slices: int,
+        worker_slices: dict[int, int],
+        reform_trace: dict,
+        detected_at: float,
+    ) -> bool:
+        """Slice-loss accounting: slices whose EVERY process died are
+        lost capacity — shrink the next world to the survivors.  A
+        partially-dead slice is a software crash (capacity presumed
+        intact): relaunch at full size, as before.  Returns True when
+        the shrink would drop below ``--min_slices`` (the caller parks
+        instead of relaunching)."""
+        if not dead or old_slices <= 1 or not worker_slices:
+            return False
+        im = self.instance_manager
+        dead_set = set(dead)
+        lost = sorted(
+            {
+                s
+                for s in set(worker_slices.values())
+                if all(
+                    w in dead_set
+                    for w, ws in worker_slices.items()
+                    if ws == s
+                )
+            }
+        )
+        if not lost:
+            return False
+        if len(lost) >= old_slices:
+            # the whole world died at once: indistinguishable from a
+            # deterministic software crash — relaunch at full size (the
+            # reform budget bounds a crash loop) rather than shrinking
+            # to nothing on ambiguous evidence
+            logger.warning(
+                "All %d slices report dead; treating as a whole-world "
+                "crash (full-size relaunch), not a capacity loss",
+                old_slices,
+            )
+            return False
+        new_slices = old_slices - len(lost)
+        park = new_slices < self._min_slices
+        self.telemetry.slice_loss(
+            generation=new_version,
+            lost_slices=lost,
+            dead_workers=sorted(dead),
+            old_slices=old_slices,
+            new_slices=new_slices,
+            parked=park,
+            started_at=detected_at,
+            trace_ctx=reform_trace,
+        )
+        logger.warning(
+            "Slice loss: slice(s) %s fully dead — shrinking the next "
+            "world from %d to %d slice(s)%s",
+            lost,
+            old_slices,
+            new_slices,
+            " (BELOW --min_slices: parking)" if park else "",
+        )
+        if hasattr(im, "set_world_slices"):
+            im.set_world_slices(max(1, new_slices))
+        return park
+
+    def _announce_mesh_resize(
+        self,
+        new_version: int,
+        old_world_size: int,
+        new_world_size: int,
+        old_slices: int,
+        new_slices: int,
+        reform_trace: dict,
+    ):
+        """Validate + record the resized hybrid mesh plan: the dp axis
+        contracts/expands across the DCN slice dimension.  Advisory on
+        the master (workers re-derive the layout from their slice
+        coordinates); the telemetry record is the contract CI gates on
+        (``mesh_resize`` span in the multislice smoke)."""
+        from elasticdl_tpu.parallel.mesh import plan_dcn_axes
+        from elasticdl_tpu.utils.constants import MeshAxis
+
+        t0 = time.monotonic()
+        dcn: dict = {}
+        if new_slices > 1:
+            try:
+                # 1 process : N devices — dp scales with processes, so
+                # divisibility by the slice count is the invariant that
+                # matters and it is process-count-exact
+                dcn = plan_dcn_axes(
+                    {MeshAxis.DP: new_world_size}, new_slices, None
+                )
+            except ValueError:
+                logger.exception(
+                    "Resized mesh plan invalid (dp=%d over %d slices); "
+                    "workers will fail loudly at join",
+                    new_world_size,
+                    new_slices,
+                )
+        self.telemetry.mesh_resize(
+            generation=new_version,
+            old_world_size=old_world_size,
+            new_world_size=new_world_size,
+            old_slices=old_slices,
+            new_slices=new_slices,
+            dcn=dcn,
+            started_at=t0,
+            trace_ctx=reform_trace,
+        )
+
+    def _park(
+        self,
+        new_version: int,
+        old_world_size: int,
+        stage: dict | None,
+        reason: str,
+    ):
+        """Graceful degradation: the surviving capacity is below
+        ``--min_slices``.  Tear the world down (tasks are already
+        re-queued and the generation fenced), hold the harvested replica
+        stage for the eventual unpark world, and wait quiesced — the
+        next capacity grant or autoscale grow relaunches."""
+        im = self.instance_manager
+        self._parked = True
+        # the stage was staged for THIS generation, which will never
+        # run: hold it master-side; the unpark reform re-stamps it
+        self._parked_stage = stage
+        self.servicer.set_restore_stage(None)
+        self.servicer.begin_quiesce()
+        if hasattr(im, "teardown_world"):
+            im.teardown_world()
+        else:  # no dedicated teardown: a hard stop is the close analogue
+            im.stop_workers(grace_secs=0.0)
+        if self.autoscaler is not None:
+            self.autoscaler.note_reform()
+        self.telemetry.reform_complete(new_version, old_world_size, 0)
+        self._record_world()
+        logger.warning(
+            "Job PARKED quiesced (generation %d, %s): surviving "
+            "capacity is below --min_slices %d; waiting for a capacity "
+            "grant",
+            new_version,
+            reason,
+            self._min_slices,
+        )
+
+    def _autoscale_tick(self):
+        """Run-loop tick: evaluate the autoscaler's SLOs and turn a
+        decision into an elective re-formation request."""
+        im = self.instance_manager
+        if im is None or not getattr(im, "lockstep", False):
+            return
+        snap = self.task_d.snapshot()
+        backlog = snap["pending"] + snap["pending_eval"]
+        current = getattr(im, "world_num_slices", 1)
+        decision = self.autoscaler.evaluate(backlog, current)
+        if decision is None:
+            return
+        t0 = time.monotonic()
+        if hasattr(im, "set_world_slices"):
+            im.set_world_slices(decision["to_slices"])
+        self.telemetry.autoscale_decision(
+            generation=self.servicer.cluster_version,
+            started_at=t0,
+            **decision,
+        )
+        logger.warning(
+            "Autoscale %s: %d -> %d slice(s) (%s)",
+            decision["action"],
+            decision["from_slices"],
+            decision["to_slices"],
+            decision["reason"],
+        )
+        self.request_reform(f"autoscale:{decision['action']}")
+
     def _stage_replica_restore(
         self, new_version: int, dead: list[int], old_world_size: int,
         reform_trace: dict,
-    ):
+    ) -> dict | None:
         """Harvest the freshest complete replica set from surviving
         workers' RAM and stage it for the relaunched generation; stages
         None (disk fallback) when replication is off or coverage is
-        incomplete."""
+        incomplete.  Returns the stage so a parking caller can hold it
+        for the unpark world."""
         if self.replica_directory is None:
-            return
+            return None
+        if self._parked_stage is not None:
+            # unparking: the world that died parked left its harvest in
+            # master RAM — re-stamp it for the relaunching generation
+            # instead of harvesting from (nonexistent) survivors
+            stage = dict(self._parked_stage)
+            self._parked_stage = None
+            stage["generation"] = new_version
+            stage.pop("served", None)
+            stage["world_size"] = getattr(
+                self.instance_manager, "world_size", old_world_size
+            )
+            self.servicer.set_restore_stage(stage)
+            if self.journal is not None:
+                self.journal.record_stage(
+                    new_version, stage["version"], complete=True
+                )
+            self.telemetry.replica_harvest(
+                generation=new_version,
+                complete=True,
+                version=stage["version"],
+                sources=stage.get("sources", old_world_size),
+            )
+            logger.info(
+                "Unpark: serving the parked replica stage (version %s) "
+                "to generation %d",
+                stage["version"],
+                new_version,
+            )
+            return stage
         from elasticdl_tpu.telemetry.tracing import SPAN_REPLICA_HARVEST
 
         live = [
@@ -756,6 +1100,7 @@ class Master:
             version=stage["version"] if stage else None,
             sources=old_world_size,
         )
+        return stage
 
     def request_crash(self, site: str = "tick"):
         """Chaos hook (MASTER_KILL): arm an in-process master kill at a
@@ -953,6 +1298,7 @@ class LocalInstanceManager:
         lockstep: bool = False,
         max_reforms: int = 3,
         standby_workers: int = -1,
+        num_slices: int = 1,
     ):
         self._master = master
         self._num_workers = num_workers
@@ -961,6 +1307,29 @@ class LocalInstanceManager:
         self._envs = dict(envs or {})
         self.lockstep = lockstep and num_workers > 1
         self._max_reforms = max_reforms
+        # slice topology (--num_slices): the fleet splits into this many
+        # TPU slices; worlds resize in SLICE units (a whole-slice loss
+        # shrinks to the survivors, a capacity grant grows back) and
+        # every process learns its slice coordinates via world kwargs
+        num_slices = max(1, int(num_slices or 1))
+        if num_slices > 1 and not (lockstep and num_workers > 1):
+            logger.warning(
+                "--num_slices applies only to lockstep jobs "
+                "(num_workers > 1); ignoring"
+            )
+            num_slices = 1
+        if num_slices > 1 and num_workers % num_slices:
+            raise ValueError(
+                f"--num_workers {num_workers} not divisible by "
+                f"--num_slices {num_slices}: the local backend needs "
+                "equal processes per slice"
+            )
+        self._fleet_slices = num_slices
+        self._procs_per_slice = num_workers // num_slices
+        self._world_slices = num_slices
+        # worker_id -> slice_id of the LIVE world (used by the master's
+        # slice-loss accounting and the journal's world record)
+        self._worker_slices: dict[int, int] = {}
         self._reforms = 0
         self._procs: dict[int, object] = {}
         self._next_worker_id = 0
@@ -992,12 +1361,60 @@ class LocalInstanceManager:
     def world_size(self) -> int:
         return self._world_size
 
+    @property
+    def max_world_size(self) -> int:
+        """The configured fleet size — what a full capacity restore
+        grows back to (the live world may be smaller)."""
+        return self._num_workers
+
+    @property
+    def fleet_slices(self) -> int:
+        """Configured slice count of the full fleet (--num_slices)."""
+        return self._fleet_slices
+
+    @property
+    def world_num_slices(self) -> int:
+        """Slice count of the NEXT world (== the live one outside a
+        resize window)."""
+        return self._world_slices
+
     def set_world_size(self, n: int):
         """Resize the NEXT world (the live one is untouched until a
         re-formation — ask the master via ``request_reform``).  Clamped
         to [1, num_workers]: growth beyond the configured fleet would
-        need new capacity this manager does not own."""
-        self._world_size = max(1, min(self._num_workers, int(n)))
+        need new capacity this manager does not own.  On a multi-slice
+        fleet the size snaps DOWN to a whole number of slices — worlds
+        resize in slice units, never half a slice."""
+        n = max(1, min(self._num_workers, int(n)))
+        # getattr: partially-constructed test doubles predate slices
+        if getattr(self, "_fleet_slices", 1) > 1:
+            slices = max(1, n // self._procs_per_slice)
+            self._world_slices = min(slices, self._fleet_slices)
+            n = self._world_slices * self._procs_per_slice
+        self._world_size = n
+
+    def set_world_slices(self, n: int):
+        """Resize the NEXT world in slice units (slice-granular
+        elasticity: slice loss shrinks, capacity grant grows)."""
+        n = max(1, min(self._fleet_slices, int(n)))
+        self._world_slices = n
+        self._world_size = min(
+            self._num_workers, n * self._procs_per_slice
+        )
+
+    def worker_slices(self) -> dict[int, int]:
+        """worker_id -> slice_id of the live world ({} when single
+        slice): the master's slice-loss accounting input."""
+        with self._lock:
+            return dict(self._worker_slices)
+
+    def restore_worker_slices(self, mapping: dict[int, int]):
+        """Install a journal-restored world's slice map (the restarted
+        master adopted workers it never spawned)."""
+        with self._lock:
+            self._worker_slices = {
+                int(k): int(v) for k, v in (mapping or {}).items()
+            }
 
     def worker_ids(self) -> list[int]:
         with self._lock:
@@ -1032,10 +1449,21 @@ class LocalInstanceManager:
 
     def _start_world(self, cluster_version: int, num_processes: int | None = None):
         from elasticdl_tpu.parallel import elastic
+        from elasticdl_tpu.parallel.mesh import slice_assignments
 
         n = num_processes if num_processes is not None else self._world_size
         coordinator = f"localhost:{elastic.pick_coordinator_port()}"
         trace, self.pending_world_trace = self.pending_world_trace, None
+        # slice coordinates ride the world kwargs ONLY on a multi-slice
+        # world: single-slice worker argv stays byte-identical to a
+        # slice-blind build
+        assign = (
+            slice_assignments(n, self._world_slices)
+            if self._world_slices > 1
+            else None
+        )
+        with self._lock:
+            self._worker_slices = {}
         for process_id in range(n):
             world = dict(
                 coordinator_addr=coordinator,
@@ -1043,9 +1471,15 @@ class LocalInstanceManager:
                 process_id=process_id,
                 cluster_version=cluster_version,
             )
+            if assign is not None:
+                world["slice_id"] = assign[process_id]
+                world["num_slices"] = self._world_slices
             if trace:
                 world["trace"] = dict(trace)
             worker_id = self._claim_worker_id()
+            if assign is not None:
+                with self._lock:
+                    self._worker_slices[worker_id] = assign[process_id]
             if not self._activate_standby(worker_id, world):
                 self._start(worker_id, **world)
 
@@ -1222,6 +1656,26 @@ class LocalInstanceManager:
         threading.Thread(
             target=self._replenish_standbys, daemon=True
         ).start()
+
+    def teardown_world(self, budget: bool = False):
+        """Kill the live world WITHOUT relaunching — graceful
+        degradation's park path (the master harvested replicas first;
+        lingering crashed survivors end here).  ``budget=False``: a park
+        is not a crash loop."""
+        with self._lock:
+            procs = list(self._procs.values())
+            self._procs.clear()
+            self._worker_slices = {}
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+        if budget:
+            self._reforms += 1
 
     def stop_workers(self, grace_secs: float = 15.0):
         """Stop worker subprocesses.  Workers exit on their own once the

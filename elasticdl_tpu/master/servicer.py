@@ -623,6 +623,13 @@ class MasterServicer:
 
         self._emit(EVENT_QUIESCE_BEGIN, generation=generation)
 
+    def clear_quiesce(self):
+        """Drop the quiesce flag WITHOUT bumping the generation (the
+        graceful-degradation unpark: the relaunching re-formation
+        already bumped it)."""
+        with self._lock:
+            self._quiesce = False
+
     def end_quiesce(self):
         with self._lock:
             self._quiesce = False

@@ -64,6 +64,75 @@ def detect_num_slices(devices, slice_index_fn=None) -> int:
     return len(slices)
 
 
+def slice_assignments(num_processes: int, num_slices: int) -> list[int]:
+    """THE canonical process->slice map: contiguous blocks, earlier
+    slices absorbing the remainder (``np.array_split`` semantics).
+
+    Shared by the instance manager (world kwargs), the lockstep worker
+    (forced slice layout on backends without a device ``slice_index``)
+    and the replica ring (off-slice neighbor repin), so no two layers
+    can ever disagree about which process lives on which slice."""
+    if num_processes <= 0:
+        return []
+    num_slices = max(1, min(int(num_slices), num_processes))
+    out: list[int] = []
+    base, extra = divmod(num_processes, num_slices)
+    for s in range(num_slices):
+        out.extend([s] * (base + (1 if s < extra else 0)))
+    return out
+
+
+def process_slice_index_fn(num_processes: int, num_slices: int):
+    """A ``slice_index_fn`` for :meth:`MeshConfig.create` deriving each
+    device's slice from its owning PROCESS via the canonical
+    :func:`slice_assignments` map — how a forced multi-slice layout is
+    imposed on backends whose devices carry no usable ``slice_index``.
+    Deliberately ignores any device ``slice_index``: multi-process CPU
+    worlds expose a constant 0 on EVERY device, which would collapse
+    the forced layout back to one slice; callers that trust hardware
+    attributes go through :func:`resolved_slice_index_fn`."""
+    assign = slice_assignments(num_processes, num_slices)
+
+    def fn(device):
+        proc = int(getattr(device, "process_index", 0) or 0)
+        return assign[min(proc, len(assign) - 1)] if assign else 0
+
+    return fn
+
+
+def mesh_process_slice_map(mesh, slice_index_fn=None) -> list[int]:
+    """process_index -> slice id for every process in the mesh, derived
+    from the DEVICES (the resolved layout the collectives actually
+    follow), ordered by process index.  On hardware whose ``slice_index``
+    disagrees with the canonical process->slice assignment, the mesh is
+    the truth — consumers that need physical placement (the replica
+    ring's off-slice guarantee) read this, never the canonical map."""
+    get_slice = slice_index_fn or (
+        lambda d: getattr(d, "slice_index", 0) or 0
+    )
+    by_proc: dict[int, int] = {}
+    for d in mesh.devices.flat:
+        by_proc[int(d.process_index)] = int(get_slice(d))
+    return [by_proc[p] for p in sorted(by_proc)]
+
+
+def resolved_slice_index_fn(devices, num_processes: int, num_slices: int):
+    """The ``slice_index_fn`` a world assigned ``num_slices`` slices
+    should build its mesh with:
+
+    - None when single-slice, or when the backend already exposes a
+      non-degenerate multi-slice topology (real TPU multislice: the
+      hardware ``slice_index`` is authoritative);
+    - the canonical process->slice map otherwise (CPU backends expose
+      no ``slice_index`` — or a constant one on every device of a
+      multi-process world, which is just as sliceless)."""
+    if num_slices <= 1:
+        return None
+    if detect_num_slices(devices) > 1:
+        return None
+    return process_slice_index_fn(num_processes, num_slices)
+
+
 def plan_dcn_axes(
     sizes: dict[str, int], n_slices: int, dcn_axes: dict[str, int] | None
 ) -> dict[str, int]:

@@ -36,9 +36,38 @@ PUSH_TIMEOUT_SECS = 30.0
 
 REPLICA_HOST_ENV = "MY_POD_IP"  # k8s pods advertise their pod IP
 
+# chaos corruption (--corrupt same_slice_ring): force the slice-blind
+# (i+1)%n neighbor even on a multi-slice world, so the
+# cross_slice_replica_coverage invariant can be proven falsifiable — a
+# slice loss then takes a shard and its only replica together
+SAME_SLICE_RING_ENV = "ELASTICDL_TPU_CHAOS_SAME_SLICE_RING"
+
 
 def replica_host() -> str:
     return os.environ.get(REPLICA_HOST_ENV, "") or "127.0.0.1"
+
+
+def ring_neighbor(
+    process_id: int, num_processes: int, slice_map: list[int] | None = None
+) -> int:
+    """The ring-push target for ``process_id``.
+
+    Single-slice worlds keep the classic ``(i+1) % n``.  On a
+    multi-slice world the neighbor is REPINNED to the next process (in
+    ring order) living on a DIFFERENT slice, so at least one copy of
+    every shard survives a whole-slice preemption — with the classic
+    ring, a slice loss takes state and replicas together whenever two
+    ring-adjacent processes share a slice."""
+    if num_processes < 2:
+        return process_id
+    if not slice_map or len(set(slice_map)) <= 1:
+        return (process_id + 1) % num_processes
+    my_slice = slice_map[process_id]
+    for hop in range(1, num_processes):
+        candidate = (process_id + hop) % num_processes
+        if slice_map[candidate] != my_slice:
+            return candidate
+    return (process_id + 1) % num_processes
 
 
 class PeerReplicator:
@@ -50,12 +79,39 @@ class PeerReplicator:
         generation: int,
         addr: str,
         replication_steps: int = 0,
+        num_slices: int = 1,
+        slice_map: list[int] | None = None,
     ):
         self._store = store
         self._process_id = process_id
         self._num_processes = num_processes
         self._generation = generation
         self._addr = addr
+        # slice-aware ring.  ``slice_map`` (process -> slice) should be
+        # the MESH-derived physical placement (mesh_process_slice_map):
+        # on hardware whose slice_index disagrees with the canonical
+        # assignment, replicas must land off the PHYSICAL slice or a
+        # real preemption takes shard and copy together.  The canonical
+        # map is only the fallback (it equals the mesh-derived one on
+        # forced/CPU layouts).  The chaos corruption env forces the
+        # slice-blind classic ring so the coverage invariant is
+        # falsifiable.
+        from elasticdl_tpu.parallel.mesh import slice_assignments
+
+        if slice_map is not None and len(slice_map) == num_processes:
+            self._slice_map = list(slice_map)
+        elif num_slices > 1:
+            self._slice_map = slice_assignments(num_processes, num_slices)
+        else:
+            self._slice_map = []
+        if len(set(self._slice_map)) <= 1:
+            self._slice_map = []
+        self._slice_id = (
+            self._slice_map[process_id] if self._slice_map else 0
+        )
+        self._same_slice_ring = bool(
+            os.environ.get(SAME_SLICE_RING_ENV, "")
+        )
         # 0 = replicate at EVERY task boundary (the default cadence);
         # N > 0 = milestone-crossing every N steps, like the checkpointer
         self._steps = max(0, int(replication_steps or 0))
@@ -71,7 +127,21 @@ class PeerReplicator:
 
     @property
     def neighbor(self) -> int:
-        return (self._process_id + 1) % self._num_processes
+        if self._same_slice_ring:
+            # corruption mode: the pre-slice-aware ring, kept ONLY so
+            # --corrupt same_slice_ring can prove the coverage checker
+            # trips when a replica lands on its owner's slice
+            return (self._process_id + 1) % self._num_processes
+        return ring_neighbor(
+            self._process_id, self._num_processes, self._slice_map
+        )
+
+    def _slice_of(self, process_id: int) -> int:
+        return (
+            self._slice_map[process_id]
+            if self._slice_map and 0 <= process_id < len(self._slice_map)
+            else 0
+        )
 
     # ---- peer discovery (heartbeat thread) ---------------------------------
 
@@ -81,6 +151,7 @@ class PeerReplicator:
         return {
             "addr": self._addr,
             "process_id": self._process_id,
+            "slice_id": self._slice_id,
             "generation": self._generation,
             "holdings": self._store.holdings(),
         }
@@ -155,6 +226,13 @@ class PeerReplicator:
             step=version,
             source=self._process_id,
             target=self.neighbor,
+            # slice placement of the push: what the multi-slice chaos
+            # invariant (cross_slice_replica_coverage) audits — on a
+            # multi-slice world a shard's ring replica must live on a
+            # DIFFERENT slice than its owner
+            source_slice=self._slice_id,
+            target_slice=self._slice_of(self.neighbor),
+            num_slices=len(set(self._slice_map)) if self._slice_map else 1,
             ok=bool(ok),
         )
 

@@ -194,6 +194,10 @@ class WorldAssignmentResponse:
     num_processes: int = 1
     process_id: int = 0
     cluster_version: int = 0
+    # slice coordinates of a multi-slice world (slice-granular
+    # elasticity); defaults keep old payloads wire-compatible
+    slice_id: int = 0
+    num_slices: int = 1
     # reform trace context: the activated standby's world_join span links
     # into the master's re-formation trace
     trace: dict = field(default_factory=dict)

@@ -56,6 +56,13 @@ EVENT_REPLICA_RESTORE = "replica_restore"
 EVENT_MASTER_RESTART = "master_restart"
 EVENT_JOURNAL_REPLAY = "journal_replay"
 EVENT_WORKER_REHOME = "worker_rehome"
+# slice-granular elasticity: a whole slice's processes died (reform
+# shrinks to the survivors, or parks below --min_slices) / the hybrid
+# mesh was re-planned for a new slice set (dp axis resized over DCN) /
+# the autoscaler requested a grow/shrink on an SLO crossing
+EVENT_SLICE_LOSS = "slice_loss"
+EVENT_MESH_RESIZE = "mesh_resize"
+EVENT_AUTOSCALE_DECISION = "autoscale_decision"
 
 EVENTS_FILENAME = "events.jsonl"
 

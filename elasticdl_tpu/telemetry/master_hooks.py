@@ -466,6 +466,117 @@ class MasterTelemetry:
             requeued=requeued,
         )
 
+    def slice_loss(
+        self,
+        generation: int,
+        lost_slices: list,
+        dead_workers: list,
+        old_slices: int,
+        new_slices: int,
+        parked: bool,
+        started_at: float,
+        trace_ctx: dict | None = None,
+    ):
+        """A whole slice's processes died (slice-granular reform): the
+        span covers failure detection to the re-plan decision, inside
+        the re-formation's trace."""
+        from elasticdl_tpu.telemetry.events import EVENT_SLICE_LOSS
+        from elasticdl_tpu.telemetry.tracing import SPAN_SLICE_LOSS
+
+        self.events.emit(
+            EVENT_SLICE_LOSS,
+            generation=generation,
+            lost_slices=list(lost_slices),
+            dead_workers=list(dead_workers),
+            old_slices=old_slices,
+            new_slices=new_slices,
+            parked=bool(parked),
+        )
+        self.tracer.record_span(
+            SPAN_SLICE_LOSS,
+            started_at,
+            time.monotonic(),
+            trace_ctx=trace_ctx,
+            generation=generation,
+            lost_slices=list(lost_slices),
+            new_slices=new_slices,
+            parked=bool(parked),
+        )
+
+    def mesh_resize(
+        self,
+        generation: int,
+        old_world_size: int,
+        new_world_size: int,
+        old_slices: int,
+        new_slices: int,
+        dcn: dict | None,
+        started_at: float,
+        trace_ctx: dict | None = None,
+    ):
+        """The hybrid mesh was re-planned for a resized world (the dp
+        axis grows/shrinks across the DCN slice dimension) — the span
+        the multislice smoke gates on."""
+        from elasticdl_tpu.telemetry.events import EVENT_MESH_RESIZE
+        from elasticdl_tpu.telemetry.tracing import SPAN_MESH_RESIZE
+
+        self.events.emit(
+            EVENT_MESH_RESIZE,
+            generation=generation,
+            old_world_size=old_world_size,
+            new_world_size=new_world_size,
+            old_slices=old_slices,
+            new_slices=new_slices,
+            dcn=dict(dcn or {}),
+        )
+        self.tracer.record_span(
+            SPAN_MESH_RESIZE,
+            started_at,
+            time.monotonic(),
+            trace_ctx=trace_ctx,
+            generation=generation,
+            old_world_size=old_world_size,
+            new_world_size=new_world_size,
+            old_slices=old_slices,
+            new_slices=new_slices,
+        )
+        self.tracer.flush()
+
+    def autoscale_decision(
+        self,
+        generation: int,
+        started_at: float,
+        action: str,
+        from_slices: int,
+        to_slices: int,
+        reason: str,
+        p95_step_ms=None,
+        backlog=None,
+    ):
+        """The autoscaler crossed an SLO and requested a resize."""
+        from elasticdl_tpu.telemetry.events import EVENT_AUTOSCALE_DECISION
+        from elasticdl_tpu.telemetry.tracing import SPAN_AUTOSCALE_DECISION
+
+        self.events.emit(
+            EVENT_AUTOSCALE_DECISION,
+            generation=generation,
+            action=action,
+            from_slices=from_slices,
+            to_slices=to_slices,
+            reason=reason,
+            p95_step_ms=p95_step_ms,
+            backlog=backlog,
+        )
+        self.tracer.record_span(
+            SPAN_AUTOSCALE_DECISION,
+            started_at,
+            time.monotonic(),
+            generation=generation,
+            action=action,
+            from_slices=from_slices,
+            to_slices=to_slices,
+        )
+
     def replica_harvest(
         self, generation, complete: bool, version, sources: int
     ):

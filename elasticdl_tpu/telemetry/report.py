@@ -212,10 +212,72 @@ def analyze_events(events: list[dict], faults: list[dict]) -> dict:
     replication = replication_section(events)
     if replication is not None:
         out["replication"] = replication
+    multislice = multislice_section(events)
+    if multislice is not None:
+        out["multislice"] = multislice
     master_ha = master_ha_section(events)
     if master_ha is not None:
         out["master_ha"] = master_ha
     return out
+
+
+def multislice_section(events: list[dict]) -> dict | None:
+    """Slice-topology timeline (slice-granular elasticity): every
+    whole-slice loss, hybrid-mesh resize and autoscale decision, plus
+    per-slice replica-push counts (the cross-slice ring's observable).
+    None (key absent) when the run never touched slice machinery, so
+    single-slice reports are unchanged."""
+    losses = []
+    resizes = []
+    decisions = []
+    pushes_by_slice: dict[str, int] = defaultdict(int)
+    for event in events:
+        kind = event.get("event")
+        if kind == "slice_loss":
+            losses.append(
+                {
+                    "generation": event.get("generation"),
+                    "lost_slices": event.get("lost_slices"),
+                    "dead_workers": event.get("dead_workers"),
+                    "old_slices": event.get("old_slices"),
+                    "new_slices": event.get("new_slices"),
+                    "parked": event.get("parked"),
+                }
+            )
+        elif kind == "mesh_resize":
+            resizes.append(
+                {
+                    "generation": event.get("generation"),
+                    "old_world_size": event.get("old_world_size"),
+                    "new_world_size": event.get("new_world_size"),
+                    "old_slices": event.get("old_slices"),
+                    "new_slices": event.get("new_slices"),
+                    "dcn": event.get("dcn"),
+                }
+            )
+        elif kind == "autoscale_decision":
+            decisions.append(
+                {
+                    "generation": event.get("generation"),
+                    "action": event.get("action"),
+                    "from_slices": event.get("from_slices"),
+                    "to_slices": event.get("to_slices"),
+                    "reason": event.get("reason"),
+                }
+            )
+        elif (
+            kind == "replica_push"
+            and int(event.get("num_slices", 1) or 1) > 1
+        ):
+            pushes_by_slice[str(event.get("source_slice"))] += 1
+    if not (losses or resizes or decisions or pushes_by_slice):
+        return None
+    return {
+        "slice_losses": losses,
+        "mesh_resizes": resizes,
+        "autoscale_decisions": decisions,
+        "replica_pushes_by_source_slice": dict(pushes_by_slice),
+    }
 
 
 def master_ha_section(events: list[dict]) -> dict | None:
@@ -480,6 +542,50 @@ def _format_text(report: dict) -> str:
                         restore["generation"], restore["step"]
                     )
                 )
+        multislice = run.get("multislice")
+        if multislice:
+            for loss in multislice["slice_losses"]:
+                lines.append(
+                    "slice loss (gen {}): slices {} dead -> {} of {} "
+                    "slice(s) survive{}".format(
+                        loss["generation"],
+                        loss["lost_slices"],
+                        loss["new_slices"],
+                        loss["old_slices"],
+                        "  [PARKED below --min_slices]"
+                        if loss.get("parked")
+                        else "",
+                    )
+                )
+            for resize in multislice["mesh_resizes"]:
+                lines.append(
+                    "mesh resize (gen {}): {} procs / {} slice(s) -> "
+                    "{} procs / {} slice(s)  dcn={}".format(
+                        resize["generation"],
+                        resize["old_world_size"],
+                        resize["old_slices"],
+                        resize["new_world_size"],
+                        resize["new_slices"],
+                        resize["dcn"],
+                    )
+                )
+            for decision in multislice["autoscale_decisions"]:
+                lines.append(
+                    "autoscale {} (gen {}): {} -> {} slice(s)  "
+                    "({})".format(
+                        decision["action"],
+                        decision["generation"],
+                        decision["from_slices"],
+                        decision["to_slices"],
+                        decision["reason"],
+                    )
+                )
+            pushes = multislice["replica_pushes_by_source_slice"]
+            if pushes:
+                per_slice = " ".join(
+                    f"slice{s}={n}" for s, n in sorted(pushes.items())
+                )
+                lines.append(f"cross-slice replica pushes: {per_slice}")
         for worker, rate in run["records_per_sec_by_worker"].items():
             lines.append(f"throughput: worker {worker}: {rate:.1f} records/s")
         if run["worker_time_ms"]:

@@ -54,6 +54,7 @@ from elasticdl_tpu.telemetry.tracing import (
     SPAN_COMPILE,
     SPAN_JOURNAL_REPLAY,
     SPAN_MASTER_RESTART,
+    SPAN_MESH_RESIZE,
     SPAN_REFORM,
     SPAN_REFORM_FENCE,
     SPAN_REFORM_RELAUNCH,
@@ -616,12 +617,31 @@ def analyze_telemetry_dir(telemetry_dir: str) -> dict:
     recovered_links = sum(
         1 for s in spans if s.get("recovered") and s.get("trace_id")
     )
+    # slice-granular elasticity: every hybrid-mesh resize the run's
+    # re-formations performed (a separate listing — the resize re-plan
+    # runs inside the reform window, so it is NOT a new downtime phase
+    # and the sum-exact phase attribution above is untouched)
+    mesh_resizes = [
+        {
+            "generation": s.get("generation"),
+            "old_world_size": s.get("old_world_size"),
+            "new_world_size": s.get("new_world_size"),
+            "old_slices": s.get("old_slices"),
+            "new_slices": s.get("new_slices"),
+            "plan_secs": round(s["end"] - s["start"], 6),
+        }
+        for s in sorted(
+            _spans_named(spans, SPAN_MESH_RESIZE),
+            key=lambda s: s["start"],
+        )
+    ]
     return {
         "spans_total": len(spans),
         "traces_total": len({s.get("trace_id") for s in spans}),
         "recovered_task_spans": recovered_links,
         "reform_downtime": reform_downtime,
         "master_outage": _master_outages(spans, events),
+        "mesh_resizes": mesh_resizes,
         "stragglers": stragglers,
     }
 
@@ -675,6 +695,17 @@ def _format_analysis(report: dict) -> str:
             )
             for phase, secs in outage["phases_secs"].items():
                 lines.append(f"  {phase:<20s} {secs:8.3f}s")
+        for resize in run.get("mesh_resizes", []):
+            lines.append(
+                "mesh resize (gen {}): {} procs / {} slice(s) -> {} "
+                "procs / {} slice(s)".format(
+                    resize["generation"],
+                    resize["old_world_size"],
+                    resize["old_slices"],
+                    resize["new_world_size"],
+                    resize["new_slices"],
+                )
+            )
         for gen, stats in run["stragglers"].items():
             for worker, w in stats["workers"].items():
                 flag = "  STRAGGLER" if w["straggler"] else ""

@@ -76,6 +76,9 @@ SPAN_COMPILE = "compile"  # any process: one XLA backend compile
 SPAN_MASTER_RESTART = "master_restart"  # master: restore start -> serving
 SPAN_JOURNAL_REPLAY = "journal_replay"  # master: journal replay proper
 SPAN_WORKER_REHOME = "worker_rehome"  # master: one re-home handshake
+SPAN_SLICE_LOSS = "slice_loss"  # master: slice death detect -> re-plan
+SPAN_MESH_RESIZE = "mesh_resize"  # master: hybrid mesh re-plan (resize)
+SPAN_AUTOSCALE_DECISION = "autoscale_decision"  # master: one SLO decision
 
 
 def gen_trace_id() -> str:

@@ -539,6 +539,85 @@ def _add_master_params(parser: argparse.ArgumentParser):
             "ones dead; default max(10, 3x heartbeat timeout)"
         ),
     )
+    # slice-granular elasticity.  Defaults are None (not 1/0) so unset
+    # flags are absent from any reconstructed argv: with multislice and
+    # autoscaling off, worker command lines and the k8s golden manifests
+    # stay byte-identical to a slice-blind build (same rule as the
+    # replication and HA flags)
+    parser.add_argument(
+        "--num_slices",
+        type=pos_int,
+        default=None,
+        required=False,
+        help=(
+            "Split the worker fleet into this many TPU slices for the "
+            "hybrid ICI/DCN mesh (the dp axis spans slices over DCN).  "
+            "On backends without a device slice_index (CPU dryruns) the "
+            "layout is forced via the canonical process->slice map.  "
+            "Reform is then slice-granular: a whole-slice loss shrinks "
+            "the world to the surviving slices, a capacity grant grows "
+            "it back.  Unset = single slice (classic reform)"
+        ),
+    )
+    parser.add_argument(
+        "--min_slices",
+        type=pos_int,
+        default=None,
+        required=False,
+        help=(
+            "Graceful degradation floor: a slice loss that would shrink "
+            "the world below this parks the job quiesced (tasks "
+            "re-queued, no world running) instead of crashing; the next "
+            "capacity grant or autoscale grow resumes it.  Unset = 1"
+        ),
+    )
+    parser.add_argument(
+        "--autoscale_p95_step_ms",
+        type=pos_float,
+        default=None,
+        required=False,
+        help=(
+            "Autoscaler SLO: grow the world by one slice when the p95 "
+            "step time (master-observed from version reports) exceeds "
+            "this many milliseconds.  Unset disables the step-time "
+            "trigger"
+        ),
+    )
+    parser.add_argument(
+        "--autoscale_backlog_tasks",
+        type=pos_int,
+        default=None,
+        required=False,
+        help=(
+            "Autoscaler SLO: grow the world by one slice when the "
+            "pending (unleased) task backlog reaches this count.  "
+            "Unset disables the backlog trigger"
+        ),
+    )
+    parser.add_argument(
+        "--autoscale_cooldown_secs",
+        type=non_neg_float,
+        default=None,
+        required=False,
+        help=(
+            "Minimum seconds between autoscale decisions (and after any "
+            "re-formation) before the next decision may fire; default 30"
+        ),
+    )
+    parser.add_argument(
+        "--autoscale_shrink",
+        type=parse_bool,
+        default=None,
+        required=False,
+        help=(
+            "Let the autoscaler also SHRINK by one slice when the "
+            "MEASURED p95 step time sits under a quarter of "
+            "--autoscale_p95_step_ms with no backlog pending (down to "
+            "--min_slices).  Requires the p95 SLO: an empty backlog "
+            "alone is not over-provisioning evidence (it reads zero "
+            "while every worker is busy mid-lease).  Off unless set"
+        ),
+    )
     parser.add_argument(
         "--standby_workers",
         type=int,
@@ -583,6 +662,27 @@ def _add_worker_params(parser: argparse.ArgumentParser):
         help=(
             "World generation assigned by the master; fences stale "
             "workers after a mesh re-formation"
+        ),
+    )
+    # slice coordinates of a multi-slice lockstep world; assigned by the
+    # instance manager per process / per generation (like process_id),
+    # and ONLY when the world spans >1 slice — single-slice worker argv
+    # stays byte-identical to a slice-blind build
+    parser.add_argument(
+        "--slice_id",
+        type=non_neg_int,
+        default=0,
+        help="This worker's TPU slice index in the multi-slice world",
+    )
+    parser.add_argument(
+        "--num_slices",
+        type=pos_int,
+        default=1,
+        help=(
+            "Slices in the distributed world this worker joins; >1 "
+            "builds the hybrid ICI/DCN mesh (forced via the canonical "
+            "process->slice map on backends without a device "
+            "slice_index)"
         ),
     )
     parser.add_argument(
@@ -702,6 +802,16 @@ _MASTER_ONLY_FLAGS = frozenset(
         "heartbeat_timeout_secs",
         "task_timeout_secs",
         "standby_workers",
+        # slice-granular elasticity is the master's business: workers
+        # receive their slice coordinates (--slice_id/--num_slices) from
+        # the instance manager per generation, never from this flag, and
+        # the autoscaler runs only in the master's run loop
+        "num_slices",
+        "min_slices",
+        "autoscale_p95_step_ms",
+        "autoscale_backlog_tasks",
+        "autoscale_cooldown_secs",
+        "autoscale_shrink",
         "yaml",
         "cluster_spec",
         # master HA is the master's business: workers receive the addr

@@ -102,9 +102,36 @@ class LockstepWorker:
 
         mesh_shape = getattr(args, "mesh_shape", "") or ""
         dcn_shape = getattr(args, "dcn_mesh_shape", "") or ""
+        # slice coordinates of a multi-slice world (assigned by the
+        # instance manager per generation, like process_id).  On a
+        # backend whose devices carry no slice_index (CPU) the canonical
+        # process->slice map forces the hybrid ICI/DCN layout — the same
+        # map the master used to assign --slice_id, so membership and
+        # mesh can never disagree
+        self._slice_id = int(getattr(args, "slice_id", 0) or 0)
+        self._num_slices = int(getattr(args, "num_slices", 1) or 1)
+        slice_fn = None
+        if self._num_slices > 1:
+            from elasticdl_tpu.parallel.mesh import resolved_slice_index_fn
+
+            slice_fn = resolved_slice_index_fn(
+                devices if devices is not None else jax.devices(),
+                self._num_processes,
+                self._num_slices,
+            )
         self._mesh = MeshConfig.from_string(mesh_shape, dcn_shape).create(
-            devices
+            devices, slice_index_fn=slice_fn
         )
+        # the PHYSICAL process->slice placement the mesh resolved (==
+        # the canonical map on forced layouts; the hardware truth on
+        # real multislice) — what the replica ring keys off
+        self._mesh_slice_map: list[int] | None = None
+        if self._num_slices > 1:
+            from elasticdl_tpu.parallel.mesh import mesh_process_slice_map
+
+            self._mesh_slice_map = mesh_process_slice_map(
+                self._mesh, slice_fn
+            )
         self._trainer: SPMDTrainer | None = None
         self._stopped = False
         # master HA: the lease currently in flight (presented in the
@@ -129,7 +156,10 @@ class LockstepWorker:
         from elasticdl_tpu.chaos import hooks as chaos_hooks
 
         self._chaos = chaos_hooks.install_from_env(
-            self._process_id, self._cluster_version, self._worker_id
+            self._process_id,
+            self._cluster_version,
+            self._worker_id,
+            slice_id=self._slice_id,
         )
         # telemetry step sampling (no-op unless the master exported
         # ELASTICDL_TPU_TELEMETRY_DIR): a re-formed world installs a
@@ -170,10 +200,11 @@ class LockstepWorker:
         # single process has no surviving peer to restore from
         self._replicator = None
         self._replica_server = None
-        if (
-            bool(getattr(args, "replication", False))
-            and self._num_processes > 1
-        ):
+        # replication ON (the flag, not the ring): even a single-process
+        # world — e.g. one shrunk to a lone surviving slice — must still
+        # ASK the master for a staged replica harvest at restore time
+        self._replication_on = bool(getattr(args, "replication", False))
+        if self._replication_on and self._num_processes > 1:
             from elasticdl_tpu.replication.replicator import (
                 PeerReplicator,
                 replica_host,
@@ -192,6 +223,12 @@ class LockstepWorker:
                 generation=self._cluster_version,
                 addr=f"{replica_host()}:{replica_port}",
                 replication_steps=getattr(args, "replication_steps", 0) or 0,
+                # slice-aware ring: the neighbor is repinned off-slice so
+                # a whole-slice loss never takes a shard and its only
+                # replica together; keyed by the MESH's physical
+                # placement, not the canonical assignment
+                num_slices=self._num_slices,
+                slice_map=self._mesh_slice_map,
             )
         from elasticdl_tpu.utils.profiling import StepProfiler
 
@@ -305,7 +342,7 @@ class LockstepWorker:
         before relaunch, so every process of this world resolves the
         same source — the restore itself stays process-local either
         way (lockstep invariant preserved)."""
-        if self._replicator is not None:
+        if self._replication_on:
             from elasticdl_tpu.replication.replicator import (
                 restore_from_replica,
             )

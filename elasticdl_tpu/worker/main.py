@@ -158,6 +158,10 @@ def _poll_world_assignment(
                     "num_processes": resp.num_processes,
                     "process_id": resp.process_id,
                     "cluster_version": resp.cluster_version,
+                    # slice coordinates (multi-slice worlds; defaults
+                    # on single-slice jobs)
+                    "slice_id": resp.slice_id,
+                    "num_slices": resp.num_slices,
                     # reform trace context: the activated standby's
                     # world_join span links into the re-formation's trace
                     "trace": dict(resp.trace),

@@ -58,6 +58,10 @@ REQUIRED_EVENT_NAMES = frozenset(
         "master_restart",
         "journal_replay",
         "worker_rehome",
+        # slice-granular elasticity (ISSUE 7)
+        "slice_loss",
+        "mesh_resize",
+        "autoscale_decision",
     }
 )
 REQUIRED_SPAN_NAMES = frozenset(
@@ -69,6 +73,10 @@ REQUIRED_SPAN_NAMES = frozenset(
         "master_restart",
         "journal_replay",
         "worker_rehome",
+        # slice-granular elasticity (ISSUE 7)
+        "slice_loss",
+        "mesh_resize",
+        "autoscale_decision",
     }
 )
 # metric families other tooling depends on (the compile-count regression

@@ -1,0 +1,149 @@
+#!/usr/bin/env python3
+"""Tier-1 multislice smoke (wired into scripts/run_tier1.sh).
+
+Runs a tiny 2-process lockstep mnist job on the CPU backend with a
+FORCED 2-slice hybrid ICI/DCN layout (``--num_slices 2`` — each process
+is one slice; devices carry no ``slice_index``, so the canonical
+process->slice map drives ``slice_index_fn``) under the
+``slice_loss_mid_epoch`` chaos plan with peer state replication ON and
+``checkpoint_steps`` coarser than the replication cadence, then
+requires slice-granular reform to have actually happened:
+
+1. the chaos report's invariants all PASS — including
+   ``cross_slice_replica_coverage`` (every replica push landed on a
+   different slice than its source) and ``replication_no_lost_steps``
+   (the shrunken world restored at exactly the last replicated step);
+2. the span log contains a ``mesh_resize`` span whose slice count
+   SHRANK (the dp axis contracted to the surviving slice set);
+3. replication_smoke discipline extends across the resize: at least
+   one ``replica_restore`` span and ZERO ``checkpoint_restore_state``
+   spans — the slice loss recovered from the surviving slice's replica
+   ring with no disk read on the critical path.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "")
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main() -> int:
+    import tempfile
+
+    from elasticdl_tpu.chaos.harness import ChaosJobConfig, run_chaos_job
+    from elasticdl_tpu.chaos.plan import named_plan
+    from elasticdl_tpu.telemetry.tracing import (
+        SPAN_CHECKPOINT_RESTORE,
+        SPAN_MESH_RESIZE,
+        SPAN_REPLICA_RESTORE,
+        SPANS_FILENAME,
+        read_spans,
+    )
+
+    with tempfile.TemporaryDirectory() as workdir:
+        report = run_chaos_job(
+            ChaosJobConfig(
+                plan=named_plan("slice_loss_mid_epoch", 2),
+                workdir=os.path.join(workdir, "chaos"),
+                num_records=256,
+                num_epochs=2,
+                num_workers=2,
+                num_slices=2,
+                # coarser than the per-boundary replication cadence: a
+                # disk-only restore could NOT land at the step pushed
+                # right before the slice died
+                checkpoint_steps=4,
+                replication=True,
+                run_timeout_secs=300.0,
+            )
+        )
+        failed = [
+            i["name"]
+            for i in report["invariants"]
+            if i["status"] != "PASS"
+        ]
+        if not report["invariants_ok"] or failed:
+            print(
+                f"multislice_smoke: invariants failed: {failed} "
+                f"(rc={report.get('rc')}, timed_out="
+                f"{report.get('timed_out')})",
+                file=sys.stderr,
+            )
+            return 1
+        names = [i["name"] for i in report["invariants"]]
+        for required in (
+            "cross_slice_replica_coverage",
+            "replication_no_lost_steps",
+        ):
+            if required not in names:
+                print(
+                    f"multislice_smoke: {required} invariant missing "
+                    "from the report",
+                    file=sys.stderr,
+                )
+                return 1
+        spans = read_spans(
+            os.path.join(workdir, "chaos", "telemetry", SPANS_FILENAME)
+        )
+        resizes = [
+            s for s in spans if s.get("span") == SPAN_MESH_RESIZE
+        ]
+        shrunk = [
+            s
+            for s in resizes
+            if (s.get("new_slices") or 0) < (s.get("old_slices") or 0)
+        ]
+        if not shrunk:
+            print(
+                "multislice_smoke: no shrinking mesh_resize span — the "
+                f"slice loss did not resize the dp axis (resizes: "
+                f"{resizes})",
+                file=sys.stderr,
+            )
+            return 1
+        restores = [
+            s for s in spans if s.get("span") == SPAN_REPLICA_RESTORE
+        ]
+        disk_reads = [
+            s for s in spans if s.get("span") == SPAN_CHECKPOINT_RESTORE
+        ]
+        if not restores:
+            print(
+                "multislice_smoke: no replica_restore span — the "
+                "shrunken world did not restore from the surviving "
+                "slice's replica ring",
+                file=sys.stderr,
+            )
+            return 1
+        if disk_reads:
+            print(
+                f"multislice_smoke: {len(disk_reads)} "
+                "checkpoint_restore_state span(s) — a disk read leaked "
+                "onto the slice-loss recovery path",
+                file=sys.stderr,
+            )
+            return 1
+        stats = report.get("multislice") or {}
+    print(
+        "multislice_smoke: OK (mesh {}p/{}s -> {}p/{}s; restored at "
+        "step {} from peer RAM; cross-slice pushes {})".format(
+            shrunk[0].get("old_world_size"),
+            shrunk[0].get("old_slices"),
+            shrunk[0].get("new_world_size"),
+            shrunk[0].get("new_slices"),
+            restores[0].get("step"),
+            stats.get("replica_pushes_by_source_slice"),
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,8 +7,11 @@
 # MUST come from peer RAM: a replica_restore span and no
 # checkpoint_restore_state disk read) + master-HA smoke (SIGKILL the
 # master mid-epoch; it must relaunch from the journal, the workers must
-# re-home, and the job must complete) + the ROADMAP.md test command,
-# verbatim.
+# re-home, and the job must complete) + multislice smoke (force a
+# 2-slice layout onto CPU devices, kill a whole slice mid-epoch; reform
+# must shrink the dp axis to the survivors — a mesh_resize span — and
+# hot-restore from the cross-slice replica ring with zero disk reads)
+# + the ROADMAP.md test command, verbatim.
 # Run from the repo root: scripts/run_tier1.sh
 cd "$(dirname "$0")/.." || exit 2
 python scripts/check_telemetry_names.py || exit 1
@@ -16,4 +19,5 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/trace_smoke.py || exit 1
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/compile_smoke.py || exit 1
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/replication_smoke.py || exit 1
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/master_ha_smoke.py || exit 1
+timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/multislice_smoke.py || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

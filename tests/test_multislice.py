@@ -1,0 +1,984 @@
+"""Slice-granular elastic reform: the mesh seams, the slice-aware
+replica ring, the autoscaler policy, the master's shrink/park logic,
+and the cross_slice_replica_coverage checker's falsifiability.
+
+End-to-end (subprocess worlds, mesh resize, hot restore) lives in
+``scripts/multislice_smoke.py`` (tier-1) and the slow chaos acceptance
+tests; everything here is process-local and fast.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from elasticdl_tpu.parallel.mesh import (
+    detect_num_slices,
+    plan_dcn_axes,
+    process_slice_index_fn,
+    slice_assignments,
+)
+
+
+class _FakeDevice:
+    def __init__(self, process_index=0, slice_index=None):
+        self.process_index = process_index
+        if slice_index is not None:
+            self.slice_index = slice_index
+
+
+# ---- mesh seams the tentpole leans on ---------------------------------------
+
+
+class TestDetectNumSlices:
+    def test_empty_devices_is_one_slice(self):
+        assert detect_num_slices([]) == 1
+
+    def test_devices_without_slice_index_are_one_slice(self):
+        devices = [_FakeDevice(process_index=i) for i in range(4)]
+        assert detect_num_slices(devices) == 1
+
+    def test_mixed_none_slice_index_is_one_slice(self):
+        devices = [
+            _FakeDevice(process_index=0, slice_index=0),
+            _FakeDevice(process_index=1),
+        ]
+        assert detect_num_slices(devices) == 1
+
+    def test_real_slice_index_counted(self):
+        devices = [
+            _FakeDevice(process_index=i, slice_index=i // 2)
+            for i in range(4)
+        ]
+        assert detect_num_slices(devices) == 2
+
+    def test_slice_index_fn_override_forces_layout(self):
+        """The multichip-dryrun CPU path: host-platform devices carry no
+        slice_index; the fn imposes one."""
+        devices = [_FakeDevice(process_index=i) for i in range(4)]
+        assert (
+            detect_num_slices(
+                devices, slice_index_fn=lambda d: d.process_index % 2
+            )
+            == 2
+        )
+
+    def test_slice_index_fn_with_empty_devices(self):
+        assert detect_num_slices([], slice_index_fn=lambda d: 0) == 1
+
+
+class TestPlanDcnAxes:
+    def test_explicit_product_mismatch_is_clear_error(self):
+        with pytest.raises(ValueError, match="product 4 != number of slices 2"):
+            plan_dcn_axes({"dp": 8}, 2, {"dp": 4})
+
+    def test_non_divisible_dp_is_clear_error(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            plan_dcn_axes({"dp": 3}, 2, None)
+
+    def test_default_puts_all_slices_on_dp(self):
+        assert plan_dcn_axes({"dp": 8}, 2, None) == {"dp": 2}
+
+    def test_single_slice_is_empty_plan(self):
+        assert plan_dcn_axes({"dp": 8}, 1, {"dp": 8}) == {}
+
+    def test_dcn_axis_must_divide_mesh_axis(self):
+        with pytest.raises(ValueError, match="does not divide"):
+            plan_dcn_axes({"dp": 4, "fsdp": 3}, 2, {"fsdp": 2})
+
+
+class TestSliceAssignments:
+    def test_even_split(self):
+        assert slice_assignments(4, 2) == [0, 0, 1, 1]
+
+    def test_uneven_split_front_loads(self):
+        assert slice_assignments(5, 2) == [0, 0, 0, 1, 1]
+        assert slice_assignments(7, 3) == [0, 0, 0, 1, 1, 2, 2]
+
+    def test_single_slice(self):
+        assert slice_assignments(3, 1) == [0, 0, 0]
+
+    def test_clamps_slices_to_processes(self):
+        assert slice_assignments(2, 5) == [0, 1]
+
+    def test_empty(self):
+        assert slice_assignments(0, 2) == []
+
+    def test_process_slice_index_fn_uses_canonical_map(self):
+        fn = process_slice_index_fn(4, 2)
+        devices = [_FakeDevice(process_index=i) for i in range(4)]
+        assert [fn(d) for d in devices] == [0, 0, 1, 1]
+
+    def test_process_slice_index_fn_ignores_degenerate_slice_index(self):
+        """Multi-process CPU worlds expose a CONSTANT slice_index=0 on
+        every device; the forced map must win or the layout collapses
+        back to one slice (caught by the CLI drive, PR 7)."""
+        fn = process_slice_index_fn(4, 2)
+        devices = [
+            _FakeDevice(process_index=i, slice_index=0) for i in range(4)
+        ]
+        assert [fn(d) for d in devices] == [0, 0, 1, 1]
+
+    def test_resolved_fn_defers_to_real_multislice_hardware(self):
+        from elasticdl_tpu.parallel.mesh import resolved_slice_index_fn
+
+        real = [
+            _FakeDevice(process_index=i, slice_index=i // 2)
+            for i in range(4)
+        ]
+        assert resolved_slice_index_fn(real, 4, 2) is None
+
+    def test_resolved_fn_forces_on_degenerate_backends(self):
+        from elasticdl_tpu.parallel.mesh import resolved_slice_index_fn
+
+        for devices in (
+            [_FakeDevice(process_index=i) for i in range(4)],  # no attr
+            [
+                _FakeDevice(process_index=i, slice_index=0)  # constant
+                for i in range(4)
+            ],
+        ):
+            fn = resolved_slice_index_fn(devices, 4, 2)
+            assert fn is not None
+            assert [fn(d) for d in devices] == [0, 0, 1, 1]
+        assert resolved_slice_index_fn(devices, 4, 1) is None
+
+
+# ---- slice-aware replica ring ----------------------------------------------
+
+
+class TestRingNeighbor:
+    def _map(self, n, k):
+        return slice_assignments(n, k)
+
+    def test_single_slice_keeps_classic_ring(self):
+        from elasticdl_tpu.replication.replicator import ring_neighbor
+
+        for n in (2, 3, 4):
+            for i in range(n):
+                assert ring_neighbor(i, n, self._map(n, 1)) == (i + 1) % n
+
+    @pytest.mark.parametrize(
+        "n,k",
+        [
+            (2, 2),
+            (4, 2),
+            (6, 2),
+            (6, 3),
+            (3, 3),
+            # uneven processes-per-slice
+            (5, 2),
+            (5, 3),
+            (7, 3),
+        ],
+    )
+    def test_replica_never_on_owner_slice(self, n, k):
+        """The pin: for n_slices in {1,2,3} and uneven splits, a shard's
+        only ring replica NEVER lands on its owner's slice (a slice loss
+        would otherwise take state and replica together)."""
+        from elasticdl_tpu.replication.replicator import ring_neighbor
+
+        slice_map = self._map(n, k)
+        for i in range(n):
+            j = ring_neighbor(i, n, slice_map)
+            assert j != i
+            assert slice_map[j] != slice_map[i], (
+                f"process {i} (slice {slice_map[i]}) replicates onto its "
+                f"own slice via neighbor {j}"
+            )
+
+    def test_classic_ring_violates_on_shared_slice(self):
+        """Why the repin exists: with 2 procs per slice, (i+1)%n puts
+        p0's replica on p1 — the SAME slice."""
+        slice_map = self._map(4, 2)
+        assert slice_map[(0 + 1) % 4] == slice_map[0]
+
+    def test_same_slice_ring_env_restores_classic_ring(self, monkeypatch):
+        from elasticdl_tpu.replication.replicator import (
+            SAME_SLICE_RING_ENV,
+            PeerReplicator,
+        )
+        from elasticdl_tpu.replication.store import ReplicaStore
+
+        monkeypatch.setenv(SAME_SLICE_RING_ENV, "1")
+        rep = PeerReplicator(
+            ReplicaStore(),
+            process_id=0,
+            num_processes=4,
+            generation=0,
+            addr="127.0.0.1:1",
+            num_slices=2,
+        )
+        assert rep.neighbor == 1  # slice-blind: p1 shares slice 0
+        monkeypatch.delenv(SAME_SLICE_RING_ENV)
+        rep = PeerReplicator(
+            ReplicaStore(),
+            process_id=0,
+            num_processes=4,
+            generation=0,
+            addr="127.0.0.1:1",
+            num_slices=2,
+        )
+        assert rep.neighbor == 2  # slice-aware: first off-slice process
+        assert rep.advertisement()["slice_id"] == 0
+
+
+    def test_replicator_prefers_mesh_derived_slice_map(self):
+        """On hardware whose slice_index grouping diverges from the
+        canonical assignment, the ring must follow the PHYSICAL map."""
+        from elasticdl_tpu.replication.replicator import PeerReplicator
+        from elasticdl_tpu.replication.store import ReplicaStore
+
+        # physical: slice 0 = {p0, p2}, slice 1 = {p1, p3} — interleaved,
+        # unlike the canonical contiguous [0, 0, 1, 1]
+        rep = PeerReplicator(
+            ReplicaStore(),
+            process_id=0,
+            num_processes=4,
+            generation=0,
+            addr="127.0.0.1:1",
+            num_slices=2,
+            slice_map=[0, 1, 0, 1],
+        )
+        assert rep.neighbor == 1  # p1 IS off-slice physically
+        assert rep.advertisement()["slice_id"] == 0
+
+    def test_mesh_process_slice_map_reads_devices(self):
+        from elasticdl_tpu.parallel.mesh import mesh_process_slice_map
+
+        class _FakeMesh:
+            class devices:
+                flat = [
+                    _FakeDevice(process_index=0, slice_index=1),
+                    _FakeDevice(process_index=1, slice_index=0),
+                ]
+
+        assert mesh_process_slice_map(_FakeMesh()) == [1, 0]
+        forced = mesh_process_slice_map(
+            _FakeMesh(), slice_index_fn=lambda d: d.process_index
+        )
+        assert forced == [0, 1]
+
+
+# ---- cross_slice_replica_coverage: falsifiable ------------------------------
+
+
+class TestCrossSliceCoverage:
+    def _push(self, src, dst, src_slice, dst_slice, step=2, slices=2):
+        return {
+            "event": "replica_push",
+            "step": step,
+            "source": src,
+            "target": dst,
+            "source_slice": src_slice,
+            "target_slice": dst_slice,
+            "num_slices": slices,
+            "ok": True,
+        }
+
+    def test_cross_slice_pushes_pass(self):
+        from elasticdl_tpu.chaos.harness import check_cross_slice_coverage
+
+        events = [self._push(0, 2, 0, 1), self._push(2, 0, 1, 0)]
+        assert check_cross_slice_coverage(events, 2) == []
+
+    def test_same_slice_push_is_flagged(self):
+        """The --corrupt same_slice_ring trip: a push landing on its
+        owner's slice MUST fail the invariant."""
+        from elasticdl_tpu.chaos.harness import check_cross_slice_coverage
+
+        events = [self._push(0, 1, 0, 0), self._push(2, 0, 1, 0)]
+        violations = check_cross_slice_coverage(events, 2)
+        assert len(violations) == 1
+        assert "OWN slice" in violations[0]
+
+    def test_no_pushes_is_unproven_coverage(self):
+        from elasticdl_tpu.chaos.harness import check_cross_slice_coverage
+
+        violations = check_cross_slice_coverage([], 2)
+        assert violations and "unproven" in violations[0]
+
+    def test_single_slice_pushes_exempt(self):
+        """A post-shrink single-slice world legitimately pushes
+        on-slice (there is no other slice); only multi-slice pushes are
+        in contract."""
+        from elasticdl_tpu.chaos.harness import check_cross_slice_coverage
+
+        events = [
+            self._push(0, 1, 0, 0, slices=1),
+            self._push(0, 2, 0, 1, slices=2),
+        ]
+        assert check_cross_slice_coverage(events, 2) == []
+
+    def test_missing_slice_fields_flagged(self):
+        from elasticdl_tpu.chaos.harness import check_cross_slice_coverage
+
+        events = [
+            {
+                "event": "replica_push",
+                "step": 4,
+                "num_slices": 2,
+                "source": 0,
+                "target": 1,
+            }
+        ]
+        violations = check_cross_slice_coverage(events, 2)
+        assert violations and "no slice placement" in violations[0]
+
+
+# ---- chaos plumbing ---------------------------------------------------------
+
+
+class TestSliceLossFault:
+    def test_plan_registered(self):
+        from elasticdl_tpu.chaos.plan import FaultKind, builtin_plans
+        from elasticdl_tpu.chaos.runner import MULTISLICE_PLANS
+
+        plans = builtin_plans(2)
+        fault = plans["slice_loss_mid_epoch"].faults[0]
+        assert fault.kind == FaultKind.SLICE_LOSS
+        assert fault.slice_id == 1
+        assert fault.process_id is None
+        assert plans["grow_under_load"].faults[0].kind == (
+            FaultKind.RESTORE_CAPACITY
+        )
+        assert set(MULTISLICE_PLANS) <= set(plans)
+
+    def test_injector_arms_only_matching_slice(self, tmp_path):
+        from elasticdl_tpu.chaos.hooks import ChaosInjector
+        from elasticdl_tpu.chaos.plan import Fault, FaultKind, FaultPlan
+
+        plan = FaultPlan(
+            name="t",
+            faults=[
+                Fault(
+                    kind=FaultKind.SLICE_LOSS,
+                    fault_id="sl",
+                    at_step=4,
+                    slice_id=1,
+                )
+            ],
+        )
+        on_slice = ChaosInjector(
+            plan, process_id=2, cluster_version=0, worker_id=2, slice_id=1
+        )
+        off_slice = ChaosInjector(
+            plan, process_id=0, cluster_version=0, worker_id=0, slice_id=0
+        )
+        assert len(on_slice._pending) == 1
+        assert off_slice._pending == []
+
+    def test_slice_loss_roundtrips_json(self):
+        from elasticdl_tpu.chaos.plan import FaultPlan, named_plan
+
+        plan = named_plan("slice_loss_mid_epoch", 2)
+        again = FaultPlan.from_json(plan.to_json())
+        assert again.faults[0].slice_id == 1
+
+    def test_harness_refuses_slice_plan_without_slices(self, tmp_path):
+        from elasticdl_tpu.chaos.harness import ChaosJobConfig, run_chaos_job
+        from elasticdl_tpu.chaos.plan import named_plan
+
+        with pytest.raises(ValueError, match="SLICE_LOSS"):
+            run_chaos_job(
+                ChaosJobConfig(
+                    plan=named_plan("slice_loss_mid_epoch", 2),
+                    workdir=str(tmp_path / "w"),
+                    num_slices=1,
+                )
+            )
+
+    def test_harness_refuses_same_slice_ring_without_replication(
+        self, tmp_path
+    ):
+        from elasticdl_tpu.chaos.harness import ChaosJobConfig, run_chaos_job
+        from elasticdl_tpu.chaos.plan import named_plan
+
+        with pytest.raises(ValueError, match="same_slice_ring"):
+            run_chaos_job(
+                ChaosJobConfig(
+                    plan=named_plan("slice_loss_mid_epoch", 2),
+                    workdir=str(tmp_path / "w"),
+                    num_slices=2,
+                    replication=False,
+                    corrupt="same_slice_ring",
+                )
+            )
+
+    def test_runner_list_prints_plans_and_invariants(self, capsys):
+        from elasticdl_tpu.chaos import runner
+
+        assert runner.main(["--list"]) == 0
+        out = capsys.readouterr().out
+        assert "slice_loss_mid_epoch" in out
+        assert "grow_under_load" in out
+        assert "cross_slice_replica_coverage" in out
+        assert "exactly_once" in out
+
+
+# ---- autoscaler policy ------------------------------------------------------
+
+
+class TestAutoscaler:
+    def _scaler(self, **kw):
+        from elasticdl_tpu.master.autoscaler import Autoscaler
+
+        kw.setdefault("cooldown_secs", 0.0)
+        kw.setdefault("max_slices", 4)
+        return Autoscaler(**kw)
+
+    def test_build_returns_none_with_no_slos(self):
+        from argparse import Namespace
+
+        from elasticdl_tpu.master.autoscaler import build_autoscaler
+
+        args = Namespace(
+            autoscale_p95_step_ms=None, autoscale_backlog_tasks=None
+        )
+        assert build_autoscaler(args, 4) is None
+
+    def test_grow_on_backlog(self):
+        scaler = self._scaler(backlog_tasks=10)
+        decision = scaler.evaluate(backlog=12, current_slices=2, now=100.0)
+        assert decision["action"] == "grow"
+        assert decision["to_slices"] == 3
+
+    def test_no_grow_under_backlog_slo(self):
+        scaler = self._scaler(backlog_tasks=10)
+        assert scaler.evaluate(backlog=3, current_slices=2, now=100.0) is None
+
+    def test_grow_clamped_at_max_slices(self):
+        scaler = self._scaler(backlog_tasks=10, max_slices=2)
+        assert (
+            scaler.evaluate(backlog=50, current_slices=2, now=100.0) is None
+        )
+
+    def test_grow_on_p95(self):
+        scaler = self._scaler(p95_step_ms=100.0)
+        for i in range(20):
+            # 2 steps per second -> 500ms/step, way over the 100ms SLO
+            scaler.tracker._samples_ms.append(500.0)
+        decision = scaler.evaluate(backlog=0, current_slices=1, now=100.0)
+        assert decision["action"] == "grow"
+        assert decision["p95_step_ms"] == 500.0
+
+    def test_cooldown_blocks_consecutive_decisions(self):
+        scaler = self._scaler(backlog_tasks=10, cooldown_secs=30.0)
+        assert scaler.evaluate(10, 1, now=100.0)["action"] == "grow"
+        assert scaler.evaluate(10, 2, now=110.0) is None  # cooling down
+        assert scaler.evaluate(10, 2, now=140.0)["action"] == "grow"
+
+    def test_reform_restarts_cooldown_and_baseline(self):
+        scaler = self._scaler(backlog_tasks=10, cooldown_secs=1e6)
+        scaler.tracker._samples_ms.extend([100.0] * 8)
+        scaler.note_reform()
+        assert scaler.tracker.p95_ms() is None
+        assert scaler.evaluate(50, 1) is None  # cooldown holds
+
+    def test_shrink_gated_and_bounded(self):
+        scaler = self._scaler(
+            p95_step_ms=100.0, shrink=True, min_slices=1, max_slices=4
+        )
+        # measured p95 well under a quarter of the SLO: over-provisioned
+        scaler.tracker._samples_ms.extend([10.0] * 8)
+        decision = scaler.evaluate(backlog=0, current_slices=2, now=100.0)
+        assert decision["action"] == "shrink"
+        assert decision["to_slices"] == 1
+        # at the floor: no further shrink
+        assert scaler.evaluate(backlog=0, current_slices=1, now=200.0) is None
+
+    def test_no_shrink_on_empty_backlog_alone(self):
+        """pending counts only UNLEASED tasks — it reads 0 while every
+        worker is busy mid-lease, so an empty backlog must never be
+        shrink evidence by itself (a shrink would requeue the leases,
+        spike the backlog, and flap grow/shrink every cooldown)."""
+        scaler = self._scaler(
+            backlog_tasks=10, shrink=True, min_slices=1, max_slices=4
+        )
+        assert scaler.evaluate(backlog=0, current_slices=2, now=100.0) is None
+
+    def test_no_shrink_without_flag(self):
+        scaler = self._scaler(p95_step_ms=100.0)
+        scaler.tracker._samples_ms.extend([10.0] * 8)
+        assert scaler.evaluate(backlog=0, current_slices=2, now=100.0) is None
+
+    def test_step_time_tracker_p95(self):
+        from elasticdl_tpu.master.autoscaler import StepTimeTracker
+
+        tracker = StepTimeTracker()
+        assert tracker.p95_ms() is None  # too few samples
+        tracker._samples_ms.extend(float(i) for i in range(1, 101))
+        assert tracker.p95_ms() == pytest.approx(96.0, abs=1.0)
+
+    def test_step_time_tracker_derives_per_step_interval(self):
+        from elasticdl_tpu.master.autoscaler import StepTimeTracker
+
+        tracker = StepTimeTracker()
+        import time as _time
+
+        t0 = _time.monotonic()
+        tracker._last = (t0 - 1.0, 10)  # 1s ago at version 10
+        tracker.note_version(0, 20)  # 10 steps in ~1s -> ~100ms/step
+        assert tracker._samples_ms[-1] == pytest.approx(100.0, rel=0.2)
+
+
+# ---- instance-manager slice math -------------------------------------------
+
+
+class TestInstanceManagerSlices:
+    def _im(self, num_workers=4, num_slices=2):
+        from elasticdl_tpu.master.master import LocalInstanceManager
+
+        return LocalInstanceManager(
+            master=None,
+            num_workers=num_workers,
+            build_argv=lambda *a, **k: [],
+            lockstep=True,
+            num_slices=num_slices,
+        )
+
+    def test_fleet_must_divide(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            self._im(num_workers=3, num_slices=2)
+
+    def test_set_world_slices(self):
+        im = self._im(4, 2)
+        assert im.world_size == 4 and im.world_num_slices == 2
+        im.set_world_slices(1)
+        assert im.world_size == 2 and im.world_num_slices == 1
+        im.set_world_slices(99)  # clamped to the fleet
+        assert im.world_size == 4 and im.world_num_slices == 2
+
+    def test_set_world_size_snaps_to_slice_units(self):
+        im = self._im(4, 2)
+        im.set_world_size(3)  # not a whole number of slices
+        assert im.world_size == 2 and im.world_num_slices == 1
+        im.set_world_size(4)
+        assert im.world_size == 4 and im.world_num_slices == 2
+
+    def test_max_world_size_is_fleet(self):
+        im = self._im(4, 2)
+        im.set_world_slices(1)
+        assert im.max_world_size == 4
+
+    def test_single_slice_ignores_slice_snap(self):
+        im = self._im(4, 1)
+        im.set_world_size(3)
+        assert im.world_size == 3
+        assert im.world_num_slices == 1
+
+    def test_restore_worker_slices(self):
+        im = self._im(4, 2)
+        im.restore_worker_slices({"7": 0, "8": 1})
+        assert im.worker_slices() == {7: 0, 8: 1}
+
+
+# ---- master slice reform: shrink / park / unpark ----------------------------
+
+
+class _FakeSliceIM:
+    """LocalInstanceManager's slice surface without subprocesses."""
+
+    lockstep = True
+
+    def __init__(self, num_workers=4, num_slices=2):
+        self._num_workers = num_workers
+        self.fleet_slices = num_slices
+        self._pps = num_workers // num_slices
+        self.world_num_slices = num_slices
+        self.world_size = num_workers
+        from elasticdl_tpu.parallel.mesh import slice_assignments
+
+        assign = slice_assignments(num_workers, num_slices)
+        self._workers = {wid: assign[wid] for wid in range(num_workers)}
+        self.reformed_with: list[int] = []
+        self.torn_down = 0
+        self.pending_world_trace = None
+
+    @property
+    def max_world_size(self):
+        return self._num_workers
+
+    def worker_ids(self):
+        return list(self._workers)
+
+    def worker_slices(self):
+        return dict(self._workers)
+
+    def set_world_slices(self, n):
+        n = max(1, min(self.fleet_slices, int(n)))
+        self.world_num_slices = n
+        self.world_size = n * self._pps
+
+    def set_world_size(self, n):
+        self.set_world_slices(max(1, int(n) // self._pps))
+
+    def reform_world(self, cluster_version, count_against_budget=True):
+        self.reformed_with.append(self.world_size)
+        from elasticdl_tpu.parallel.mesh import slice_assignments
+
+        assign = slice_assignments(self.world_size, self.world_num_slices)
+        self._workers = {
+            100 * (len(self.reformed_with) + 1) + i: assign[i]
+            for i in range(self.world_size)
+        }
+
+    def teardown_world(self, budget=False):
+        self.torn_down += 1
+        self._workers = {}
+
+    def start_workers(self):
+        self.started = True
+
+    def stop_workers(self, grace_secs=0.0):
+        pass
+
+
+def _make_master(tmp_path, extra_args=(), num_workers=4, fake_im=None):
+    from elasticdl_tpu.data.recordio_gen import synthetic
+    from elasticdl_tpu.master.master import Master
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    train = synthetic.gen_mnist(
+        str(tmp_path / "train"), num_records=64, num_shards=1, seed=3
+    )
+    args = parse_master_args(
+        [
+            "--model_def",
+            "mnist_functional_api.mnist_functional_api.custom_model",
+            "--training_data",
+            train,
+            "--minibatch_size",
+            "16",
+            "--records_per_task",
+            "32",
+            "--num_workers",
+            str(num_workers),
+            "--distribution_strategy",
+            "AllreduceStrategy",
+            *extra_args,
+        ]
+    )
+    return Master(
+        args,
+        instance_manager_factory=(lambda m: fake_im) if fake_im else None,
+    )
+
+
+class TestSliceReform:
+    def test_whole_slice_death_shrinks_next_world(self, tmp_path):
+        im = _FakeSliceIM(4, 2)
+        master = _make_master(tmp_path, fake_im=im)
+        # slice 1 = workers {2, 3}: both dead -> shrink to 1 slice
+        master._reform_lockstep([2, 3], reason="worker_failure")
+        assert im.reformed_with == [2]
+        assert im.world_num_slices == 1
+        assert not master._parked
+
+    def test_partial_slice_death_keeps_size(self, tmp_path):
+        im = _FakeSliceIM(4, 2)
+        master = _make_master(tmp_path, fake_im=im)
+        master._reform_lockstep([3], reason="worker_failure")
+        assert im.reformed_with == [4]  # full-size relaunch
+        assert im.world_num_slices == 2
+
+    def test_all_slices_dead_is_whole_world_crash(self, tmp_path):
+        im = _FakeSliceIM(4, 2)
+        master = _make_master(tmp_path, fake_im=im)
+        master._reform_lockstep([0, 1, 2, 3], reason="worker_failure")
+        assert im.reformed_with == [4]  # ambiguous evidence: full size
+        assert im.world_num_slices == 2
+
+    def test_shrink_below_min_slices_parks_then_grant_unparks(
+        self, tmp_path
+    ):
+        im = _FakeSliceIM(4, 2)
+        master = _make_master(
+            tmp_path, extra_args=["--min_slices", "2"], fake_im=im
+        )
+        master._reform_lockstep([2, 3], reason="worker_failure")
+        assert master._parked
+        assert im.torn_down == 1
+        assert im.reformed_with == []  # no relaunch below the floor
+        assert master.servicer.is_quiescing
+        # a stray elective request below the floor stays parked
+        im.set_world_slices(1)
+        master._reform_lockstep([], reason="stray")
+        assert master._parked and im.reformed_with == []
+        # the capacity grant restores the fleet and unparks
+        im.set_world_slices(2)
+        master._reform_lockstep([], reason="capacity_grant")
+        assert not master._parked
+        assert im.reformed_with == [4]
+        assert not master.servicer.is_quiescing
+
+    def test_master_restart_while_parked_stays_parked(self, tmp_path):
+        """The journal world record carries the parked flag: a master
+        relaunched from it must NOT start a fleet the capacity cannot
+        run — it waits quiesced for a grant."""
+        journal_dir = str(tmp_path / "journal")
+        im1 = _FakeSliceIM(4, 2)
+        master1 = _make_master(
+            tmp_path,
+            extra_args=[
+                "--min_slices", "2", "--master_journal_dir", journal_dir,
+            ],
+            fake_im=im1,
+        )
+        master1._reform_lockstep([2, 3], reason="worker_failure")
+        assert master1._parked
+        # relaunch a master from the journal (the parked one "died")
+        im2 = _FakeSliceIM(4, 2)
+        master2 = _make_master(
+            tmp_path,
+            extra_args=[
+                "--min_slices", "2", "--master_journal_dir", journal_dir,
+            ],
+            fake_im=im2,
+        )
+        assert master2._parked
+        master2.prepare(port=0)
+        try:
+            assert not getattr(im2, "started", False)
+            assert master2.servicer.is_quiescing
+        finally:
+            master2.stop()
+            master1.journal.close()
+
+    def test_slice_loss_emits_mesh_resize_event(self, tmp_path):
+        im = _FakeSliceIM(4, 2)
+        master = _make_master(tmp_path, fake_im=im)
+        emitted = []
+        master.telemetry.events.emit = lambda name, **kw: emitted.append(
+            (name, kw)
+        )
+        master._reform_lockstep([2, 3], reason="worker_failure")
+        names = [n for n, _ in emitted]
+        assert "slice_loss" in names
+        assert "mesh_resize" in names
+        resize = dict(emitted)[("mesh_resize")]
+        assert resize["old_slices"] == 2 and resize["new_slices"] == 1
+        assert resize["old_world_size"] == 4
+        assert resize["new_world_size"] == 2
+        loss = dict(emitted)[("slice_loss")]
+        assert loss["lost_slices"] == [1] and not loss["parked"]
+
+    def test_autoscale_tick_requests_grow_on_backlog(self, tmp_path):
+        im = _FakeSliceIM(4, 2)
+        im.set_world_slices(1)
+        master = _make_master(
+            tmp_path,
+            extra_args=[
+                "--autoscale_backlog_tasks",
+                "1",
+                "--autoscale_cooldown_secs",
+                "0",
+            ],
+            fake_im=im,
+        )
+        assert master.autoscaler is not None
+        master._autoscale_tick()
+        assert im.world_num_slices == 2
+        assert master._reform_requested == "autoscale:grow"
+
+    def test_no_autoscaler_without_flags(self, tmp_path):
+        master = _make_master(tmp_path, fake_im=_FakeSliceIM(4, 2))
+        assert master.autoscaler is None
+
+
+# ---- argv / golden coupling -------------------------------------------------
+
+
+class TestArgvAudit:
+    def test_new_flags_default_none_and_absent_from_worker_argv(self):
+        from elasticdl_tpu.utils.args import (
+            build_worker_arguments,
+            parse_master_args,
+        )
+
+        base = [
+            "--model_def",
+            "m.custom_model",
+            "--training_data",
+            "/tmp/t",
+        ]
+        plain = parse_master_args(base)
+        for flag in (
+            "num_slices",
+            "min_slices",
+            "autoscale_p95_step_ms",
+            "autoscale_backlog_tasks",
+            "autoscale_cooldown_secs",
+            "autoscale_shrink",
+        ):
+            assert getattr(plain, flag) is None, flag
+        sliced = parse_master_args(
+            base
+            + [
+                "--num_slices",
+                "2",
+                "--min_slices",
+                "1",
+                "--autoscale_backlog_tasks",
+                "5",
+                "--autoscale_p95_step_ms",
+                "200",
+                "--autoscale_cooldown_secs",
+                "10",
+                "--autoscale_shrink",
+                "true",
+            ]
+        )
+        # byte-identical worker argv whether the master flags are set
+        # or not (they are master-only and filtered)
+        assert build_worker_arguments(
+            sliced, 0, "localhost:1"
+        ) == build_worker_arguments(plain, 0, "localhost:1")
+        assert not any(
+            "autoscale" in a or "slices" in a
+            for a in build_worker_arguments(plain, 0, "localhost:1")
+        )
+
+    def test_worker_slice_args_parse(self):
+        from elasticdl_tpu.utils.args import parse_worker_args
+
+        args = parse_worker_args(
+            [
+                "--model_def",
+                "m.custom_model",
+                "--worker_id",
+                "0",
+                "--master_addr",
+                "localhost:1",
+                "--slice_id",
+                "1",
+                "--num_slices",
+                "2",
+            ]
+        )
+        assert args.slice_id == 1 and args.num_slices == 2
+
+
+# ---- end to end (multi-process; slow) --------------------------------------
+
+
+@pytest.mark.slow
+def test_slice_loss_chaos_end_to_end(tmp_path):
+    """Acceptance: slice_loss_mid_epoch with replication — invariants
+    all PASS (incl. cross_slice_replica_coverage), the world shrank."""
+    from elasticdl_tpu.chaos.harness import ChaosJobConfig, run_chaos_job
+    from elasticdl_tpu.chaos.plan import named_plan
+
+    report = run_chaos_job(
+        ChaosJobConfig(
+            plan=named_plan("slice_loss_mid_epoch", 2),
+            workdir=str(tmp_path / "chaos"),
+            num_records=256,
+            num_epochs=2,
+            num_workers=2,
+            num_slices=2,
+            checkpoint_steps=4,
+            replication=True,
+            run_timeout_secs=300.0,
+        )
+    )
+    assert report["invariants_ok"], report["invariants"]
+    names = {i["name"] for i in report["invariants"]}
+    assert "cross_slice_replica_coverage" in names
+    resizes = report["multislice"]["mesh_resizes"]
+    assert any(r["new_slices"] < r["old_slices"] for r in resizes)
+    assert report["multislice"]["slice_losses"][0]["lost_slices"] == [1]
+
+
+@pytest.mark.slow
+def test_grow_under_load_chaos_end_to_end(tmp_path):
+    """Acceptance: the job starts on 1 of 2 slices; a capacity grant
+    grows the world mid-training with exactly-once accounting."""
+    from elasticdl_tpu.chaos.harness import ChaosJobConfig, run_chaos_job
+    from elasticdl_tpu.chaos.plan import named_plan
+
+    report = run_chaos_job(
+        ChaosJobConfig(
+            plan=named_plan("grow_under_load", 2),
+            workdir=str(tmp_path / "chaos"),
+            num_records=512,
+            num_epochs=2,
+            num_workers=2,
+            num_slices=2,
+            initial_slices=1,
+            run_timeout_secs=300.0,
+        )
+    )
+    assert report["invariants_ok"], report["invariants"]
+    resizes = report["multislice"]["mesh_resizes"]
+    assert any(r["new_slices"] > r["old_slices"] for r in resizes)
+    assert any(
+        "capacity-grant" in r.get("reason", "") for r in report["reforms"]
+    )
+
+
+# ---- journal world record carries slice topology ----------------------------
+
+
+class TestJournalSlices:
+    def test_world_replay_roundtrips_slices(self):
+        from elasticdl_tpu.master.journal import replay
+
+        records = [
+            {
+                "kind": "snapshot",
+                "state": {
+                    "dispatcher": {
+                        "pending": [],
+                        "pending_eval": [],
+                        "active": [],
+                        "epoch": 0,
+                    },
+                    "servicer": {
+                        "cluster_version": 0,
+                        "model_version": 0,
+                        "stream": {},
+                    },
+                    "callbacks_invoked": 0,
+                    "world": None,
+                },
+            },
+            {
+                "kind": "world",
+                "cluster_version": 1,
+                "worker_ids": [4, 5],
+                "world_size": 2,
+                "num_slices": 2,
+                "slices": {"4": 0, "5": 1},
+            },
+        ]
+        state = replay(records)
+        assert state["world"]["num_slices"] == 2
+        assert state["world"]["slices"] == {"4": 0, "5": 1}
+
+    def test_pre_multislice_world_record_defaults(self):
+        from elasticdl_tpu.master.journal import replay
+
+        records = [
+            {
+                "kind": "snapshot",
+                "state": {
+                    "dispatcher": {
+                        "pending": [],
+                        "pending_eval": [],
+                        "active": [],
+                        "epoch": 0,
+                    },
+                    "servicer": {},
+                    "callbacks_invoked": 0,
+                },
+            },
+            {
+                "kind": "world",
+                "cluster_version": 0,
+                "worker_ids": [0, 1],
+                "world_size": 2,
+            },
+        ]
+        state = replay(records)
+        assert state["world"]["num_slices"] == 1
+        assert state["world"]["slices"] == {}

@@ -692,43 +692,33 @@ def test_harness_no_lost_steps_checker(tmp_path):
     )
     from elasticdl_tpu.chaos.plan import FaultPlan
 
-    telemetry = tmp_path / "telemetry"
-    telemetry.mkdir()
-
-    def _write(events):
-        with open(telemetry / "events.jsonl", "w", encoding="utf-8") as f:
-            for event in events:
-                f.write(json.dumps(event) + "\n")
-
+    # the checker takes the ALREADY-PARSED event list (one shared parse
+    # per run since PR 7), so the test feeds lists directly
     config = ChaosJobConfig(
         plan=FaultPlan(name="t"), workdir=str(tmp_path), replication=True
     )
     kill = [{"kind": "preempt_worker", "monotonic": 100.0}]
-    _write(
-        [
-            {"event": "replica_push", "step": 6, "monotonic": 99.0},
-            {"event": "replica_restore", "step": 6, "monotonic": 105.0},
-        ]
-    )
-    verdict = _check_no_lost_steps(config, str(telemetry), kill)
+    events = [
+        {"event": "replica_push", "step": 6, "monotonic": 99.0},
+        {"event": "replica_restore", "step": 6, "monotonic": 105.0},
+    ]
+    verdict = _check_no_lost_steps(config, events, kill)
     assert verdict["status"] == "PASS"
     # restoring below the replicated step = lost steps
-    _write(
-        [
-            {"event": "replica_push", "step": 6, "monotonic": 99.0},
-            {"event": "replica_restore", "step": 4, "monotonic": 105.0},
-        ]
-    )
-    assert _check_no_lost_steps(config, str(telemetry), kill)["status"] == (
+    events = [
+        {"event": "replica_push", "step": 6, "monotonic": 99.0},
+        {"event": "replica_restore", "step": 4, "monotonic": 105.0},
+    ]
+    assert _check_no_lost_steps(config, events, kill)["status"] == (
         "FAIL"
     )
     # no restore at all = FAIL; replication off = not applicable
-    _write([{"event": "replica_push", "step": 6, "monotonic": 99.0}])
-    assert _check_no_lost_steps(config, str(telemetry), kill)["status"] == (
+    events = [{"event": "replica_push", "step": 6, "monotonic": 99.0}]
+    assert _check_no_lost_steps(config, events, kill)["status"] == (
         "FAIL"
     )
     config.replication = False
-    assert _check_no_lost_steps(config, str(telemetry), kill) is None
+    assert _check_no_lost_steps(config, events, kill) is None
 
 
 # ---- dispatcher liveness (found by the replication smoke) -------------------
