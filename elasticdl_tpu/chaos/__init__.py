@@ -14,6 +14,12 @@ package is the correctness tooling that *proves* it, systematically:
   (by model-version step, fenced by cluster generation so a re-formed
   world does not re-fire them) and append every firing to a shared
   event log;
+- :mod:`.netem` — the transport-level shim for GRAY failures (the
+  process lives, its link degrades): per-method latency with seeded
+  jitter, drop-with-hang blackholes, duplicate delivery re-executed
+  server-side, injected UNAVAILABLE, and one-way worker<->master
+  partitions, injected at the RPC client/server seam
+  (docs/designs/network_chaos.md);
 - :mod:`.invariants` — an observer-fed checker asserting the elastic
   contract: every training task trained exactly once, record totals
   accounted, model version monotonic per worker per generation, and

@@ -28,7 +28,11 @@ from elasticdl_tpu.chaos.invariants import InvariantChecker
 from elasticdl_tpu.chaos.plan import FaultKind, FaultPlan
 from elasticdl_tpu.utils.log_utils import default_logger as logger
 
-# fault kinds whose firing is "the preemption" for latency metrics
+# fault kinds whose firing is "the preemption" for latency metrics —
+# including the network kinds that end in an eviction: a blackholed or
+# one-way-partitioned worker exhausts its retry budget, dies, and the
+# reform it causes is the fault's downtime (delay and duplicate kinds
+# are excluded: they must NOT cost a re-formation)
 _KILL_KINDS = frozenset(
     {
         FaultKind.PREEMPT,
@@ -37,6 +41,8 @@ _KILL_KINDS = frozenset(
         FaultKind.KILL_DURING_REPLICATION,
         FaultKind.DROP_HEARTBEAT,
         FaultKind.SLICE_LOSS,
+        FaultKind.NET_BLACKHOLE,
+        FaultKind.NET_PARTITION,
     }
 )
 
@@ -60,6 +66,10 @@ _REPLICA_RECOVERABLE_KINDS = frozenset(
 # multi-slice world (worker-side, via env): a slice loss then takes a
 # shard and its only replica together — cross_slice_replica_coverage
 # must flag the same-slice pushes and the restore degrades to disk.
+# ``drop_dedup`` disables the dispatcher's task-id dedup, so a netem-
+# duplicated report counts TWICE — exactly_once and
+# duplicate_delivery_exactly_once must both trip (requires a plan with
+# net_duplicate faults, e.g. dup_report_storm).
 CORRUPTIONS = (
     "",
     "double_report",
@@ -67,6 +77,7 @@ CORRUPTIONS = (
     "version_rollback",
     "journal_rollback",
     "same_slice_ring",
+    "drop_dedup",
 )
 
 
@@ -108,6 +119,14 @@ class ChaosJobConfig:
     # start the job on fewer slices than the fleet (grow_under_load:
     # a capacity grant then grows the world mid-training)
     initial_slices: int | None = None
+    # network-chaos knobs (netem plans): per-method RPC deadlines so a
+    # blackhole degrades to DEADLINE_EXCEEDED, a retry budget so the
+    # worker survives transient windows (and dies — evictably — on
+    # permanent ones), and a task lease timeout so an unreachable
+    # worker's lease is reclaimed.  None = flags absent, byte-identical
+    rpc_deadline_secs: float | None = None
+    rpc_retry_secs: float | None = None
+    task_timeout_secs: float | None = None
 
 
 def _master_args(config: ChaosJobConfig, train_dir: str, ckpt_dir: str):
@@ -191,6 +210,21 @@ def _master_args(config: ChaosJobConfig, train_dir: str, ckpt_dir: str):
                 if config.num_slices > 1
                 else []
             ),
+            *(
+                ["--rpc_deadline_secs", str(config.rpc_deadline_secs)]
+                if config.rpc_deadline_secs is not None
+                else []
+            ),
+            *(
+                ["--rpc_retry_secs", str(config.rpc_retry_secs)]
+                if config.rpc_retry_secs is not None
+                else []
+            ),
+            *(
+                ["--task_timeout_secs", str(config.task_timeout_secs)]
+                if config.task_timeout_secs is not None
+                else []
+            ),
             *config.extra_master_args,
         ]
     )
@@ -204,7 +238,11 @@ def _install_corruption(master, checker: InvariantChecker, mode: str):
     - ``lose_task``: the first successful training completion is hidden
       from observers (a silently-lost completion);
     - ``version_rollback``: once training passes version 4, a
-      lower-version report is injected (state regression).
+      lower-version report is injected (state regression);
+    - ``drop_dedup``: the dispatcher's task-id dedup is disabled — a
+      report for a no-longer-active lease (i.e. a netem-duplicated
+      delivery) is counted AGAIN instead of dropped, so the
+      exactly-once and duplicate-delivery invariants must trip.
     """
     from elasticdl_tpu.utils.constants import TaskType
 
@@ -252,6 +290,40 @@ def _install_corruption(master, checker: InvariantChecker, mode: str):
                 checker.on_version_report(worker_id, version - 3)
 
         master.servicer.add_version_observer(rollback)
+    elif mode == "drop_dedup":
+        task_d = master.task_d
+        orig_report = task_d.report
+        leased: dict[int, object] = {}
+
+        class _LeaseMemo:
+            """Remembers every lease so the duplicate path below can
+            resurrect the Task object the dispatcher already popped."""
+
+            def on_task_leased(self, task_id, worker_id, task):
+                leased[task_id] = task
+
+        task_d.add_observer(_LeaseMemo())
+
+        def no_dedup_report(task_id, success=True, exec_counters=None):
+            active_before = task_d.is_active(task_id)
+            orig_report(
+                task_id, success=success, exec_counters=exec_counters
+            )
+            task = leased.get(task_id)
+            if (
+                success
+                and not active_before
+                and task is not None
+                and task.type == TaskType.TRAINING
+            ):
+                # dedup disabled: the duplicate delivery the dispatcher
+                # just (correctly) dropped is counted anyway — the
+                # double-counting bug the dedup contract prevents
+                task_d._notify(
+                    "on_task_reported", task_id, task, True, True
+                )
+
+        task_d.report = no_dedup_report
 
 
 class _CapacityDriver(threading.Thread):
@@ -622,6 +694,77 @@ def check_cross_slice_coverage(
     return violations
 
 
+def _check_no_false_dead(
+    config: ChaosJobConfig, reform_events: list[dict]
+) -> dict | None:
+    """Gray-vs-dead discrimination, the tolerant half: a plan whose only
+    faults are network LATENCY (within the heartbeat tolerance) must
+    complete with ZERO re-formations — a slow link is not a dead
+    worker, and evicting on latency turns every congested epoch into a
+    reform storm."""
+    kinds = {f.kind for f in config.plan.faults}
+    if not kinds or kinds != {FaultKind.NET_DELAY}:
+        return None
+    violations = []
+    if reform_events:
+        violations.append(
+            f"{len(reform_events)} re-formation(s) during a latency-only "
+            "network plan — a slow-but-alive worker was declared dead "
+            f"(reasons: {[e.get('reason') for e in reform_events]})"
+        )
+    return {
+        "name": "no_false_dead",
+        "status": "FAIL" if violations else "PASS",
+        "violations": violations,
+    }
+
+
+def _check_duplicate_delivery(
+    config: ChaosJobConfig, checker: InvariantChecker, fault_events: list[dict]
+) -> dict | None:
+    """The dedup contract under ACTUAL duplicate delivery: netem
+    re-executed report RPCs server-side, and task accounting must still
+    be exactly-once — with proof the dedup ENGAGED (the dispatcher
+    visibly dropped the re-deliveries), not that duplication silently
+    never happened.  Falsifiable via ``--corrupt drop_dedup``."""
+    dup_faults = [
+        f
+        for f in config.plan.faults
+        if f.kind == FaultKind.NET_DUPLICATE
+    ]
+    if not dup_faults and config.corrupt != "drop_dedup":
+        return None
+    violations = []
+    dup_fired = [
+        e for e in fault_events if e.get("kind") == FaultKind.NET_DUPLICATE
+    ]
+    if dup_faults and not dup_fired:
+        # realization first (PR-6 pattern): an unfired duplicate fault
+        # must not let this invariant pass vacuously
+        violations.append(
+            f"plan injects {len(dup_faults)} duplicate-delivery fault(s) "
+            "but none fired — netem server-seam plumbing broken?"
+        )
+    dup_task_reports = [
+        e for e in dup_fired if e.get("method") == "report_task_result"
+    ]
+    if dup_task_reports and checker.dropped_reports == 0:
+        violations.append(
+            f"{len(dup_task_reports)} duplicated report_task_result "
+            "deliveries but the dispatcher never dropped one — the "
+            "task-id dedup did not engage"
+        )
+    for detail in checker.double_counted_tasks():
+        violations.append(
+            f"task {detail} — duplicate delivery double-counted a shard"
+        )
+    return {
+        "name": "duplicate_delivery_exactly_once",
+        "status": "FAIL" if violations else "PASS",
+        "violations": violations,
+    }
+
+
 def _check_cross_slice_coverage(
     config: ChaosJobConfig, events: list[dict]
 ) -> dict | None:
@@ -701,6 +844,17 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
             "with master HA enabled (the forgery lands between master "
             "lives)"
         )
+    if config.corrupt == "drop_dedup" and not any(
+        f.kind == FaultKind.NET_DUPLICATE for f in config.plan.faults
+    ):
+        # the corruption counts DUPLICATED deliveries twice; without a
+        # net_duplicate fault nothing is ever duplicated and the
+        # "corrupted runs must exit non-zero" contract would pass green
+        raise ValueError(
+            "--corrupt drop_dedup requires a plan with net_duplicate "
+            "faults (dup_report_storm) — without duplicate delivery "
+            "the disabled dedup corrupts nothing"
+        )
     if config.corrupt == "same_slice_ring" and not (
         config.replication and config.num_slices > 1
     ):
@@ -729,71 +883,98 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
     rc: list[int] = []
     life = 0
     fired_capacity: set[str] = set()
-    while True:
-        master = build_master(args)
-        if config.initial_slices is not None and hasattr(
-            master.instance_manager, "set_world_slices"
-        ):
-            # grow_under_load: the job STARTS on fewer slices than the
-            # fleet; the capacity-grant fault grows it mid-training
-            master.instance_manager.set_world_slices(config.initial_slices)
-        # the SAME checker spans every master life: task identity is the
-        # journaled uid, so the restored dispatcher's backlog replay
-        # dedups onto the pre-outage records instead of resetting them
-        master.task_d.add_observer(checker)
-        master.servicer.add_version_observer(checker.on_version_report)
-        master.reform_callbacks.append(checker.on_reform)
-        if life == 0:
-            _install_corruption(master, checker, config.corrupt)
-        kill = kills[life] if life < len(kills) else None
-        watcher = None
-        if kill is not None:
-            if kill.trigger == "reform":
-                master.request_crash("reform")
-            else:
-                watcher = _MasterKillWatcher(master, kill)
-        driver = _CapacityDriver(
-            master, config.plan, events_path, fired=fired_capacity
-        )
-        master.prepare()
-        crashed: list[bool] = []
+    from elasticdl_tpu.chaos import netem
 
-        def run_master(m=master):
-            try:
-                rc.append(m.run())
-            except SimulatedMasterCrash:
-                crashed.append(True)
-
-        runner = threading.Thread(
-            target=run_master, name=f"chaos-master-run-{life}"
-        )
-        runner.start()
-        driver.start()
-        if watcher is not None:
-            watcher.start()
-        try:
-            runner.join(timeout=max(1.0, deadline - time.monotonic()))
-            timed_out = runner.is_alive()
-        finally:
-            driver.stop()
-            if watcher is not None:
-                watcher.stop()
-            if timed_out or not crashed:
-                master.request_stop()
-                runner.join(timeout=30)
-        reform_events.extend(master.reform_events)
-        if crashed and not timed_out:
-            life += 1
-            _record_master_kill(events_path, kill, master.crashed_at)
-            if config.corrupt == "journal_rollback":
-                _corrupt_journal_rollback(
-                    os.path.join(config.workdir, "journal")
+    # start clean: a previous run in this process (back-to-back tests)
+    # may have left a server-seam shim installed if it unwound on error
+    netem.uninstall()
+    net_shim = None
+    try:
+        while True:
+            master = build_master(args)
+            # server-seam network faults (duplicate delivery) fire inside
+            # THIS process's handlers.  Installed ONCE per run — the shim's
+            # arming state must span master lives (a rebuilt shim would
+            # reset its counters and re-fire exhausted faults after a
+            # MASTER_KILL relaunch, like the capacity-fault fired-set
+            # guards against) — with only the telemetry sink rebound to the
+            # new life's event log.  A plan without such faults installs
+            # nothing.
+            if net_shim is None:
+                net_shim = netem.install_master_from_plan(
+                    config.plan,
+                    events_path,
+                    telemetry_sink=master.telemetry.events.emit,
                 )
-            # the master-down window: workers retry/back off in here
-            time.sleep(kill.duration_secs or 2.0)
-            continue
-        break
+            else:
+                net_shim.set_telemetry_sink(master.telemetry.events.emit)
+            if config.initial_slices is not None and hasattr(
+                master.instance_manager, "set_world_slices"
+            ):
+                # grow_under_load: the job STARTS on fewer slices than the
+                # fleet; the capacity-grant fault grows it mid-training
+                master.instance_manager.set_world_slices(config.initial_slices)
+            # the SAME checker spans every master life: task identity is the
+            # journaled uid, so the restored dispatcher's backlog replay
+            # dedups onto the pre-outage records instead of resetting them
+            master.task_d.add_observer(checker)
+            master.servicer.add_version_observer(checker.on_version_report)
+            master.reform_callbacks.append(checker.on_reform)
+            if life == 0:
+                _install_corruption(master, checker, config.corrupt)
+            kill = kills[life] if life < len(kills) else None
+            watcher = None
+            if kill is not None:
+                if kill.trigger == "reform":
+                    master.request_crash("reform")
+                else:
+                    watcher = _MasterKillWatcher(master, kill)
+            driver = _CapacityDriver(
+                master, config.plan, events_path, fired=fired_capacity
+            )
+            master.prepare()
+            crashed: list[bool] = []
 
+            def run_master(m=master):
+                try:
+                    rc.append(m.run())
+                except SimulatedMasterCrash:
+                    crashed.append(True)
+
+            runner = threading.Thread(
+                target=run_master, name=f"chaos-master-run-{life}"
+            )
+            runner.start()
+            driver.start()
+            if watcher is not None:
+                watcher.start()
+            try:
+                runner.join(timeout=max(1.0, deadline - time.monotonic()))
+                timed_out = runner.is_alive()
+            finally:
+                driver.stop()
+                if watcher is not None:
+                    watcher.stop()
+                if timed_out or not crashed:
+                    master.request_stop()
+                    runner.join(timeout=30)
+            reform_events.extend(master.reform_events)
+            if crashed and not timed_out:
+                life += 1
+                _record_master_kill(events_path, kill, master.crashed_at)
+                if config.corrupt == "journal_rollback":
+                    _corrupt_journal_rollback(
+                        os.path.join(config.workdir, "journal")
+                    )
+                # the master-down window: workers retry/back off in here
+                time.sleep(kill.duration_secs or 2.0)
+                continue
+            break
+    finally:
+        # the module-global server-seam shim must not leak into the
+        # baseline run that typically follows in this same process —
+        # nor into unrelated masters if this loop unwinds on an error
+        netem.uninstall()
     counters = master.task_d.counters(TaskType.TRAINING)
     fault_events, observations = _read_events(events_path)
 
@@ -851,10 +1032,28 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
             "plan has %d fault(s) but none fired — injection plumbing "
             "broken?" % len(config.plan.faults)
         )
+    def _evicting(f) -> bool:
+        """Kill kinds always cost their worker; a network window fault
+        only when the window OUTLASTS the worker's retry budget — a
+        survivable blackhole (netchaos smoke) must ride out on retries
+        with no re-formation at all."""
+        if f.kind not in _KILL_KINDS:
+            return False
+        if f.kind in (FaultKind.NET_BLACKHOLE, FaultKind.NET_PARTITION):
+            from elasticdl_tpu.rpc.retry import DEFAULT_RETRY_SECS
+
+            budget = (
+                config.rpc_retry_secs
+                if config.rpc_retry_secs is not None
+                else DEFAULT_RETRY_SECS
+            )
+            return (f.duration_secs or 0.0) > budget
+        return True
+
     gen0_kills = [
         f
         for f in config.plan.faults
-        if f.cluster_version == 0 and f.kind in _KILL_KINDS
+        if f.cluster_version == 0 and _evicting(f)
     ]
     if gen0_kills and not reform_events:
         fault_violations.append(
@@ -891,6 +1090,17 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
     )
     if fault_violations:
         invariants["ok"] = False
+
+    # ---- network-chaos invariants (gray failures: docs/designs/
+    # network_chaos.md) — None unless the plan is in their contract
+    for network_check in (
+        _check_no_false_dead(config, reform_events),
+        _check_duplicate_delivery(config, checker, fault_events),
+    ):
+        if network_check is not None:
+            invariants["invariants"].append(network_check)
+            if network_check["status"] == "FAIL":
+                invariants["ok"] = False
 
     telemetry_dir = os.path.join(config.workdir, "telemetry")
     # ONE shared parse of the (possibly multi-shard) telemetry event log
@@ -977,6 +1187,14 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
         "standby_activated": getattr(
             master.instance_manager, "standby_activations", 0
         ),
+        # fleet-wide RPC outcome totals (heartbeat-shipped; rpc/stats.py)
+        # plus the master-observed dedup drops — what the netchaos smoke
+        # gates on (a blackhole run must show deadline_exceeded > 0)
+        "rpc": {
+            **master.servicer.rpc_stats_totals(),
+            "reports_deduped": checker.dropped_reports,
+            "eval_reports_deduped": master.servicer.duplicate_eval_drops,
+        },
     }
     if replication_stats is not None:
         report["replication"] = replication_stats

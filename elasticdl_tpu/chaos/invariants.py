@@ -76,6 +76,11 @@ class InvariantChecker:
         self._max_version = 0
         self._reforms: list[dict] = []
         self._violations: list[Violation] = []
+        # reports the dispatcher DROPPED (unknown/reclaimed lease):
+        # correct behavior — and under duplicate delivery the proof that
+        # the task-id dedup actually engaged (duplicate_delivery_
+        # exactly_once reads it)
+        self._dropped_reports = 0
 
     @staticmethod
     def _key(task) -> int:
@@ -111,6 +116,7 @@ class InvariantChecker:
         or reclaimed lease) — correct behavior, not a completion."""
         with self._lock:
             if task is None or not counted:
+                self._dropped_reports += 1
                 return
             rec = self._tasks.get(self._key(task))
             if rec is None:
@@ -235,6 +241,23 @@ class InvariantChecker:
     @property
     def max_version(self) -> int:
         return self._max_version
+
+    @property
+    def dropped_reports(self) -> int:
+        """Reports the dispatcher refused to count (task-id dedup)."""
+        with self._lock:
+            return self._dropped_reports
+
+    def double_counted_tasks(self) -> list[str]:
+        """Descriptions of tasks counted successful more than once —
+        what duplicate delivery MUST NOT produce."""
+        with self._lock:
+            return [
+                f"{r.task.shard_name}[{r.task.start}:{r.task.end}] "
+                f"counted {r.successes} times"
+                for r in self._tasks.values()
+                if r.successes > 1
+            ]
 
     def summary(self, dispatcher_counters=None) -> dict:
         violations = self.check(dispatcher_counters)

@@ -1,0 +1,417 @@
+"""Transport-level network fault injection (gray failures, not deaths).
+
+PRs 1-7 hardened the system against *process* faults — every one of
+them clean: the peer vanishes and gRPC says UNAVAILABLE.  Real DCN
+fleets mostly fail *gray*: a link goes slow or blackholes, a retried
+RPC is delivered twice, one direction of a connection dies while the
+other lives.  This module injects exactly those failures at the two
+choke points every msgpack-framed RPC already passes
+(:mod:`elasticdl_tpu.rpc.service`):
+
+- **client seam** (``RpcClient._invoke``): per-method latency with
+  seeded jitter (NET_DELAY), drop-with-hang (NET_BLACKHOLE — silence
+  until the call's deadline turns it into DEADLINE_EXCEEDED; with no
+  deadline, the hang the deadline policy exists to prevent), injected
+  UNAVAILABLE (NET_UNAVAILABLE), and the one-way partition
+  (NET_PARTITION: ``direction="request"`` drops the request before the
+  server sees it; ``direction="response"`` lets the request EXECUTE
+  server-side and drops only the reply — so every client retry
+  re-delivers a landed request);
+- **server seam** (``create_server`` generic handler): duplicate
+  delivery (NET_DUPLICATE — the handler literally re-executes the
+  request; the first execution's response is discarded, as after a
+  lost reply + retry).
+
+Arming is plan-driven like every other fault (same
+``ELASTICDL_TPU_CHAOS_PLAN`` env propagation, same generation fence so
+a re-formed world does not re-fire a gen-0 fault), but by
+**matched-call index**, not trainer step — the transport shim sees
+calls, not steps (``Fault.at_step`` = matched calls to skip).  Jitter
+draws from an RNG seeded by (plan seed, fault id, process id), so a
+re-run of the same plan produces the same delays.
+
+Every firing is recorded to the chaos event log (fsync — the affected
+process may be about to die of it), mirrored as an
+``rpc_fault_injected`` telemetry event, and window faults additionally
+record an ``rpc_degraded`` span covering the planned window so
+``trace analyze`` can attribute a degraded-network phase inside reform
+downtime.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+import grpc
+
+from elasticdl_tpu.chaos import hooks as chaos_hooks
+from elasticdl_tpu.chaos.plan import Fault, FaultKind, FaultPlan
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+# window kinds stay open duration_secs from their first matched call;
+# per-call kinds affect the next `count` matched calls
+_WINDOW_KINDS = frozenset(
+    {FaultKind.NET_DELAY, FaultKind.NET_BLACKHOLE, FaultKind.NET_PARTITION}
+)
+_DEFAULT_WINDOW_SECS = 10.0
+
+# hang-poll granularity for a deadline-less blackhole (bounded by the
+# fault window so a policy-less run still terminates — the link "flaps
+# back" and the in-flight request dies with a reset)
+_HANG_POLL_SECS = 0.05
+
+
+class InjectedRpcError(grpc.RpcError):
+    """A netem-injected failure wearing the grpc error surface the
+    retry layer keys on (callable ``code()``)."""
+
+    def __init__(self, code, details: str):
+        super().__init__(details)
+        self._code = code
+        self._details = details
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return self._details
+
+
+class _Armed:
+    """One plan fault plus its runtime arming state."""
+
+    def __init__(self, fault: Fault, seed):
+        self.fault = fault
+        self.seen = 0  # matched calls observed (arming counter)
+        self.window_until: float | None = None
+        self.remaining = max(1, int(fault.count or 1))
+        self.rng = random.Random(f"{seed}:{fault.fault_id}")
+
+
+class NetemShim:
+    """The seam object :mod:`elasticdl_tpu.rpc.service` consults.
+
+    One instance per process per world generation; ``faults`` must
+    already be filtered to this process/generation/side.  ``sleep`` and
+    ``clock`` are injectable for deterministic tests.
+    """
+
+    def __init__(
+        self,
+        faults: list[Fault],
+        *,
+        plan_seed=None,
+        process_id: int = 0,
+        worker_id: int = 0,
+        cluster_version: int = 0,
+        events_path: str = "",
+        telemetry_sink=None,
+        sleep=time.sleep,
+        clock=time.monotonic,
+    ):
+        self._process_id = process_id
+        self._worker_id = worker_id
+        self._cluster_version = cluster_version
+        self._events_path = events_path
+        self._telemetry_sink = telemetry_sink
+        self._sleep = sleep
+        self._clock = clock
+        self._lock = threading.Lock()
+        seed = f"{plan_seed}:{process_id}"
+        self._armed = [_Armed(f, seed) for f in faults]
+
+    @property
+    def armed_count(self) -> int:
+        with self._lock:
+            return len(self._armed)
+
+    def set_telemetry_sink(self, sink):
+        """Rebind the master-side telemetry sink (a relaunched master
+        life brings a fresh EventLog, but the SHIM must survive the
+        restart — rebuilding it would reset the arming counters and
+        re-fire exhausted faults, breaking replayability)."""
+        self._telemetry_sink = sink
+
+    # ---- matching ----------------------------------------------------------
+
+    def _consult(self, method: str):
+        """Return ``(armed, fired_now)`` for the fault governing this
+        call, or ``(None, False)``.  Counter updates happen under the
+        lock; the event/span recording and all sleeping happen in the
+        caller, outside it."""
+        now = self._clock()
+        with self._lock:
+            for armed in list(self._armed):
+                fault = armed.fault
+                if fault.method and fault.method != method:
+                    continue
+                if fault.kind in _WINDOW_KINDS:
+                    if armed.window_until is None:
+                        armed.seen += 1
+                        if armed.seen <= fault.at_step:
+                            continue
+                        armed.window_until = now + (
+                            fault.duration_secs or _DEFAULT_WINDOW_SECS
+                        )
+                        return armed, True
+                    if now >= armed.window_until:
+                        # the window closed: the link healed — retire
+                        # the fault and let other faults match
+                        self._armed.remove(armed)
+                        continue
+                    return armed, False
+                # per-call kinds (duplicate, unavailable)
+                armed.seen += 1
+                if armed.seen <= fault.at_step:
+                    continue
+                armed.remaining -= 1
+                if armed.remaining <= 0:
+                    self._armed.remove(armed)
+                return armed, True
+        return None, False
+
+    # ---- event / span recording --------------------------------------------
+
+    def _record(self, armed: _Armed, method: str, **extra):
+        fault = armed.fault
+        event = {
+            "fault_id": fault.fault_id,
+            "kind": fault.kind,
+            "method": method or fault.method,
+            "process_id": self._process_id,
+            "worker_id": self._worker_id,
+            "cluster_version": self._cluster_version,
+            "time": time.time(),
+            "monotonic": time.monotonic(),
+            **extra,
+        }
+        logger.warning("CHAOS netem firing %s: %s", fault.fault_id, event)
+        from elasticdl_tpu.telemetry.events import EVENT_RPC_FAULT_INJECTED
+
+        # identity keys stripped: the worker-side recorder stamps its own
+        # worker_id/process_id keywords, and a duplicate-keyword TypeError
+        # here would escape through the RPC seam as a non-retryable crash
+        fields = {
+            k: v
+            for k, v in event.items()
+            if k not in ("fault_id", "worker_id", "process_id")
+        }
+        try:
+            if self._telemetry_sink is not None:  # master-side shim
+                self._telemetry_sink(
+                    EVENT_RPC_FAULT_INJECTED,
+                    fault_id=fault.fault_id,
+                    **fields,
+                )
+            else:  # worker-side process-scoped recorder (no-op if off)
+                from elasticdl_tpu.telemetry import worker_hooks
+
+                worker_hooks.emit_event(
+                    EVENT_RPC_FAULT_INJECTED,
+                    fault_id=fault.fault_id,
+                    **fields,
+                )
+        except Exception:  # noqa: BLE001 — telemetry must NEVER break
+            # injection: an exception escaping here would ride the RPC
+            # seam into the caller as a bogus non-retryable failure
+            logger.exception("Netem telemetry mirror failed")
+        # fsync: a blackholed worker may be about to die of this fault
+        chaos_hooks.append_event(self._events_path, event, fsync=True)
+
+    def _record_window_span(self, armed: _Armed):
+        """One ``rpc_degraded`` span per window fault, recorded AT OPEN
+        covering the planned window (the victim may not survive to see
+        it close), flushed immediately for the same reason."""
+        try:
+            from elasticdl_tpu.telemetry import tracing
+
+            tracer = tracing.get_tracer()
+            if tracer is None:
+                return
+            start = time.monotonic()
+            tracer.record_span(
+                tracing.SPAN_RPC_DEGRADED,
+                start,
+                start
+                + (armed.fault.duration_secs or _DEFAULT_WINDOW_SECS),
+                kind=armed.fault.kind,
+                fault_id=armed.fault.fault_id,
+            )
+            tracing.flush()
+        except Exception:  # noqa: BLE001 — tracing must never break
+            # injection (same rule as the telemetry mirror)
+            logger.exception("Netem span recording failed")
+
+    # ---- client seam --------------------------------------------------------
+
+    def client_call(self, service: str, method: str, invoke, timeout):
+        armed, fired = self._consult(method)
+        if armed is None:
+            return invoke()
+        fault = armed.fault
+        if fired and fault.kind in _WINDOW_KINDS:
+            self._record(
+                armed, method, duration_secs=fault.duration_secs
+            )
+            self._record_window_span(armed)
+        if fault.kind == FaultKind.NET_DELAY:
+            # seeded jitter: uniform in [0, delay/2) on top of the base
+            delay = (
+                fault.delay_ms + armed.rng.uniform(0.0, fault.delay_ms / 2.0)
+            ) / 1000.0
+            if timeout is not None and delay >= timeout:
+                # on a real link a delay past the deadline IS a deadline
+                # expiry — the caller must see DEADLINE_EXCEEDED, not a
+                # slow success (approximation: the late-landing request
+                # is treated as dropped)
+                self._sleep(timeout)
+                raise InjectedRpcError(
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                    f"netem: injected delay exceeded the deadline "
+                    f"({fault.fault_id}/{method})",
+                )
+            self._sleep(delay)
+            return invoke()
+        if fault.kind == FaultKind.NET_UNAVAILABLE:
+            self._record(armed, method)
+            raise InjectedRpcError(
+                grpc.StatusCode.UNAVAILABLE,
+                f"netem: injected UNAVAILABLE ({fault.fault_id})",
+            )
+        if fault.kind == FaultKind.NET_PARTITION and (
+            fault.direction == "response"
+        ):
+            # the request LANDS — the server executes it — and only the
+            # reply dies; a retry of this call re-delivers it for real
+            invoke()
+        # blackhole / request-direction partition: the request is
+        # dropped on the floor; either way the caller gets silence,
+        # not an error — _hang always raises
+        self._hang(armed, method, timeout)
+
+    def _hang(self, armed: _Armed, method: str, timeout):
+        fault = armed.fault
+        if timeout is not None:
+            self._sleep(timeout)
+            raise InjectedRpcError(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                f"netem: call dropped, deadline expired "
+                f"({fault.fault_id}/{method})",
+            )
+        # no deadline: THE infinite hang --rpc_deadline_secs exists to
+        # prevent.  Bounded by the fault window so a deadline-less run
+        # still terminates: when the link flaps back the in-flight
+        # request dies with a reset
+        while self._clock() < (armed.window_until or 0.0):
+            self._sleep(_HANG_POLL_SECS)
+        raise InjectedRpcError(
+            grpc.StatusCode.UNAVAILABLE,
+            f"netem: connection reset at blackhole window close "
+            f"({fault.fault_id}/{method})",
+        )
+
+    # ---- server seam --------------------------------------------------------
+
+    def server_call(self, service: str, method: str, handler, request):
+        armed, fired = self._consult(method)
+        if armed is None or armed.fault.kind != FaultKind.NET_DUPLICATE:
+            return handler(request)
+        self._record(armed, method, remaining=armed.remaining)
+        # duplicate delivery: the first execution's response is
+        # discarded (the client never saw it); the re-execution answers.
+        # Any dedup the servicer claims must make the pair one effect.
+        handler(request)
+        return handler(request)
+
+
+# ---- install / uninstall ----------------------------------------------------
+
+
+def install_from_env(
+    process_id: int,
+    cluster_version: int,
+    worker_id: int,
+) -> NetemShim | None:
+    """Worker-process entry: arm the plan's client-seam network faults
+    for this process/generation and hook them into the RPC client.
+    No plan, or no matching faults, installs NOTHING — the transport
+    stays byte-identical."""
+    plan_path = os.environ.get(chaos_hooks.PLAN_ENV, "")
+    if not plan_path:
+        return None
+    try:
+        plan = FaultPlan.load(plan_path)
+    except (OSError, ValueError, KeyError) as ex:
+        logger.error("Ignoring unreadable chaos plan %s: %s", plan_path, ex)
+        return None
+    faults = [
+        f
+        for f in plan.network_client_faults()
+        if f.cluster_version == cluster_version
+        and (f.process_id is None or f.process_id == process_id)
+    ]
+    if not faults:
+        return None
+    shim = NetemShim(
+        faults,
+        plan_seed=plan.seed,
+        process_id=process_id,
+        worker_id=worker_id,
+        cluster_version=cluster_version,
+        events_path=os.environ.get(chaos_hooks.EVENTS_ENV, ""),
+    )
+    from elasticdl_tpu.rpc import service as rpc_service
+
+    rpc_service.set_client_fault_shim(shim)
+    logger.warning(
+        "Chaos netem armed (process %d, generation %d): %d network "
+        "fault(s) at the client seam",
+        process_id,
+        cluster_version,
+        len(faults),
+    )
+    return shim
+
+
+def install_master_from_plan(
+    plan: FaultPlan, events_path: str = "", telemetry_sink=None
+) -> NetemShim | None:
+    """Master-process entry (the chaos harness runs the master
+    in-process): arm the plan's server-seam faults — duplicate delivery
+    re-executes the request inside the master's own handler.  The
+    server cannot attribute a caller, so ``process_id`` targeting does
+    not apply here; and where client-side faults are fenced by the
+    worker generation, the server shim's fence is its own arming state
+    — the harness installs it ONCE per run and only rebinds the
+    telemetry sink across master lives (``set_telemetry_sink``), so an
+    exhausted fault can never re-fire after a MASTER_KILL relaunch."""
+    faults = plan.network_server_faults()
+    if not faults:
+        return None
+    shim = NetemShim(
+        faults,
+        plan_seed=plan.seed,
+        events_path=events_path,
+        telemetry_sink=telemetry_sink,
+    )
+    from elasticdl_tpu.rpc import service as rpc_service
+
+    rpc_service.set_server_fault_shim(shim)
+    logger.warning(
+        "Chaos netem armed (master): %d network fault(s) at the "
+        "server seam",
+        len(faults),
+    )
+    return shim
+
+
+def uninstall():
+    """Clear both seams (harness cleanup between the chaos'd run and
+    its fault-free baseline; module globals would otherwise leak)."""
+    from elasticdl_tpu.rpc import service as rpc_service
+
+    rpc_service.set_client_fault_shim(None)
+    rpc_service.set_server_fault_shim(None)

@@ -47,6 +47,22 @@ class FaultKind:
     # `duration_secs` of downtime — the master-HA closure fault
     MASTER_KILL = "master_kill"
 
+    # network-side (chaos/netem.py): gray failures of the RPC plane,
+    # injected at the RpcClient._call / create_server handler seam — the
+    # link degrades, the processes live.  Unlike worker kinds these arm
+    # by MATCHED-CALL INDEX (``at_step`` = matched calls to skip before
+    # arming), because the transport shim has no trainer step; they are
+    # still generation-fenced and plan-driven like everything else.
+    NET_DELAY = "net_delay"  # +delay_ms (seeded jitter) per matched call
+    NET_BLACKHOLE = "net_blackhole"  # drop-with-hang: silence, not error
+    NET_DUPLICATE = "net_duplicate"  # request re-executed server-side
+    NET_UNAVAILABLE = "net_unavailable"  # injected UNAVAILABLE, `count`x
+    # one-way partition of a worker<->master pair: direction="request"
+    # drops requests (server never executes), direction="response"
+    # executes server-side but drops the reply — the nastiest gray
+    # failure, because every client retry re-delivers a landed request
+    NET_PARTITION = "net_partition"
+
     WORKER_SIDE = frozenset(
         {
             PREEMPT,
@@ -59,7 +75,15 @@ class FaultKind:
         }
     )
     MASTER_SIDE = frozenset({REDUCE_CAPACITY, RESTORE_CAPACITY, MASTER_KILL})
-    ALL = WORKER_SIDE | MASTER_SIDE
+    # client-seam kinds fire in the targeted worker's RpcClient; the
+    # server-seam kind (duplicate delivery) fires in the master's
+    # generic handler, where "re-executed server-side" is literal
+    NETWORK_CLIENT_SIDE = frozenset(
+        {NET_DELAY, NET_BLACKHOLE, NET_UNAVAILABLE, NET_PARTITION}
+    )
+    NETWORK_SERVER_SIDE = frozenset({NET_DUPLICATE})
+    NETWORK_SIDE = NETWORK_CLIENT_SIDE | NETWORK_SERVER_SIDE
+    ALL = WORKER_SIDE | MASTER_SIDE | NETWORK_SIDE
 
 
 @dataclass(frozen=True)
@@ -79,6 +103,15 @@ class Fault:
     fires inside the NEXT re-formation, after the generation fence and
     task recovery but before the relaunch — the nastiest window (the
     fence is journaled, no new world exists).
+
+    Network kinds re-read two fields: ``method`` filters which RPC
+    method the fault matches ("" = every method of every service riding
+    the shim'd transport), and ``at_step`` is the number of MATCHED
+    calls to skip before arming (the transport shim sees calls, not
+    trainer steps).  ``direction`` selects the dropped half of a
+    NET_PARTITION; ``duration_secs`` bounds window kinds
+    (delay/blackhole/partition) and ``count`` bounds per-call kinds
+    (duplicate/unavailable).
     """
 
     kind: str
@@ -93,6 +126,9 @@ class Fault:
     # SLICE_LOSS target: every process of this slice dies at at_step
     # (None on every other kind)
     slice_id: int | None = None
+    # network-kind fields (defaults keep old plan JSONs loading)
+    method: str = ""
+    direction: str = "request"
 
     def __post_init__(self):
         if self.kind not in FaultKind.ALL:
@@ -104,6 +140,11 @@ class Fault:
             raise ValueError(
                 f"unknown fault trigger {self.trigger!r}; valid: "
                 "('step', 'reform')"
+            )
+        if self.direction not in ("request", "response"):
+            raise ValueError(
+                f"unknown partition direction {self.direction!r}; "
+                "valid: ('request', 'response')"
             )
 
 
@@ -157,6 +198,23 @@ class FaultPlan:
 
     def master_kill_faults(self) -> list[Fault]:
         return [f for f in self.faults if f.kind == FaultKind.MASTER_KILL]
+
+    def network_client_faults(self) -> list[Fault]:
+        """Faults the targeted worker's RPC-client shim arms."""
+        return [
+            f
+            for f in self.faults
+            if f.kind in FaultKind.NETWORK_CLIENT_SIDE
+        ]
+
+    def network_server_faults(self) -> list[Fault]:
+        """Faults the master's server-handler shim arms (duplicate
+        delivery: the request literally re-executes server-side)."""
+        return [
+            f
+            for f in self.faults
+            if f.kind in FaultKind.NETWORK_SERVER_SIDE
+        ]
 
 
 # ---- built-in plans ---------------------------------------------------------
@@ -372,6 +430,89 @@ def builtin_plans(num_workers: int = 2) -> dict[str, FaultPlan]:
             "slice, a grant arrives under load, and reform grows the "
             "dp axis across slices without losing or double-training "
             "a record",
+        ),
+        "slow_network_mid_epoch": FaultPlan(
+            name="slow_network_mid_epoch",
+            faults=[
+                Fault(
+                    kind=FaultKind.NET_DELAY,
+                    fault_id="net-delay-all",
+                    # skip the first few calls so the world is up and
+                    # training before the link degrades
+                    at_step=4,
+                    process_id=None,  # every process's master link
+                    delay_ms=150.0,
+                    duration_secs=6.0,
+                )
+            ],
+            notes="gray, not dead: +150ms (seeded jitter) on every "
+            "master-plane RPC for 6s — well inside the heartbeat "
+            "tolerance, so the job must complete with ZERO "
+            "re-formations (no false-dead from latency)",
+        ),
+        "blackhole_master_link": FaultPlan(
+            name="blackhole_master_link",
+            faults=[
+                Fault(
+                    kind=FaultKind.NET_BLACKHOLE,
+                    fault_id="blackhole-p%d" % last,
+                    at_step=12,
+                    process_id=last,
+                    # outlasts the worker's retry budget (the runner
+                    # configures ~4s): deadlines turn the silence into
+                    # DEADLINE_EXCEEDED, retries exhaust, the worker
+                    # dies, reform evicts it — convergence, not a hang
+                    duration_secs=60.0,
+                )
+            ],
+            notes="one worker's master link blackholes (silence, not "
+            "an error): every RPC must degrade to DEADLINE_EXCEEDED, "
+            "flow through the retry loop, exhaust the budget, and the "
+            "reform must evict the unreachable worker with exactly-once "
+            "accounting intact",
+        ),
+        "oneway_partition_worker": FaultPlan(
+            name="oneway_partition_worker",
+            faults=[
+                Fault(
+                    kind=FaultKind.NET_PARTITION,
+                    fault_id="oneway-p0",
+                    at_step=12,
+                    process_id=0,
+                    direction="response",
+                    duration_secs=60.0,
+                )
+            ],
+            notes="one-way partition of the chief's master link: "
+            "requests LAND server-side but every reply is dropped, so "
+            "each retry re-delivers an already-executed request — the "
+            "server-side dedup must hold while the lease timeout and "
+            "reform converge the job",
+        ),
+        "dup_report_storm": FaultPlan(
+            name="dup_report_storm",
+            faults=[
+                Fault(
+                    kind=FaultKind.NET_DUPLICATE,
+                    fault_id="dup-report-task",
+                    at_step=2,
+                    method="report_task_result",
+                    count=4,
+                ),
+                Fault(
+                    kind=FaultKind.NET_DUPLICATE,
+                    fault_id="dup-report-version",
+                    at_step=2,
+                    method="report_version",
+                    count=4,
+                ),
+            ],
+            notes="duplicate delivery: report RPCs re-execute "
+            "server-side (the response of the first execution is "
+            "discarded, as after a lost reply + retry); task accounting "
+            "must stay exactly-once and version reports monotone — the "
+            "MASTER_RETRYABLE_METHODS dedup contract, proven under "
+            "actual duplication",
         ),
         "shrink_then_restore": FaultPlan(
             name="shrink_then_restore",

@@ -57,6 +57,33 @@ MULTISLICE_PLANS = {
     "grow_under_load": {"num_slices": 2, "initial_slices": 1},
 }
 
+# network-chaos plans and the RPC-plane posture each one needs.  The
+# delay plan gets deadlines generous enough that latency is NOT an
+# error (the job must finish with zero reforms); the blackhole and
+# partition plans get a tight deadline + a retry budget the fault
+# window deliberately OUTLASTS, so the unreachable worker fails fast,
+# dies, and the reform evicts it (convergence) — plus a lease timeout
+# so its tasks are reclaimable even without a reform.  The dup plan
+# keeps retries on (a duplicated report is exactly what a retry
+# produces) with room to spare.
+NETWORK_PLANS = {
+    "slow_network_mid_epoch": {"rpc_deadline_secs": 5.0},
+    "blackhole_master_link": {
+        "rpc_deadline_secs": 1.0,
+        "rpc_retry_secs": 4.0,
+        "task_timeout_secs": 30.0,
+    },
+    "oneway_partition_worker": {
+        "rpc_deadline_secs": 1.0,
+        "rpc_retry_secs": 4.0,
+        "task_timeout_secs": 30.0,
+    },
+    "dup_report_storm": {
+        "rpc_deadline_secs": 5.0,
+        "rpc_retry_secs": 8.0,
+    },
+}
+
 # one-line descriptions of every invariant the checker can emit, for
 # --list discoverability (the checker itself owns the semantics)
 INVARIANT_DESCRIPTIONS = {
@@ -78,6 +105,12 @@ INVARIANT_DESCRIPTIONS = {
     "replica push lands on a DIFFERENT slice than its source",
     "master_recovery": "a relaunched master restored from its journal "
     "and the generation fence never rolled back",
+    "no_false_dead": "a latency-only network plan (delay within the "
+    "heartbeat tolerance) completed with ZERO re-formations — gray is "
+    "not dead",
+    "duplicate_delivery_exactly_once": "duplicated report RPCs "
+    "re-executed server-side were visibly deduplicated and no task "
+    "counted twice (falsified by --corrupt drop_dedup)",
 }
 
 # plans that kill the master: they require the journaled-HA control
@@ -181,6 +214,7 @@ def _run(args, workdir: str) -> dict:
         if args.num_slices is not None
         else slice_config.get("num_slices", 1)
     )
+    network_config = NETWORK_PLANS.get(plan.name, {})
     report = run_chaos_job(
         ChaosJobConfig(
             plan=plan,
@@ -196,6 +230,9 @@ def _run(args, workdir: str) -> dict:
             or bool(plan.master_kill_faults()),
             num_slices=num_slices,
             initial_slices=slice_config.get("initial_slices"),
+            rpc_deadline_secs=network_config.get("rpc_deadline_secs"),
+            rpc_retry_secs=network_config.get("rpc_retry_secs"),
+            task_timeout_secs=network_config.get("task_timeout_secs"),
         )
     )
     if args.baseline and not args.corrupt:
@@ -280,6 +317,10 @@ def write_result_json(report: dict, workdir: str) -> str:
     if report.get("master_ha") is not None:
         result["master_ha"] = report["master_ha"]
         result["master_lives"] = report.get("master_lives")
+    # RPC-plane outcomes (retries/deadlines/dedup drops) so CI reads the
+    # gray-failure posture from the same artifact as the verdicts
+    if report.get("rpc") is not None:
+        result["rpc"] = report["rpc"]
     # causal-trace summary (reform phase breakdown + stragglers) so CI
     # reads the critical path from the same artifact as the verdicts
     try:

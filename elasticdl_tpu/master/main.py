@@ -53,24 +53,30 @@ def build_master(args) -> Master:
             if sample_rate is not None:
                 envs.setdefault(TRACE_SAMPLE_RATE_ENV, str(sample_rate))
         journal_dir = getattr(args, "master_journal_dir", None) or ""
+        retry_secs = getattr(args, "rpc_retry_secs", None)
         if journal_dir:
-            # master HA: workers learn (a) where to re-resolve the
-            # control-plane address after a master restart and (b) the
-            # RPC retry budget that carries them across the outage —
-            # both by env, like the telemetry dir (never argv)
+            # master HA: workers learn where to re-resolve the
+            # control-plane address after a master restart — by env,
+            # like the telemetry dir (never argv)
             from elasticdl_tpu.master.journal import (
                 MASTER_ADDR_FILE_ENV,
                 addr_file_path,
-            )
-            from elasticdl_tpu.rpc.retry import (
-                DEFAULT_RETRY_SECS,
-                RETRY_SECS_ENV,
             )
 
             envs.setdefault(
                 MASTER_ADDR_FILE_ENV, addr_file_path(journal_dir)
             )
-            retry_secs = getattr(args, "rpc_retry_secs", None)
+        if journal_dir or retry_secs is not None:
+            # the RPC retry budget that carries workers across an
+            # outage: implied by HA (journal_dir), or requested alone by
+            # --rpc_retry_secs — a gray network (transient UNAVAILABLE,
+            # deadline expiries under --rpc_deadline_secs) deserves the
+            # backoff loop even on a journal-less master
+            from elasticdl_tpu.rpc.retry import (
+                DEFAULT_RETRY_SECS,
+                RETRY_SECS_ENV,
+            )
+
             envs.setdefault(
                 RETRY_SECS_ENV,
                 str(
@@ -79,6 +85,15 @@ def build_master(args) -> Master:
                     else DEFAULT_RETRY_SECS
                 ),
             )
+        deadline_secs = getattr(args, "rpc_deadline_secs", None)
+        if deadline_secs is not None:
+            # per-method deadlines (rpc/deadline.py): a blackholed
+            # master link degrades to DEADLINE_EXCEEDED instead of
+            # hanging the worker forever.  Env-forwarded like the retry
+            # budget so worker argv stays byte-identical when unset
+            from elasticdl_tpu.rpc.deadline import DEADLINE_SECS_ENV
+
+            envs.setdefault(DEADLINE_SECS_ENV, str(deadline_secs))
         if backend == "k8s":
             import os
 

@@ -47,6 +47,17 @@ class Master:
         self.job_type = derive_job_type(args)
         self._stop_requested = False
         self._job_failed = False
+        # resolved ONCE (shared fallback constant lives next to the RPC
+        # retry budget it is tuned against); the run loop's failure
+        # detector and the rehome-grace computation both read this
+        from elasticdl_tpu.rpc.retry import DEFAULT_HEARTBEAT_TIMEOUT_SECS
+
+        self._heartbeat_timeout_secs = (
+            getattr(
+                args, "heartbeat_timeout_secs", DEFAULT_HEARTBEAT_TIMEOUT_SECS
+            )
+            or DEFAULT_HEARTBEAT_TIMEOUT_SECS
+        )
         self.reform_events: list[dict] = []
         # callbacks(cluster_version, dead_workers, reason) invoked on
         # every re-formation — chaos invariant checking, metrics
@@ -183,8 +194,16 @@ class Master:
         self.replica_directory = None
         if bool(getattr(args, "replication", False)):
             from elasticdl_tpu.replication.directory import ReplicaDirectory
+            from elasticdl_tpu.rpc.deadline import DeadlinePolicy
 
-            self.replica_directory = ReplicaDirectory()
+            deadline_secs = getattr(args, "rpc_deadline_secs", None)
+            self.replica_directory = ReplicaDirectory(
+                # the harvest adopts the job's deadline policy (state-
+                # transfer tier); None keeps the historical fixed timeout
+                deadlines=DeadlinePolicy.from_secs(deadline_secs)
+                if deadline_secs is not None
+                else None
+            )
             self.servicer.set_replica_directory(self.replica_directory)
 
         # ---- master high availability (off by default: with no
@@ -509,11 +528,7 @@ class Master:
                         im.restore_worker_slices(restored["slices"])
                 grace = getattr(self._args, "rehome_grace_secs", None)
                 if grace is None:
-                    heartbeat = (
-                        getattr(self._args, "heartbeat_timeout_secs", 0)
-                        or 0
-                    )
-                    grace = max(10.0, 3.0 * heartbeat)
+                    grace = max(10.0, 3.0 * self._heartbeat_timeout_secs)
                 self._rehome_deadline = time.monotonic() + grace
                 logger.warning(
                     "Waiting up to %.1fs for workers %s to re-home",
@@ -579,7 +594,7 @@ class Master:
                         for worker_id in poll_failed():
                             self.servicer.mark_worker_dead(worker_id)
                 dead = self.servicer.dead_workers(
-                    getattr(self._args, "heartbeat_timeout_secs", 0) or 0
+                    self._heartbeat_timeout_secs
                 )
                 if dead and self.instance_manager is not None:
                     # a killed stale worker's last in-flight RPC can

@@ -61,6 +61,19 @@ class MasterServicer:
         self._standby_drain = False
         # (worker_id, model_version) observers — chaos invariant checking
         self._version_observers: list = []
+        # worker-shipped RPC outcome totals (heartbeat `rpc` field,
+        # rpc/stats.py): monotone per worker, summed onto /metrics.
+        # Never cleared by forget_worker — an evicted worker's failures
+        # happened and the exposed totals must stay monotone
+        self._worker_rpc_stats: dict[int, dict[str, int]] = {}
+        # eval-metrics dedup: lease ids whose metrics were already
+        # accumulated.  The is_active guard alone only covers RECLAIMED
+        # leases — a duplicate delivery (lost reply + retry) arrives
+        # while the lease is still active and would double-count the
+        # accumulated metrics.  Lease ids are never reused, so the set
+        # needs no generation reset.
+        self._eval_metrics_seen: set[int] = set()
+        self._duplicate_eval_drops = 0
         # telemetry event sink: ``fn(event_name, **fields)`` for quiesce
         # lifecycle records; never raises into an RPC
         self._event_sink = None
@@ -393,6 +406,26 @@ class MasterServicer:
                 "Dropping eval metrics for inactive task %d", request.task_id
             )
             return
+        if request.task_id >= 0:
+            # duplicate delivery (lost reply + client retry): the lease
+            # is STILL active — the is_active guard above cannot see the
+            # duplicate, so metric accumulation dedups by lease id here.
+            # This is what makes report_evaluation_metrics honest in
+            # MASTER_RETRYABLE_METHODS' "task_id-deduplicated" claim.
+            with self._lock:
+                if request.task_id in self._eval_metrics_seen:
+                    self._duplicate_eval_drops += 1
+                    duplicate = True
+                else:
+                    self._eval_metrics_seen.add(request.task_id)
+                    duplicate = False
+            if duplicate:
+                logger.warning(
+                    "Dropping duplicate eval metrics for task %d "
+                    "(re-delivered report)",
+                    request.task_id,
+                )
+                return
         if self._evaluation_service is not None:
             self._evaluation_service.report_evaluation_metrics(
                 request.model_outputs,
@@ -404,6 +437,17 @@ class MasterServicer:
         with self._lock:
             self._heartbeats[request.worker_id] = time.monotonic()
             generation = self._cluster_version
+            if request.rpc:
+                # worker-shipped RPC outcome totals: max-merge so a
+                # reordered beat can never walk a counter backward
+                merged = self._worker_rpc_stats.setdefault(
+                    request.worker_id, {}
+                )
+                for key, value in request.rpc.items():
+                    try:
+                        merged[key] = max(merged.get(key, 0), int(value))
+                    except (TypeError, ValueError):
+                        continue
         if self._instance_manager is not None:
             self._instance_manager.on_heartbeat(request.worker_id)
         replica_peers: dict = {}
@@ -604,6 +648,23 @@ class MasterServicer:
         (the /healthz liveness view)."""
         with self._lock:
             return sorted(set(self._heartbeats) - self._marked_dead)
+
+    def rpc_stats_totals(self) -> dict[str, int]:
+        """Fleet-wide RPC outcome totals (retries, deadline_exceeded,
+        unavailable): per-worker monotone maxima summed across every
+        worker ever heard from — what /metrics mirrors."""
+        with self._lock:
+            totals: dict[str, int] = {}
+            for stats in self._worker_rpc_stats.values():
+                for key, value in stats.items():
+                    totals[key] = totals.get(key, 0) + value
+            return totals
+
+    @property
+    def duplicate_eval_drops(self) -> int:
+        """Eval-metric reports dropped by the lease-id dedup (duplicate
+        delivery of a still-active lease's metrics)."""
+        return self._duplicate_eval_drops
 
     @property
     def cluster_version(self) -> int:

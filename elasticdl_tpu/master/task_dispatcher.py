@@ -127,6 +127,13 @@ class TaskDispatcher:
         self._active: dict[int, _Assignment] = {}
         self._next_task_id = 0
         self._next_task_uid = 0
+        # lease ids whose report was PROCESSED (assignment consumed):
+        # distinguishes a duplicate delivery of an already-processed
+        # report (its exec counters were already summed — bank nothing)
+        # from a stale reclaimed-lease report (nothing was summed — the
+        # compile delta must still be banked).  One int per lease, same
+        # footprint as the servicer's eval-metrics dedup set.
+        self._reported_task_ids: set[int] = set()
 
         self._counters: dict[TaskType, JobCounters] = {}
         self._done_callbacks: list[Callable[[], None]] = []
@@ -324,13 +331,22 @@ class TaskDispatcher:
                     COMPILE_COUNT_KEY,
                 )
 
-                if exec_counters and COMPILE_COUNT_KEY in exec_counters:
+                if (
+                    exec_counters
+                    and COMPILE_COUNT_KEY in exec_counters
+                    and task_id not in self._reported_task_ids
+                ):
                     # the compile counter is PROCESS-level, not
                     # task-scoped: a stale (reclaimed-lease) report's
                     # delta is still a real recompile, and the worker's
                     # watermark advances on RPC success — dropping it
                     # here would hide the recompile from the
-                    # elasticdl_compile_total mirror forever
+                    # elasticdl_compile_total mirror forever.  But a
+                    # DUPLICATE DELIVERY of an already-processed report
+                    # (network chaos: lost reply + re-execution) already
+                    # summed this exact delta on its first execution —
+                    # banking it again would double-count, so the
+                    # reported-ids memory gates the bank
                     stale = self._counters.setdefault(
                         TaskType.TRAINING, JobCounters()
                     )
@@ -343,6 +359,7 @@ class TaskDispatcher:
                     "on_task_reported", task_id, None, success, False
                 )
                 return
+            self._reported_task_ids.add(task_id)
             now = time.monotonic()
             for a in self._active.values():
                 if a.worker_id == assignment.worker_id:

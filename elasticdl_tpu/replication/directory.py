@@ -41,7 +41,17 @@ FETCH_TIMEOUT_SECS = 30.0
 
 
 class ReplicaDirectory:
-    def __init__(self):
+    def __init__(self, deadlines=None):
+        # the job-wide DeadlinePolicy (rpc/deadline.py): harvest probes
+        # and fetches are state transfer, so its transfer tier replaces
+        # the fixed FETCH_TIMEOUT_SECS when the master configured
+        # --rpc_deadline_secs; None keeps the historical constant
+        self._deadlines = deadlines
+        self._fetch_timeout = (
+            deadlines.transfer_secs
+            if deadlines is not None
+            else FETCH_TIMEOUT_SECS
+        )
         self._lock = threading.Lock()
         # worker_id -> latest advertisement ({"addr", "process_id",
         # "generation", "holdings"})
@@ -166,7 +176,10 @@ class ReplicaDirectory:
             return None
         clients = []
         try:
-            clients = [(addr, ReplicaClient(addr)) for addr in addrs]
+            clients = [
+                (addr, ReplicaClient(addr, deadlines=self._deadlines))
+                for addr in addrs
+            ]
             # probe every live server for every source's metadata (ALL
             # retained versions, not just the newest — an older shard
             # may be the only complete set left after a mid-push death),
@@ -221,12 +234,11 @@ class ReplicaDirectory:
             "sources": num_sources,
         }
 
-    @staticmethod
-    def _probe(client, source: int, generation: int):
+    def _probe(self, client, source: int, generation: int):
         try:
             resp = client.fetch_replica(
                 msg.FetchReplicaRequest(source=source, probe=True),
-                timeout=FETCH_TIMEOUT_SECS,
+                timeout=self._fetch_timeout,
             )
         except Exception as ex:  # noqa: BLE001 — a dying survivor is a
             # missing offer, not a harvest crash
@@ -250,8 +262,7 @@ class ReplicaDirectory:
         )
         return max(candidates) if candidates else None
 
-    @staticmethod
-    def _fetch(offer_list, source: int, version: int, generation: int):
+    def _fetch(self, offer_list, source: int, version: int, generation: int):
         """Fetch-and-verify one shard from any offering holder."""
         for offered_version, client, addr in offer_list:
             if offered_version != version:
@@ -259,7 +270,7 @@ class ReplicaDirectory:
             try:
                 resp = client.fetch_replica(
                     msg.FetchReplicaRequest(source=source, version=version),
-                    timeout=FETCH_TIMEOUT_SECS,
+                    timeout=self._fetch_timeout,
                 )
             except Exception:  # noqa: BLE001 — try the next holder
                 continue

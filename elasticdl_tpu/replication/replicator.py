@@ -112,6 +112,18 @@ class PeerReplicator:
         self._same_slice_ring = bool(
             os.environ.get(SAME_SLICE_RING_ENV, "")
         )
+        # the job-wide deadline policy (rpc/deadline.py, env-forwarded
+        # like the retry budget): pushes are state transfer, so the
+        # transfer tier replaces the fixed PUSH_TIMEOUT_SECS when a
+        # policy is configured — one object, no second timeout story
+        from elasticdl_tpu.rpc.deadline import DeadlinePolicy
+
+        self._deadlines = DeadlinePolicy.from_env()
+        self._push_timeout = (
+            self._deadlines.transfer_secs
+            if self._deadlines is not None
+            else PUSH_TIMEOUT_SECS
+        )
         # 0 = replicate at EVERY task boundary (the default cadence);
         # N > 0 = milestone-crossing every N steps, like the checkpointer
         self._steps = max(0, int(replication_steps or 0))
@@ -250,7 +262,9 @@ class PeerReplicator:
             if self._client is None or self._client_addr != addr:
                 if self._client is not None:
                     self._client.close()
-                self._client = ReplicaClient(addr)
+                self._client = ReplicaClient(
+                    addr, deadlines=self._deadlines
+                )
                 self._client_addr = addr
             resp = self._client.push_replica(
                 msg.PushReplicaRequest(
@@ -260,7 +274,7 @@ class PeerReplicator:
                     checksum=shard.checksum,
                     payload=shard.payload,
                 ),
-                timeout=PUSH_TIMEOUT_SECS,
+                timeout=self._push_timeout,
             )
             accepted = bool(resp is not None and resp.accepted)
         except Exception as ex:  # noqa: BLE001 — a dead neighbor must

@@ -89,13 +89,19 @@ def start_replica_server(
 
 
 class ReplicaClient(RpcClient):
-    """Stub for one peer's replica server (ring push / harvest pull)."""
+    """Stub for one peer's replica server (ring push / harvest pull).
 
-    def __init__(self, addr: str):
+    ``deadlines`` is the job-wide :class:`~elasticdl_tpu.rpc.deadline.
+    DeadlinePolicy` — replica pushes/fetches are state transfer, so the
+    policy's transfer tier applies when a caller passes no explicit
+    timeout; None keeps the historical fixed-constant behavior."""
+
+    def __init__(self, addr: str, deadlines=None):
         super().__init__(
             addr,
             methods=REPLICA_METHODS,
             service_name=REPLICA_SERVICE_NAME,
+            deadlines=deadlines,
         )
 
     def push_replica(

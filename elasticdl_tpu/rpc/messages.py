@@ -124,6 +124,12 @@ class HeartbeatRequest:
     # Empty when replication is off; old payloads decode to {} so the
     # field is wire-compatible
     replica: dict = field(default_factory=dict)
+    # client-side RPC outcome totals (rpc/stats.py): monotone counts of
+    # retries / deadline_exceeded / unavailable since process start.
+    # The heartbeat carries them BECAUSE it keeps flowing when task
+    # reports stall — exactly when these spike.  Empty on a clean link;
+    # old payloads decode to {} so the field is wire-compatible
+    rpc: dict = field(default_factory=dict)
 
 
 @dataclass

@@ -35,12 +35,23 @@ import random
 import time
 from dataclasses import dataclass
 
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
 RETRY_SECS_ENV = "ELASTICDL_TPU_RPC_RETRY_SECS"
 
 # outage budget when --rpc_retry_secs is unset: the master exports it,
 # the worker falls back to it on a missing/malformed env — ONE constant
 # so the two sides can never disagree
 DEFAULT_RETRY_SECS = 60.0
+
+# heartbeat-timeout fallback when the parsed args carry no
+# --heartbeat_timeout_secs (0 disables timeout-based failure detection).
+# Kept next to DEFAULT_RETRY_SECS because operators size the two
+# against each other — a silence tolerance shorter than the worker's
+# retry budget turns every surviving blip into a re-formation.  The
+# master resolves the value ONCE (Master._heartbeat_timeout_secs); its
+# run-loop failure detector and rehome-grace computation both reuse it.
+DEFAULT_HEARTBEAT_TIMEOUT_SECS = 0.0
 
 # naturally idempotent / read-only master methods: safe to retry on ANY
 # service without knowing its dedup story
@@ -119,8 +130,17 @@ def call_with_retry(
             if out_of_attempts or out_of_time:
                 raise
             if on_retry is not None:
-                on_retry(attempt, ex)
+                try:
+                    on_retry(attempt, ex)
+                except Exception:  # noqa: BLE001 — a broken hook (e.g.
+                    # a re-resolve probe dying) must not end the retry
+                    # loop: the loop IS the outage survival path
+                    logger.exception("Retry hook failed; continuing")
             delay = rng.uniform(0.0, policy.delay_cap(attempt))
             if deadline is not None:
+                # the wall budget clamps the FINAL backoff sleep too: a
+                # full jitter draw near max_delay must not overshoot the
+                # deadline and bill the caller for time the budget
+                # already spent
                 delay = min(delay, max(0.0, deadline - clock()))
             sleep(delay)

@@ -16,11 +16,13 @@ Tensor payloads (eval outputs/labels) ride inside the same frames; the
 from __future__ import annotations
 
 import threading
+import time
 from concurrent import futures
 
 import grpc
 
 from elasticdl_tpu.rpc import messages as msg
+from elasticdl_tpu.rpc import stats as rpc_stats
 from elasticdl_tpu.utils.constants import GRPC
 from elasticdl_tpu.utils.log_utils import default_logger as logger
 
@@ -59,18 +61,76 @@ def _retryable_grpc_error(ex) -> bool:
     code = getattr(ex, "code", None)
     return callable(code) and code() in _RETRYABLE_CODES
 
+
+def _note_rpc_failure(ex):
+    """Mirror an outage-class failure into the process-local stats the
+    heartbeat ships to the master's /metrics (rpc/stats.py).  Anything
+    without a status code (or outside the tracked set) is ignored."""
+    code = getattr(ex, "code", None)
+    if callable(code):
+        try:
+            rpc_stats.note_failure(code().name.lower())
+        except Exception:  # noqa: BLE001 — accounting never breaks a call
+            pass
+
+
+# ---- chaos netem seam (chaos/netem.py) --------------------------------------
+#
+# The transport-level fault shim plugs in HERE, at the two choke points
+# every msgpack-framed RPC passes: the client's per-attempt invoke and
+# the server's generic handler.  Production code never sets these; the
+# netem layer installs them from an env-armed fault plan, so a run with
+# no plan pays one module-global None check per call and nothing else.
+# The server observer is the telemetry hook for per-method handler
+# latency (master_hooks registers it).
+
+_client_fault_shim = None
+_server_fault_shim = None
+_server_rpc_observer = None
+
+
+def set_client_fault_shim(shim):
+    global _client_fault_shim
+    _client_fault_shim = shim
+
+
+def set_server_fault_shim(shim):
+    global _server_fault_shim
+    _server_fault_shim = shim
+
+
+def set_server_rpc_observer(observer):
+    """``observer(method, seconds)`` after every server-side handler."""
+    global _server_rpc_observer
+    _server_rpc_observer = observer
+
+
 _CHANNEL_OPTIONS = [
     ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
     ("grpc.max_receive_message_length", GRPC.MAX_RECEIVE_MESSAGE_LENGTH),
 ]
 
 
-def _handler(servicer, name):
+def _handler(servicer, name, service_name=SERVICE_NAME):
     fn = getattr(servicer, name)
 
     def unary(request_bytes: bytes, context) -> bytes:
         request = msg.decode(request_bytes)
-        response = fn(request)
+        t0 = time.monotonic()
+        try:
+            shim = _server_fault_shim
+            if shim is not None:
+                response = shim.server_call(service_name, name, fn, request)
+            else:
+                response = fn(request)
+        finally:
+            observer = _server_rpc_observer
+            if observer is not None:
+                try:
+                    observer(name, time.monotonic() - t0)
+                except Exception:  # noqa: BLE001 — telemetry never
+                    # breaks an RPC
+                    pass
         return msg.encode(response) if response is not None else b""
 
     return grpc.unary_unary_rpc_method_handler(unary)
@@ -91,7 +151,9 @@ def create_server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=_CHANNEL_OPTIONS,
     )
-    handlers = {name: _handler(servicer, name) for name in methods}
+    handlers = {
+        name: _handler(servicer, name, service_name) for name in methods
+    }
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(service_name, handlers),)
     )
@@ -115,8 +177,12 @@ class RpcClient:
     see rpc/retry.py for the safety contract).  ``resolve_addr`` is the
     re-resolve hook: called after repeated failures, and a changed
     address rebuilds the channel — how a worker follows a master that
-    restarted on a new port.  With ``retry=None`` (the default) every
-    code path is byte-identical to the retry-less client."""
+    restarted on a new port.  ``deadlines`` (a :class:`~elasticdl_tpu.
+    rpc.deadline.DeadlinePolicy`) supplies a per-method timeout when the
+    caller passes none, so a blackholed link degrades to
+    DEADLINE_EXCEEDED instead of hanging the calling thread forever.
+    With ``retry=None`` and ``deadlines=None`` (the defaults) every
+    code path is byte-identical to the policy-less client."""
 
     # failed attempts between re-resolve probes (the first probe fires
     # early so a fast master relaunch is caught within ~2 backoffs)
@@ -130,11 +196,13 @@ class RpcClient:
         retry=None,
         retryable_methods: frozenset[str] | set[str] | None = None,
         resolve_addr=None,
+        deadlines=None,
     ):
         self._addr = addr
         self._methods = tuple(methods)
         self._service_name = service_name
         self._retry = retry
+        self._deadlines = deadlines
         if retryable_methods is None:
             from elasticdl_tpu.rpc.retry import DEFAULT_IDEMPOTENT
 
@@ -187,25 +255,55 @@ class RpcClient:
             # change, so the parked set is bounded by master restarts.
             self._stale_channels.append(old)
 
+    def _invoke(self, name, payload, timeout):
+        """One wire attempt.  The call table is snapshotted under the
+        channel lock on EVERY path (a concurrent re-resolve can swap it
+        mid-read), and the netem shim — when chaos armed one — wraps
+        the attempt so injected latency/blackholes/duplicates apply per
+        attempt, exactly like a real flaky link."""
+        with self._channel_lock:
+            call = self._calls[name]
+        shim = _client_fault_shim
+        if shim is not None:
+            return shim.client_call(
+                self._service_name,
+                name,
+                lambda: call(payload, timeout=timeout),
+                timeout,
+            )
+        return call(payload, timeout=timeout)
+
     def _call(self, name, request, timeout: float | None = None):
+        if timeout is None and self._deadlines is not None:
+            timeout = self._deadlines.deadline_for(name)
         payload = msg.encode(request)
         if self._retry is None or name not in self._retryable:
-            out = self._calls[name](payload, timeout=timeout)
+            try:
+                out = self._invoke(name, payload, timeout)
+            except Exception as ex:  # noqa: BLE001 — re-raised below
+                _note_rpc_failure(ex)
+                raise
             return msg.decode(out) if out else None
         from elasticdl_tpu.rpc.retry import call_with_retry
 
         def attempt():
-            # re-read the call table each attempt: a re-resolve may have
-            # swapped the channel under us
-            with self._channel_lock:
-                call = self._calls[name]
-            return call(payload, timeout=timeout)
+            return self._invoke(name, payload, timeout)
+
+        def is_retryable(ex):
+            retryable = _retryable_grpc_error(ex)
+            if retryable:
+                _note_rpc_failure(ex)
+            return retryable
+
+        def on_retry(attempt_no, ex):
+            rpc_stats.note_retry()
+            self._maybe_reresolve(attempt_no, ex)
 
         out = call_with_retry(
             attempt,
             self._retry,
-            is_retryable=_retryable_grpc_error,
-            on_retry=self._maybe_reresolve,
+            is_retryable=is_retryable,
+            on_retry=on_retry,
         )
         return msg.decode(out) if out else None
 

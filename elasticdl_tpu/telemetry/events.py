@@ -63,6 +63,11 @@ EVENT_WORKER_REHOME = "worker_rehome"
 EVENT_SLICE_LOSS = "slice_loss"
 EVENT_MESH_RESIZE = "mesh_resize"
 EVENT_AUTOSCALE_DECISION = "autoscale_decision"
+# network chaos (chaos/netem.py): a transport-level fault fired at the
+# RPC seam — injected latency window, blackhole, duplicate delivery,
+# UNAVAILABLE, or one-way partition (distinct from fault_injected: the
+# process lives, only its link degrades)
+EVENT_RPC_FAULT_INJECTED = "rpc_fault_injected"
 
 EVENTS_FILENAME = "events.jsonl"
 

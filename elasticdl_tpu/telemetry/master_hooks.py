@@ -136,6 +136,33 @@ class MasterTelemetry:
             "elasticdl_compile_total",
             "XLA backend compiles (master process + worker-reported)",
         )
+        # gray-failure RPC plane (rpc/stats.py ships the worker-side
+        # totals by heartbeat; the dedup counters are master-observed)
+        self._rpc_retries = r.counter(
+            "elasticdl_rpc_retries_total",
+            "Worker RPC backoff re-sends (heartbeat-shipped totals)",
+        )
+        self._rpc_deadline_exceeded = r.counter(
+            "elasticdl_rpc_deadline_exceeded_total",
+            "Worker RPC attempts that expired their deadline",
+        )
+        self._rpc_unavailable = r.counter(
+            "elasticdl_rpc_unavailable_total",
+            "Worker RPC attempts that failed UNAVAILABLE",
+        )
+        self._rpc_reports_deduped = r.counter(
+            "elasticdl_rpc_reports_deduped_total",
+            "Task reports dropped by task-id dedup (duplicate delivery "
+            "or stale lease)",
+        )
+        self._rpc_eval_deduped = r.counter(
+            "elasticdl_rpc_eval_reports_deduped_total",
+            "Eval-metric reports dropped as duplicate deliveries of a "
+            "still-active lease",
+        )
+        # per-method server-side handler latency; children created
+        # lazily per observed method (one family, one registration site)
+        self._rpc_latency_children: dict = {}
         from elasticdl_tpu.telemetry import compile_tracker
 
         compile_tracker.install()
@@ -158,6 +185,24 @@ class MasterTelemetry:
         servicer.add_version_observer(self.on_version_report)
         servicer.set_event_sink(self.events.emit)
         servicer.set_trace_provider(self.trace_for_task)
+        # per-method handler latency rides the transport's server seam
+        # (module-global observer: the latest attached master wins,
+        # which is exactly the in-process-harness sequencing)
+        from elasticdl_tpu.rpc import service as rpc_service
+
+        rpc_service.set_server_rpc_observer(self.observe_rpc)
+
+    def observe_rpc(self, method: str, seconds: float):
+        """Server-seam hook: one handler execution of ``method``."""
+        hist = self._rpc_latency_children.get(method)
+        if hist is None:
+            hist = self.registry.histogram(
+                "elasticdl_rpc_latency_seconds",
+                "Server-side RPC handler latency by method",
+                labels={"method": method},
+            )
+            self._rpc_latency_children[method] = hist
+        hist.observe(seconds)
 
     def trace_for_task(self, task_id: int) -> dict:
         """The dispatch span's trace context for an active lease — what
@@ -201,6 +246,19 @@ class MasterTelemetry:
         if self._servicer is not None:
             self._workers_live.set(len(self._servicer.live_workers()))
             self._generation.set(self._servicer.cluster_version)
+            # heartbeat-shipped worker RPC outcomes + the servicer's own
+            # eval dedup drops (set_total: mirrored monotone aggregates)
+            totals = getattr(
+                self._servicer, "rpc_stats_totals", lambda: {}
+            )()
+            self._rpc_retries.set_total(totals.get("retries", 0))
+            self._rpc_deadline_exceeded.set_total(
+                totals.get("deadline_exceeded", 0)
+            )
+            self._rpc_unavailable.set_total(totals.get("unavailable", 0))
+            self._rpc_eval_deduped.set_total(
+                getattr(self._servicer, "duplicate_eval_drops", 0)
+            )
 
     def build_health_fn(self, job_type: str, instance_manager_fn=lambda: None):
         """The ``/healthz`` payload closure (also used directly by
@@ -302,6 +360,14 @@ class MasterTelemetry:
                 records=task.num_records,
                 reason="report_failed",
             )
+
+    def on_task_reported(self, task_id, task, success, counted):
+        """Every report outcome, counted or not: a ``counted=False``
+        report is a drop by the dispatcher's task-id dedup — a
+        duplicate delivery or a stale (reclaimed) lease — the counter
+        the duplicate-safety contract is observable through."""
+        if not counted:
+            self._rpc_reports_deduped.inc()
 
     def on_task_reclaimed(self, task_id, task):
         span = self._task_spans.pop(task_id, None)

@@ -60,6 +60,7 @@ from elasticdl_tpu.telemetry.tracing import (
     SPAN_REFORM_RELAUNCH,
     SPAN_REPLICA_HARVEST,
     SPAN_REPLICA_RESTORE,
+    SPAN_RPC_DEGRADED,
     SPAN_TRAINER_BUILD,
     SPAN_WORKER_REHOME,
     SPAN_WORLD_INITIALIZE,
@@ -275,8 +276,30 @@ def _phase_intervals(
         ),
         None,
     )
+    # degraded-network windows (netem rpc_degraded spans): the period a
+    # link was injected slow/blackholed.  Listed right after
+    # death_detection so it REFINES the detection segment — the sweep's
+    # later-stage-wins rule keeps every reform phase on top of it —
+    # and clamped to the reform start: the eviction resolves the
+    # degradation as far as this gap's pipeline is concerned.
+    degraded = _merged_window(
+        [
+            s
+            for s in _spans_named(spans, SPAN_RPC_DEGRADED)
+            if s["end"] > gap_start - _GAP_MATCH_SLACK_SECS
+            and s["start"] < gap_end
+        ]
+    )
     if reform is not None:
         intervals.append(("death_detection", gap_start, reform["start"]))
+        if degraded:
+            intervals.append(
+                (
+                    "degraded_network",
+                    degraded[0],
+                    min(degraded[1], reform["start"]),
+                )
+            )
         children = [
             s
             for s in spans
@@ -299,6 +322,10 @@ def _phase_intervals(
         )
         if relaunch:
             intervals.append(("world_relaunch", relaunch[0], relaunch[1]))
+    elif degraded:
+        # no reform span matched the gap: the degraded window is still
+        # the best name for the time it covers
+        intervals.append(("degraded_network", degraded[0], degraded[1]))
     join_spans = [
         s
         for s in _spans_named(spans, SPAN_WORLD_JOIN, SPAN_WORLD_INITIALIZE)
@@ -341,6 +368,7 @@ def _phase_intervals(
 # spawn; after the join the worker is re-initializing (model spec, data
 # reader, first lease); after the build/restore it is compiling the step
 _BRIDGE_AFTER = {
+    "degraded_network": "death_detection",
     "replica_harvest": "quiesce_recover",
     "world_relaunch": "worker_spawn",
     "world_join": "worker_init",

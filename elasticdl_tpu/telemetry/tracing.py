@@ -79,6 +79,7 @@ SPAN_WORKER_REHOME = "worker_rehome"  # master: one re-home handshake
 SPAN_SLICE_LOSS = "slice_loss"  # master: slice death detect -> re-plan
 SPAN_MESH_RESIZE = "mesh_resize"  # master: hybrid mesh re-plan (resize)
 SPAN_AUTOSCALE_DECISION = "autoscale_decision"  # master: one SLO decision
+SPAN_RPC_DEGRADED = "rpc_degraded"  # netem window: link slow/blackholed
 
 
 def gen_trace_id() -> str:

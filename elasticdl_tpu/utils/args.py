@@ -529,6 +529,21 @@ def _add_master_params(parser: argparse.ArgumentParser):
         ),
     )
     parser.add_argument(
+        "--rpc_deadline_secs",
+        type=pos_float,
+        default=None,
+        required=False,
+        help=(
+            "Per-call deadline for worker control RPCs (state-transfer "
+            "methods like get_restore_state and the replication "
+            "push/fetch get a proportionally longer tier; see "
+            "rpc/deadline.py).  Makes a blackholed master link degrade "
+            "to DEADLINE_EXCEEDED — which feeds the retry loop — "
+            "instead of hanging the worker forever.  Forwarded to "
+            "workers by env; unset = no deadlines (historical behavior)"
+        ),
+    )
+    parser.add_argument(
         "--rehome_grace_secs",
         type=non_neg_float,
         default=None,
@@ -815,9 +830,11 @@ _MASTER_ONLY_FLAGS = frozenset(
         "yaml",
         "cluster_spec",
         # master HA is the master's business: workers receive the addr
-        # file and retry budget via env (master/main.py), never argv
+        # file, retry budget and RPC deadline policy via env
+        # (master/main.py), never argv
         "master_journal_dir",
         "rpc_retry_secs",
+        "rpc_deadline_secs",
         "rehome_grace_secs",
         # workers receive the telemetry dir via ELASTICDL_TPU_TELEMETRY_DIR
         # and the span sample rate via ELASTICDL_TPU_TRACE_SAMPLE_RATE

@@ -634,6 +634,8 @@ class LockstepWorker:
     def _start_heartbeats(self, interval_secs: float = 2.0):
         import threading
 
+        from elasticdl_tpu.rpc import stats as rpc_stats
+
         def beat():
             while not self._stopped:
                 if (
@@ -658,6 +660,9 @@ class LockstepWorker:
                             replica=self._replicator.advertisement()
                             if self._replicator is not None
                             else {},
+                            # RPC outcome totals ride the beat — the one
+                            # RPC still flowing when reports stall
+                            rpc=rpc_stats.snapshot(),
                         )
                     )
                     if self._replicator is not None and resp is not None:

@@ -25,15 +25,19 @@ from elasticdl_tpu.utils.log_utils import default_logger as logger
 
 
 def build_master_client(master_addr: str) -> MasterClient:
-    """Master-HA-aware client: when the master exported a retry budget
-    (it does exactly when ``--master_journal_dir`` is set), RPCs back
-    off across a master outage and re-resolve the control-plane address
-    from the journal dir's addr file.  Without the env, the client is
-    the plain fail-fast one — byte-identical behavior."""
+    """Master-HA- and deadline-aware client: when the master exported a
+    retry budget (``--master_journal_dir`` or ``--rpc_retry_secs``),
+    RPCs back off across an outage and re-resolve the control-plane
+    address from the journal dir's addr file; when it exported
+    ``--rpc_deadline_secs``, every call carries a per-method deadline so
+    a blackholed link degrades to DEADLINE_EXCEEDED (which feeds that
+    same retry loop) instead of hanging forever.  With neither env the
+    client is the plain fail-fast one — byte-identical behavior."""
     from elasticdl_tpu.master.journal import (
         MASTER_ADDR_FILE_ENV,
         read_master_addr,
     )
+    from elasticdl_tpu.rpc.deadline import DeadlinePolicy
     from elasticdl_tpu.rpc.retry import (
         DEFAULT_RETRY_SECS,
         RETRY_SECS_ENV,
@@ -41,10 +45,11 @@ def build_master_client(master_addr: str) -> MasterClient:
     )
     from elasticdl_tpu.rpc.service import MASTER_RETRYABLE_METHODS
 
+    deadlines = DeadlinePolicy.from_env()
     budget = os.environ.get(RETRY_SECS_ENV, "")
     addr_file = os.environ.get(MASTER_ADDR_FILE_ENV, "")
     if not budget and not addr_file:
-        return MasterClient(master_addr)
+        return MasterClient(master_addr, deadlines=deadlines)
     try:
         budget_secs = float(budget) if budget else DEFAULT_RETRY_SECS
     except ValueError:
@@ -56,6 +61,7 @@ def build_master_client(master_addr: str) -> MasterClient:
         resolve_addr=(
             (lambda: read_master_addr(addr_file)) if addr_file else None
         ),
+        deadlines=deadlines,
     )
 
 
@@ -198,6 +204,17 @@ def main(argv=None) -> int:
         worker_id=args.worker_id,
         process_id=int(getattr(args, "process_id", 0) or 0),
         generation=int(getattr(args, "cluster_version", 0) or 0),
+    )
+    # transport-level network chaos (chaos/netem.py): a no-op unless the
+    # master exported a fault plan with network faults targeting this
+    # process/generation — armed BEFORE the client is built so the very
+    # first RPC rides the shim'd seam
+    from elasticdl_tpu.chaos import netem
+
+    netem.install_from_env(
+        process_id=int(getattr(args, "process_id", 0) or 0),
+        cluster_version=int(getattr(args, "cluster_version", 0) or 0),
+        worker_id=args.worker_id,
     )
     reform_parent = getattr(args, "trace", None) or tracing.parent_from_env()
     client = build_master_client(args.master_addr)

@@ -764,6 +764,8 @@ class Worker:
         watch stream; every get_task also counts implicitly)."""
         import threading
 
+        from elasticdl_tpu.rpc import stats as rpc_stats
+
         def beat():
             while not self._stopped:
                 t0 = time.monotonic()
@@ -773,6 +775,9 @@ class Worker:
                             worker_id=self._worker_id,
                             step=self._trainer.step if self._trainer else 0,
                             timestamp=time.time(),
+                            # RPC outcome totals ride the beat — the one
+                            # RPC still flowing when reports stall
+                            rpc=rpc_stats.snapshot(),
                         )
                     )
                     if resp is not None:

@@ -62,6 +62,8 @@ REQUIRED_EVENT_NAMES = frozenset(
         "slice_loss",
         "mesh_resize",
         "autoscale_decision",
+        # network chaos (ISSUE 9): transport-level fault firings
+        "rpc_fault_injected",
     }
 )
 REQUIRED_SPAN_NAMES = frozenset(
@@ -77,12 +79,23 @@ REQUIRED_SPAN_NAMES = frozenset(
         "slice_loss",
         "mesh_resize",
         "autoscale_decision",
+        # network chaos (ISSUE 9): injected link-degradation window —
+        # trace analyze's degraded_network phase reads it
+        "rpc_degraded",
     }
 )
 # metric families other tooling depends on (the compile-count regression
-# gate scrapes elasticdl_compile_total): must be registered somewhere,
-# at exactly one site (the single-site rule above)
-REQUIRED_METRIC_NAMES = frozenset({"elasticdl_compile_total"})
+# gate scrapes elasticdl_compile_total; the netchaos smoke requires a
+# deadline-exceeded counter; the RPC latency family is the per-method
+# handler histogram): must be registered somewhere, at exactly one site
+# (the single-site rule above)
+REQUIRED_METRIC_NAMES = frozenset(
+    {
+        "elasticdl_compile_total",
+        "elasticdl_rpc_deadline_exceeded_total",
+        "elasticdl_rpc_latency_seconds",
+    }
+)
 
 # CLI entry points whose stdout IS their product (reports, dataset
 # paths); everything else logs

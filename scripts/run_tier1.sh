@@ -11,11 +11,16 @@
 # 2-slice layout onto CPU devices, kill a whole slice mid-epoch; reform
 # must shrink the dp axis to the survivors — a mesh_resize span — and
 # hot-restore from the cross-slice replica ring with zero disk reads)
+# + netchaos smoke (blackhole one worker's master link for a window the
+# retry budget outlasts: every call must degrade to DEADLINE_EXCEEDED,
+# retry, and complete — deadline-exceeded counter > 0, zero reforms,
+# zero hung threads at exit)
 # + the ROADMAP.md test command, verbatim.
 # Run from the repo root: scripts/run_tier1.sh
 cd "$(dirname "$0")/.." || exit 2
 python scripts/check_telemetry_names.py || exit 1
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/trace_smoke.py || exit 1
+timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/netchaos_smoke.py || exit 1
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/compile_smoke.py || exit 1
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/replication_smoke.py || exit 1
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/master_ha_smoke.py || exit 1

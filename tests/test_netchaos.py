@@ -1,0 +1,1104 @@
+"""Network-chaos tests: the gray-failure RPC plane.
+
+Unit coverage for the netem shim (deterministic arming, seeded jitter,
+blackhole-to-deadline degradation, one-way partition semantics,
+server-side duplicate delivery), the per-method deadline policy, the
+retry-loop edge cases the shim exercises, the duplicate-safety of the
+report handlers (the MASTER_RETRYABLE_METHODS contract, proven under
+actual duplication), and the new telemetry (rpc stats by heartbeat,
+dedup counters, degraded_network trace phase).  The end-to-end
+blackhole -> deadline -> retry -> complete path is gated by
+``scripts/netchaos_smoke.py`` in tier-1; the full eviction plans run
+under the slow marker.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import grpc
+import pytest
+
+from elasticdl_tpu.chaos import netem
+from elasticdl_tpu.chaos.harness import (
+    ChaosJobConfig,
+    _check_duplicate_delivery,
+    _check_no_false_dead,
+)
+from elasticdl_tpu.chaos.invariants import InvariantChecker
+from elasticdl_tpu.chaos.netem import InjectedRpcError, NetemShim
+from elasticdl_tpu.chaos.plan import (
+    Fault,
+    FaultKind,
+    FaultPlan,
+    builtin_plans,
+    named_plan,
+)
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.rpc import messages as msg
+from elasticdl_tpu.rpc import stats as rpc_stats
+from elasticdl_tpu.rpc.deadline import (
+    DEADLINE_SECS_ENV,
+    DeadlinePolicy,
+)
+from elasticdl_tpu.rpc.retry import RetryPolicy, call_with_retry
+from elasticdl_tpu.rpc.service import (
+    RpcClient,
+    _retryable_grpc_error,
+    set_client_fault_shim,
+)
+from elasticdl_tpu.utils.constants import TaskType
+
+
+@pytest.fixture(autouse=True)
+def _clean_seams():
+    """Module-global seams must never leak between tests."""
+    yield
+    netem.uninstall()
+    rpc_stats.reset_for_tests()
+
+
+# ---- fault plan model -------------------------------------------------------
+
+
+NETWORK_PLAN_NAMES = (
+    "slow_network_mid_epoch",
+    "blackhole_master_link",
+    "oneway_partition_worker",
+    "dup_report_storm",
+)
+
+
+def test_network_plans_exist_and_round_trip(tmp_path):
+    plans = builtin_plans(2)
+    for name in NETWORK_PLAN_NAMES:
+        assert name in plans
+        plan = plans[name]
+        assert all(f.kind in FaultKind.NETWORK_SIDE for f in plan.faults)
+        path = str(tmp_path / f"{name}.json")
+        plan.save(path)
+        loaded = FaultPlan.load(path)
+        # method/direction are part of the replayability contract
+        assert loaded.faults == plan.faults
+
+
+def test_network_kinds_partition_client_vs_server():
+    assert FaultKind.NET_DUPLICATE in FaultKind.NETWORK_SERVER_SIDE
+    assert FaultKind.NET_BLACKHOLE in FaultKind.NETWORK_CLIENT_SIDE
+    assert not (
+        FaultKind.NETWORK_CLIENT_SIDE & FaultKind.NETWORK_SERVER_SIDE
+    )
+    # network kinds must NOT be worker-side: the step-armed injector
+    # would otherwise try to fire them with no network semantics
+    assert not (FaultKind.NETWORK_SIDE & FaultKind.WORKER_SIDE)
+
+
+def test_fault_rejects_bad_direction():
+    with pytest.raises(ValueError):
+        Fault(
+            kind=FaultKind.NET_PARTITION,
+            fault_id="x",
+            direction="sideways",
+        )
+
+
+# ---- netem shim: client seam ------------------------------------------------
+
+
+def _shim(faults, **kwargs):
+    kwargs.setdefault("sleep", lambda s: None)
+    return NetemShim(faults, **kwargs)
+
+
+def test_delay_applies_and_jitter_is_seeded():
+    sleeps_a, sleeps_b = [], []
+    fault = Fault(
+        kind=FaultKind.NET_DELAY,
+        fault_id="d",
+        delay_ms=100.0,
+        duration_secs=30.0,
+    )
+    a = _shim([fault], plan_seed=7, sleep=sleeps_a.append)
+    b = _shim([fault], plan_seed=7, sleep=sleeps_b.append)
+    for shim, out in ((a, "x"), (b, "x")):
+        assert shim.client_call("svc", "m", lambda: out, None) == out
+    assert sleeps_a == sleeps_b  # same seed -> same jitter draw
+    assert 0.1 <= sleeps_a[0] <= 0.15  # base + uniform(0, base/2)
+    c = _shim([fault], plan_seed=8, sleep=sleeps_b.append)
+    c.client_call("svc", "m", lambda: "x", None)
+    assert sleeps_b[-1] != sleeps_a[0]
+
+
+def test_delay_past_the_deadline_is_a_deadline_expiry():
+    """A real link's delay beyond the caller's deadline IS a deadline
+    expiry — the shim must raise DEADLINE_EXCEEDED after the deadline,
+    not deliver a slow success."""
+    sleeps = []
+    invoked = []
+    shim = _shim(
+        [
+            Fault(
+                kind=FaultKind.NET_DELAY,
+                fault_id="d",
+                delay_ms=2000.0,
+                duration_secs=30.0,
+            )
+        ],
+        sleep=sleeps.append,
+    )
+    with pytest.raises(InjectedRpcError) as exc:
+        shim.client_call("svc", "m", lambda: invoked.append(1), 1.0)
+    assert exc.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+    assert invoked == [] and sleeps[-1] == 1.0  # waited out the deadline
+    # a delay UNDER the deadline still succeeds, just late
+    assert shim.client_call("svc", "m", lambda: "ok", 5.0) == "ok"
+
+
+def test_blackhole_with_deadline_degrades_to_deadline_exceeded():
+    sleeps = []
+    shim = _shim(
+        [
+            Fault(
+                kind=FaultKind.NET_BLACKHOLE,
+                fault_id="b",
+                duration_secs=30.0,
+            )
+        ],
+        sleep=sleeps.append,
+    )
+    invoked = []
+    with pytest.raises(InjectedRpcError) as exc:
+        shim.client_call("svc", "m", lambda: invoked.append(1), 1.5)
+    assert exc.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+    # the dropped request never reached the server, and the caller
+    # waited out its full deadline — silence, not an error
+    assert invoked == []
+    assert sleeps == [1.5]
+    # a deadline expiry is retryable: the whole point is that it feeds
+    # the existing full-jitter loop
+    assert _retryable_grpc_error(exc.value)
+
+
+def test_blackhole_without_deadline_hangs_until_window_closes():
+    """The deadline-less hang is bounded by the fault window (the link
+    'flaps back'), so a policy-less run still terminates — with the
+    UNAVAILABLE a reset connection would produce."""
+    clock = [0.0]
+
+    def fake_clock():
+        return clock[0]
+
+    def fake_sleep(s):
+        clock[0] += max(s, 0.01)
+
+    shim = _shim(
+        [
+            Fault(
+                kind=FaultKind.NET_BLACKHOLE,
+                fault_id="b",
+                duration_secs=2.0,
+            )
+        ],
+        sleep=fake_sleep,
+        clock=fake_clock,
+    )
+    with pytest.raises(InjectedRpcError) as exc:
+        shim.client_call("svc", "m", lambda: 1, None)
+    assert exc.value.code() == grpc.StatusCode.UNAVAILABLE
+    assert clock[0] >= 2.0
+
+
+def test_partition_response_direction_executes_then_drops_reply():
+    invoked = []
+    shim = _shim(
+        [
+            Fault(
+                kind=FaultKind.NET_PARTITION,
+                fault_id="p",
+                direction="response",
+                duration_secs=30.0,
+            )
+        ]
+    )
+    with pytest.raises(InjectedRpcError) as exc:
+        shim.client_call("svc", "m", lambda: invoked.append(1), 0.5)
+    # THE gray-failure signature: the request landed, the caller saw a
+    # deadline — its retry will re-deliver a landed request
+    assert invoked == [1]
+    assert exc.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+
+
+def test_partition_request_direction_never_executes():
+    invoked = []
+    shim = _shim(
+        [
+            Fault(
+                kind=FaultKind.NET_PARTITION,
+                fault_id="p",
+                direction="request",
+                duration_secs=30.0,
+            )
+        ]
+    )
+    with pytest.raises(InjectedRpcError):
+        shim.client_call("svc", "m", lambda: invoked.append(1), 0.5)
+    assert invoked == []
+
+
+def test_unavailable_counts_and_at_step_skips():
+    shim = _shim(
+        [
+            Fault(
+                kind=FaultKind.NET_UNAVAILABLE,
+                fault_id="u",
+                at_step=1,
+                count=1,
+            )
+        ]
+    )
+    # at_step=1: the first matched call passes unharmed
+    assert shim.client_call("svc", "m", lambda: "a", None) == "a"
+    with pytest.raises(InjectedRpcError) as exc:
+        shim.client_call("svc", "m", lambda: "b", None)
+    assert exc.value.code() == grpc.StatusCode.UNAVAILABLE
+    # count exhausted: the fault retires
+    assert shim.client_call("svc", "m", lambda: "c", None) == "c"
+    assert shim.armed_count == 0
+
+
+def test_method_filter_only_matches_named_method():
+    shim = _shim(
+        [
+            Fault(
+                kind=FaultKind.NET_UNAVAILABLE,
+                fault_id="u",
+                method="report_task_result",
+                count=1,
+            )
+        ]
+    )
+    assert shim.client_call("svc", "get_task", lambda: "ok", None) == "ok"
+    with pytest.raises(InjectedRpcError):
+        shim.client_call("svc", "report_task_result", lambda: "x", None)
+
+
+def test_window_close_retires_fault():
+    clock = [0.0]
+    shim = _shim(
+        [
+            Fault(
+                kind=FaultKind.NET_DELAY,
+                fault_id="d",
+                delay_ms=10.0,
+                duration_secs=5.0,
+            )
+        ],
+        clock=lambda: clock[0],
+    )
+    shim.client_call("svc", "m", lambda: 1, None)  # opens the window
+    clock[0] = 6.0  # past the window
+    shim.client_call("svc", "m", lambda: 1, None)
+    assert shim.armed_count == 0
+
+
+# ---- netem install: env arming + generation/process fence ------------------
+
+
+def test_install_from_env_fences_process_and_generation(
+    tmp_path, monkeypatch
+):
+    from elasticdl_tpu.chaos import hooks as chaos_hooks
+    from elasticdl_tpu.rpc import service as rpc_service
+
+    plan = named_plan("blackhole_master_link", 2)
+    path = str(tmp_path / "plan.json")
+    plan.save(path)
+    monkeypatch.setenv(chaos_hooks.PLAN_ENV, path)
+    # wrong process: nothing installs
+    assert (
+        netem.install_from_env(
+            process_id=0, cluster_version=0, worker_id=0
+        )
+        is None
+    )
+    # wrong generation (fault is gen 0): nothing installs
+    assert (
+        netem.install_from_env(
+            process_id=1, cluster_version=1, worker_id=1
+        )
+        is None
+    )
+    # the targeted process/generation arms the shim at the client seam
+    shim = netem.install_from_env(
+        process_id=1, cluster_version=0, worker_id=1
+    )
+    assert shim is not None and shim.armed_count == 1
+    assert rpc_service._client_fault_shim is shim
+    netem.uninstall()
+    assert rpc_service._client_fault_shim is None
+
+
+def test_install_from_env_no_plan_is_noop(monkeypatch):
+    from elasticdl_tpu.chaos import hooks as chaos_hooks
+
+    monkeypatch.delenv(chaos_hooks.PLAN_ENV, raising=False)
+    assert (
+        netem.install_from_env(
+            process_id=0, cluster_version=0, worker_id=0
+        )
+        is None
+    )
+
+
+def test_firing_is_recorded_to_chaos_event_log(tmp_path):
+    events_path = str(tmp_path / "chaos_events.jsonl")
+    shim = _shim(
+        [
+            Fault(
+                kind=FaultKind.NET_UNAVAILABLE,
+                fault_id="u-1",
+                count=1,
+            )
+        ],
+        events_path=events_path,
+        process_id=1,
+        worker_id=3,
+    )
+    with pytest.raises(InjectedRpcError):
+        shim.client_call("svc", "m", lambda: 1, None)
+    lines = [
+        json.loads(line)
+        for line in open(events_path, encoding="utf-8")
+        if line.strip()
+    ]
+    assert lines and lines[0]["fault_id"] == "u-1"
+    assert lines[0]["kind"] == FaultKind.NET_UNAVAILABLE
+    assert lines[0]["process_id"] == 1 and lines[0]["worker_id"] == 3
+
+
+def test_firing_record_survives_installed_step_recorder(tmp_path):
+    """Regression: with the worker telemetry recorder installed, the
+    firing mirror must not collide with the recorder's own identity
+    keywords — a TypeError here once escaped through the RPC seam as a
+    bogus non-retryable failure that crashed the worker."""
+    from elasticdl_tpu.telemetry import worker_hooks
+    from elasticdl_tpu.telemetry.events import (
+        EVENT_RPC_FAULT_INJECTED,
+        read_jsonl,
+    )
+
+    worker_hooks.install(
+        str(tmp_path / "telemetry"), worker_id=3, process_id=1, generation=0
+    )
+    try:
+        shim = _shim(
+            [Fault(kind=FaultKind.NET_UNAVAILABLE, fault_id="u", count=1)],
+            process_id=1,
+            worker_id=3,
+        )
+        with pytest.raises(InjectedRpcError) as exc:
+            shim.client_call("svc", "m", lambda: 1, None)
+        assert exc.value.code() == grpc.StatusCode.UNAVAILABLE
+    finally:
+        worker_hooks.uninstall()
+    events = read_jsonl(str(tmp_path / "telemetry" / "events.jsonl"))
+    fired = [
+        e for e in events if e.get("event") == EVENT_RPC_FAULT_INJECTED
+    ]
+    assert fired and fired[0]["fault_id"] == "u"
+    assert fired[0]["worker_id"] == 3  # the recorder's identity stamp
+
+
+# ---- server seam: duplicate delivery vs the dedup contract ------------------
+
+
+def _lease_one(dispatcher, worker_id=0):
+    tid, task = dispatcher.get(worker_id)
+    assert task is not None
+    return tid, task
+
+
+def test_duplicate_report_is_deduped_by_task_id():
+    """The MASTER_RETRYABLE_METHODS claim, proven: a server-side
+    re-execution of report_task_result counts the task ONCE and
+    visibly drops the duplicate."""
+    checker = InvariantChecker(expected_records=128)
+    d = TaskDispatcher({"s": (0, 128)}, records_per_task=64, shuffle_seed=3)
+    d.add_observer(checker)
+    servicer = MasterServicer(32, d)
+    shim = NetemShim(
+        [
+            Fault(
+                kind=FaultKind.NET_DUPLICATE,
+                fault_id="dup",
+                method="report_task_result",
+                count=8,
+            )
+        ]
+    )
+    while True:
+        tid, task = d.get(worker_id=0)
+        if task is None:
+            break
+        request = msg.ReportTaskResultRequest(task_id=tid)
+        # the duplicated delivery: handler re-executes server-side
+        shim.server_call(
+            "elasticdl_tpu.Master",
+            "report_task_result",
+            servicer.report_task_result,
+            request,
+        )
+    assert checker.check(d.counters(TaskType.TRAINING)) == []
+    assert checker.dropped_reports == 2  # one drop per duplicated pair
+    assert checker.double_counted_tasks() == []
+
+
+def test_duplicate_report_does_not_double_bank_compile_delta():
+    """The dedup contract covers exec counters too: a duplicated
+    report's compile_count was already summed by its first execution —
+    the unknown-lease bank (which exists for STALE reclaimed reports,
+    where nothing was summed) must not add it again."""
+    from elasticdl_tpu.telemetry.compile_tracker import COMPILE_COUNT_KEY
+
+    d = TaskDispatcher({"s": (0, 128)}, records_per_task=64, shuffle_seed=3)
+    tid, _task = _lease_one(d)
+    d.report(tid, success=True, exec_counters={COMPILE_COUNT_KEY: 2})
+    # duplicate delivery of the SAME processed report: dropped, no bank
+    d.report(tid, success=True, exec_counters={COMPILE_COUNT_KEY: 2})
+    assert (
+        d.counters(TaskType.TRAINING).exec_metrics[COMPILE_COUNT_KEY] == 2
+    )
+    # a STALE report (never processed: the lease was reclaimed before
+    # any report landed) still banks — that recompile really happened
+    # and the worker's watermark advanced on RPC success
+    d.report(10**6, success=True, exec_counters={COMPILE_COUNT_KEY: 3})
+    assert (
+        d.counters(TaskType.TRAINING).exec_metrics[COMPILE_COUNT_KEY] == 5
+    )
+
+
+def test_master_shim_survives_sink_rebind_without_rearming():
+    """A MASTER_KILL relaunch rebinds the telemetry sink on the SAME
+    shim: exhausted faults must not re-fire (the server-side analogue
+    of the capacity-fault fired-set)."""
+    calls = []
+    shim = NetemShim(
+        [
+            Fault(
+                kind=FaultKind.NET_DUPLICATE,
+                fault_id="dup",
+                method="report",
+                count=1,
+            )
+        ]
+    )
+    shim.server_call("svc", "report", lambda req: calls.append(req), "A")
+    assert calls == ["A", "A"] and shim.armed_count == 0
+    shim.set_telemetry_sink(lambda *a, **k: None)  # the relaunch rebind
+    shim.server_call("svc", "report", lambda req: calls.append(req), "B")
+    assert calls == ["A", "A", "B"]  # exhausted: no re-fire
+
+
+def test_duplicate_eval_metrics_are_deduped_while_lease_active():
+    """The fixed non-idempotence: a duplicated
+    report_evaluation_metrics arrives while the lease is STILL active
+    (lost reply + retry), so the is_active guard alone cannot catch it
+    — the lease-id dedup must."""
+
+    class _EvalService:
+        def __init__(self):
+            self.reports = 0
+
+        def set_master_servicer(self, s):
+            pass
+
+        def report_evaluation_metrics(self, outputs, labels, **kwargs):
+            self.reports += 1
+
+    eval_service = _EvalService()
+    d = TaskDispatcher({"s": (0, 64)}, records_per_task=64, shuffle_seed=3)
+    servicer = MasterServicer(32, d, evaluation_service=eval_service)
+    tid, _task = _lease_one(d)
+    request = msg.ReportEvaluationMetricsRequest(task_id=tid)
+    servicer.report_evaluation_metrics(request)
+    servicer.report_evaluation_metrics(request)  # duplicate delivery
+    assert eval_service.reports == 1
+    assert servicer.duplicate_eval_drops == 1
+    # a DIFFERENT lease still reports normally
+    tid2 = tid + 1000  # unknown lease: inactive guard drops it first
+    servicer.report_evaluation_metrics(
+        msg.ReportEvaluationMetricsRequest(task_id=tid2)
+    )
+    assert eval_service.reports == 1
+
+
+def test_duplicate_report_version_is_monotone_safe():
+    checker = InvariantChecker()
+    d = TaskDispatcher({"s": (0, 64)}, records_per_task=64)
+    servicer = MasterServicer(32, d)
+    servicer.add_version_observer(checker.on_version_report)
+    shim = NetemShim(
+        [
+            Fault(
+                kind=FaultKind.NET_DUPLICATE,
+                fault_id="dupv",
+                method="report_version",
+                count=4,
+            )
+        ]
+    )
+    for version in (2, 4, 6):
+        shim.server_call(
+            "elasticdl_tpu.Master",
+            "report_version",
+            servicer.report_version,
+            msg.ReportVersionRequest(model_version=version, worker_id=0),
+        )
+    assert servicer.get_model_version() == 6
+    assert not any(
+        v.invariant == "version_monotonic" for v in checker.check()
+    )
+
+
+# ---- deadline policy --------------------------------------------------------
+
+
+def test_deadline_policy_tiers():
+    policy = DeadlinePolicy.from_secs(1.0)
+    assert policy.deadline_for("get_task") == 1.0
+    assert policy.deadline_for("report_task_result") == 1.0
+    # state transfer gets the long tier, floored at 30s (the historical
+    # replication timeouts) so a tight control deadline can't squeeze it
+    assert policy.deadline_for("get_restore_state") == 30.0
+    assert policy.deadline_for("push_replica") == 30.0
+    assert DeadlinePolicy.from_secs(5.0).deadline_for("fetch_replica") == 50.0
+
+
+def test_deadline_policy_from_env(monkeypatch):
+    monkeypatch.delenv(DEADLINE_SECS_ENV, raising=False)
+    assert DeadlinePolicy.from_env() is None
+    monkeypatch.setenv(DEADLINE_SECS_ENV, "2.5")
+    policy = DeadlinePolicy.from_env()
+    assert policy is not None and policy.control_secs == 2.5
+    monkeypatch.setenv(DEADLINE_SECS_ENV, "not-a-number")
+    assert DeadlinePolicy.from_env() is None
+
+
+def _client_with_fake_call(recorded, deadlines=None):
+    client = RpcClient("localhost:1", deadlines=deadlines)
+
+    def fake_call(payload, timeout=None):
+        recorded.append(timeout)
+        return msg.encode(msg.TaskResponse())
+
+    client._calls = {name: fake_call for name in client._methods}
+    return client
+
+
+def test_client_applies_per_method_deadlines():
+    recorded = []
+    client = _client_with_fake_call(
+        recorded, deadlines=DeadlinePolicy.from_secs(1.0)
+    )
+    client._call("get_task", msg.GetTaskRequest(worker_id=0))
+    client._call(
+        "get_restore_state", msg.GetRestoreStateRequest(cluster_version=0)
+    )
+    # an explicit caller timeout wins over the policy
+    client._call("get_task", msg.GetTaskRequest(worker_id=0), timeout=9.0)
+    assert recorded == [1.0, 30.0, 9.0]
+
+
+def test_client_without_policy_passes_no_timeout():
+    recorded = []
+    client = _client_with_fake_call(recorded)
+    client._call("get_task", msg.GetTaskRequest(worker_id=0))
+    assert recorded == [None]
+
+
+def test_client_routes_attempts_through_fault_shim():
+    recorded = []
+    client = _client_with_fake_call(recorded)
+
+    class _Shim:
+        calls = []
+
+        def client_call(self, service, method, invoke, timeout):
+            self.calls.append((service, method, timeout))
+            return invoke()
+
+    shim = _Shim()
+    set_client_fault_shim(shim)
+    try:
+        client._call("heartbeat", msg.HeartbeatRequest(worker_id=0))
+    finally:
+        set_client_fault_shim(None)
+    assert shim.calls == [("elasticdl_tpu.Master", "heartbeat", None)]
+    assert recorded == [None]
+
+
+def test_client_failure_counts_into_rpc_stats():
+    rpc_stats.reset_for_tests()
+    client = RpcClient("localhost:1")
+
+    def failing_call(payload, timeout=None):
+        raise InjectedRpcError(
+            grpc.StatusCode.DEADLINE_EXCEEDED, "injected"
+        )
+
+    client._calls = {name: failing_call for name in client._methods}
+    with pytest.raises(InjectedRpcError):
+        client._call("get_task", msg.GetTaskRequest(worker_id=0))
+    assert rpc_stats.snapshot() == {"deadline_exceeded": 1}
+
+
+def test_retried_client_counts_retries_and_failures():
+    rpc_stats.reset_for_tests()
+    from elasticdl_tpu.rpc.service import MASTER_RETRYABLE_METHODS
+
+    client = RpcClient(
+        "localhost:1",
+        retry=RetryPolicy(max_attempts=3, base_delay_secs=0.0),
+        retryable_methods=MASTER_RETRYABLE_METHODS,
+    )
+    attempts = []
+
+    def flaky_call(payload, timeout=None):
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise InjectedRpcError(
+                grpc.StatusCode.UNAVAILABLE, "injected"
+            )
+        return b""
+
+    client._calls = {name: flaky_call for name in client._methods}
+    client._call("heartbeat", msg.HeartbeatRequest(worker_id=0))
+    assert len(attempts) == 3
+    assert rpc_stats.snapshot() == {"unavailable": 2, "retries": 2}
+
+
+# ---- retry edge cases (the paths netem exercises) ---------------------------
+
+
+def test_on_retry_hook_raising_does_not_end_the_loop():
+    attempts = []
+
+    def fn():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise ValueError("boom")
+        return "done"
+
+    def bad_hook(attempt, ex):
+        raise RuntimeError("hook died")
+
+    out = call_with_retry(
+        fn,
+        RetryPolicy(max_attempts=5, base_delay_secs=0.0),
+        on_retry=bad_hook,
+        sleep=lambda s: None,
+    )
+    assert out == "done" and len(attempts) == 3
+
+
+def test_deadline_expiring_exactly_between_attempts_ends_the_loop():
+    clock_values = iter([0.0, 10.0])  # deadline calc, then the check
+
+    def fn():
+        raise ValueError("always")
+
+    with pytest.raises(ValueError):
+        call_with_retry(
+            fn,
+            RetryPolicy(max_attempts=100, total_timeout_secs=10.0),
+            sleep=lambda s: None,
+            clock=lambda: next(clock_values),
+        )
+
+
+def test_total_timeout_clamps_the_final_backoff_sleep():
+    sleeps = []
+    clock_values = iter([0.0, 5.0, 6.0, 99.0])
+
+    class _MaxRng:
+        def uniform(self, lo, hi):
+            return hi  # always draw the cap
+
+    def fn():
+        raise ValueError("always")
+
+    with pytest.raises(ValueError):
+        call_with_retry(
+            fn,
+            RetryPolicy(
+                max_attempts=100,
+                base_delay_secs=50.0,
+                max_delay_secs=50.0,
+                total_timeout_secs=10.0,
+            ),
+            rng=_MaxRng(),
+            sleep=sleeps.append,
+            clock=lambda: next(clock_values),
+        )
+    # drew the 50s cap, but only 10-6=4s of budget remained
+    assert sleeps == [4.0]
+
+
+# ---- heartbeat-shipped rpc stats -------------------------------------------
+
+
+def test_heartbeat_rpc_stats_max_merge_and_totals():
+    d = TaskDispatcher({"s": (0, 64)}, records_per_task=64)
+    servicer = MasterServicer(32, d)
+    servicer.heartbeat(
+        msg.HeartbeatRequest(
+            worker_id=1, rpc={"retries": 3, "deadline_exceeded": 2}
+        )
+    )
+    # a reordered (older) beat must not walk the totals backward
+    servicer.heartbeat(
+        msg.HeartbeatRequest(worker_id=1, rpc={"retries": 1})
+    )
+    servicer.heartbeat(
+        msg.HeartbeatRequest(worker_id=2, rpc={"retries": 4})
+    )
+    assert servicer.rpc_stats_totals() == {
+        "retries": 7,
+        "deadline_exceeded": 2,
+    }
+    # beats without the field change nothing (wire-compat default)
+    servicer.heartbeat(msg.HeartbeatRequest(worker_id=1))
+    assert servicer.rpc_stats_totals()["retries"] == 7
+
+
+def test_master_telemetry_exposes_rpc_counters():
+    from elasticdl_tpu.telemetry.master_hooks import MasterTelemetry
+
+    telemetry = MasterTelemetry("")
+    d = TaskDispatcher({"s": (0, 64)}, records_per_task=64)
+    servicer = MasterServicer(32, d)
+    telemetry.attach(d, servicer)
+    servicer.heartbeat(
+        msg.HeartbeatRequest(
+            worker_id=1, rpc={"retries": 5, "deadline_exceeded": 3}
+        )
+    )
+    # a dropped (duplicate/stale) report increments the dedup counter
+    d.report(10**9, success=True)
+    telemetry.observe_rpc("heartbeat", 0.01)
+    text = telemetry.registry.exposition()
+    assert "elasticdl_rpc_retries_total 5" in text
+    assert "elasticdl_rpc_deadline_exceeded_total 3" in text
+    assert "elasticdl_rpc_reports_deduped_total 1" in text
+    assert 'elasticdl_rpc_latency_seconds_count{method="heartbeat"} 1' in text
+
+
+# ---- harness invariants -----------------------------------------------------
+
+
+def _config(plan, tmp_path, **kwargs):
+    return ChaosJobConfig(
+        plan=plan, workdir=str(tmp_path / "w"), **kwargs
+    )
+
+
+def test_no_false_dead_applies_only_to_delay_plans(tmp_path):
+    config = _config(named_plan("slow_network_mid_epoch"), tmp_path)
+    ok = _check_no_false_dead(config, [])
+    assert ok is not None and ok["status"] == "PASS"
+    bad = _check_no_false_dead(
+        config, [{"reason": "worker_failure", "detected_at": 0.0}]
+    )
+    assert bad["status"] == "FAIL"
+    # a plan with any non-delay fault is out of contract
+    assert (
+        _check_no_false_dead(
+            _config(named_plan("blackhole_master_link"), tmp_path), []
+        )
+        is None
+    )
+    assert (
+        _check_no_false_dead(
+            _config(named_plan("preempt_one_worker"), tmp_path), []
+        )
+        is None
+    )
+
+
+def test_duplicate_delivery_invariant_requires_realization(tmp_path):
+    config = _config(named_plan("dup_report_storm"), tmp_path)
+    checker = InvariantChecker()
+    # nothing fired: the invariant must refuse to pass vacuously
+    verdict = _check_duplicate_delivery(config, checker, [])
+    assert verdict["status"] == "FAIL"
+    assert any("none fired" in v for v in verdict["violations"])
+
+
+def test_duplicate_delivery_invariant_requires_dedup_engagement(tmp_path):
+    config = _config(named_plan("dup_report_storm"), tmp_path)
+    fired = [
+        {"kind": FaultKind.NET_DUPLICATE, "method": "report_task_result"},
+        {"kind": FaultKind.NET_DUPLICATE, "method": "report_version"},
+    ]
+    checker = InvariantChecker()
+    verdict = _check_duplicate_delivery(config, checker, fired)
+    assert verdict["status"] == "FAIL"  # no drops observed
+    checker.on_task_reported(1, None, True, False)  # the dedup drop
+    verdict = _check_duplicate_delivery(config, checker, fired)
+    assert verdict["status"] == "PASS"
+
+
+def test_duplicate_delivery_invariant_flags_double_counting(tmp_path):
+    config = _config(named_plan("dup_report_storm"), tmp_path)
+    checker = InvariantChecker(expected_records=128)
+    d = TaskDispatcher({"s": (0, 128)}, records_per_task=64, shuffle_seed=3)
+    d.add_observer(checker)
+    tid, task = _lease_one(d)
+    d.report(tid, success=True)
+    checker.on_task_reported(tid, task, True, True)  # dedup disabled
+    checker.on_task_reported(1, None, True, False)
+    fired = [
+        {"kind": FaultKind.NET_DUPLICATE, "method": "report_task_result"}
+    ]
+    verdict = _check_duplicate_delivery(config, checker, fired)
+    assert verdict["status"] == "FAIL"
+    assert any("double-counted" in v for v in verdict["violations"])
+
+
+def test_drop_dedup_corruption_requires_duplicate_plan(tmp_path):
+    from elasticdl_tpu.chaos.harness import run_chaos_job
+
+    with pytest.raises(ValueError, match="drop_dedup"):
+        run_chaos_job(
+            _config(
+                named_plan("preempt_one_worker"),
+                tmp_path,
+                corrupt="drop_dedup",
+                num_records=64,
+            )
+        )
+
+
+def test_drop_dedup_corruption_counts_duplicates(tmp_path):
+    """The corruption itself: with dedup disabled, a duplicated report
+    for a no-longer-active lease is counted AGAIN — exactly_once must
+    then trip."""
+    from elasticdl_tpu.chaos.harness import _install_corruption
+
+    checker = InvariantChecker(expected_records=128)
+    d = TaskDispatcher({"s": (0, 128)}, records_per_task=64, shuffle_seed=3)
+    d.add_observer(checker)
+
+    class _FakeMaster:
+        task_d = d
+        servicer = None
+
+    _install_corruption(_FakeMaster(), checker, "drop_dedup")
+    while True:
+        tid, task = d.get(worker_id=0)
+        if task is None:
+            break
+        d.report(tid, success=True)
+        d.report(tid, success=True)  # the duplicate delivery
+    violations = checker.check(d.counters(TaskType.TRAINING))
+    assert any(v.invariant == "exactly_once" for v in violations)
+
+
+# ---- runner surface ---------------------------------------------------------
+
+
+def test_runner_list_describes_network_plans_and_invariants(capsys):
+    from elasticdl_tpu.chaos.runner import main
+
+    assert main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for name in NETWORK_PLAN_NAMES:
+        assert name in out
+    assert "no_false_dead" in out
+    assert "duplicate_delivery_exactly_once" in out
+
+
+def test_runner_network_plan_config():
+    from elasticdl_tpu.chaos.runner import NETWORK_PLANS
+
+    for name in NETWORK_PLAN_NAMES:
+        assert name in NETWORK_PLANS
+        assert NETWORK_PLANS[name].get("rpc_deadline_secs")
+    # eviction plans need the budget the window outlasts + lease reclaim
+    for name in ("blackhole_master_link", "oneway_partition_worker"):
+        cfg = NETWORK_PLANS[name]
+        assert cfg["rpc_retry_secs"] < 60.0
+        assert cfg["task_timeout_secs"]
+
+
+def test_drop_dedup_in_corruptions_choices():
+    from elasticdl_tpu.chaos.harness import CORRUPTIONS
+
+    assert "drop_dedup" in CORRUPTIONS
+
+
+# ---- argv / env byte-identity ----------------------------------------------
+
+
+def test_rpc_deadline_flag_is_master_only_and_default_none():
+    from elasticdl_tpu.utils.args import (
+        build_worker_arguments,
+        parse_master_args,
+    )
+
+    base = [
+        "--model_def",
+        "m.model",
+        "--training_data",
+        "/tmp/x",
+    ]
+    args = parse_master_args(base)
+    assert getattr(args, "rpc_deadline_secs") is None
+    argv = build_worker_arguments(args, 0, "localhost:1")
+    assert "--rpc_deadline_secs" not in argv
+    # even when SET it travels by env, never worker argv
+    args = parse_master_args(base + ["--rpc_deadline_secs", "2.0"])
+    argv = build_worker_arguments(args, 0, "localhost:1")
+    assert "--rpc_deadline_secs" not in argv
+
+
+def test_master_exports_deadline_and_retry_envs(tmp_path):
+    from elasticdl_tpu.master.main import build_master
+    from elasticdl_tpu.rpc.deadline import DEADLINE_SECS_ENV
+    from elasticdl_tpu.rpc.retry import RETRY_SECS_ENV
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    (tmp_path / "d").mkdir()
+    args = parse_master_args(
+        [
+            "--model_def",
+            "mnist_functional_api.mnist_functional_api.custom_model",
+            "--training_data",
+            str(tmp_path / "d"),
+            "--num_workers",
+            "1",
+            "--rpc_deadline_secs",
+            "1.5",
+            "--rpc_retry_secs",
+            "7.0",
+        ]
+    )
+    master = build_master(args)
+    envs = master.instance_manager._envs
+    assert envs[DEADLINE_SECS_ENV] == "1.5"
+    # --rpc_retry_secs alone (no journal) now enables worker retries:
+    # a gray network deserves the backoff loop without full master HA
+    assert envs[RETRY_SECS_ENV] == "7.0"
+
+
+def test_no_flags_no_envs(tmp_path):
+    from elasticdl_tpu.master.main import build_master
+    from elasticdl_tpu.rpc.deadline import DEADLINE_SECS_ENV
+    from elasticdl_tpu.rpc.retry import RETRY_SECS_ENV
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    (tmp_path / "d").mkdir()
+    args = parse_master_args(
+        [
+            "--model_def",
+            "mnist_functional_api.mnist_functional_api.custom_model",
+            "--training_data",
+            str(tmp_path / "d"),
+            "--num_workers",
+            "1",
+        ]
+    )
+    master = build_master(args)
+    envs = master.instance_manager._envs
+    assert DEADLINE_SECS_ENV not in envs
+    assert RETRY_SECS_ENV not in envs
+
+
+# ---- trace analyze: degraded_network phase ---------------------------------
+
+
+def test_degraded_network_phase_sums_exactly(tmp_path):
+    from elasticdl_tpu.telemetry.trace import analyze_telemetry_dir
+
+    run = tmp_path / "telemetry"
+    run.mkdir()
+    events = [
+        {
+            "event": "step",
+            "monotonic": 100.0,
+            "generation": 0,
+            "worker_id": 0,
+            "step": 5,
+            "duration_secs": 0.1,
+        },
+        {
+            "event": "step",
+            "monotonic": 110.0,
+            "generation": 1,
+            "worker_id": 0,
+            "step": 6,
+            "duration_secs": 0.1,
+        },
+    ]
+    spans = [
+        {
+            "span": "reform",
+            "start": 104.0,
+            "end": 106.0,
+            "trace_id": "t1",
+            "span_id": "s1",
+            "generation": 1,
+            "role": "master",
+        },
+        {
+            "span": "rpc_degraded",
+            "start": 101.0,
+            "end": 105.0,
+            "trace_id": "t2",
+            "span_id": "s2",
+            "generation": 0,
+            "role": "worker",
+        },
+    ]
+    with open(run / "events.jsonl", "w", encoding="utf-8") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    with open(run / "spans.jsonl", "w", encoding="utf-8") as f:
+        for s in spans:
+            f.write(json.dumps(s) + "\n")
+    report = analyze_telemetry_dir(str(run))
+    gap = report["reform_downtime"][0]
+    phases = gap["phases_secs"]
+    # the degraded window refines detection time, clamped to the reform
+    assert phases["degraded_network"] == pytest.approx(3.0)
+    assert phases["death_detection"] == pytest.approx(1.0)
+    # sum-exactness is the analyze contract and must survive the new
+    # phase
+    assert sum(phases.values()) == pytest.approx(gap["downtime_secs"])
+
+
+# ---- slow end-to-end: the dedup contract under real duplication ------------
+
+
+@pytest.mark.slow
+def test_dup_report_storm_end_to_end(tmp_path):
+    from elasticdl_tpu.chaos.harness import run_chaos_job
+    from elasticdl_tpu.chaos.runner import NETWORK_PLANS
+
+    report = run_chaos_job(
+        ChaosJobConfig(
+            plan=named_plan("dup_report_storm", 2),
+            workdir=str(tmp_path / "chaos"),
+            num_records=256,
+            num_epochs=2,
+            num_workers=2,
+            run_timeout_secs=300.0,
+            **NETWORK_PLANS["dup_report_storm"],
+        )
+    )
+    assert report["invariants_ok"], report["invariants"]
+    names = {i["name"]: i["status"] for i in report["invariants"]}
+    assert names["duplicate_delivery_exactly_once"] == "PASS"
+    assert report["rpc"]["reports_deduped"] >= 1
