@@ -618,12 +618,28 @@ def _measure_e2e(
         dev_rate = dev_records / (time.perf_counter() - t0) / n_chips
         probe_after = _probe_dispatch_secs()
 
+        # ---- anatomy window: a SEPARATE short instrumented run --------
+        # (--step_anatomy blocks each dispatch on its outputs, so it
+        # must never share a window with the rate measurements above);
+        # its goodput section rides the artifact so every future round
+        # can EXPLAIN its e2e_vs_roofline from measured phases instead
+        # of restating the ratio (ISSUE 10)
+        anatomy_section = _measure_anatomy_window(
+            td,
+            gen_name,
+            model_def,
+            batch,
+            records_per_task,
+            extra_argv,
+        )
+
     roofline = min(host_rate, dev_rate)
     return {
         "e2e_samples_per_sec_per_chip": round(e2e_rate, 1),
         "batch": batch,
         "records_measured": steady_records,
         "tasks_measured": len(marks) - 1,
+        "anatomy": anatomy_section,
         "budget": {
             "host_pipeline_records_per_sec": round(host_rate),
             "device_path_records_per_sec": round(dev_rate),
@@ -642,6 +658,69 @@ def _measure_e2e(
             "probe_dispatch_secs_after": round(probe_after, 4),
         },
     }
+
+
+def _measure_anatomy_window(
+    td, gen_name, model_def, batch, records_per_task, extra_argv
+):
+    """Per-dispatch phase anatomy of the SAME e2e configuration over a
+    small fresh dataset (two tasks): the measured
+    host_fetch/assemble/h2d/device_compute/bookkeeping split behind the
+    budget's e2e_vs_roofline ratio.  Returns the report's overall
+    goodput section, or an error marker — never fails the bench."""
+    import os as _os
+
+    from elasticdl_tpu.data.recordio_gen import synthetic
+    from elasticdl_tpu.telemetry import anatomy as anatomy_mod
+    from elasticdl_tpu.telemetry import tracing, worker_hooks
+    from elasticdl_tpu.trainer.local_executor import LocalExecutor
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    try:
+        data_dir = getattr(synthetic, gen_name)(
+            _os.path.join(td, "anatomy_data"),
+            num_records=records_per_task * 2,
+            num_shards=2,
+            seed=1,
+        )
+        telemetry_dir = _os.path.join(td, "anatomy_telemetry")
+        args = parse_master_args(
+            [
+                "--model_def",
+                model_def,
+                "--training_data",
+                data_dir,
+                "--minibatch_size",
+                str(batch),
+                "--records_per_task",
+                str(records_per_task),
+                "--num_epochs",
+                "1",
+                "--telemetry_dir",
+                telemetry_dir,
+                "--step_anatomy",
+                "true",
+            ]
+            + list(extra_argv)
+        )
+        LocalExecutor(args).run()
+        from elasticdl_tpu.telemetry.events import read_events
+        from elasticdl_tpu.telemetry.report import goodput_section
+
+        section = goodput_section(
+            read_events(_os.path.join(telemetry_dir, "events.jsonl"))
+        )
+        if not section:
+            return {"error": "no step_anatomy events recorded"}
+        return section["overall"]
+    except Exception as ex:  # noqa: BLE001 — anatomy must not fail bench
+        return {"error": f"{type(ex).__name__}: {ex}"}
+    finally:
+        # the instrumented run installed process-global recorders bound
+        # to this tempdir; later configs must not inherit them
+        anatomy_mod.uninstall()
+        worker_hooks.uninstall()
+        tracing.uninstall()
 
 
 E2E_CONFIGS = {
@@ -854,6 +933,10 @@ COMPACT_KEY_LEGEND = {
     "vsb": "vs_baseline (reference TF2 step on host CPU)",
     "vs": "e2e rate / device-resident step rate at the same batch",
     "roof": "e2e rate / min(host decode, device path) budget roofline",
+    "roofm": (
+        "measured live roofline ratio from the --step_anatomy window "
+        "(binding path busy time / dispatch wall; phases in full detail)"
+    ),
     "bind": "binding budget ceiling: h=host decode, d=device path",
     "deg": "1 = degraded link window detected (see full detail)",
     "acc": "[accuracy, 1 if >= threshold]",
@@ -938,6 +1021,12 @@ def _compact_models(models: dict) -> dict:
             c["roof"] = budget["e2e_vs_roofline"]
         if budget.get("binding"):
             c["bind"] = budget["binding"][0]
+        anatomy = m.get("anatomy") or {}
+        if anatomy.get("e2e_vs_roofline") is not None:
+            # the MEASURED live ratio from the instrumented anatomy
+            # window (per-dispatch phase sums), vs `roof`'s inferred
+            # ceiling-run ratio — full phase detail in BENCH_full.json
+            c["roofm"] = anatomy["e2e_vs_roofline"]
         if m.get("link_degraded") or m.get("link_degraded_retry"):
             c["deg"] = 1
         out[name] = c

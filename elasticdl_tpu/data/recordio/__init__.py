@@ -23,6 +23,7 @@ __all__ = [
     "num_records",
     "CorruptFileError",
     "native_available",
+    "ensure_native_codec",
 ]
 
 _NATIVE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native.so")
@@ -96,6 +97,29 @@ def _register_decode(decode):
 
 def native_available() -> bool:
     return _load_native() is not None
+
+
+def ensure_native_codec() -> str:
+    """Make the native codec available or fail FAST with one actionable
+    line.  Lockstep worlds require it (a host missing it would silently
+    shuffle different batches than its peers — ``build_task_batches``
+    raises per-worker), so harness entry points call this BEFORE
+    spawning workers: one clear error beats a worker crash-loop that
+    burns the whole reform budget on a missing .so.  Attempts the build
+    in place first (the common case: fresh checkout, compiler
+    present)."""
+    if native_available():
+        return _NATIVE_PATH
+    from elasticdl_tpu.data.recordio import build as build_mod
+
+    built = build_mod.build(quiet=True)
+    if built is not None and native_available():
+        return built
+    raise RuntimeError(
+        "native EDLIO codec missing and unbuildable: run "
+        "`python -m elasticdl_tpu.data.recordio.build` (needs g++ and "
+        "zlib) before starting lockstep jobs"
+    )
 
 
 def native_lib():

@@ -52,6 +52,13 @@ def build_master(args) -> Master:
             sample_rate = getattr(args, "trace_sample_rate", None)
             if sample_rate is not None:
                 envs.setdefault(TRACE_SAMPLE_RATE_ENV, str(sample_rate))
+        if getattr(args, "step_anatomy", None):
+            # per-dispatch phase anatomy: enabled by env like the
+            # telemetry dir (never argv — worker command lines stay
+            # byte-identical when the flag is off)
+            from elasticdl_tpu.telemetry.anatomy import STEP_ANATOMY_ENV
+
+            envs.setdefault(STEP_ANATOMY_ENV, "1")
         journal_dir = getattr(args, "master_journal_dir", None) or ""
         retry_secs = getattr(args, "rpc_retry_secs", None)
         if journal_dir:

@@ -66,6 +66,24 @@ class MasterServicer:
         # Never cleared by forget_worker — an evicted worker's failures
         # happened and the exposed totals must stay monotone
         self._worker_rpc_stats: dict[int, dict[str, int]] = {}
+        # worker-shipped step-anatomy phase totals (heartbeat `phases`
+        # field, telemetry/anatomy.py): same monotone max-merge
+        # discipline, mirrored onto the elasticdl_step_phase_* families
+        self._worker_phase_stats: dict[int, dict] = {}
+        # liveness-vs-progress split (/healthz): when any worker last
+        # ADVANCED its step sample (heartbeat `step` / version report) —
+        # a hung-but-alive job heartbeats forever but this stops moving
+        self._last_step_sample = 0
+        self._last_step_sample_at: float | None = None
+        # when a heartbeat last raised an outage-class RPC counter
+        # (deadline_exceeded / unavailable): the /healthz
+        # degraded_network flag's timestamp.  Only a rise RELATIVE TO A
+        # PREVIOUS BEAT counts — a worker's first beat to THIS master
+        # seeds silently, since rpc/stats.py totals are process-
+        # lifetime and a restarted master would otherwise re-learn
+        # hours-old failures as a fresh degradation
+        self._net_degraded_at: float | None = None
+        self._rpc_seen: set[int] = set()
         # eval-metrics dedup: lease ids whose metrics were already
         # accumulated.  The is_active guard alone only covers RECLAIMED
         # leases — a duplicate delivery (lost reply + retry) arrives
@@ -384,6 +402,11 @@ class MasterServicer:
         (reference servicer.py:79-85, where the PS did the pinging)."""
         with self._lock:
             self._version = max(self._version, request.model_version)
+            if request.model_version > self._last_step_sample:
+                # a version report is the strongest progress signal —
+                # it advances the /healthz staleness clock too
+                self._last_step_sample = int(request.model_version)
+                self._last_step_sample_at = time.monotonic()
         for callback in self._version_observers:
             try:
                 callback(request.worker_id, request.model_version)
@@ -434,9 +457,17 @@ class MasterServicer:
             )
 
     def heartbeat(self, request: msg.HeartbeatRequest) -> msg.HeartbeatResponse:
+        now = time.monotonic()
         with self._lock:
-            self._heartbeats[request.worker_id] = time.monotonic()
+            self._heartbeats[request.worker_id] = now
             generation = self._cluster_version
+            if request.step > self._last_step_sample:
+                # progress, not mere liveness: the /healthz staleness
+                # clock resets only when the fleet's step ADVANCES
+                self._last_step_sample = int(request.step)
+                self._last_step_sample_at = now
+            first_contact = request.worker_id not in self._rpc_seen
+            self._rpc_seen.add(request.worker_id)
             if request.rpc:
                 # worker-shipped RPC outcome totals: max-merge so a
                 # reordered beat can never walk a counter backward
@@ -445,7 +476,43 @@ class MasterServicer:
                 )
                 for key, value in request.rpc.items():
                     try:
-                        merged[key] = max(merged.get(key, 0), int(value))
+                        value = int(value)
+                    except (TypeError, ValueError):
+                        continue
+                    if (
+                        not first_contact
+                        and key in ("deadline_exceeded", "unavailable")
+                        and value > merged.get(key, 0)
+                    ):
+                        # an outage-class counter moved SINCE THE LAST
+                        # beat: the link is degraded as of now (the
+                        # /healthz flag)
+                        self._net_degraded_at = now
+                    merged[key] = max(merged.get(key, 0), value)
+            if request.phases:
+                # step-anatomy phase totals: nested max-merge (ms,
+                # count, and each log bucket are all monotone per
+                # worker), summed across workers at scrape time
+                merged = self._worker_phase_stats.setdefault(
+                    request.worker_id, {}
+                )
+                for phase, stats in request.phases.items():
+                    if not isinstance(stats, dict):
+                        continue
+                    slot = merged.setdefault(
+                        phase, {"ms": 0.0, "count": 0, "buckets": {}}
+                    )
+                    try:
+                        slot["ms"] = max(
+                            slot["ms"], float(stats.get("ms", 0.0))
+                        )
+                        slot["count"] = max(
+                            slot["count"], int(stats.get("count", 0))
+                        )
+                        for bound, n in (stats.get("buckets") or {}).items():
+                            slot["buckets"][bound] = max(
+                                slot["buckets"].get(bound, 0), int(n)
+                            )
                     except (TypeError, ValueError):
                         continue
         if self._instance_manager is not None:
@@ -659,6 +726,54 @@ class MasterServicer:
                 for key, value in stats.items():
                     totals[key] = totals.get(key, 0) + value
             return totals
+
+    def phase_stats_totals(self) -> dict[str, dict]:
+        """Fleet-wide step-anatomy phase totals: per-worker monotone
+        maxima summed across workers — ``{phase: {"ms": float, "count":
+        int, "buckets": {str(bound): int}}}``, what /metrics mirrors
+        onto the ``elasticdl_step_phase_*`` families."""
+        with self._lock:
+            totals: dict[str, dict] = {}
+            for stats in self._worker_phase_stats.values():
+                for phase, slot in stats.items():
+                    agg = totals.setdefault(
+                        phase, {"ms": 0.0, "count": 0, "buckets": {}}
+                    )
+                    agg["ms"] += slot["ms"]
+                    agg["count"] += slot["count"]
+                    for bound, n in slot["buckets"].items():
+                        agg["buckets"][bound] = (
+                            agg["buckets"].get(bound, 0) + n
+                        )
+            return totals
+
+    def last_step_age_secs(self) -> float | None:
+        """Seconds since any worker last ADVANCED its step sample
+        (heartbeat step / version report); None before the first
+        advance.  The /healthz field that tells a hung-but-alive job
+        (heartbeats flowing, this growing) from a progressing one."""
+        with self._lock:
+            at = self._last_step_sample_at
+        return None if at is None else max(0.0, time.monotonic() - at)
+
+    # how recently an outage-class RPC counter must have moved for
+    # /healthz to flag the network as degraded
+    NETWORK_DEGRADED_WINDOW_SECS = 60.0
+
+    def network_degraded(self, window_secs: float | None = None) -> bool:
+        """True when a worker-shipped deadline_exceeded / unavailable
+        total rose within the window (PR-8's gray-failure counters,
+        surfaced as a point-in-time /healthz flag)."""
+        with self._lock:
+            at = self._net_degraded_at
+        if at is None:
+            return False
+        window = (
+            self.NETWORK_DEGRADED_WINDOW_SECS
+            if window_secs is None
+            else window_secs
+        )
+        return (time.monotonic() - at) <= window
 
     @property
     def duplicate_eval_drops(self) -> int:

@@ -130,6 +130,11 @@ class HeartbeatRequest:
     # reports stall — exactly when these spike.  Empty on a clean link;
     # old payloads decode to {} so the field is wire-compatible
     rpc: dict = field(default_factory=dict)
+    # step-anatomy phase totals (telemetry/anatomy.py): monotone
+    # per-phase {ms, count, buckets} the master mirrors onto the
+    # elasticdl_step_phase_* metric families.  Empty when --step_anatomy
+    # is off; old payloads decode to {} so the field is wire-compatible
+    phases: dict = field(default_factory=dict)
 
 
 @dataclass

@@ -68,6 +68,11 @@ EVENT_AUTOSCALE_DECISION = "autoscale_decision"
 # UNAVAILABLE, or one-way partition (distinct from fault_injected: the
 # process lives, only its link degrades)
 EVENT_RPC_FAULT_INJECTED = "rpc_fault_injected"
+# step anatomy (telemetry/anatomy.py): one event per dispatch group
+# carrying the sum-exact phase decomposition (host_fetch / assemble /
+# h2d_transfer / device_compute / step_bookkeeping / untracked, in ms)
+# — the data the report's goodput section is computed from
+EVENT_STEP_ANATOMY = "step_anatomy"
 
 EVENTS_FILENAME = "events.jsonl"
 

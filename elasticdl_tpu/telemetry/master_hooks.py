@@ -259,6 +259,30 @@ class MasterTelemetry:
             self._rpc_eval_deduped.set_total(
                 getattr(self._servicer, "duplicate_eval_drops", 0)
             )
+            # step-anatomy phase totals (heartbeat-shipped,
+            # telemetry/anatomy.py): a monotone ms counter AND a
+            # mirrored log-bucket histogram per phase — the one
+            # registration site of the elasticdl_step_phase_* families
+            phase_totals = getattr(
+                self._servicer, "phase_stats_totals", lambda: {}
+            )()
+            for phase, agg in phase_totals.items():
+                self.registry.counter(
+                    "elasticdl_step_phase_ms_total",
+                    "Per-dispatch phase wall time by phase "
+                    "(host_fetch/assemble/h2d_transfer/device_compute/"
+                    "step_bookkeeping/untracked)",
+                    labels={"phase": phase},
+                ).set_total(agg.get("ms", 0.0))
+                self.registry.histogram(
+                    "elasticdl_step_phase_seconds",
+                    "Per-dispatch phase duration distribution by phase",
+                    labels={"phase": phase},
+                ).set_totals(
+                    agg.get("buckets", {}),
+                    agg.get("ms", 0.0) / 1000.0,
+                    agg.get("count", 0),
+                )
 
     def build_health_fn(self, job_type: str, instance_manager_fn=lambda: None):
         """The ``/healthz`` payload closure (also used directly by
@@ -273,6 +297,18 @@ class MasterTelemetry:
                 else (servicer.live_workers() if servicer else [])
             )
             quiescing = bool(servicer and servicer.is_quiescing)
+            # progress-vs-liveness split: a hung-but-alive job keeps
+            # heartbeating (live_workers stays full) while
+            # last_step_age_secs grows without bound; degraded_network
+            # says whether PR-8's outage-class RPC counters moved
+            # recently — together they tell "stuck" from "slow link"
+            # from "progressing" without reading the event log
+            step_age = (
+                servicer.last_step_age_secs()
+                if servicer is not None
+                and hasattr(servicer, "last_step_age_secs")
+                else None
+            )
             return {
                 "status": "quiescing" if quiescing else "ok",
                 "job_type": job_type,
@@ -283,6 +319,14 @@ class MasterTelemetry:
                 "live_workers": sorted(live),
                 "num_live_workers": len(live),
                 "quiescing": quiescing,
+                "last_step_age_secs": round(step_age, 3)
+                if step_age is not None
+                else None,
+                "degraded_network": bool(
+                    servicer is not None
+                    and hasattr(servicer, "network_degraded")
+                    and servicer.network_degraded()
+                ),
             }
 
         return health

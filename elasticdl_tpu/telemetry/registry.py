@@ -148,6 +148,28 @@ class Histogram:
                     return
             self._counts[-1] += 1
 
+    def set_totals(self, bucket_counts: dict, total_sum: float, total_count: int):
+        """Mirror an externally-accumulated distribution (the
+        histogram analogue of :meth:`Counter.set_total`): per-bucket
+        NON-cumulative counts keyed by upper bound (floats, or the
+        string forms a msgpack payload carries; ``inf``/``"inf"`` is
+        the overflow slot).  Monotone per slot — a reordered or
+        restarted source can never walk the exposed counts backward."""
+        parsed: dict[float, int] = {}
+        for bound, count in (bucket_counts or {}).items():
+            try:
+                parsed[float(bound)] = int(count)
+            except (TypeError, ValueError):
+                continue
+        with self._lock:
+            for i, bound in enumerate(self._bounds):
+                if bound in parsed:
+                    self._counts[i] = max(self._counts[i], parsed[bound])
+            if math.inf in parsed:
+                self._counts[-1] = max(self._counts[-1], parsed[math.inf])
+            self._sum = max(self._sum, float(total_sum))
+            self._count = max(self._count, int(total_count))
+
     def snapshot(self) -> dict:
         """Cumulative bucket counts keyed by upper bound, plus sum/count
         (the exposition shape, reusable by tests and the report CLI)."""

@@ -599,6 +599,40 @@ def _straggler_report(steps: list[dict]) -> dict:
     }
 
 
+def _steady_state(events: list[dict]) -> dict:
+    """Per-generation step-anatomy phase totals — where a NORMAL
+    (non-reform) step's time goes, with the same sum-exact residual
+    contract (`untracked` is tracked, not dropped).  Empty when the run
+    never recorded anatomy (--step_anatomy off)."""
+    from elasticdl_tpu.telemetry.anatomy import ALL_PHASES
+
+    by_gen: dict[int, list[dict]] = defaultdict(list)
+    for event in events:
+        if event.get("event") == "step_anatomy":
+            by_gen[event.get("generation", 0)].append(event)
+    out = {}
+    for gen in sorted(by_gen):
+        gen_events = by_gen[gen]
+        wall_ms = sum(float(e.get("wall_ms", 0.0)) for e in gen_events)
+        phases = {}
+        for phase in ALL_PHASES:
+            total = sum(
+                float(e.get(f"{phase}_ms", 0.0)) for e in gen_events
+            )
+            if total:
+                phases[phase] = {
+                    "total_ms": round(total, 3),
+                    "share": round(total / wall_ms, 4) if wall_ms else None,
+                }
+        out[gen] = {
+            "dispatches": len(gen_events),
+            "steps": sum(int(e.get("steps", 0)) for e in gen_events),
+            "wall_ms_total": round(wall_ms, 3),
+            "phases": phases,
+        }
+    return out
+
+
 def analyze_telemetry_dir(telemetry_dir: str) -> dict:
     """Analysis of ONE run's spans+events pair (pure function of the
     logs; the unit tests drive it with canned files)."""
@@ -645,6 +679,12 @@ def analyze_telemetry_dir(telemetry_dir: str) -> dict:
     recovered_links = sum(
         1 for s in spans if s.get("recovered") and s.get("trace_id")
     )
+    # steady-state (non-reform) mode: the same phase discipline the
+    # reform attribution applies to downtime, applied to NORMAL steps —
+    # per-generation step-anatomy phase totals (from the complete
+    # per-dispatch events; the sampled step_anatomy spans render the
+    # same breakdown on the Perfetto timeline)
+    steady_state = _steady_state(events)
     # slice-granular elasticity: every hybrid-mesh resize the run's
     # re-formations performed (a separate listing — the resize re-plan
     # runs inside the reform window, so it is NOT a new downtime phase
@@ -663,7 +703,7 @@ def analyze_telemetry_dir(telemetry_dir: str) -> dict:
             key=lambda s: s["start"],
         )
     ]
-    return {
+    out = {
         "spans_total": len(spans),
         "traces_total": len({s.get("trace_id") for s in spans}),
         "recovered_task_spans": recovered_links,
@@ -672,6 +712,9 @@ def analyze_telemetry_dir(telemetry_dir: str) -> dict:
         "mesh_resizes": mesh_resizes,
         "stragglers": stragglers,
     }
+    if steady_state:
+        out["steady_state"] = steady_state
+    return out
 
 
 def analyze_run_dir(run_dir: str) -> dict:
@@ -734,6 +777,24 @@ def _format_analysis(report: dict) -> str:
                     resize["new_slices"],
                 )
             )
+        for gen, g in (run.get("steady_state") or {}).items():
+            lines.append(
+                "steady state gen {}: {} dispatches / {} steps, "
+                "{:.1f}ms".format(
+                    gen,
+                    g["dispatches"],
+                    g["steps"],
+                    g["wall_ms_total"],
+                )
+            )
+            for phase, stats in g["phases"].items():
+                lines.append(
+                    "  {:<20s} {:9.1f}ms ({:5.1f}%)".format(
+                        phase,
+                        stats["total_ms"],
+                        (stats["share"] or 0.0) * 100.0,
+                    )
+                )
         for gen, stats in run["stragglers"].items():
             for worker, w in stats["workers"].items():
                 flag = "  STRAGGLER" if w["straggler"] else ""

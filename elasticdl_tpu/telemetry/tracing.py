@@ -80,6 +80,7 @@ SPAN_SLICE_LOSS = "slice_loss"  # master: slice death detect -> re-plan
 SPAN_MESH_RESIZE = "mesh_resize"  # master: hybrid mesh re-plan (resize)
 SPAN_AUTOSCALE_DECISION = "autoscale_decision"  # master: one SLO decision
 SPAN_RPC_DEGRADED = "rpc_degraded"  # netem window: link slow/blackholed
+SPAN_STEP_ANATOMY = "step_anatomy"  # one dispatch phase (phase= attr)
 
 
 def gen_trace_id() -> str:
@@ -277,6 +278,12 @@ class SpanRecorder:
             sampled=True,
             step=int(last_step) if last_step is not None else None,
         )
+
+    def should_sample(self, name: str) -> bool:
+        """Public face of the deterministic 1-in-N sampler for callers
+        that make ONE keep/drop decision covering a group of related
+        records (the step-anatomy phase spans of one dispatch)."""
+        return self._sample(name)
 
     def _sample(self, name: str) -> bool:
         if self._sample_period == 1:

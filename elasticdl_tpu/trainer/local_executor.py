@@ -151,6 +151,17 @@ class LocalExecutor:
             sample_rate=getattr(args, "trace_sample_rate", None),
         )
         self._tracing = tracing
+        # per-dispatch phase anatomy (--step_anatomy or the forwarded
+        # env): host_fetch/assemble/h2d/device_compute/bookkeeping
+        # summing exactly to each dispatch's wall time — feeds the
+        # report's goodput section and the goodput smoke
+        from elasticdl_tpu.telemetry import anatomy as anatomy_mod
+
+        self._anatomy_mod = anatomy_mod
+        anatomy_mod.install_if_enabled(
+            getattr(args, "step_anatomy", None),
+            model_def=getattr(args, "model_def", "") or "",
+        )
         self._last_eval_milestone = 0
         from elasticdl_tpu.utils.profiling import StepProfiler
 
@@ -273,6 +284,7 @@ class LocalExecutor:
             post_group=self._post_step_hooks,
             dispatch_ctx=lambda: self._timing.record("batch_process"),
             canonical_rows=self._canonical_rows,
+            anatomy=self._anatomy_mod.get_recorder(),
         )
 
     def _post_step_hooks(self):

@@ -218,6 +218,7 @@ def run_stacked_steps(
     dispatch_ctx: Callable | None = None,
     deterministic_auto: bool = False,
     canonical_rows: int | None = None,
+    anatomy=None,
 ) -> int:
     """Drive ``batches`` of ``(features, labels)`` through the trainer in
     groups of ``k`` steps per dispatch; returns records processed.
@@ -229,6 +230,15 @@ def run_stacked_steps(
     (milestone hooks run at dispatch granularity, deviation D9a).
     ``dispatch_ctx()``: context manager wrapping each device dispatch
     (timing buckets).
+
+    ``anatomy`` (an installed
+    :class:`~elasticdl_tpu.telemetry.anatomy.AnatomyRecorder`, or None):
+    per-dispatch phase attribution — fetch waits, pad/stack, placement,
+    dispatch-to-ready and the post-group hooks are timed as disjoint
+    phases summing exactly to each group's wall time, and each dispatch
+    additionally blocks on its outputs so device time is measured, not
+    queued.  ``None`` (the default) keeps the uninstrumented path: ONE
+    branch per flush, no clock reads, identical dispatch behavior.
 
     ``canonical_rows`` (the runtimes pass
     :func:`canonical_batch_rows`): SHAPE-CANONICAL mode — every batch is
@@ -249,54 +259,136 @@ def run_stacked_steps(
     first_shape = None
     processed = 0
     canonical = canonical_rows is not None
+    if anatomy is not None:
+        # step anatomy (telemetry/anatomy.py): fetch waits are timed at
+        # the stream seam, per-step hooks are timed as bookkeeping, and
+        # the flush bodies below time assemble/placement/compute — the
+        # disabled path takes none of these wrappers (one `is None`
+        # branch per flush, no clock reads)
+        from elasticdl_tpu.telemetry.anatomy import (
+            PHASE_ASSEMBLE,
+            PHASE_DEVICE_COMPUTE,
+            PHASE_H2D_TRANSFER,
+            SUB_ENQUEUE,
+            SUB_READY_WAIT,
+        )
+
+        batches = anatomy.wrap_fetches(batches)
+        pre_batch = anatomy.wrapped_hook(pre_batch)
+        post_group = anatomy.wrapped_hook(post_group)
 
     def _flush_canonical():
         nonlocal processed
         if not group:
             return
         trainer = get_trainer()
-        padded = [
-            (
-                trainer.pad_to(f, canonical_rows),
-                trainer.pad_to(l, canonical_rows),
-                trainer.row_mask(n, canonical_rows),
-            )
-            for f, l, n in group
-        ]
-        if len(padded) >= 2 and len(padded) == k:
-            stacked_f = jax.tree_util.tree_map(
-                lambda *xs: np.stack(xs), *[p[0] for p in padded]
-            )
-            stacked_l = jax.tree_util.tree_map(
-                lambda *xs: np.stack(xs), *[p[1] for p in padded]
-            )
-            stacked_w = np.stack([p[2] for p in padded])
-            with ctx():
-                trainer.train_steps_stacked(
-                    trainer.place_stacked(stacked_f),
-                    trainer.place_stacked(stacked_l),
-                    trainer.place_stacked(stacked_w),
+        steps = len(group)
+        n_records = sum(n for _f, _l, n in group)
+        if anatomy is None:
+            padded = [
+                (
+                    trainer.pad_to(f, canonical_rows),
+                    trainer.pad_to(l, canonical_rows),
+                    trainer.row_mask(n, canonical_rows),
                 )
-        else:
-            # trailing partial group: k' single weighted steps through
-            # the one compiled program — never a scan-k' compile
-            for features, labels, mask in padded:
+                for f, l, n in group
+            ]
+            if len(padded) >= 2 and len(padded) == k:
+                stacked_f = jax.tree_util.tree_map(
+                    lambda *xs: np.stack(xs), *[p[0] for p in padded]
+                )
+                stacked_l = jax.tree_util.tree_map(
+                    lambda *xs: np.stack(xs), *[p[1] for p in padded]
+                )
+                stacked_w = np.stack([p[2] for p in padded])
                 with ctx():
-                    trainer.train_step(
-                        trainer.place_batch(features),
-                        trainer.place_batch(labels),
-                        trainer.place_batch(mask),
+                    trainer.train_steps_stacked(
+                        trainer.place_stacked(stacked_f),
+                        trainer.place_stacked(stacked_l),
+                        trainer.place_stacked(stacked_w),
                     )
-        processed += sum(n for _f, _l, n in group)
+            else:
+                # trailing partial group: k' single weighted steps through
+                # the one compiled program — never a scan-k' compile
+                for features, labels, mask in padded:
+                    with ctx():
+                        trainer.train_step(
+                            trainer.place_batch(features),
+                            trainer.place_batch(labels),
+                            trainer.place_batch(mask),
+                        )
+        else:
+            # same dispatch decisions, each segment attributed; the
+            # trailing block_until_ready trades a little async overlap
+            # for a measured (not queued) device_compute phase
+            with anatomy.phase(PHASE_ASSEMBLE):
+                padded = [
+                    (
+                        trainer.pad_to(f, canonical_rows),
+                        trainer.pad_to(l, canonical_rows),
+                        trainer.row_mask(n, canonical_rows),
+                    )
+                    for f, l, n in group
+                ]
+                stack_full = len(padded) >= 2 and len(padded) == k
+                if stack_full:
+                    stacked_f = jax.tree_util.tree_map(
+                        lambda *xs: np.stack(xs), *[p[0] for p in padded]
+                    )
+                    stacked_l = jax.tree_util.tree_map(
+                        lambda *xs: np.stack(xs), *[p[1] for p in padded]
+                    )
+                    stacked_w = np.stack([p[2] for p in padded])
+            if stack_full:
+                with anatomy.phase(PHASE_H2D_TRANSFER):
+                    placed = (
+                        trainer.place_stacked(stacked_f),
+                        trainer.place_stacked(stacked_l),
+                        trainer.place_stacked(stacked_w),
+                    )
+                with ctx():
+                    with anatomy.phase(
+                        PHASE_DEVICE_COMPUTE, sub=SUB_ENQUEUE
+                    ):
+                        out = trainer.train_steps_stacked(*placed)
+                    with anatomy.phase(
+                        PHASE_DEVICE_COMPUTE, sub=SUB_READY_WAIT
+                    ):
+                        jax.block_until_ready(out)
+            else:
+                for features, labels, mask in padded:
+                    with anatomy.phase(PHASE_H2D_TRANSFER):
+                        placed = (
+                            trainer.place_batch(features),
+                            trainer.place_batch(labels),
+                            trainer.place_batch(mask),
+                        )
+                    with ctx():
+                        with anatomy.phase(
+                            PHASE_DEVICE_COMPUTE, sub=SUB_ENQUEUE
+                        ):
+                            out = trainer.train_step(*placed)
+                        with anatomy.phase(
+                            PHASE_DEVICE_COMPUTE, sub=SUB_READY_WAIT
+                        ):
+                            jax.block_until_ready(out)
+        processed += n_records
         group.clear()
         if post_group is not None:
             post_group()
+        if anatomy is not None:
+            anatomy.commit(
+                steps=steps,
+                records=n_records,
+                step=getattr(trainer, "step", None),
+            )
 
     def _flush_legacy():
         nonlocal processed
         if not group:
             return
         trainer = get_trainer()
+        n_records = sum(_batch_size(g[1]) for g in group)
         if len(group) == 1:
             features, labels = group[0]
             with ctx():
@@ -304,7 +396,7 @@ def run_stacked_steps(
                     trainer.place_padded(features),
                     trainer.place_padded(labels),
                 )
-            processed += _batch_size(labels)
+            processed += n_records
         else:
             padded = [
                 (trainer.pad_batch(f)[0], trainer.pad_batch(l)[0])
@@ -321,10 +413,17 @@ def run_stacked_steps(
                     trainer.place_stacked(stacked_f),
                     trainer.place_stacked(stacked_l),
                 )
-            processed += sum(_batch_size(g[1]) for g in group)
+            processed += n_records
+        steps = len(group)
         group.clear()
         if post_group is not None:
             post_group()
+        if anatomy is not None:
+            # the legacy dispatch body is not segment-timed (the
+            # runtimes' hot paths are canonical); commit what was
+            # measured at the seams so intervals never leak across
+            # dispatch windows — the dispatch itself lands in untracked
+            anatomy.commit(steps=steps, records=n_records)
 
     _flush = _flush_canonical if canonical else _flush_legacy
 
@@ -340,26 +439,61 @@ def run_stacked_steps(
                 for _ in range(item.num_steps):
                     pre_batch(item.sample_features)
             trainer = get_trainer()
-            with ctx():
-                if canonical:
-                    # PreStacked groups hold full batches only — an
-                    # all-ones mask keeps the ONE weighted scan shape
-                    leaf = jax.tree_util.tree_leaves(item.features)[0]
-                    trainer.train_steps_stacked(
-                        trainer.place_stacked(item.features),
-                        trainer.place_stacked(item.labels),
-                        trainer.place_stacked(
-                            np.ones(leaf.shape[:2], np.float32)
-                        ),
-                    )
-                else:
-                    trainer.train_steps_stacked(
-                        trainer.place_stacked(item.features),
-                        trainer.place_stacked(item.labels),
-                    )
+            if anatomy is None:
+                with ctx():
+                    if canonical:
+                        # PreStacked groups hold full batches only — an
+                        # all-ones mask keeps the ONE weighted scan shape
+                        leaf = jax.tree_util.tree_leaves(item.features)[0]
+                        trainer.train_steps_stacked(
+                            trainer.place_stacked(item.features),
+                            trainer.place_stacked(item.labels),
+                            trainer.place_stacked(
+                                np.ones(leaf.shape[:2], np.float32)
+                            ),
+                        )
+                    else:
+                        trainer.train_steps_stacked(
+                            trainer.place_stacked(item.features),
+                            trainer.place_stacked(item.labels),
+                        )
+            else:
+                # a ready-made group has no pad/stack assembly — its
+                # anatomy is placement + compute (+ the fetch/hook time
+                # already attributed at the seams)
+                with anatomy.phase(PHASE_H2D_TRANSFER):
+                    if canonical:
+                        leaf = jax.tree_util.tree_leaves(item.features)[0]
+                        placed = (
+                            trainer.place_stacked(item.features),
+                            trainer.place_stacked(item.labels),
+                            trainer.place_stacked(
+                                np.ones(leaf.shape[:2], np.float32)
+                            ),
+                        )
+                    else:
+                        placed = (
+                            trainer.place_stacked(item.features),
+                            trainer.place_stacked(item.labels),
+                        )
+                with ctx():
+                    with anatomy.phase(
+                        PHASE_DEVICE_COMPUTE, sub=SUB_ENQUEUE
+                    ):
+                        out = trainer.train_steps_stacked(*placed)
+                    with anatomy.phase(
+                        PHASE_DEVICE_COMPUTE, sub=SUB_READY_WAIT
+                    ):
+                        jax.block_until_ready(out)
             processed += item.num_records
             if post_group is not None:
                 post_group()
+            if anatomy is not None:
+                anatomy.commit(
+                    steps=item.num_steps,
+                    records=item.num_records,
+                    step=getattr(trainer, "step", None),
+                )
             continue
         features, labels = item
         if pre_batch is not None:

@@ -297,6 +297,24 @@ def _add_train_params(parser: argparse.ArgumentParser):
         ),
     )
     parser.add_argument(
+        "--step_anatomy",
+        type=parse_bool,
+        default=None,
+        required=False,
+        help=(
+            "Continuous per-dispatch time anatomy: decompose every "
+            "dispatch group's wall time into host_fetch / assemble / "
+            "h2d_transfer / device_compute / step_bookkeeping phases "
+            "(sum-exact; residual tracked as 'untracked').  Feeds the "
+            "elasticdl_step_phase_* metric families, the report's "
+            "goodput section and sampled step_anatomy spans.  Workers "
+            "inherit it via ELASTICDL_TPU_STEP_ANATOMY (never argv).  "
+            "Measuring blocks each dispatch on its outputs, trading a "
+            "little async-dispatch overlap for exact attribution; "
+            "default off"
+        ),
+    )
+    parser.add_argument(
         "--profile_dir",
         default="",
         help=(
@@ -843,6 +861,9 @@ _MASTER_ONLY_FLAGS = frozenset(
         "metrics_port",
         "metrics_host",
         "trace_sample_rate",
+        # step anatomy travels by ELASTICDL_TPU_STEP_ANATOMY (never
+        # argv) so worker command lines stay byte-identical when off
+        "step_anatomy",
     }
 )
 

@@ -172,6 +172,15 @@ class LockstepWorker:
             process_id=self._process_id,
             generation=self._cluster_version,
         )
+        # per-dispatch phase anatomy (enabled by the master's forwarded
+        # ELASTICDL_TPU_STEP_ANATOMY, never argv): phase totals ship on
+        # the heartbeat like the PR-8 RPC counters
+        from elasticdl_tpu.telemetry import anatomy as anatomy_mod
+
+        self._anatomy_mod = anatomy_mod
+        anatomy_mod.install_from_env(
+            model_def=getattr(args, "model_def", "") or ""
+        )
         # process-wide compile counter; the chief ships deltas to the
         # master as a `compile_count` exec counter with task reports
         from elasticdl_tpu.telemetry import compile_tracker
@@ -486,6 +495,11 @@ class LockstepWorker:
                 # per-process wall-clock probe
                 deterministic_auto=True,
                 canonical_rows=self._canonical_rows,
+                # anatomy changes TIMING only (an extra block on the
+                # dispatch outputs), never shapes or dispatch count, so
+                # the lockstep schedule agreement is preserved even if
+                # only some processes had it enabled
+                anatomy=self._anatomy_mod.get_recorder(),
             )
         self._report_task_result(
             task.task_id, include_timing=True, trace=task.trace
@@ -635,6 +649,9 @@ class LockstepWorker:
         import threading
 
         from elasticdl_tpu.rpc import stats as rpc_stats
+        from elasticdl_tpu.telemetry.anatomy import (
+            heartbeat_snapshot as anatomy_snapshot,
+        )
 
         def beat():
             while not self._stopped:
@@ -663,6 +680,9 @@ class LockstepWorker:
                             # RPC outcome totals ride the beat — the one
                             # RPC still flowing when reports stall
                             rpc=rpc_stats.snapshot(),
+                            # step-anatomy phase totals ({} when off):
+                            # the master mirrors them onto /metrics
+                            phases=anatomy_snapshot(),
                         )
                     )
                     if self._replicator is not None and resp is not None:

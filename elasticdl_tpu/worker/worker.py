@@ -95,6 +95,15 @@ class Worker:
         if tracing.get_tracer() is None:
             tracing.install_from_env(worker_id=self._worker_id)
         self._tracing = tracing
+        # per-dispatch phase anatomy (enabled via the master's forwarded
+        # ELASTICDL_TPU_STEP_ANATOMY, never argv); phase totals ship on
+        # the heartbeat like the RPC outcome counters
+        from elasticdl_tpu.telemetry import anatomy as anatomy_mod
+
+        self._anatomy_mod = anatomy_mod
+        anatomy_mod.install_from_env(
+            model_def=getattr(args, "model_def", "") or ""
+        )
         self._task_traces: dict[int, dict] = {}
         # the lease ledger the re-home handshake presents: every lease
         # this worker holds an unreported task for.  Tracked
@@ -307,6 +316,7 @@ class Worker:
         there re-pull from the PS — here the state is device-resident, so a
         retry is just a re-run after a transient failure)."""
         err = ""
+        anat = self._anatomy_mod.get_recorder()
         for _ in range(MAX_MINIBATCH_RETRY_NUM):
             try:
                 if task_type == int(TaskType.TRAINING):
@@ -321,11 +331,16 @@ class Worker:
                     record_step_span(int(self._trainer.step))
                     self._timing.start_record_time("batch_process")
                     n = _batch_len(labels)
-                    self._trainer.train_step(
-                        self._place(features),
-                        self._place(labels),
-                        self._trainer.place_mask(n, self._canonical_rows),
-                    )
+                    if anat is None:
+                        self._trainer.train_step(
+                            self._place(features),
+                            self._place(labels),
+                            self._trainer.place_mask(
+                                n, self._canonical_rows
+                            ),
+                        )
+                    else:
+                        self._anatomized_train_step(anat, features, labels, n)
                     self._timing.end_record_time("batch_process")
                 elif task_type == int(TaskType.PREDICTION):
                     self._ensure_trainer(features)
@@ -337,6 +352,38 @@ class Worker:
                 err = str(ex)
                 traceback.print_exc()
         return err
+
+    def _anatomized_train_step(self, anat, features, labels, n):
+        """The same train_step feed as the uninstrumented branch, each
+        segment attributed: pad (assemble) / placement (h2d) / dispatch
+        + block (device_compute enqueue/ready-wait).  ``place_canonical``
+        is pad_to + place_batch, split here so the two phases are
+        separable."""
+        import jax as _jax
+
+        from elasticdl_tpu.telemetry.anatomy import (
+            PHASE_ASSEMBLE,
+            PHASE_DEVICE_COMPUTE,
+            PHASE_H2D_TRANSFER,
+            SUB_ENQUEUE,
+            SUB_READY_WAIT,
+        )
+
+        trainer = self._trainer
+        with anat.phase(PHASE_ASSEMBLE):
+            padded_f = trainer.pad_to(features, self._canonical_rows)
+            padded_l = trainer.pad_to(labels, self._canonical_rows)
+            mask = trainer.row_mask(n, self._canonical_rows)
+        with anat.phase(PHASE_H2D_TRANSFER):
+            placed = (
+                trainer.place_batch(padded_f),
+                trainer.place_batch(padded_l),
+                trainer.place_batch(mask),
+            )
+        with anat.phase(PHASE_DEVICE_COMPUTE, sub=SUB_ENQUEUE):
+            out = trainer.train_step(*placed)
+        with anat.phase(PHASE_DEVICE_COMPUTE, sub=SUB_READY_WAIT):
+            _jax.block_until_ready(out)
 
     def _predict_minibatch(self, features):
         n = _batch_len(features)
@@ -423,9 +470,32 @@ class Worker:
             trace_span,
         )
 
+        anat = self._anatomy_mod.get_recorder()
+        if anat is not None:
+            from elasticdl_tpu.telemetry.anatomy import (
+                PHASE_STEP_BOOKKEEPING,
+            )
+
+        def boundary(n, err):
+            if tds.report_record_done(n, err):
+                # task boundary: report version (may trigger
+                # step-based eval) and drain any eval tasks.
+                # Polling here instead of every batch
+                # (reference worker.py:982-987) keeps the
+                # get_task RPC out of the minibatch hot loop.
+                self._timing.report_timing(reset=True)
+                self.report_version()
+                self._checkpointer.maybe_save(self._trainer, self._mesh)
+                if self._job_type == JobType.TRAINING_WITH_EVALUATION:
+                    self._evaluate_only()
+
         total = 0
         try:
             for _tid, task, batches in prefetcher:
+                if anat is not None:
+                    # the time this thread blocks on the prefetcher is
+                    # the dispatch's host_fetch phase
+                    batches = anat.wrap_fetches(batches)
                 with trace_span(
                     SPAN_TASK_EXECUTE,
                     trace_ctx=task.trace,
@@ -436,29 +506,27 @@ class Worker:
                         if isinstance(batch, PreStacked):
                             err = self._process_stacked_group(batch)
                             n = batch.num_records
+                            steps = batch.num_steps
                         else:
                             features, labels = batch
                             err = self._process_minibatch(
                                 task.type, features, labels
                             )
                             n = _batch_len(labels)
+                            steps = 1
                         total += n
-                        if tds.report_record_done(n, err):
-                            # task boundary: report version (may trigger
-                            # step-based eval) and drain any eval tasks.
-                            # Polling here instead of every batch
-                            # (reference worker.py:982-987) keeps the
-                            # get_task RPC out of the minibatch hot loop.
-                            self._timing.report_timing(reset=True)
-                            self.report_version()
-                            self._checkpointer.maybe_save(
-                                self._trainer, self._mesh
+                        if anat is None:
+                            boundary(n, err)
+                        else:
+                            with anat.phase(PHASE_STEP_BOOKKEEPING):
+                                boundary(n, err)
+                            anat.commit(
+                                steps=steps,
+                                records=n,
+                                step=self._trainer.step
+                                if self._trainer is not None
+                                else None,
                             )
-                            if (
-                                self._job_type
-                                == JobType.TRAINING_WITH_EVALUATION
-                            ):
-                                self._evaluate_only()
         finally:
             prefetcher.close()
         return total
@@ -516,6 +584,7 @@ class Worker:
         """A PreStacked dispatch group (k steps, one scanned dispatch)
         with the same retry contract as ``_process_minibatch``."""
         err = ""
+        anat = self._anatomy_mod.get_recorder()
         for _ in range(MAX_MINIBATCH_RETRY_NUM):
             try:
                 self._ensure_trainer(group.sample_features)
@@ -529,13 +598,36 @@ class Worker:
                 # batches, and the weights keep the ONE weighted scan
                 # shape shared with canonical plain groups
                 leaf = jax.tree_util.tree_leaves(group.features)[0]
-                self._trainer.train_steps_stacked(
-                    self._trainer.place_stacked(group.features),
-                    self._trainer.place_stacked(group.labels),
-                    self._trainer.place_stacked(
-                        np.ones(leaf.shape[:2], np.float32)
-                    ),
-                )
+                if anat is None:
+                    self._trainer.train_steps_stacked(
+                        self._trainer.place_stacked(group.features),
+                        self._trainer.place_stacked(group.labels),
+                        self._trainer.place_stacked(
+                            np.ones(leaf.shape[:2], np.float32)
+                        ),
+                    )
+                else:
+                    from elasticdl_tpu.telemetry.anatomy import (
+                        PHASE_DEVICE_COMPUTE,
+                        PHASE_H2D_TRANSFER,
+                        SUB_ENQUEUE,
+                        SUB_READY_WAIT,
+                    )
+
+                    with anat.phase(PHASE_H2D_TRANSFER):
+                        placed = (
+                            self._trainer.place_stacked(group.features),
+                            self._trainer.place_stacked(group.labels),
+                            self._trainer.place_stacked(
+                                np.ones(leaf.shape[:2], np.float32)
+                            ),
+                        )
+                    with anat.phase(PHASE_DEVICE_COMPUTE, sub=SUB_ENQUEUE):
+                        out = self._trainer.train_steps_stacked(*placed)
+                    with anat.phase(
+                        PHASE_DEVICE_COMPUTE, sub=SUB_READY_WAIT
+                    ):
+                        jax.block_until_ready(out)
                 self._timing.end_record_time("batch_process")
                 return ""
             except Exception as ex:  # noqa: BLE001 — report upstream
@@ -765,6 +857,9 @@ class Worker:
         import threading
 
         from elasticdl_tpu.rpc import stats as rpc_stats
+        from elasticdl_tpu.telemetry.anatomy import (
+            heartbeat_snapshot as anatomy_snapshot,
+        )
 
         def beat():
             while not self._stopped:
@@ -778,6 +873,9 @@ class Worker:
                             # RPC outcome totals ride the beat — the one
                             # RPC still flowing when reports stall
                             rpc=rpc_stats.snapshot(),
+                            # step-anatomy phase totals ({} when off):
+                            # the master mirrors them onto /metrics
+                            phases=anatomy_snapshot(),
                         )
                     )
                     if resp is not None:

@@ -64,6 +64,8 @@ REQUIRED_EVENT_NAMES = frozenset(
         "autoscale_decision",
         # network chaos (ISSUE 9): transport-level fault firings
         "rpc_fault_injected",
+        # step anatomy (ISSUE 10): per-dispatch phase decomposition
+        "step_anatomy",
     }
 )
 REQUIRED_SPAN_NAMES = frozenset(
@@ -82,8 +84,25 @@ REQUIRED_SPAN_NAMES = frozenset(
         # network chaos (ISSUE 9): injected link-degradation window —
         # trace analyze's degraded_network phase reads it
         "rpc_degraded",
+        # step anatomy (ISSUE 10): one sampled span per phase interval
+        "step_anatomy",
     }
 )
+# the step-anatomy phase vocabulary (telemetry/anatomy.py PHASE_*
+# constants): the event fields, the metric labels, the report's goodput
+# section and the goodput smoke all key off these exact names — one
+# definition site, all six present
+REQUIRED_PHASE_NAMES = frozenset(
+    {
+        "host_fetch",
+        "assemble",
+        "h2d_transfer",
+        "device_compute",
+        "step_bookkeeping",
+        "untracked",
+    }
+)
+PHASE_CONST = re.compile(r"^PHASE_\w+\s*=\s*[\"']([^\"']+)[\"']", re.M)
 # metric families other tooling depends on (the compile-count regression
 # gate scrapes elasticdl_compile_total; the netchaos smoke requires a
 # deadline-exceeded counter; the RPC latency family is the per-method
@@ -94,6 +113,9 @@ REQUIRED_METRIC_NAMES = frozenset(
         "elasticdl_compile_total",
         "elasticdl_rpc_deadline_exceeded_total",
         "elasticdl_rpc_latency_seconds",
+        # step anatomy (ISSUE 10): per-phase totals + distribution
+        "elasticdl_step_phase_ms_total",
+        "elasticdl_step_phase_seconds",
     }
 )
 
@@ -184,6 +206,12 @@ def main() -> int:
             "span",
             REQUIRED_SPAN_NAMES,
         ),
+        (
+            os.path.join("telemetry", "anatomy.py"),
+            PHASE_CONST,
+            "phase",
+            REQUIRED_PHASE_NAMES,
+        ),
     ):
         with open(os.path.join(PACKAGE, rel_path), encoding="utf-8") as f:
             const_values = pattern.findall(f.read())
@@ -215,7 +243,8 @@ def main() -> int:
         "check_telemetry_names: OK "
         f"({len(metric_sites)} metric names, "
         f"{const_counts['event'] + len(event_sites)} event names, "
-        f"{const_counts['span'] + len(span_sites)} span names)"
+        f"{const_counts['span'] + len(span_sites)} span names, "
+        f"{const_counts['phase']} phase names)"
     )
     return 0
 

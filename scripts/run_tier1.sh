@@ -15,10 +15,22 @@
 # retry budget outlasts: every call must degrade to DEADLINE_EXCEEDED,
 # retry, and complete — deadline-exceeded counter > 0, zero reforms,
 # zero hung threads at exit)
+# + goodput smoke (tiny LocalExecutor run with --step_anatomy: every
+# dispatch's phases must sum exactly to its wall time with < 2%
+# untracked residual, and telemetry.report must emit a goodput section
+# whose e2e_vs_roofline is computed from measured phases)
 # + the ROADMAP.md test command, verbatim.
 # Run from the repo root: scripts/run_tier1.sh
 cd "$(dirname "$0")/.." || exit 2
+# the lockstep chaos/smoke jobs hard-require the native recordio codec
+# (a worker missing it crash-loops the world): build it ONCE up front,
+# or fail with one actionable line
+python -m elasticdl_tpu.data.recordio.build || {
+  echo "run_tier1: native recordio codec build failed — install g++ and zlib, then re-run 'python -m elasticdl_tpu.data.recordio.build'" >&2
+  exit 1
+}
 python scripts/check_telemetry_names.py || exit 1
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/goodput_smoke.py || exit 1
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/trace_smoke.py || exit 1
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/netchaos_smoke.py || exit 1
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/compile_smoke.py || exit 1
