@@ -1,0 +1,48 @@
+"""elastic-lint: whole-repo static analysis for this repo's contracts.
+
+Four of the last five PRs found latent races and contract violations
+only by hand or by chaos luck: the ``_rehome_pending`` gRPC-thread vs
+run-loop race, the ``RpcClient`` call-table snapshot race under
+re-resolve, the non-idempotent ``report_evaluation_metrics``
+double-accumulation, the double-banked compile delta.  The codebase
+already encodes its safety rules — lock-guarded fields,
+deadline-on-every-RPC, idempotent-only retry, flags-default-None argv
+byte-identity, one-registration-site telemetry names — but only as
+prose in design docs and as hand-written pins in tests.  This package
+makes the machine check them on every tier-1 run:
+
+    python -m elasticdl_tpu.analysis [--json] [--output PATH] [paths...]
+
+Zero dependencies (stdlib ``ast`` + ``tokenize``), pluggable checkers
+(:mod:`.checkers`), and a waivers file
+(``elasticdl_tpu/analysis/waivers.toml``) where every intentional
+exception carries a mandatory one-line justification.  Checkers:
+
+- ``lock-discipline``  — attributes annotated ``# guarded-by: <lock>``
+  are only touched inside ``with self.<lock>:`` or methods documented
+  ``# lock-holding: <lock>`` (the ``_rehome_pending`` bug class);
+- ``rpc-contract``     — every RPC client construction threads a
+  deadline policy, and every method named in a retryable set is
+  classified in :mod:`elasticdl_tpu.rpc.idempotency` (new methods fail
+  the build until classified);
+- ``flag-hygiene``     — master-group flags are filtered from worker
+  argv and optional shared flags default to ``None`` (the argv
+  byte-identity contract);
+- ``hot-path``         — disabled-telemetry fast paths stay one global
+  load + ``None`` check (no clock reads, no allocations), and no
+  ``print()`` outside CLI modules;
+- ``thread-discipline``— every ``threading.Thread`` is daemon or
+  provably joined;
+- ``telemetry-names``  — the naming lint absorbed from
+  ``scripts/check_telemetry_names.py`` (snake_case, one registration
+  site, required vocabulary).
+
+See docs/designs/static_analysis.md for the checker taxonomy, the
+annotation grammar, and the waiver policy.
+"""
+
+from elasticdl_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    load_sources,
+    run_analysis,
+)

@@ -1,0 +1,88 @@
+"""CLI: ``python -m elasticdl_tpu.analysis [--json] [paths...]``.
+
+Exit codes: 0 = clean (waived findings allowed), 1 = unwaived findings,
+2 = usage error.  ``--json`` prints the machine-readable result to
+stdout (the human rendering moves to stderr); ``--output PATH``
+additionally writes the JSON artifact (what ``scripts/run_tier1.sh``
+collects as ``analysis_result.json``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from elasticdl_tpu.analysis.core import checker_ids, run_analysis
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m elasticdl_tpu.analysis",
+        description="elastic-lint: static contract analysis for this repo",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="Files/directories to analyze (default: the elasticdl_tpu package)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="JSON result on stdout"
+    )
+    parser.add_argument(
+        "--output", default="", help="Also write the JSON result to this file"
+    )
+    parser.add_argument(
+        "--checkers",
+        default="",
+        help="Comma-separated checker subset (default: all). "
+        f"Available: {', '.join(checker_ids())}",
+    )
+    parser.add_argument(
+        "--waivers",
+        default="",
+        help="Waivers file (default: elasticdl_tpu/analysis/waivers.toml)",
+    )
+    parser.add_argument(
+        "--root",
+        default="",
+        help="Root for repo-relative finding paths (default: the repo root; "
+        "fixture tests point this at the fixture tree)",
+    )
+    args = parser.parse_args(argv)
+
+    result = run_analysis(
+        paths=args.paths or None,
+        root=args.root or None,
+        only=(
+            [c.strip() for c in args.checkers.split(",") if c.strip()]
+            if args.checkers
+            else None
+        ),
+        waivers_path=args.waivers or None,
+    )
+    unwaived = result.pop("_unwaived_findings")
+
+    human = sys.stderr if args.json else sys.stdout
+    for finding in unwaived:
+        print(f"elastic-lint: {finding.render()}", file=human)
+    verdict = (
+        "OK" if result["ok"] else f"FAIL ({result['unwaived']} unwaived finding(s))"
+    )
+    print(
+        f"elastic-lint: {verdict} — {result['files_scanned']} files, "
+        f"{len(result['checkers'])} checkers, {result['waived']} waived",
+        file=human,
+    )
+    if args.json:
+        json.dump(result, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

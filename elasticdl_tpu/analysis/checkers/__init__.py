@@ -1,0 +1,10 @@
+"""Checker modules; importing this package registers every checker."""
+
+from elasticdl_tpu.analysis.checkers import (  # noqa: F401
+    flag_hygiene,
+    hot_path,
+    lock_discipline,
+    rpc_contract,
+    telemetry_names,
+    thread_discipline,
+)

@@ -1,0 +1,243 @@
+"""flag-hygiene: the worker-argv byte-identity contract, machine-checked.
+
+The master reconstructs each worker's command line from its own parsed
+namespace (``utils/args.py: build_worker_arguments``).  Byte-identity —
+a feature left off must leave worker argv and the k8s golden manifests
+byte-for-byte unchanged — rests on three mechanisms this checker pins:
+
+- **FH1 master-group filtering**: every flag registered inside the
+  master-only group (``_add_master_params``) must appear in
+  ``_MASTER_ONLY_FLAGS`` so it is ALWAYS filtered from worker argv.  A
+  new master flag missing from the filter silently leaks into every
+  worker command line.
+- **FH2 no stale filter entries**: every ``_MASTER_ONLY_FLAGS`` name
+  must be registered by some ``add_argument`` — a stale entry means the
+  filter and the parser drifted.
+- **FH3 optional shared flags default to None**: a flag registered in a
+  SHARED group (one used by both the master and worker parsers) with an
+  explicit ``required=False`` is, by this repo's convention, a
+  post-baseline feature gate: it must have ``default=None`` so an unset
+  flag is DROPPED from the reconstructed argv (None values are
+  skipped), keeping worker argv byte-identical with the feature off.
+- **FH4 the drop mechanism exists**: ``build_arguments_from_parsed_
+  result`` must still contain the ``value is None`` skip — the single
+  behavior every default-None flag relies on.
+
+The checker finds the flag module structurally (any scanned file
+defining both ``_MASTER_ONLY_FLAGS`` and ``build_arguments_from_
+parsed_result``), so falsification fixtures can carry a miniature one.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from elasticdl_tpu.analysis.core import Finding, register
+
+CHECKER = "flag-hygiene"
+
+_MASTER_GROUP = "_add_master_params"
+_FILTER_NAME = "_MASTER_ONLY_FLAGS"
+_BUILDER = "build_arguments_from_parsed_result"
+_MASTER_GROUPS_NAME = "_MASTER_GROUPS"
+_WORKER_GROUPS_NAME = "_WORKER_GROUPS"
+
+
+def _dest_of(call: ast.Call) -> str | None:
+    """``add_argument("--flag", ...)`` -> ``flag`` (explicit dest= wins)."""
+    for kw in call.keywords:
+        if kw.arg == "dest" and isinstance(kw.value, ast.Constant):
+            return str(kw.value.value)
+    if call.args and isinstance(call.args[0], ast.Constant):
+        raw = str(call.args[0].value)
+        if raw.startswith("--"):
+            return raw[2:].replace("-", "_")
+    return None
+
+
+def _kw(call: ast.Call, name: str) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _group_names(tree: ast.Module, assign_name: str) -> set[str]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id == assign_name:
+                    return {
+                        e.id
+                        for e in getattr(node.value, "elts", ())
+                        if isinstance(e, ast.Name)
+                    }
+    return set()
+
+
+def _filter_set(tree: ast.Module) -> set[str] | None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id == _FILTER_NAME:
+                    value = node.value
+                    if isinstance(value, ast.Call) and value.args:
+                        value = value.args[0]
+                    elements = getattr(value, "elts", None)
+                    if elements is None:
+                        return None
+                    return {
+                        e.value
+                        for e in elements
+                        if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    }
+    return None
+
+
+@register(CHECKER)
+def check(sources) -> list[Finding]:
+    findings: list[Finding] = []
+    for source in sources:
+        if source.tree is None:
+            continue
+        if _FILTER_NAME not in source.text or _BUILDER not in source.text:
+            continue
+        tree = source.tree
+        # the flag module is the file that ASSIGNS the filter and DEFINES
+        # the builder (not one that merely mentions their names, like
+        # this checker's own source)
+        assigns_filter = any(
+            isinstance(n, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == _FILTER_NAME
+                for t in n.targets
+            )
+            for n in ast.walk(tree)
+        )
+        defines_builder = any(
+            isinstance(n, ast.FunctionDef) and n.name == _BUILDER
+            for n in ast.walk(tree)
+        )
+        if not (assigns_filter and defines_builder):
+            continue
+        master_only = _filter_set(tree)
+        if master_only is None:
+            findings.append(
+                Finding(
+                    CHECKER,
+                    source.path,
+                    _FILTER_NAME,
+                    f"{_FILTER_NAME} is not a literal frozenset of flag "
+                    "names — the checker (and reviewers) must be able to "
+                    "read the filter",
+                )
+            )
+            continue
+
+        master_groups = _group_names(tree, _MASTER_GROUPS_NAME)
+        worker_groups = _group_names(tree, _WORKER_GROUPS_NAME)
+        shared_groups = master_groups & worker_groups
+
+        all_dests: set[str] = set()
+        for func in ast.walk(tree):
+            if not isinstance(func, ast.FunctionDef):
+                continue
+            for node in ast.walk(func):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add_argument"
+                ):
+                    continue
+                dest = _dest_of(node)
+                if dest is None:
+                    continue
+                all_dests.add(dest)
+                # FH1: master-group flags must be filtered
+                if func.name == _MASTER_GROUP and dest not in master_only:
+                    findings.append(
+                        Finding(
+                            CHECKER,
+                            source.path,
+                            dest,
+                            f"--{dest} is registered in {_MASTER_GROUP} "
+                            f"but missing from {_FILTER_NAME}: it leaks "
+                            "into every reconstructed worker argv",
+                            line=node.lineno,
+                        )
+                    )
+                # FH3: optional shared flags default to None
+                if func.name in shared_groups:
+                    required = _kw(node, "required")
+                    default = _kw(node, "default")
+                    explicitly_optional = (
+                        isinstance(required, ast.Constant)
+                        and required.value is False
+                    )
+                    default_is_none = (
+                        isinstance(default, ast.Constant)
+                        and default.value is None
+                    )
+                    if (
+                        explicitly_optional
+                        and not default_is_none
+                        and dest not in master_only
+                    ):
+                        findings.append(
+                            Finding(
+                                CHECKER,
+                                source.path,
+                                dest,
+                                f"--{dest} is an optional shared flag "
+                                "(required=False in a group both parsers "
+                                "use) whose default is not None: when "
+                                "unset it still appears in reconstructed "
+                                "worker argv, breaking the byte-identity "
+                                "contract — default to None or filter it",
+                                line=node.lineno,
+                            )
+                        )
+        # FH2: stale filter entries
+        for name in sorted(master_only - all_dests):
+            findings.append(
+                Finding(
+                    CHECKER,
+                    source.path,
+                    name,
+                    f"{_FILTER_NAME} names {name!r} but no add_argument "
+                    "defines it — the filter and the parser drifted",
+                )
+            )
+        # FH4: the None-drop mechanism
+        builder = next(
+            (
+                n
+                for n in ast.walk(tree)
+                if isinstance(n, ast.FunctionDef) and n.name == _BUILDER
+            ),
+            None,
+        )
+        has_drop = False
+        if builder is not None:
+            for node in ast.walk(builder):
+                if isinstance(node, ast.Compare) and any(
+                    isinstance(op, ast.Is) for op in node.ops
+                ):
+                    if any(
+                        isinstance(c, ast.Constant) and c.value is None
+                        for c in node.comparators
+                    ):
+                        has_drop = True
+        if not has_drop:
+            findings.append(
+                Finding(
+                    CHECKER,
+                    source.path,
+                    _BUILDER,
+                    f"{_BUILDER} no longer skips None values — every "
+                    "default-None feature flag relies on that drop for "
+                    "argv byte-identity",
+                    line=getattr(builder, "lineno", 0),
+                )
+            )
+    return findings

@@ -1,0 +1,253 @@
+"""hot-path: disabled-telemetry fast paths stay free; no stray print().
+
+The telemetry spine's overhead contract (pinned by clock-poison tests,
+now machine-checked): when telemetry is NOT installed, the per-step /
+per-dispatch hook is ONE module-global load and a ``None`` check — no
+clock read, no allocation, no attribute chase.  Functions opt in with a
+comment on (or directly above) their ``def`` line:
+
+    def record_step(step, records=0):  # elastic-lint: hot-path
+
+The checker examines the function's **disabled prefix** — every
+statement up to and including the first ``if`` whose test is an
+``is None`` / ``not x`` check, plus that guard's taken suite (the code
+that runs when telemetry is off).  Inside the prefix it forbids:
+
+- calls with arguments (a zero-argument accessor like ``get_recorder()``
+  is the one allowed call shape), and ANY call whose terminal name is a
+  known clock (``monotonic``, ``perf_counter``, ``time`` ...);
+- non-empty container displays and comprehensions (allocations);
+- f-strings (allocation + formatting);
+- attribute chains deeper than 3 (``a.b.c`` is the pinned shape limit);
+- ``print``.
+
+A function annotated hot-path with NO early-return guard is checked in
+full (it should be a trivial accessor).
+
+Separately — repo-wide, no annotation needed — ``print()`` calls are
+forbidden outside the allowlisted CLI entry points whose stdout IS
+their product: runtime output goes through the logger or the telemetry
+spine, where it is structured and greppable.  (This subsumes the old
+``check_telemetry_names.py`` bare-print regex, and being AST-based it
+also catches indented/conditional prints the regex missed.)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from elasticdl_tpu.analysis.core import Finding, enclosing_names, register
+
+CHECKER = "hot-path"
+
+_ANNOTATION = "elastic-lint: hot-path"
+
+_CLOCK_NAMES = frozenset(
+    {
+        "monotonic",
+        "monotonic_ns",
+        "perf_counter",
+        "perf_counter_ns",
+        "process_time",
+        "thread_time",
+        "time",
+        "time_ns",
+        "clock_gettime",
+    }
+)
+
+_MAX_ATTR_DEPTH = 3
+
+# CLI entry points whose stdout IS their product (reports, dataset
+# paths, analysis results); everything else logs
+PRINT_ALLOWLIST = (
+    "elasticdl_tpu/analysis/",
+    "elasticdl_tpu/chaos/runner.py",
+    "elasticdl_tpu/telemetry/report.py",
+    "elasticdl_tpu/telemetry/trace.py",
+    "elasticdl_tpu/client.py",
+    "elasticdl_tpu/data/recordio/build.py",
+    "elasticdl_tpu/data/recordio_gen/",
+)
+
+
+def _attr_depth(node: ast.Attribute) -> int:
+    depth = 0
+    while isinstance(node, ast.Attribute):
+        depth += 1
+        node = node.value
+    return depth + 1  # the base name
+
+
+def _is_disabled_guard(test: ast.expr) -> bool:
+    """``x is None`` / ``not x`` — the disabled-telemetry check shape."""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1:
+        if isinstance(test.ops[0], ast.Is) and isinstance(
+            test.comparators[0], ast.Constant
+        ) and test.comparators[0].value is None:
+            return True
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return True
+    return False
+
+
+def _audit_fast_node(source, func_name, node, findings):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            callee = sub.func
+            terminal = (
+                callee.id
+                if isinstance(callee, ast.Name)
+                else getattr(callee, "attr", "")
+            )
+            if terminal in _CLOCK_NAMES:
+                findings.append(
+                    Finding(
+                        CHECKER,
+                        source.path,
+                        f"{func_name}:clock",
+                        f"clock read ({terminal}) on the disabled fast "
+                        "path — the off state must cost one global load "
+                        "+ None check",
+                        line=sub.lineno,
+                    )
+                )
+            elif sub.args or sub.keywords:
+                findings.append(
+                    Finding(
+                        CHECKER,
+                        source.path,
+                        f"{func_name}:call",
+                        f"call with arguments ({ast.unparse(callee)}) on "
+                        "the disabled fast path — only a zero-arg gate "
+                        "accessor is allowed before the None check",
+                        line=sub.lineno,
+                    )
+                )
+        elif isinstance(
+            sub, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+        ):
+            findings.append(
+                Finding(
+                    CHECKER,
+                    source.path,
+                    f"{func_name}:alloc",
+                    "comprehension on the disabled fast path (allocation)",
+                    line=sub.lineno,
+                )
+            )
+        elif isinstance(sub, (ast.List, ast.Set, ast.Dict, ast.Tuple)):
+            if getattr(sub, "elts", None) or getattr(sub, "keys", None):
+                findings.append(
+                    Finding(
+                        CHECKER,
+                        source.path,
+                        f"{func_name}:alloc",
+                        "non-empty container literal on the disabled "
+                        "fast path (allocation)",
+                        line=sub.lineno,
+                    )
+                )
+        elif isinstance(sub, ast.JoinedStr):
+            findings.append(
+                Finding(
+                    CHECKER,
+                    source.path,
+                    f"{func_name}:alloc",
+                    "f-string on the disabled fast path (allocation)",
+                    line=sub.lineno,
+                )
+            )
+    _audit_attr_chains(source, func_name, node, findings)
+
+
+def _audit_attr_chains(source, func_name, node, findings):
+    class V(ast.NodeVisitor):
+        def visit_Attribute(self, attr_node: ast.Attribute):
+            depth = _attr_depth(attr_node)
+            if depth > _MAX_ATTR_DEPTH:
+                findings.append(
+                    Finding(
+                        CHECKER,
+                        source.path,
+                        f"{func_name}:attr-chain",
+                        f"attribute chain of depth {depth} on the "
+                        f"disabled fast path (pinned shape is "
+                        f"<= {_MAX_ATTR_DEPTH})",
+                        line=attr_node.lineno,
+                    )
+                )
+            # do not descend: inner attributes are part of this chain
+
+    V().visit(node)
+
+
+def _check_hot_function(source, func: ast.FunctionDef, findings):
+    name = func.name
+    prefix: list[ast.stmt] = []
+    for stmt in func.body:
+        if (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and isinstance(stmt.value.value, str)
+        ):
+            continue  # docstring
+        if isinstance(stmt, ast.If) and _is_disabled_guard(stmt.test):
+            prefix.append(stmt.test)
+            prefix.extend(stmt.body)  # the disabled suite
+            break
+        prefix.append(stmt)
+    else:
+        # no guard: the whole body is the fast path (trivial accessor)
+        pass
+    for node in prefix:
+        _audit_fast_node(source, name, node, findings)
+
+
+@register(CHECKER)
+def check(sources) -> list[Finding]:
+    findings: list[Finding] = []
+    for source in sources:
+        if source.tree is None:
+            continue
+        allowlisted = any(
+            source.path.startswith(prefix) or f"/{prefix}" in source.path
+            for prefix in PRINT_ALLOWLIST
+        )
+        enclosing = None
+        for node in ast.walk(source.tree):
+            if (
+                not allowlisted
+                and isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                if enclosing is None:
+                    enclosing = enclosing_names(source.tree)
+                # symbol anchored to the enclosing def, not the line —
+                # a waived intentional print must survive edits above it
+                findings.append(
+                    Finding(
+                        CHECKER,
+                        source.path,
+                        f"print:{enclosing.get(node.lineno, '<module>')}",
+                        "print() outside the CLI allowlist — use the "
+                        "logger or the telemetry event log",
+                        line=node.lineno,
+                    )
+                )
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                first = (
+                    node.decorator_list[0].lineno
+                    if node.decorator_list
+                    else node.lineno
+                )
+                # look at BOTH the def line and the decorator-stack top:
+                # a decorated function's trailing annotation sits on the
+                # def line, which is not `first`
+                note = source.comment_on(first)
+                if first != node.lineno:
+                    note += " " + source.comment_on(node.lineno)
+                if _ANNOTATION in note:
+                    _check_hot_function(source, node, findings)
+    return findings

@@ -1,0 +1,234 @@
+"""lock-discipline: guarded attributes are only touched under their lock.
+
+The bug class that produced the ``_rehome_pending`` gRPC-thread vs
+run-loop race and the ``RpcClient`` call-table snapshot race: a field
+documented as lock-guarded, with one access site that predates (or
+forgot) the lock.  The discipline is opt-in per attribute via a comment
+annotation at the attribute's ``__init__`` assignment:
+
+    self._heartbeats = {}        # guarded-by: _lock
+    self._version = 0            # guarded-by: _lock (writes)
+
+``guarded-by: <lock>`` requires EVERY lexical read/write of
+``self.<attr>`` outside ``__init__`` to sit inside ``with
+self.<lock>:``.  The ``(writes)`` variant guards mutations only —
+the repo's documented pattern for GIL-atomic int/bool reads (e.g. the
+``cluster_version`` property, the ``_reform_requested`` unlocked peek
+whose locked swap re-checks).
+
+Method-level escape hatches, annotated on (or directly above) ``def``:
+
+- ``# lock-holding: <lock>[, <lock2>]`` — the method documents that its
+  CALLER holds the lock (the ``_locked()``-suffix convention); its body
+  is analyzed as if the listed locks were held.
+- ``# single-threaded`` — a known init/teardown window (e.g. journal
+  replay before the RPC server starts); the body is exempt.
+
+``__init__`` is always a single-threaded window.  Mutating an attribute
+through an alias (``x = self._attr; x.append(...)``) is invisible to
+this lexical analysis — the annotation is a contract the checker
+enforces at direct-access sites, not an alias-tracking race prover.
+Nested functions (closures often run on OTHER threads) deliberately do
+NOT inherit the enclosing ``with`` stack: a guarded access inside a
+closure must take the lock itself or be waived.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from elasticdl_tpu.analysis.core import Finding, register
+
+CHECKER = "lock-discipline"
+
+_GUARDED_BY = re.compile(r"guarded-by:\s*(\w+)\s*(\(writes\))?")
+# method escapes must START their comment line: prose like "callers:
+# __init__ (single-threaded construction)" inside another annotation's
+# explanation must never silently exempt a method
+_LOCK_HOLDING = re.compile(r"^lock-holding:\s*([\w,\s]+)")
+_SINGLE_THREADED = re.compile(r"^single-threaded\b")
+
+
+def _self_attr(node: ast.expr) -> str | None:
+    """``self.<name>`` -> name, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _def_annotation_lines(source, node: ast.FunctionDef) -> list[str]:
+    """Annotation candidates for a method, one comment line per entry:
+    trailing comments on the def line AND on the first decorator line,
+    plus the contiguous block of comment-ONLY lines directly above the
+    decorator stack.  Line-granular so the escape-hatch regexes can
+    anchor to line start (prose inside one annotation's explanation must
+    never activate another)."""
+    first = node.decorator_list[0].lineno if node.decorator_list else node.lineno
+    parts = list(source.comments.get(node.lineno, ()))
+    if first != node.lineno:
+        parts.extend(source.comments.get(first, ()))
+    lines = source.text.splitlines()
+    line = first - 1
+    while 1 <= line <= len(lines) and lines[line - 1].strip().startswith("#"):
+        parts.append(lines[line - 1].strip().lstrip("#").strip())
+        line -= 1
+    return parts
+
+
+class _MethodVisitor(ast.NodeVisitor):
+    """Walk one method body tracking which locks are lexically held."""
+
+    def __init__(self, source, class_name, method_name, guarded, findings):
+        self.source = source
+        self.class_name = class_name
+        self.method_name = method_name
+        self.guarded = guarded  # attr -> (lock, writes_only)
+        self.findings = findings
+        self.held: list[str] = []
+
+    def visit_With(self, node: ast.With):
+        acquired = []
+        for item in node.items:
+            lock = _self_attr(item.context_expr)
+            if lock is not None:
+                acquired.append(lock)
+            else:
+                # a non-lock context expr can itself touch guarded state
+                # (``with self._calls[...]``) — check it outside the lock
+                self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        self.held.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        if acquired:
+            del self.held[-len(acquired):]
+
+    def _enter_closure(self, node):
+        # closures execute later, possibly on another thread: analyze
+        # their bodies with NO inherited locks
+        saved, self.held = self.held, []
+        for stmt in ast.iter_child_nodes(node):
+            self.visit(stmt)
+        self.held = saved
+
+    def visit_FunctionDef(self, node):
+        self._enter_closure(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._enter_closure(node)
+
+    def visit_Lambda(self, node):
+        self._enter_closure(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        attr = _self_attr(node)
+        if attr is not None and attr in self.guarded:
+            lock, writes_only = self.guarded[attr]
+            is_write = not isinstance(node.ctx, ast.Load)
+            if lock not in self.held and (is_write or not writes_only):
+                access = "write" if is_write else "read"
+                self.findings.append(
+                    Finding(
+                        CHECKER,
+                        self.source.path,
+                        f"{self.class_name}.{self.method_name}:{attr}",
+                        f"{access} of self.{attr} (guarded-by: {lock}"
+                        f"{' (writes)' if writes_only else ''}) outside "
+                        f"'with self.{lock}:' — take the lock, mark the "
+                        f"method '# lock-holding: {lock}', or waive with "
+                        "a justification",
+                        line=node.lineno,
+                    )
+                )
+        self.generic_visit(node)
+
+def _attr_note(source, line: int):
+    """guarded-by annotation for the assignment at ``line``: the
+    trailing comment on the line itself, or a comment-ONLY line directly
+    above — a neighboring attribute's trailing annotation never bleeds."""
+    for comment in source.comments.get(line, ()):
+        note = _GUARDED_BY.search(comment)
+        if note is not None:
+            return note
+    lines = source.text.splitlines()
+    above = line - 1
+    while 1 <= above <= len(lines) and lines[above - 1].strip().startswith("#"):
+        note = _GUARDED_BY.search(lines[above - 1].strip())
+        if note is not None:
+            return note
+        above -= 1
+    return None
+
+
+def _collect_guarded(source, cls: ast.ClassDef) -> dict[str, tuple[str, bool]]:
+    guarded: dict[str, tuple[str, bool]] = {}
+    for method in cls.body:
+        if not isinstance(method, ast.FunctionDef):
+            continue
+        for node in ast.walk(method):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            else:
+                continue
+            note = _attr_note(source, node.lineno)
+            if note is None:
+                continue
+            for target in targets:
+                attr = _self_attr(target)
+                if attr is not None:
+                    guarded[attr] = (note.group(1), bool(note.group(2)))
+    return guarded
+
+
+def _method_exemptions(source, method: ast.FunctionDef) -> tuple[set[str], bool]:
+    holding: set[str] = set()
+    single_threaded = False
+    for note in _def_annotation_lines(source, method):
+        match = _LOCK_HOLDING.match(note)
+        if match:
+            holding.update(
+                part.strip()
+                for part in match.group(1).split(",")
+                if part.strip()
+            )
+        if _SINGLE_THREADED.match(note):
+            single_threaded = True
+    return holding, single_threaded
+
+
+@register(CHECKER)
+def check(sources) -> list[Finding]:
+    findings: list[Finding] = []
+    for source in sources:
+        if source.tree is None or "guarded-by:" not in source.text:
+            continue
+        for cls in ast.walk(source.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            guarded = _collect_guarded(source, cls)
+            if not guarded:
+                continue
+            for method in cls.body:
+                if not isinstance(method, ast.FunctionDef):
+                    continue
+                if method.name == "__init__":
+                    continue  # the single-threaded construction window
+                holding, exempt = _method_exemptions(source, method)
+                if exempt:
+                    continue
+                visitor = _MethodVisitor(
+                    source, cls.name, method.name, guarded, findings
+                )
+                visitor.held = list(holding)
+                for stmt in method.body:
+                    visitor.visit(stmt)
+    return findings

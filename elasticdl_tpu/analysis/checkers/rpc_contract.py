@@ -1,0 +1,259 @@
+"""rpc-contract: deadlines on every client, one idempotency registry.
+
+Two rules, both hard-won:
+
+1. **Deadline threading.**  Every construction of an RPC client class
+   (``RpcClient`` or any class inheriting from it, discovered from the
+   scanned sources) must pass a ``deadlines=`` keyword — the
+   :mod:`elasticdl_tpu.rpc.deadline` policy object (or an expression
+   evaluating to None where the caller consciously opts out).  A
+   construction site without the keyword is exactly how a blackholed
+   link regains the power to hang a thread forever: the policy exists,
+   but this one client never heard of it.  The framework-internal
+   single resolution site (``RpcClient._call`` calling
+   ``deadline_for``) is pinned too: it must exist, in exactly one
+   client-side module.
+
+2. **Idempotency classification.**  Every method string named in a
+   server method table (module-level ``*_METHODS`` tuples) or in a
+   retryable set (``*RETRYABLE*`` / ``*IDEMPOTENT*`` assignments) must
+   be a key of ``IDEMPOTENCY`` in :mod:`elasticdl_tpu.rpc.idempotency`
+   — new RPC methods fail the build until someone writes down why a
+   duplicate delivery is safe.  A method classified ``not-retryable``
+   must not appear in any retryable set.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from elasticdl_tpu.analysis.core import Finding, enclosing_names, register
+
+CHECKER = "rpc-contract"
+
+_BASE_CLIENT = "RpcClient"
+
+
+def _string_elements(
+    node: ast.expr, resolved: dict[str, list[str]] | None = None
+) -> list[str] | None:
+    """Literal strings of a tuple/set/list/frozenset(...) display.
+
+    ``resolved`` maps module-level names to already-collected string
+    tables, so the repo's own ``MASTER_RETRYABLE_METHODS =
+    frozenset(_METHODS)`` shape resolves instead of silently skipping —
+    a computed set the checker can't see would make the retry-safety
+    rule vacuous exactly where the master's retryable set lives.
+    """
+    if isinstance(node, ast.Call) and not node.keywords and len(node.args) == 1:
+        func = node.func
+        name = func.id if isinstance(func, ast.Name) else getattr(func, "attr", "")
+        if name in ("frozenset", "set", "tuple", "list"):
+            return _string_elements(node.args[0], resolved)
+    if isinstance(node, ast.Name) and resolved is not None:
+        return resolved.get(node.id)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        # union of tables (a | b): resolve both sides or give up
+        left = _string_elements(node.left, resolved)
+        right = _string_elements(node.right, resolved)
+        if left is not None and right is not None:
+            return left + right
+        return None
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for element in node.elts:
+            if isinstance(element, ast.Constant) and isinstance(element.value, str):
+                out.append(element.value)
+            else:
+                return None  # computed table: not this checker's business
+        return out
+    return None
+
+
+def _registry_keys(sources) -> tuple[dict[str, str], str | None]:
+    """Parse IDEMPOTENCY from the scanned tree; (method -> class, path)."""
+    for source in sources:
+        if source.tree is None or "IDEMPOTENCY" not in source.text:
+            continue
+        for node in ast.walk(source.tree):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+            else:
+                continue
+            names = {t.id for t in targets if isinstance(t, ast.Name)}
+            if "IDEMPOTENCY" not in names or not isinstance(
+                getattr(node, "value", None), ast.Dict
+            ):
+                continue
+            registry: dict[str, str] = {}
+            for key, value in zip(node.value.keys, node.value.values):
+                if not (isinstance(key, ast.Constant) and isinstance(key.value, str)):
+                    continue
+                klass = ""
+                if isinstance(value, ast.Tuple) and value.elts:
+                    first = value.elts[0]
+                    if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                        klass = first.value
+                elif isinstance(value, ast.Constant) and isinstance(value.value, str):
+                    klass = value.value
+                registry[key.value] = klass
+            return registry, source.path
+    return {}, None
+
+
+@register(CHECKER)
+def check(sources) -> list[Finding]:
+    findings: list[Finding] = []
+
+    # ---- discover client classes (RpcClient + subclasses, transitively)
+    client_classes = {_BASE_CLIENT}
+    grew = True
+    while grew:
+        grew = False
+        for source in sources:
+            if source.tree is None:
+                continue
+            for node in ast.walk(source.tree):
+                if isinstance(node, ast.ClassDef) and node.name not in client_classes:
+                    bases = {
+                        b.id if isinstance(b, ast.Name) else getattr(b, "attr", "")
+                        for b in node.bases
+                    }
+                    if bases & client_classes:
+                        client_classes.add(node.name)
+                        grew = True
+
+    registry, registry_path = _registry_keys(sources)
+
+    # ---- scan: constructions, method tables, retryable sets, deadline_for
+    deadline_resolution_sites: list[tuple[str, int]] = []
+    table_methods: list[tuple[str, str, int, str]] = []  # path, name, line, method
+    retryable_methods: list[tuple[str, str, int, str]] = []
+
+    for source in sources:
+        if source.tree is None:
+            continue
+        enclosing = None
+        # pre-pass: literal string tables by name, so a second pass can
+        # resolve frozenset(_METHODS)-style references
+        module_tables: dict[str, list[str]] = {}
+        for node in ast.walk(source.tree):
+            if isinstance(node, ast.Assign):
+                elements = _string_elements(node.value)
+                if elements is None:
+                    continue
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        module_tables[target.id] = elements
+        for node in ast.walk(source.tree):
+            # 1) client constructions must thread a deadline policy
+            if isinstance(node, ast.Call):
+                func = node.func
+                callee = func.id if isinstance(func, ast.Name) else getattr(func, "attr", "")
+                if callee in client_classes:
+                    kwargs = {kw.arg for kw in node.keywords}
+                    if "deadlines" not in kwargs and None not in kwargs:
+                        if enclosing is None:
+                            enclosing = enclosing_names(source.tree)
+                        where = enclosing.get(node.lineno, "<module>")
+                        findings.append(
+                            Finding(
+                                CHECKER,
+                                source.path,
+                                f"{where}:{callee}",
+                                f"{callee}(...) constructed without a "
+                                "deadlines= policy — this client's calls "
+                                "can hang forever on a blackholed link; "
+                                "pass DeadlinePolicy.from_env() (workers) "
+                                "or the job policy (master), explicitly "
+                                "None only with a waiver",
+                                line=node.lineno,
+                            )
+                        )
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr == "deadline_for"
+                    and "deadline.py" not in source.path
+                ):
+                    deadline_resolution_sites.append((source.path, node.lineno))
+            # 2) method tables / retryable sets
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if not isinstance(target, ast.Name):
+                        continue
+                    elements = _string_elements(node.value, module_tables)
+                    if elements is None:
+                        continue
+                    upper = target.id.upper()
+                    if upper.endswith("_METHODS") and "RETRYABLE" not in upper:
+                        for method in elements:
+                            table_methods.append(
+                                (source.path, target.id, node.lineno, method)
+                            )
+                    if "RETRYABLE" in upper or "IDEMPOTENT" in upper:
+                        for method in elements:
+                            retryable_methods.append(
+                                (source.path, target.id, node.lineno, method)
+                            )
+
+    # ---- registry coverage
+    if registry_path is None:
+        if table_methods or retryable_methods:
+            findings.append(
+                Finding(
+                    CHECKER,
+                    "elasticdl_tpu/rpc/idempotency.py",
+                    "IDEMPOTENCY",
+                    "no IDEMPOTENCY registry found in the scanned sources "
+                    "but RPC method tables exist — the retry-safety "
+                    "registry is required",
+                )
+            )
+    else:
+        for path, table, line, method in table_methods + retryable_methods:
+            if method not in registry:
+                findings.append(
+                    Finding(
+                        CHECKER,
+                        path,
+                        f"{table}:{method}",
+                        f"RPC method {method!r} (in {table}) is not "
+                        f"classified in {registry_path} — new methods "
+                        "fail the build until someone writes down why a "
+                        "duplicate delivery is safe",
+                        line=line,
+                    )
+                )
+        for path, table, line, method in retryable_methods:
+            if registry.get(method) == "not-retryable":
+                findings.append(
+                    Finding(
+                        CHECKER,
+                        path,
+                        f"{table}:{method}",
+                        f"method {method!r} is classified not-retryable "
+                        f"but appears in retryable set {table}",
+                        line=line,
+                    )
+                )
+
+    # ---- the single framework resolution site
+    if any(s.path.endswith("rpc/service.py") for s in sources):
+        if len(deadline_resolution_sites) != 1:
+            sites = ", ".join(f"{p}:{ln}" for p, ln in deadline_resolution_sites)
+            findings.append(
+                Finding(
+                    CHECKER,
+                    "elasticdl_tpu/rpc/service.py",
+                    "deadline_for",
+                    "expected exactly ONE client-side deadline resolution "
+                    f"site (RpcClient._call); found {len(deadline_resolution_sites)}"
+                    + (f" ({sites})" if sites else "")
+                    + " — per-call-site deadline math drifts; route "
+                    "through the policy object",
+                )
+            )
+    return findings

@@ -1,0 +1,134 @@
+"""thread-discipline: every thread is daemon or provably joined.
+
+A non-daemon, never-joined thread is how a "completed" job hangs at
+interpreter exit (the netchaos smoke's zero-hung-threads gate exists
+because this class of bug shipped).  For every ``threading.Thread(...)``
+construction the checker requires one of:
+
+- ``daemon=True`` in the constructor keywords;
+- the constructed object (``t = threading.Thread(...)`` or
+  ``self._t = ...``) has ``<t>.daemon = True`` assigned, or
+  ``<t>.join(`` called, somewhere in the same module — lexical
+  evidence the thread cannot outlive the process silently;
+- a waiver with a justification.
+
+``daemon=<expr>`` (non-literal) counts as handled: the author made an
+explicit choice the reviewer can see.  Thread SUBCLASS instantiations
+are out of scope — the subclass's ``super().__init__(daemon=True)``
+already names the choice at one definition site.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from elasticdl_tpu.analysis.core import Finding, register
+
+CHECKER = "thread-discipline"
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    func = call.func
+    if isinstance(func, ast.Name) and func.id == "Thread":
+        return True
+    return (
+        isinstance(func, ast.Attribute)
+        and func.attr == "Thread"
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "threading"
+    )
+
+
+def _target_name(parents: dict, call: ast.Call) -> str | None:
+    """Name/attr the Thread was assigned to, if directly assigned."""
+    node = parents.get(call)
+    if isinstance(node, ast.Assign) and len(node.targets) == 1:
+        target = node.targets[0]
+        if isinstance(target, ast.Name):
+            return target.id
+        if isinstance(target, ast.Attribute):
+            return target.attr
+    return None
+
+
+def _module_joins_or_daemonizes(tree: ast.Module, name: str) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "join":
+                base = node.func.value
+                base_name = (
+                    base.id
+                    if isinstance(base, ast.Name)
+                    else getattr(base, "attr", None)
+                )
+                if base_name == name:
+                    return True
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and target.attr == "daemon"
+                ):
+                    base = target.value
+                    base_name = (
+                        base.id
+                        if isinstance(base, ast.Name)
+                        else getattr(base, "attr", None)
+                    )
+                    if base_name == name:
+                        return True
+    return False
+
+
+@register(CHECKER)
+def check(sources) -> list[Finding]:
+    findings: list[Finding] = []
+    for source in sources:
+        if source.tree is None or "Thread" not in source.text:
+            continue
+        parents: dict = {}
+        for node in ast.walk(source.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        # enclosing function names for stable symbols
+        enclosing: dict[int, str] = {}
+
+        def name_spans(node, prefix=""):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    label = f"{prefix}.{child.name}" if prefix else child.name
+                    end = getattr(child, "end_lineno", child.lineno)
+                    for line in range(child.lineno, end + 1):
+                        enclosing[line] = label
+                    name_spans(child, label)
+                else:
+                    name_spans(child, prefix)
+
+        name_spans(source.tree)
+        for node in ast.walk(source.tree):
+            if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+                continue
+            if any(kw.arg == "daemon" for kw in node.keywords) or any(
+                kw.arg is None for kw in node.keywords
+            ):
+                continue
+            target = _target_name(parents, node)
+            if target and _module_joins_or_daemonizes(source.tree, target):
+                continue
+            where = enclosing.get(node.lineno, "<module>")
+            symbol = f"{where}:{target or 'anonymous'}"
+            findings.append(
+                Finding(
+                    CHECKER,
+                    source.path,
+                    symbol,
+                    "threading.Thread constructed without daemon= and "
+                    "never joined/daemonized in this module — a silent "
+                    "non-daemon thread hangs process exit; pass "
+                    "daemon=True, join it, or waive with a justification",
+                    line=node.lineno,
+                )
+            )
+    return findings

@@ -1,0 +1,281 @@
+"""Framework: sources, findings, checker registry, the analysis run.
+
+A checker is a function ``check(sources) -> list[Finding]`` registered
+under a stable id.  Findings carry a ``(checker, path, symbol)``
+identity triple — line numbers are display-only, so a waiver written
+against a finding survives unrelated edits above it.
+
+Sources are parsed ONCE (ast + a line->comments map from tokenize) and
+shared by every checker; a file that does not parse is itself a finding
+(``parse-error``), never a crash.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import tokenize
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One contract violation.
+
+    ``symbol`` is the stable within-file identity a waiver matches on
+    (e.g. ``MasterServicer.heartbeat:_worker_rpc_stats``); ``line`` is
+    for humans and editors only.
+    """
+
+    checker: str
+    path: str  # repo-relative, forward slashes
+    symbol: str
+    message: str
+    line: int = 0
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.checker, self.path, self.symbol)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.checker}] {self.symbol}: {self.message}"
+
+
+@dataclass
+class SourceFile:
+    """One parsed Python file shared by all checkers."""
+
+    path: str  # repo-relative, forward slashes
+    abspath: str
+    text: str
+    tree: ast.Module | None
+    # line number -> list of comment strings on that line (text after
+    # '#', stripped); the annotation grammar reads these
+    comments: dict[int, list[str]] = field(default_factory=dict)
+
+    def comment_on(self, line: int) -> str:
+        """Comments attached to ``line``: the line itself plus the line
+        directly above (annotations may trail the code or precede it)."""
+        parts = []
+        for candidate in (line - 1, line):
+            parts.extend(self.comments.get(candidate, ()))
+        return " ".join(parts)
+
+
+def enclosing_names(tree: ast.Module) -> dict[int, str]:
+    """line -> dotted enclosing function/class name (innermost wins).
+
+    The shared symbol-stability helper: checkers anchor finding symbols
+    to the enclosing def/class, never to line numbers, so waivers
+    survive edits elsewhere in the file.
+    """
+    spans: list[tuple[int, int, str]] = []
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                name = f"{prefix}.{child.name}" if prefix else child.name
+                end = getattr(child, "end_lineno", child.lineno)
+                spans.append((child.lineno, end, name))
+                walk(child, name)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    index: dict[int, str] = {}
+    for start, end, name in sorted(spans):
+        for line in range(start, end + 1):
+            index[line] = name  # innermost wins (nested spans sort later)
+    return index
+
+
+def _extract_comments(text: str) -> dict[int, list[str]]:
+    comments: dict[int, list[str]] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type == tokenize.COMMENT:
+                comments.setdefault(tok.start[0], []).append(
+                    tok.string.lstrip("#").strip()
+                )
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass  # the ast parse reports the real error as a finding
+    return comments
+
+
+def repo_root() -> str:
+    """The directory holding the ``elasticdl_tpu`` package."""
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def default_paths() -> list[str]:
+    return [os.path.join(repo_root(), "elasticdl_tpu")]
+
+
+def _iter_py_files(paths: list[str]):
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def load_sources(
+    paths: list[str] | None = None, root: str | None = None
+) -> tuple[list[SourceFile], list[Finding]]:
+    """Parse every .py under ``paths``; returns (sources, parse findings)."""
+    root = root or repo_root()
+    paths = paths or default_paths()
+    files: list[SourceFile] = []
+    findings: list[Finding] = []
+    seen: set[str] = set()
+    for abspath in _iter_py_files([os.path.abspath(p) for p in paths]):
+        if abspath in seen:
+            continue
+        seen.add(abspath)
+        rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+        try:
+            with open(abspath, encoding="utf-8") as f:
+                text = f.read()
+        except OSError as ex:
+            findings.append(
+                Finding("parse-error", rel, "io", f"unreadable: {ex}")
+            )
+            continue
+        try:
+            tree = ast.parse(text, filename=abspath)
+        except SyntaxError as ex:
+            findings.append(
+                Finding(
+                    "parse-error",
+                    rel,
+                    "syntax",
+                    f"does not parse: {ex.msg}",
+                    line=ex.lineno or 0,
+                )
+            )
+            tree = None
+        files.append(
+            SourceFile(
+                path=rel,
+                abspath=abspath,
+                text=text,
+                tree=tree,
+                comments=_extract_comments(text),
+            )
+        )
+    return files, findings
+
+
+# ---- checker registry -------------------------------------------------------
+
+_CHECKERS: dict[str, object] = {}
+
+
+def register(checker_id: str):
+    def wrap(fn):
+        _CHECKERS[checker_id] = fn
+        return fn
+
+    return wrap
+
+
+def checker_ids() -> list[str]:
+    _ensure_loaded()
+    return sorted(_CHECKERS)
+
+
+def _ensure_loaded():
+    if not _CHECKERS:
+        from elasticdl_tpu.analysis import checkers  # noqa: F401 — registers
+
+
+def run_analysis(
+    paths: list[str] | None = None,
+    root: str | None = None,
+    only: list[str] | None = None,
+    waivers_path: str | None = None,
+) -> dict:
+    """Run the suite; returns the result dict the CLI renders.
+
+    ``only`` restricts to the named checkers (waiver hygiene then only
+    audits waivers belonging to them).  Waived findings are carried in
+    the result (marked) but do not affect the verdict; unknown/unused/
+    unjustified waivers are findings in their own right.
+    """
+    from elasticdl_tpu.analysis import waivers as waivers_mod
+
+    _ensure_loaded()
+    sources, findings = load_sources(paths, root=root)
+    selected = (
+        {c: _CHECKERS[c] for c in only if c in _CHECKERS}
+        if only is not None
+        else dict(_CHECKERS)
+    )
+    unknown = [] if only is None else [c for c in only if c not in _CHECKERS]
+    for name in unknown:
+        findings.append(
+            Finding(
+                "usage",
+                "elasticdl_tpu/analysis",
+                name,
+                f"unknown checker {name!r} (have: {', '.join(sorted(_CHECKERS))})",
+            )
+        )
+    for checker_id in sorted(selected):
+        findings.extend(selected[checker_id](sources))
+
+    waiver_set, waiver_findings = waivers_mod.load(waivers_path)
+    if only is not None:
+        waiver_set = [w for w in waiver_set if w.checker in selected]
+    findings.extend(waiver_findings)
+    matched: set[int] = set()
+    waived_keys: set[tuple[str, str, str]] = set()
+    for finding in findings:
+        for i, waiver in enumerate(waiver_set):
+            if waiver.matches(finding):
+                matched.add(i)
+                waived_keys.add(finding.key)
+                break
+    for i, waiver in enumerate(waiver_set):
+        if i not in matched:
+            findings.append(
+                Finding(
+                    "waiver-hygiene",
+                    waiver.origin,
+                    f"{waiver.checker}:{waiver.path}:{waiver.symbol}",
+                    "stale waiver: no current finding matches it — delete "
+                    "it (waivers must not outlive the exception they "
+                    "justify)",
+                )
+            )
+    unwaived = [f for f in findings if f.key not in waived_keys]
+    waived = [f for f in findings if f.key in waived_keys]
+    return {
+        "checkers": sorted(selected) + (["waiver-hygiene"]),
+        "files_scanned": len(sources),
+        "waivers": len(waiver_set),
+        "findings": [
+            {
+                "checker": f.checker,
+                "path": f.path,
+                "line": f.line,
+                "symbol": f.symbol,
+                "message": f.message,
+                "waived": f.key in waived_keys,
+            }
+            for f in findings
+        ],
+        "unwaived": len(unwaived),
+        "waived": len(waived),
+        "ok": not unwaived,
+        "_unwaived_findings": unwaived,  # object form for callers; CLI strips
+    }

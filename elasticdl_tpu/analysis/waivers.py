@@ -1,0 +1,120 @@
+"""Waivers: intentional exceptions, each with a mandatory justification.
+
+``elasticdl_tpu/analysis/waivers.toml`` holds ``[[waiver]]`` tables:
+
+    [[waiver]]
+    checker = "flag-hygiene"
+    path = "elasticdl_tpu/utils/args.py"
+    symbol = "model_zoo"
+    reason = "baseline flag predating the default-None convention"
+
+A waiver matches a finding when ``checker``, ``path`` and ``symbol``
+are all equal — line numbers never participate, so waivers survive
+edits elsewhere in the file.  ``reason`` is REQUIRED and must be
+non-empty: a waiver without a justification is itself a finding, and so
+is a stale waiver that no longer matches anything (core.run_analysis).
+
+Python 3.10 has no ``tomllib``, and this package is zero-dep by
+contract, so the loader is a minimal parser for exactly the subset the
+file uses: ``[[waiver]]`` table headers, ``key = "basic string"``
+pairs, comments, blank lines.  Anything else is a loud finding, not a
+silent skip.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass
+
+from elasticdl_tpu.analysis.core import Finding
+
+WAIVERS_FILENAME = "waivers.toml"
+
+_HEADER = re.compile(r"^\[\[\s*waiver\s*\]\]$")
+_PAIR = re.compile(r'^(\w+)\s*=\s*"((?:[^"\\]|\\.)*)"\s*(?:#.*)?$')
+_REQUIRED_KEYS = ("checker", "path", "symbol", "reason")
+
+
+@dataclass(frozen=True)
+class Waiver:
+    checker: str
+    path: str
+    symbol: str
+    reason: str
+    origin: str  # waivers file (repo-relative) for hygiene findings
+
+    def matches(self, finding: Finding) -> bool:
+        return (
+            finding.checker == self.checker
+            and finding.path == self.path
+            and finding.symbol == self.symbol
+        )
+
+
+def default_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), WAIVERS_FILENAME)
+
+
+def load(path: str | None = None) -> tuple[list[Waiver], list[Finding]]:
+    """Parse the waivers file; malformed entries become findings."""
+    path = path or default_path()
+    origin = "elasticdl_tpu/analysis/" + os.path.basename(path)
+    waivers: list[Waiver] = []
+    findings: list[Finding] = []
+    if not os.path.exists(path):
+        return waivers, findings
+    with open(path, encoding="utf-8") as f:
+        lines = f.read().splitlines()
+
+    entries: list[tuple[int, dict[str, str]]] = []
+    current: dict[str, str] | None = None
+    for lineno, raw in enumerate(lines, 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if _HEADER.match(line):
+            current = {}
+            entries.append((lineno, current))
+            continue
+        pair = _PAIR.match(line)
+        if pair and current is not None:
+            current[pair.group(1)] = (
+                pair.group(2).replace('\\"', '"').replace("\\\\", "\\")
+            )
+            continue
+        findings.append(
+            Finding(
+                "waiver-hygiene",
+                origin,
+                f"line-{lineno}",
+                f"unparseable waivers line {lineno}: {line!r} (only "
+                '[[waiver]] tables of key = "value" pairs are allowed)',
+                line=lineno,
+            )
+        )
+    for lineno, entry in entries:
+        missing = [k for k in _REQUIRED_KEYS if not entry.get(k, "").strip()]
+        if missing:
+            findings.append(
+                Finding(
+                    "waiver-hygiene",
+                    origin,
+                    f"line-{lineno}",
+                    f"waiver at line {lineno} missing required "
+                    f"non-empty {', '.join(missing)} — every waiver "
+                    "carries a justification",
+                    line=lineno,
+                )
+            )
+            continue
+        waivers.append(
+            Waiver(
+                checker=entry["checker"],
+                path=entry["path"],
+                symbol=entry["symbol"],
+                reason=entry["reason"],
+                origin=origin,
+            )
+        )
+    return waivers, findings

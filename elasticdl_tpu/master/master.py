@@ -66,7 +66,9 @@ class Master:
         # owns re-formation, so external threads request, never perform.
         # Lock-guarded: an unsynchronized read-then-clear could drop a
         # request that lands between the load and the store.
-        self._reform_requested: str | None = None
+        # writes-guarded: the run loop's unlocked peek is re-checked by
+        # the locked swap that actually consumes the request
+        self._reform_requested: str | None = None  # guarded-by: _reform_request_lock (writes)
         self._reform_request_lock = threading.Lock()
 
         self._spec = get_model_spec(
@@ -215,7 +217,7 @@ class Master:
         # discards) while the run loop iterates it — every access goes
         # through the lock or CPython raises mid-``sorted()``
         self._rehome_lock = threading.Lock()
-        self._rehome_pending: set[int] = set()
+        self._rehome_pending: set[int] = set()  # guarded-by: _rehome_lock
         self._rehome_deadline: float | None = None
         self._restored_world: dict | None = None
         self._restored = False
@@ -253,6 +255,9 @@ class Master:
 
     # ---- master high availability ------------------------------------------
 
+    # single-threaded: journal replay runs from __init__, before the RPC
+    # server and the run loop exist — no other thread can touch the
+    # re-home set yet
     def _restore_from_journal(self, state: dict) -> int:
         """Install the journal-replayed control plane: dispatcher
         todo/doing sets, generation fence, model-version floor, the

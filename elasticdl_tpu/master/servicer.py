@@ -24,6 +24,14 @@ import time
 from elasticdl_tpu.rpc import messages as msg
 from elasticdl_tpu.utils.constants import TaskType
 from elasticdl_tpu.utils.log_utils import default_logger as logger
+from elasticdl_tpu.utils.merge import (
+    max_merge_counters,
+    max_merge_phase_stats,
+)
+
+# the outage-class RPC counters whose RISE (vs the previous beat) flips
+# the /healthz degraded-network flag
+_OUTAGE_CLASS_COUNTERS = frozenset({"deadline_exceeded", "unavailable"})
 
 
 class MasterServicer:
@@ -39,42 +47,45 @@ class MasterServicer:
         self._evaluation_service = evaluation_service
         self._instance_manager = instance_manager
         self._lock = threading.Lock()
-        self._version = 0
+        # GIL-atomic int: unlocked reads (get_task responses, the
+        # get_model_version/cluster_version properties) are the
+        # documented pattern; every WRITE takes the lock
+        self._version = 0  # guarded-by: _lock (writes)
         # worker_id -> last heartbeat wall-clock
-        self._heartbeats: dict[int, float] = {}
+        self._heartbeats: dict[int, float] = {}  # guarded-by: _lock
         # externally-reported failures (pod events); cleared only by
         # forget_worker so a racing in-flight heartbeat can't erase them
-        self._marked_dead: set[int] = set()
-        self._cluster_version = 0
-        self._quiesce = False
+        self._marked_dead: set[int] = set()  # guarded-by: _lock
+        self._cluster_version = 0  # guarded-by: _lock (writes)
+        self._quiesce = False  # guarded-by: _lock (writes)
         # lockstep step-task stream: seq -> memoized TaskResponse.  Every
         # process of a multi-process world pulls the same seq and must see
         # the same answer (the lockstep invariant); WAIT is the only
         # non-final answer and is never memoized.
-        self._step_stream: dict[int, msg.TaskResponse] = {}
+        self._step_stream: dict[int, msg.TaskResponse] = {}  # guarded-by: _stream_lock
         self._stream_lock = threading.Lock()
-        self._first_stream_pull_at: float | None = None
+        self._first_stream_pull_at: float | None = None  # guarded-by: _stream_lock
         # hot-standby world assignments addressed by standby id (the
         # RPC-transported analogue of the local backend's stdin line:
         # pods cannot receive stdin, so k8s standbys poll for these)
-        self._world_assignments: dict[str, dict] = {}
-        self._standby_drain = False
+        self._world_assignments: dict[str, dict] = {}  # guarded-by: _lock
+        self._standby_drain = False  # guarded-by: _lock
         # (worker_id, model_version) observers — chaos invariant checking
         self._version_observers: list = []
         # worker-shipped RPC outcome totals (heartbeat `rpc` field,
         # rpc/stats.py): monotone per worker, summed onto /metrics.
         # Never cleared by forget_worker — an evicted worker's failures
         # happened and the exposed totals must stay monotone
-        self._worker_rpc_stats: dict[int, dict[str, int]] = {}
+        self._worker_rpc_stats: dict[int, dict[str, int]] = {}  # guarded-by: _lock
         # worker-shipped step-anatomy phase totals (heartbeat `phases`
         # field, telemetry/anatomy.py): same monotone max-merge
         # discipline, mirrored onto the elasticdl_step_phase_* families
-        self._worker_phase_stats: dict[int, dict] = {}
+        self._worker_phase_stats: dict[int, dict] = {}  # guarded-by: _lock
         # liveness-vs-progress split (/healthz): when any worker last
         # ADVANCED its step sample (heartbeat `step` / version report) —
         # a hung-but-alive job heartbeats forever but this stops moving
-        self._last_step_sample = 0
-        self._last_step_sample_at: float | None = None
+        self._last_step_sample = 0  # guarded-by: _lock
+        self._last_step_sample_at: float | None = None  # guarded-by: _lock
         # when a heartbeat last raised an outage-class RPC counter
         # (deadline_exceeded / unavailable): the /healthz
         # degraded_network flag's timestamp.  Only a rise RELATIVE TO A
@@ -82,16 +93,16 @@ class MasterServicer:
         # seeds silently, since rpc/stats.py totals are process-
         # lifetime and a restarted master would otherwise re-learn
         # hours-old failures as a fresh degradation
-        self._net_degraded_at: float | None = None
-        self._rpc_seen: set[int] = set()
+        self._net_degraded_at: float | None = None  # guarded-by: _lock
+        self._rpc_seen: set[int] = set()  # guarded-by: _lock
         # eval-metrics dedup: lease ids whose metrics were already
         # accumulated.  The is_active guard alone only covers RECLAIMED
         # leases — a duplicate delivery (lost reply + retry) arrives
         # while the lease is still active and would double-count the
         # accumulated metrics.  Lease ids are never reused, so the set
         # needs no generation reset.
-        self._eval_metrics_seen: set[int] = set()
-        self._duplicate_eval_drops = 0
+        self._eval_metrics_seen: set[int] = set()  # guarded-by: _lock
+        self._duplicate_eval_drops = 0  # guarded-by: _lock (writes)
         # telemetry event sink: ``fn(event_name, **fields)`` for quiesce
         # lifecycle records; never raises into an RPC
         self._event_sink = None
@@ -103,7 +114,7 @@ class MasterServicer:
         # advertisements feed the directory; the harvested restore stage
         # is served to the generation it was staged for
         self._replica_directory = None
-        self._restore_stage: dict | None = None
+        self._restore_stage: dict | None = None  # guarded-by: _lock
         # master high availability (master/journal.py): the journal sink
         # records generation bumps and step-stream memo resolutions; the
         # boot id identifies THIS master process so re-homing workers
@@ -297,6 +308,7 @@ class MasterServicer:
     # run makes each journal snapshot O(steps) (quadratic on disk)
     STREAM_MEMO_KEEP = 512
 
+    # lock-holding: _stream_lock
     def _memoize_stream(
         self, seq: int, resp: msg.TaskResponse, generation: int
     ):
@@ -336,6 +348,7 @@ class MasterServicer:
         with self._stream_lock:
             return self._stream_snapshot_locked()
 
+    # lock-holding: _stream_lock
     def _stream_snapshot_locked(self) -> dict:
         from dataclasses import asdict
 
@@ -469,52 +482,30 @@ class MasterServicer:
             first_contact = request.worker_id not in self._rpc_seen
             self._rpc_seen.add(request.worker_id)
             if request.rpc:
-                # worker-shipped RPC outcome totals: max-merge so a
-                # reordered beat can never walk a counter backward
-                merged = self._worker_rpc_stats.setdefault(
-                    request.worker_id, {}
+                # worker-shipped RPC outcome totals: max-merge (one
+                # shared rule, utils/merge.py) so a reordered beat can
+                # never walk a counter backward
+                rose = max_merge_counters(
+                    self._worker_rpc_stats.setdefault(
+                        request.worker_id, {}
+                    ),
+                    request.rpc,
+                    watch=_OUTAGE_CLASS_COUNTERS,
                 )
-                for key, value in request.rpc.items():
-                    try:
-                        value = int(value)
-                    except (TypeError, ValueError):
-                        continue
-                    if (
-                        not first_contact
-                        and key in ("deadline_exceeded", "unavailable")
-                        and value > merged.get(key, 0)
-                    ):
-                        # an outage-class counter moved SINCE THE LAST
-                        # beat: the link is degraded as of now (the
-                        # /healthz flag)
-                        self._net_degraded_at = now
-                    merged[key] = max(merged.get(key, 0), value)
+                if rose and not first_contact:
+                    # an outage-class counter moved SINCE THE LAST beat:
+                    # the link is degraded as of now (the /healthz flag)
+                    self._net_degraded_at = now
             if request.phases:
                 # step-anatomy phase totals: nested max-merge (ms,
                 # count, and each log bucket are all monotone per
                 # worker), summed across workers at scrape time
-                merged = self._worker_phase_stats.setdefault(
-                    request.worker_id, {}
+                max_merge_phase_stats(
+                    self._worker_phase_stats.setdefault(
+                        request.worker_id, {}
+                    ),
+                    request.phases,
                 )
-                for phase, stats in request.phases.items():
-                    if not isinstance(stats, dict):
-                        continue
-                    slot = merged.setdefault(
-                        phase, {"ms": 0.0, "count": 0, "buckets": {}}
-                    )
-                    try:
-                        slot["ms"] = max(
-                            slot["ms"], float(stats.get("ms", 0.0))
-                        )
-                        slot["count"] = max(
-                            slot["count"], int(stats.get("count", 0))
-                        )
-                        for bound, n in (stats.get("buckets") or {}).items():
-                            slot["buckets"][bound] = max(
-                                slot["buckets"].get(bound, 0), int(n)
-                            )
-                    except (TypeError, ValueError):
-                        continue
         if self._instance_manager is not None:
             self._instance_manager.on_heartbeat(request.worker_id)
         replica_peers: dict = {}

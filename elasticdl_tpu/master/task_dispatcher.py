@@ -119,23 +119,25 @@ class TaskDispatcher:
         }
         self._records_per_task = records_per_task
         self._num_epochs = num_epochs
-        self._epoch = 0
+        # GIL-atomic int: the epoch property reads unlocked (telemetry/
+        # report consumers); every write happens under the lock
+        self._epoch = 0  # guarded-by: _lock (writes)
         self._task_timeout_secs = task_timeout_secs
 
-        self._pending: list[Task] = []
-        self._pending_eval: list[Task] = []
-        self._active: dict[int, _Assignment] = {}
-        self._next_task_id = 0
-        self._next_task_uid = 0
+        self._pending: list[Task] = []  # guarded-by: _lock
+        self._pending_eval: list[Task] = []  # guarded-by: _lock
+        self._active: dict[int, _Assignment] = {}  # guarded-by: _lock
+        self._next_task_id = 0  # guarded-by: _lock
+        self._next_task_uid = 0  # guarded-by: _lock
         # lease ids whose report was PROCESSED (assignment consumed):
         # distinguishes a duplicate delivery of an already-processed
         # report (its exec counters were already summed — bank nothing)
         # from a stale reclaimed-lease report (nothing was summed — the
         # compile delta must still be banked).  One int per lease, same
         # footprint as the servicer's eval-metrics dedup set.
-        self._reported_task_ids: set[int] = set()
+        self._reported_task_ids: set[int] = set()  # guarded-by: _lock
 
-        self._counters: dict[TaskType, JobCounters] = {}
+        self._counters: dict[TaskType, JobCounters] = {}  # guarded-by: _lock
         self._done_callbacks: list[Callable[[], None]] = []
         self._evaluation_service: Any = None
         # lifecycle observers (chaos invariant checking, metrics).  May
@@ -190,6 +192,7 @@ class TaskDispatcher:
 
     # ---- task creation ----------------------------------------------------
 
+    # lock-holding: _lock — called only from create_tasks
     def _slice_shards(
         self,
         task_type: TaskType,
@@ -217,6 +220,9 @@ class TaskDispatcher:
                 )
         return tasks
 
+    # lock-holding: _lock — callers: __init__ (single-threaded
+    # construction), get() and create_evaluation_tasks (both locked);
+    # there are deliberately no other call sites
     def create_tasks(
         self,
         task_type: TaskType,
@@ -242,6 +248,7 @@ class TaskDispatcher:
 
     # ---- task leasing -----------------------------------------------------
 
+    # lock-holding: _lock
     def _lease(self, worker_id: int, task: Task) -> int:
         self._next_task_id += 1
         self._active[self._next_task_id] = _Assignment(
@@ -424,6 +431,7 @@ class TaskDispatcher:
                 "Recovered %d tasks from dead worker %d", len(ids), worker_id
             )
 
+    # lock-holding: _lock
     def _reclaim_expired_locked(self):
         """Lease-timeout reclaim (the reference's TODO at :255)."""
         if self._task_timeout_secs <= 0:
@@ -557,15 +565,24 @@ class TaskDispatcher:
     def epoch(self) -> int:
         return self._epoch
 
-    def counters(self, task_type: TaskType) -> JobCounters:
+    # lock-holding: _lock
+    def _counters_for(self, task_type: TaskType) -> JobCounters:
         return self._counters.setdefault(task_type, JobCounters())
+
+    def counters(self, task_type: TaskType) -> JobCounters:
+        """The live counters object (run-loop summaries, post-run
+        harness reads).  The lookup/create takes the dispatcher lock;
+        the returned object is shared — cross-thread readers of its
+        exec metrics use :meth:`exec_metrics_snapshot` instead."""
+        with self._lock:
+            return self._counters_for(task_type)
 
     def exec_metrics_snapshot(self, task_type: TaskType) -> dict:
         """Copy of the summed exec counters taken under the dispatcher
         lock — scrape-time readers (telemetry collect callbacks) must
         not iterate the live dict while a report mutates it."""
         with self._lock:
-            return dict(self.counters(task_type).exec_metrics)
+            return dict(self._counters_for(task_type).exec_metrics)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -601,6 +618,7 @@ class TaskDispatcher:
         with self._lock:
             sink(self._state_snapshot_locked())
 
+    # lock-holding: _lock
     def _state_snapshot_locked(self) -> dict:
         return {
             "epoch": self._epoch,

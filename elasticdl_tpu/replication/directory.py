@@ -54,11 +54,12 @@ class ReplicaDirectory:
         )
         self._lock = threading.Lock()
         # worker_id -> latest advertisement ({"addr", "process_id",
-        # "generation", "holdings"})
-        self._ads: dict[int, dict] = {}
+        # "generation", "holdings"}); written by heartbeat handler
+        # threads, read by the reform harvest
+        self._ads: dict[int, dict] = {}  # guarded-by: _lock
         # generation -> pushes observed (holdings version advances)
-        self._pushes_by_generation: dict[int, int] = {}
-        self._last_versions: dict[tuple[int, int], int] = {}
+        self._pushes_by_generation: dict[int, int] = {}  # guarded-by: _lock
+        self._last_versions: dict[tuple[int, int], int] = {}  # guarded-by: _lock
         self.harvests = 0
         self.harvest_failures = 0
 

@@ -44,9 +44,12 @@ class ReplicaStore:
     def __init__(self, generation: int = 0):
         self._generation = generation
         # source -> {version -> shard}, at most KEEP_VERSIONS newest
-        self._shards: dict[int, dict[int, ReplicaShard]] = {}
+        self._shards: dict[int, dict[int, ReplicaShard]] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
-        self.rejected = 0  # torn / stale pushes refused (observability)
+        # torn / stale pushes refused (observability); unlocked reads by
+        # report/invariant code are fine, increments take the lock —
+        # += on a shared int is load/add/store, not atomic
+        self.rejected = 0  # guarded-by: _lock (writes)
 
     @property
     def generation(self) -> int:
@@ -61,7 +64,8 @@ class ReplicaStore:
         copy must not evict a fresher shard).
         """
         if blob_checksum(shard.payload) != shard.checksum:
-            self.rejected += 1
+            with self._lock:
+                self.rejected += 1
             logger.warning(
                 "Replica shard source=%d version=%d refused: checksum "
                 "mismatch (torn transfer)",
@@ -70,7 +74,8 @@ class ReplicaStore:
             )
             return False, "checksum_mismatch"
         if shard.generation != self._generation:
-            self.rejected += 1
+            with self._lock:
+                self.rejected += 1
             return False, "generation_mismatch"
         with self._lock:
             held = self._shards.setdefault(shard.source, {})

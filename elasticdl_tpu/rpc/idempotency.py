@@ -1,0 +1,99 @@
+"""THE retry-safety registry: every RPC method, classified once.
+
+The retry contract (rpc/retry.py) is only as safe as the claim that a
+re-delivered request cannot double its effect.  That claim used to live
+in prose — a docstring list in retry.py, per-method comments in
+service.py — which is exactly how ``report_evaluation_metrics`` shipped
+non-idempotent (the PR-8 double-accumulation).  This module is the one
+machine-checked source of truth: the ``rpc-contract`` checker
+(``python -m elasticdl_tpu.analysis``) fails the build when any method
+named in a server method table or a retryable set is missing here, so a
+NEW RPC method cannot land without someone writing down WHY a duplicate
+delivery is safe (or explicitly classifying it unsafe to retry).
+
+Classification vocabulary:
+
+- ``read-only``          — no server-side effect at all;
+- ``fenced-read``        — read gated on a generation fence;
+- ``memoized``           — first call computes, re-delivery replays the
+  memo (the lockstep step stream);
+- ``monotone-merge``     — the server max-merges, so replays are
+  absorbed (heartbeat counters, version reports);
+- ``deduped``            — the server drops duplicates by a stable id
+  (task_id / lease id report dedup);
+- ``duplicate-work-bounded`` — a lost reply can orphan work the lease
+  timeout reclaims: duplicate WORK, never duplicate ACCOUNTING;
+- ``reconciling``        — the request presents state and the server
+  converges on it (the re-home handshake);
+- ``versioned-put``      — a keyed put deduplicated by (source,
+  version); replays are refused as stale;
+- ``not-retryable``      — a duplicate WOULD double its effect: the
+  method must never appear in a retryable set (the checker enforces
+  this too).
+"""
+
+from __future__ import annotations
+
+# method name -> (classification, one-line why).  Keep alphabetical.
+IDEMPOTENCY: dict[str, tuple[str, str]] = {
+    "fetch_replica": (
+        "read-only",
+        "pure read of the replica store; probe and fetch mutate nothing",
+    ),
+    "get_restore_state": (
+        "fenced-read",
+        "serves the staged payload only to its generation; re-delivery "
+        "re-serves the same bytes (the served-set release is per process "
+        "id, so a replay cannot over-release)",
+    ),
+    "get_step_task": (
+        "memoized",
+        "memoized by seq under the stream lock; every process and every "
+        "replay sees the first resolution",
+    ),
+    "get_task": (
+        "duplicate-work-bounded",
+        "a lost reply orphans a lease the timeout/re-home reconciliation "
+        "reclaims — duplicate work, never duplicate accounting",
+    ),
+    "get_world_assignment": (
+        "duplicate-work-bounded",
+        "pops the standby mailbox; a lost reply loses one assignment the "
+        "instance manager's replenish loop re-posts",
+    ),
+    "heartbeat": (
+        "monotone-merge",
+        "liveness timestamp overwrite + max-merged rpc/phase counters; "
+        "replays are absorbed",
+    ),
+    "push_replica": (
+        "versioned-put",
+        "keyed by (source, version, generation) with checksum; a replay "
+        "is refused as a duplicate version",
+    ),
+    "rehome_worker": (
+        "reconciling",
+        "presents the worker's live leases; reconcile_leases re-accepts "
+        "what is presented and requeues the rest — converges under "
+        "re-delivery",
+    ),
+    "report_evaluation_metrics": (
+        "deduped",
+        "lease-id dedup in the servicer (the PR-8 fix): a re-delivered "
+        "still-active report is dropped before accumulation",
+    ),
+    "report_task_result": (
+        "deduped",
+        "task_id dedup in the dispatcher (a re-send of a processed "
+        "report is an unknown/inactive lease; exec counters bank once)",
+    ),
+    "report_version": (
+        "monotone-merge",
+        "server takes max(version); replays are absorbed",
+    ),
+}
+
+
+def classification(method: str) -> str | None:
+    entry = IDEMPOTENCY.get(method)
+    return entry[0] if entry else None

@@ -8,10 +8,13 @@ unit-testable without a channel.
 
 Retry safety contract: only calls the SERVER deduplicates or that are
 naturally idempotent may retry — a retried non-idempotent call whose
-first attempt actually landed would double its effect.  The generic
-default (:data:`DEFAULT_IDEMPOTENT`) is the read-only subset;
-``MasterClient`` opts the full master control plane in because every
-master RPC is dedup-safe by construction:
+first attempt actually landed would double its effect.  The
+machine-checked source of truth is :mod:`elasticdl_tpu.rpc.idempotency`
+(the ``rpc-contract`` analyzer fails the build when a method in any
+retryable set is unclassified there).  The generic default
+(:data:`DEFAULT_IDEMPOTENT`) is the read-only subset; ``MasterClient``
+opts the full master control plane in because every master RPC is
+dedup-safe by construction:
 
 - ``get_step_task`` is memoized by seq; ``heartbeat`` / ``report_version``
   are monotone merges; ``get_world_assignment`` / ``get_restore_state``

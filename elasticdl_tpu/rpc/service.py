@@ -41,9 +41,10 @@ _METHODS = (
     "rehome_worker",
 )
 
-# every master control-plane method is retry-safe (see rpc/retry.py:
-# memoized, monotone, or task_id-deduplicated server side), so the
-# MasterClient opts them all in when a retry policy is installed
+# every master control-plane method is retry-safe (classified in
+# rpc/idempotency.py — the registry the rpc-contract analyzer checks
+# every method table against), so the MasterClient opts them all in
+# when a retry policy is installed
 MASTER_RETRYABLE_METHODS = frozenset(_METHODS)
 
 # grpc status codes worth backing off on: the server is down,
@@ -198,7 +199,7 @@ class RpcClient:
         resolve_addr=None,
         deadlines=None,
     ):
-        self._addr = addr
+        self._addr = addr  # guarded-by: _channel_lock
         self._methods = tuple(methods)
         self._service_name = service_name
         self._retry = retry
@@ -210,11 +211,16 @@ class RpcClient:
         self._retryable = frozenset(retryable_methods) & set(methods)
         self._resolve_addr = resolve_addr
         self._channel_lock = threading.Lock()
-        self._stale_channels: list = []
+        self._stale_channels: list = []  # guarded-by: _channel_lock
         self._connect(addr)
 
+    # lock-holding: _channel_lock — callers: __init__ (single-threaded
+    # construction) and _maybe_reresolve (under the lock); the channel
+    # and call table swap must be atomic w.r.t. _invoke's snapshot
     def _connect(self, addr: str):
+        # guarded-by: _channel_lock
         self._channel = grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
+        # guarded-by: _channel_lock
         self._calls = {
             name: self._channel.unary_unary(
                 f"/{self._service_name}/{name}",
@@ -308,14 +314,17 @@ class RpcClient:
         return msg.decode(out) if out else None
 
     def close(self):
+        # snapshot the live channel under the same lock that swaps it:
+        # a close racing a re-resolve must not read a half-swapped pair
         with self._channel_lock:
             stale, self._stale_channels = self._stale_channels, []
+            channel = self._channel
         for ch in stale:
             try:
                 ch.close()
             except Exception:  # noqa: BLE001
                 pass
-        self._channel.close()
+        channel.close()
 
 
 class MasterClient(RpcClient):

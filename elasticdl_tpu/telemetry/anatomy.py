@@ -183,7 +183,7 @@ class AnatomyRecorder:
         # cumulative (heartbeat-shipped) totals: phase -> [secs, count,
         # per-bucket counts over STEP_LATENCY_BUCKETS + Inf]
         self._lock = threading.Lock()
-        self._totals: dict[str, list] = {}
+        self._totals: dict[str, list] = {}  # guarded-by: _lock
         self.dispatches = 0
 
     # ---- per-dispatch measurement (dispatch thread only) -------------------
@@ -363,14 +363,14 @@ def uninstall():
     _active = None
 
 
-def get_recorder() -> AnatomyRecorder | None:
+def get_recorder() -> AnatomyRecorder | None:  # elastic-lint: hot-path
     """THE runtime seam: None (one global load, no clock read) unless
     anatomy was installed — the runtimes branch ONCE on this per
     dispatch path."""
     return _active
 
 
-def heartbeat_snapshot() -> dict:
+def heartbeat_snapshot() -> dict:  # elastic-lint: hot-path
     """Phase totals for the heartbeat; {} when disabled (old payloads
     decode the same, so the field is wire-compatible)."""
     recorder = _active

@@ -173,8 +173,8 @@ class SpanRecorder:
         self._sample_period = (
             1 if sample_rate >= 1.0 else (0 if sample_rate <= 0.0 else round(1.0 / sample_rate))
         )
-        self._sample_counts: dict[str, int] = {}
-        self._buffer: list[dict] = []
+        self._sample_counts: dict[str, int] = {}  # guarded-by: _lock
+        self._buffer: list[dict] = []  # guarded-by: _lock
         self._buffer_spans = max(1, buffer_spans)
         self._lock = threading.Lock()
         self._last_step_at: float | None = None
@@ -430,12 +430,12 @@ def uninstall():
     _active = None
 
 
-def get_tracer() -> SpanRecorder | None:
+def get_tracer() -> SpanRecorder | None:  # elastic-lint: hot-path
     return _active
 
 
 @contextlib.contextmanager
-def trace_span(name: str, trace_ctx: dict | None = None, **attrs):
+def trace_span(name: str, trace_ctx: dict | None = None, **attrs):  # elastic-lint: hot-path
     """Context-managed span on the installed tracer; yields None (and
     costs one global load + None check) when tracing is disabled."""
     tracer = _active
@@ -446,7 +446,7 @@ def trace_span(name: str, trace_ctx: dict | None = None, **attrs):
         yield sp
 
 
-def record_step_span(step: int):
+def record_step_span(step: int):  # elastic-lint: hot-path
     """THE hot-path hook: one global load + None check when disabled."""
     tracer = _active
     if tracer is None:
@@ -454,7 +454,7 @@ def record_step_span(step: int):
     tracer.on_step(step)
 
 
-def trace_fetches(iterable, trace_ctx: dict | None = None, span=None):
+def trace_fetches(iterable, trace_ctx: dict | None = None, span=None):  # elastic-lint: hot-path
     """Wrap a batch stream so the FIRST host-side fetch (shard open +
     decode — the serial cost a step actually waits on) becomes a
     ``data_fetch`` span, and the total fetch wall-clock is annotated on
@@ -485,7 +485,7 @@ def trace_fetches(iterable, trace_ctx: dict | None = None, span=None):
         span.set(data_fetch_secs=round(fetch_secs, 6))
 
 
-def flush():
+def flush():  # elastic-lint: hot-path
     tracer = _active
     if tracer is not None:
         tracer.flush()

@@ -118,11 +118,11 @@ def uninstall():
     _active = None
 
 
-def get_recorder() -> StepRecorder | None:
+def get_recorder() -> StepRecorder | None:  # elastic-lint: hot-path
     return _active
 
 
-def record_step(step: int, records: int = 0):
+def record_step(step: int, records: int = 0):  # elastic-lint: hot-path
     """THE hot-path hook: one global load + None check when disabled."""
     recorder = _active
     if recorder is None:
@@ -130,7 +130,7 @@ def record_step(step: int, records: int = 0):
     recorder.record_step(step, records)
 
 
-def emit_event(event: str, **fields):
+def emit_event(event: str, **fields):  # elastic-lint: hot-path
     """Process-scoped lifecycle emission (checkpoint save/restore, chaos
     fault mirror); no-op without an installed recorder."""
     recorder = _active
@@ -139,7 +139,7 @@ def emit_event(event: str, **fields):
     recorder.emit(event, **fields)
 
 
-def publish_timing(timing):
+def publish_timing(timing):  # elastic-lint: hot-path
     """Route :class:`~elasticdl_tpu.utils.timing_utils.Timing` bucket
     totals into the event log (``worker_timing`` event with
     ``time_<bucket>_ms`` fields) so the run report sees wall-clock

@@ -1,0 +1,65 @@
+"""Monotone max-merge — THE merge rule for worker-shipped totals.
+
+Workers ship process-lifetime monotone counters on the heartbeat (PR-8
+RPC outcome totals, PR-9 step-anatomy phase totals).  Beats can be
+reordered, duplicated, or replayed after a master restart, so the
+server-side merge must be ``max``, never ``sum`` or overwrite: a stale
+beat can then never walk an exposed total backward, and a duplicate is
+absorbed.  That rule used to live as two hand-rolled loops inside
+``MasterServicer.heartbeat`` — one flat, one nested — which is one more
+copy than a correctness rule should have.  This module is the single
+definition site; the unit test pins the monotonicity and
+malformed-input tolerance both call sites rely on.
+"""
+
+from __future__ import annotations
+
+
+def max_merge_counters(
+    merged: dict[str, int],
+    update: dict,
+    watch: frozenset[str] | set[str] = frozenset(),
+) -> bool:
+    """Max-merge ``update`` into ``merged`` in place.
+
+    Non-int values are skipped (wire payloads are untrusted).  Returns
+    True when any ``watch`` key ROSE above its merged value — the
+    "an outage-class counter moved since the last beat" signal the
+    /healthz degraded-network flag keys off.
+    """
+    rose = False
+    for key, value in update.items():
+        try:
+            value = int(value)
+        except (TypeError, ValueError):
+            continue
+        if key in watch and value > merged.get(key, 0):
+            rose = True
+        merged[key] = max(merged.get(key, 0), value)
+    return rose
+
+
+def max_merge_phase_stats(merged: dict[str, dict], update: dict) -> None:
+    """Max-merge step-anatomy phase totals in place.
+
+    Shape: ``{phase: {"ms": float, "count": int, "buckets": {str(bound):
+    int}}}`` — ms, count and every log bucket are each monotone per
+    worker, so each merges independently by max.  A malformed phase
+    entry is skipped whole; a malformed bucket value skips the rest of
+    that phase's entry (same tolerance the servicer always had).
+    """
+    for phase, stats in update.items():
+        if not isinstance(stats, dict):
+            continue
+        slot = merged.setdefault(
+            phase, {"ms": 0.0, "count": 0, "buckets": {}}
+        )
+        try:
+            slot["ms"] = max(slot["ms"], float(stats.get("ms", 0.0)))
+            slot["count"] = max(slot["count"], int(stats.get("count", 0)))
+            for bound, n in (stats.get("buckets") or {}).items():
+                slot["buckets"][bound] = max(
+                    slot["buckets"].get(bound, 0), int(n)
+                )
+        except (TypeError, ValueError):
+            continue

@@ -119,8 +119,13 @@ def _poll_world_assignment(
     than polling forever as an orphan; any successful poll resets the
     clock."""
     from elasticdl_tpu.rpc import messages as msg
+    from elasticdl_tpu.rpc.deadline import DeadlinePolicy
 
-    client = MasterClient(args.master_addr)
+    # the poll loop survives ANY exception, but without a deadline a
+    # blackholed master link would hang the poll itself forever — the
+    # standby then never notices the master moved (found by elastic-lint
+    # rpc-contract: every client threads the job's deadline policy)
+    client = MasterClient(args.master_addr, deadlines=DeadlinePolicy.from_env())
     failures = 0
     unreachable_since = None
     try:

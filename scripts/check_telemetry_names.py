@@ -1,252 +1,30 @@
 #!/usr/bin/env python3
-"""Telemetry naming lint (wired into scripts/run_tier1.sh).
+"""Back-compat shim: the telemetry naming lint now lives in the
+``elasticdl_tpu.analysis`` static-analysis framework (the
+``telemetry-names`` checker; the bare-print rule became part of
+``hot-path``).  This path is kept so existing callers — CI configs,
+muscle memory, older scripts — keep working; ``scripts/run_tier1.sh``
+itself now runs the full suite via ``python -m elasticdl_tpu.analysis``.
 
-Enforces the contracts docs/designs/telemetry.md relies on:
+Equivalent invocation:
 
-1. every metric name passed literally to ``.counter(`` / ``.gauge(`` /
-   ``.histogram(``, every event name passed literally to ``.emit(`` /
-   ``emit_event(``, and every span name passed literally to
-   ``.start_span(`` / ``.record_span(`` / ``trace_span(`` is snake_case;
-2. each such name has exactly ONE registration/definition site (names
-   used from several modules must live in a shared constant — e.g. the
-   ``EVENT_*`` vocabulary in ``telemetry/events.py`` and the ``SPAN_*``
-   vocabulary in ``telemetry/tracing.py`` — so the registry, the event
-   schema and the span schema each have a single source of truth);
-3. every ``EVENT_*`` constant in ``telemetry/events.py`` and every
-   ``SPAN_*`` constant in ``telemetry/tracing.py`` is snake_case and
-   defined once;
-4. no bare ``print(`` statements inside ``elasticdl_tpu/`` outside the
-   allowlisted CLI entry points — runtime output goes through the
-   logger or the telemetry spine, where it is structured and greppable.
-
-Pure stdlib + regex: runs in any environment, imports nothing from the
-package.
+    python -m elasticdl_tpu.analysis --checkers telemetry-names,hot-path
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(REPO_ROOT, "elasticdl_tpu")
-
-SNAKE_CASE = re.compile(r"^[a-z][a-z0-9_]*$")
-METRIC_CALL = re.compile(
-    r"\.(?:counter|gauge|histogram)\(\s*[\"']([^\"']+)[\"']", re.S
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
-EMIT_CALL = re.compile(r"(?:\.emit|emit_event)\(\s*[\"']([^\"']+)[\"']", re.S)
-SPAN_CALL = re.compile(
-    r"(?:\.start_span|\.record_span|trace_span)\(\s*[\"']([^\"']+)[\"']",
-    re.S,
-)
-EVENT_CONST = re.compile(r"^EVENT_\w+\s*=\s*[\"']([^\"']+)[\"']", re.M)
-SPAN_CONST = re.compile(r"^SPAN_\w+\s*=\s*[\"']([^\"']+)[\"']", re.M)
-BARE_PRINT = re.compile(r"^\s*print\(")
-
-# the replication subsystem's vocabulary (ISSUE 4), the compile span
-# shape-canonical batching relies on (ISSUE 5), and the master-HA
-# vocabulary (ISSUE 6): each name must have exactly ONE definition site
-# in the shared constants, so the event schema, the span schema and the
-# analyzers can never drift
-REQUIRED_EVENT_NAMES = frozenset(
-    {
-        "replica_push",
-        "replica_restore",
-        "replica_harvest",
-        "master_restart",
-        "journal_replay",
-        "worker_rehome",
-        # slice-granular elasticity (ISSUE 7)
-        "slice_loss",
-        "mesh_resize",
-        "autoscale_decision",
-        # network chaos (ISSUE 9): transport-level fault firings
-        "rpc_fault_injected",
-        # step anatomy (ISSUE 10): per-dispatch phase decomposition
-        "step_anatomy",
-    }
-)
-REQUIRED_SPAN_NAMES = frozenset(
-    {
-        "replica_push",
-        "replica_restore",
-        "replica_harvest",
-        "compile",
-        "master_restart",
-        "journal_replay",
-        "worker_rehome",
-        # slice-granular elasticity (ISSUE 7)
-        "slice_loss",
-        "mesh_resize",
-        "autoscale_decision",
-        # network chaos (ISSUE 9): injected link-degradation window —
-        # trace analyze's degraded_network phase reads it
-        "rpc_degraded",
-        # step anatomy (ISSUE 10): one sampled span per phase interval
-        "step_anatomy",
-    }
-)
-# the step-anatomy phase vocabulary (telemetry/anatomy.py PHASE_*
-# constants): the event fields, the metric labels, the report's goodput
-# section and the goodput smoke all key off these exact names — one
-# definition site, all six present
-REQUIRED_PHASE_NAMES = frozenset(
-    {
-        "host_fetch",
-        "assemble",
-        "h2d_transfer",
-        "device_compute",
-        "step_bookkeeping",
-        "untracked",
-    }
-)
-PHASE_CONST = re.compile(r"^PHASE_\w+\s*=\s*[\"']([^\"']+)[\"']", re.M)
-# metric families other tooling depends on (the compile-count regression
-# gate scrapes elasticdl_compile_total; the netchaos smoke requires a
-# deadline-exceeded counter; the RPC latency family is the per-method
-# handler histogram): must be registered somewhere, at exactly one site
-# (the single-site rule above)
-REQUIRED_METRIC_NAMES = frozenset(
-    {
-        "elasticdl_compile_total",
-        "elasticdl_rpc_deadline_exceeded_total",
-        "elasticdl_rpc_latency_seconds",
-        # step anatomy (ISSUE 10): per-phase totals + distribution
-        "elasticdl_step_phase_ms_total",
-        "elasticdl_step_phase_seconds",
-    }
-)
-
-# CLI entry points whose stdout IS their product (reports, dataset
-# paths); everything else logs
-PRINT_ALLOWLIST = (
-    os.path.join("elasticdl_tpu", "chaos", "runner.py"),
-    os.path.join("elasticdl_tpu", "telemetry", "report.py"),
-    os.path.join("elasticdl_tpu", "telemetry", "trace.py"),
-    os.path.join("elasticdl_tpu", "client.py"),
-    os.path.join("elasticdl_tpu", "data", "recordio", "build.py"),
-    os.path.join("elasticdl_tpu", "data", "recordio_gen") + os.sep,
-)
-
-
-def iter_sources():
-    for root, _dirs, files in os.walk(PACKAGE):
-        if "__pycache__" in root:
-            continue
-        for name in sorted(files):
-            if name.endswith(".py"):
-                path = os.path.join(root, name)
-                with open(path, encoding="utf-8") as f:
-                    yield os.path.relpath(path, REPO_ROOT), f.read()
 
 
 def main() -> int:
-    errors: list[str] = []
-    metric_sites: dict[str, list[str]] = {}
-    event_sites: dict[str, list[str]] = {}
-    span_sites: dict[str, list[str]] = {}
+    from elasticdl_tpu.analysis.__main__ import main as analysis_main
 
-    for rel, text in iter_sources():
-        # full-text scan: registration calls wrap across lines
-        for pattern, sites in (
-            (METRIC_CALL, metric_sites),
-            (EMIT_CALL, event_sites),
-            (SPAN_CALL, span_sites),
-        ):
-            for match in pattern.finditer(text):
-                lineno = text.count("\n", 0, match.start()) + 1
-                sites.setdefault(match.group(1), []).append(
-                    f"{rel}:{lineno}"
-                )
-        for lineno, line in enumerate(text.splitlines(), 1):
-            if BARE_PRINT.match(line) and not rel.startswith(
-                PRINT_ALLOWLIST
-            ):
-                errors.append(
-                    f"{rel}:{lineno}: bare print() — use the logger or "
-                    "the telemetry event log"
-                )
-
-    for kind, sites in (
-        ("metric", metric_sites),
-        ("event", event_sites),
-        ("span", span_sites),
-    ):
-        for name, where in sorted(sites.items()):
-            if not SNAKE_CASE.match(name):
-                errors.append(
-                    f"{where[0]}: {kind} name {name!r} is not snake_case"
-                )
-            if len(where) > 1:
-                errors.append(
-                    f"{kind} name {name!r} registered at {len(where)} "
-                    f"sites ({', '.join(where)}); hoist it into a shared "
-                    "constant with one definition site"
-                )
-
-    for name in sorted(REQUIRED_METRIC_NAMES - set(metric_sites)):
-        errors.append(
-            f"required metric {name!r} is not registered anywhere "
-            "(compile-count regression gate contract)"
-        )
-
-    const_counts = {}
-    for rel_path, pattern, label, required in (
-        (
-            os.path.join("telemetry", "events.py"),
-            EVENT_CONST,
-            "event",
-            REQUIRED_EVENT_NAMES,
-        ),
-        (
-            os.path.join("telemetry", "tracing.py"),
-            SPAN_CONST,
-            "span",
-            REQUIRED_SPAN_NAMES,
-        ),
-        (
-            os.path.join("telemetry", "anatomy.py"),
-            PHASE_CONST,
-            "phase",
-            REQUIRED_PHASE_NAMES,
-        ),
-    ):
-        with open(os.path.join(PACKAGE, rel_path), encoding="utf-8") as f:
-            const_values = pattern.findall(f.read())
-        const_counts[label] = len(set(const_values))
-        for value in const_values:
-            if not SNAKE_CASE.match(value):
-                errors.append(
-                    f"telemetry/{os.path.basename(rel_path)}: {label} "
-                    f"constant value {value!r} is not snake_case"
-                )
-        duplicates = {v for v in const_values if const_values.count(v) > 1}
-        for value in sorted(duplicates):
-            errors.append(
-                f"telemetry/{os.path.basename(rel_path)}: {label} name "
-                f"{value!r} defined more than once"
-            )
-        for value in sorted(required - set(const_values)):
-            errors.append(
-                f"telemetry/{os.path.basename(rel_path)}: required "
-                f"{label} name {value!r} missing from the shared "
-                "vocabulary (replication subsystem contract)"
-            )
-
-    if errors:
-        for error in errors:
-            print(f"check_telemetry_names: {error}", file=sys.stderr)
-        return 1
-    print(
-        "check_telemetry_names: OK "
-        f"({len(metric_sites)} metric names, "
-        f"{const_counts['event'] + len(event_sites)} event names, "
-        f"{const_counts['span'] + len(span_sites)} span names, "
-        f"{const_counts['phase']} phase names)"
-    )
-    return 0
+    return analysis_main(["--checkers", "telemetry-names,hot-path"])
 
 
 if __name__ == "__main__":

@@ -1,5 +1,9 @@
 #!/usr/bin/env bash
-# Tier-1 verify gate: telemetry-naming lint + trace smoke (tiny local
+# Tier-1 verify gate: elastic-lint static analysis (whole-repo contract
+# checkers: lock discipline, RPC deadlines + idempotency registry, flag
+# hygiene, hot-path hygiene, thread discipline, telemetry naming; zero
+# unwaived findings or the build fails — analysis_result.json is the
+# artifact) + trace smoke (tiny local
 # run -> trace export parses as Chrome trace JSON -> trace analyze) +
 # compile smoke (ragged-tail run -> compiles only on the first dispatch
 # of each program kind, <= 2 compile-bearing train dispatches, zero
@@ -29,7 +33,11 @@ python -m elasticdl_tpu.data.recordio.build || {
   echo "run_tier1: native recordio codec build failed — install g++ and zlib, then re-run 'python -m elasticdl_tpu.data.recordio.build'" >&2
   exit 1
 }
-python scripts/check_telemetry_names.py || exit 1
+# elastic-lint gates first: it is the cheapest check and a contract
+# violation should fail before any smoke burns its timeout.  The JSON
+# artifact lands next to the other run artifacts; the shim at
+# scripts/check_telemetry_names.py remains for external callers.
+python -m elasticdl_tpu.analysis --output analysis_result.json || exit 1
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/goodput_smoke.py || exit 1
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/trace_smoke.py || exit 1
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/netchaos_smoke.py || exit 1

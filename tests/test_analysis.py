@@ -1,0 +1,432 @@
+"""elastic-lint (elasticdl_tpu.analysis): falsification + waiver tests.
+
+Every checker must be PROVEN falsifiable: a fixture tree seeded with
+one violation per checker (tests/testdata/analysis_fixtures/) must
+yield rc 1 naming that checker, a clean fixture must yield rc 0, and a
+waiver must round-trip (matching waiver silences the finding; a stale
+or reason-less waiver is itself a finding).  The repo itself must be
+clean — the same gate scripts/run_tier1.sh enforces.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(
+    REPO_ROOT, "tests", "testdata", "analysis_fixtures"
+)
+NO_WAIVERS = os.path.join(FIXTURES, "does_not_exist.toml")
+
+
+def run_on_fixture(name: str, waivers_path: str = NO_WAIVERS):
+    from elasticdl_tpu.analysis import run_analysis
+
+    root = os.path.join(FIXTURES, name)
+    return run_analysis(paths=[root], root=root, waivers_path=waivers_path)
+
+
+def unwaived_by_checker(result: dict) -> dict[str, list[dict]]:
+    grouped: dict[str, list[dict]] = {}
+    for finding in result["findings"]:
+        if not finding["waived"]:
+            grouped.setdefault(finding["checker"], []).append(finding)
+    return grouped
+
+
+# ---- falsification: each seeded fixture trips exactly its checker ----------
+
+
+@pytest.mark.parametrize(
+    "fixture, checker, expected_symbols",
+    [
+        (
+            "lock_violation",
+            "lock-discipline",
+            # sneaky pins the escape-hatch grammar: prose mentioning
+            # "(single-threaded ...)" inside a lock-holding comment, or
+            # a lock-holding for a DIFFERENT lock, must not exempt
+            {
+                "Store.drop:_items",
+                "Store.bump:_count",
+                "Store.sneaky:_items",
+            },
+        ),
+        (
+            "rpc_violation",
+            "rpc-contract",
+            {
+                "connect:FixtureClient",
+                "_METHODS:brand_new_unclassified_call",
+                "RETRYABLE_METHODS:forbidden_call",
+            },
+        ),
+        (
+            "flag_violation",
+            "flag-hygiene",
+            {"new_feature", "leaky_master_knob", "removed_long_ago"},
+        ),
+        (
+            "hot_violation",
+            "hot-path",
+            # decorated_gate pins annotation detection on decorated defs
+            {"record_step:clock", "decorated_gate:alloc"},
+        ),
+        (
+            "thread_violation",
+            "thread-discipline",
+            {"fire_and_forget:orphan"},
+        ),
+        (
+            "telemetry_violation",
+            "telemetry-names",
+            {"metric:BadCamelName", "multisite:metric:twice_registered"},
+        ),
+    ],
+)
+def test_seeded_violation_trips_its_checker(fixture, checker, expected_symbols):
+    result = run_on_fixture(fixture)
+    assert not result["ok"]
+    grouped = unwaived_by_checker(result)
+    assert checker in grouped, grouped
+    symbols = {f["symbol"] for f in grouped[checker]}
+    assert expected_symbols <= symbols, symbols
+
+
+def test_hot_fixture_also_catches_stray_print():
+    grouped = unwaived_by_checker(run_on_fixture("hot_violation"))
+    assert any(
+        f["symbol"].startswith("print:") for f in grouped["hot-path"]
+    )
+
+
+def test_clean_fixture_passes():
+    result = run_on_fixture("clean")
+    assert result["ok"], result["findings"]
+    assert result["unwaived"] == 0
+
+
+def test_lock_fixture_clean_file_not_flagged():
+    """The lock-holding / with-lock patterns in the clean sibling file
+    produce nothing — only the seeded violations fire."""
+    grouped = unwaived_by_checker(run_on_fixture("lock_violation"))
+    assert all(
+        f["path"] == "store.py" for f in grouped["lock-discipline"]
+    )
+
+
+# ---- waivers ---------------------------------------------------------------
+
+
+def _write_waiver(tmp_path, body: str) -> str:
+    path = str(tmp_path / "waivers.toml")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(body)
+    return path
+
+
+WAIVE_ALL = """
+[[waiver]]
+checker = "lock-discipline"
+path = "store.py"
+symbol = "Store.drop:_items"
+reason = "fixture: exercised by the waiver round-trip test"
+
+[[waiver]]
+checker = "lock-discipline"
+path = "store.py"
+symbol = "Store.bump:_count"
+reason = "fixture: exercised by the waiver round-trip test"
+
+[[waiver]]
+checker = "lock-discipline"
+path = "store.py"
+symbol = "Store.sneaky:_items"
+reason = "fixture: exercised by the waiver round-trip test"
+"""
+
+
+def test_waiver_round_trip(tmp_path):
+    """A matching waiver silences the finding (rc 0), and the waived
+    findings are still carried in the result, marked."""
+    waivers = _write_waiver(tmp_path, WAIVE_ALL)
+    result = run_on_fixture("lock_violation", waivers_path=waivers)
+    assert result["ok"], result["findings"]
+    assert result["waived"] == 3
+    assert {f["symbol"] for f in result["findings"] if f["waived"]} == {
+        "Store.drop:_items",
+        "Store.bump:_count",
+        "Store.sneaky:_items",
+    }
+
+
+def test_stale_waiver_is_a_finding(tmp_path):
+    waivers = _write_waiver(
+        tmp_path,
+        WAIVE_ALL
+        + """
+[[waiver]]
+checker = "lock-discipline"
+path = "store.py"
+symbol = "Store.gone:_items"
+reason = "this finding no longer exists"
+""",
+    )
+    result = run_on_fixture("lock_violation", waivers_path=waivers)
+    assert not result["ok"]
+    grouped = unwaived_by_checker(result)
+    assert "waiver-hygiene" in grouped
+    assert any(
+        "stale waiver" in f["message"] for f in grouped["waiver-hygiene"]
+    )
+
+
+def test_waiver_without_reason_is_a_finding(tmp_path):
+    waivers = _write_waiver(
+        tmp_path,
+        """
+[[waiver]]
+checker = "lock-discipline"
+path = "store.py"
+symbol = "Store.drop:_items"
+reason = ""
+""",
+    )
+    result = run_on_fixture("lock_violation", waivers_path=waivers)
+    grouped = unwaived_by_checker(result)
+    assert any(
+        "missing required non-empty" in f["message"]
+        for f in grouped.get("waiver-hygiene", ())
+    )
+    # and the waiver does NOT apply
+    assert "lock-discipline" in grouped
+
+
+def test_unparseable_waiver_line_is_loud(tmp_path):
+    waivers = _write_waiver(
+        tmp_path, "[[waiver]]\nchecker = unquoted_value\n"
+    )
+    result = run_on_fixture("clean", waivers_path=waivers)
+    grouped = unwaived_by_checker(result)
+    assert any(
+        "unparseable" in f["message"]
+        for f in grouped.get("waiver-hygiene", ())
+    )
+
+
+# ---- the repo itself is clean (the tier-1 gate) -----------------------------
+
+
+def test_repo_has_zero_unwaived_findings():
+    from elasticdl_tpu.analysis import run_analysis
+
+    result = run_analysis()
+    unwaived = [f for f in result["findings"] if not f["waived"]]
+    assert result["ok"], "\n".join(
+        f"{f['path']}:{f['line']} [{f['checker']}] {f['symbol']}: {f['message']}"
+        for f in unwaived
+    )
+
+
+def test_every_rpc_method_is_classified():
+    """The real method tables and the real registry agree — the
+    new-method-fails-until-classified contract, pinned from the Python
+    side too (the analyzer pins it from the AST side)."""
+    from elasticdl_tpu.replication.service import REPLICA_METHODS
+    from elasticdl_tpu.rpc.deadline import STATE_TRANSFER_METHODS
+    from elasticdl_tpu.rpc.idempotency import IDEMPOTENCY
+    from elasticdl_tpu.rpc.retry import DEFAULT_IDEMPOTENT
+    from elasticdl_tpu.rpc.service import _METHODS, MASTER_RETRYABLE_METHODS
+
+    for method in (
+        set(_METHODS)
+        | set(REPLICA_METHODS)
+        | set(MASTER_RETRYABLE_METHODS)
+        | set(DEFAULT_IDEMPOTENT)
+        | set(STATE_TRANSFER_METHODS)
+    ):
+        assert method in IDEMPOTENCY, method
+        classification, why = IDEMPOTENCY[method]
+        assert classification and why
+    retryable = set(MASTER_RETRYABLE_METHODS) | set(DEFAULT_IDEMPOTENT)
+    for method in retryable:
+        assert IDEMPOTENCY[method][0] != "not-retryable", method
+
+
+# ---- CLI --------------------------------------------------------------------
+
+
+def test_cli_json_and_artifact(tmp_path):
+    artifact = str(tmp_path / "analysis_result.json")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "elasticdl_tpu.analysis",
+            "--json",
+            "--output",
+            artifact,
+            "--root",
+            os.path.join(FIXTURES, "thread_violation"),
+            "--waivers",
+            NO_WAIVERS,
+            os.path.join(FIXTURES, "thread_violation"),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    result = json.loads(proc.stdout)
+    assert result["unwaived"] == 1
+    assert result["findings"][0]["checker"] == "thread-discipline"
+    # the human rendering went to stderr, not into the JSON stream
+    assert "thread-discipline" in proc.stderr
+    with open(artifact, encoding="utf-8") as f:
+        assert json.load(f) == result
+
+
+def test_cli_checker_subset():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "elasticdl_tpu.analysis",
+            "--checkers",
+            "telemetry-names",
+            "--root",
+            os.path.join(FIXTURES, "thread_violation"),
+            "--waivers",
+            NO_WAIVERS,
+            os.path.join(FIXTURES, "thread_violation"),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+    # the thread violation is invisible to the telemetry-names checker
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_unknown_checker_fails():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "elasticdl_tpu.analysis",
+            "--checkers",
+            "no-such-checker",
+            "--waivers",
+            NO_WAIVERS,
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 1
+    assert "unknown checker" in proc.stdout + proc.stderr
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    from elasticdl_tpu.analysis import run_analysis
+
+    bad = tmp_path / "broken.py"
+    bad.write_text("def broken(:\n")
+    result = run_analysis(
+        paths=[str(tmp_path)], root=str(tmp_path), waivers_path=NO_WAIVERS
+    )
+    assert not result["ok"]
+    assert result["findings"][0]["checker"] == "parse-error"
+
+
+# ---- the shared monotone max-merge helper (ISSUE 11 satellite) --------------
+
+
+def test_max_merge_counters_monotone_and_watch():
+    from elasticdl_tpu.utils.merge import max_merge_counters
+
+    merged: dict[str, int] = {}
+    rose = max_merge_counters(
+        merged, {"retries": 3, "deadline_exceeded": 1}, watch={"deadline_exceeded"}
+    )
+    assert rose and merged == {"retries": 3, "deadline_exceeded": 1}
+    # a stale (reordered) beat can never walk a counter backward
+    rose = max_merge_counters(
+        merged, {"retries": 1, "deadline_exceeded": 1}, watch={"deadline_exceeded"}
+    )
+    assert not rose
+    assert merged == {"retries": 3, "deadline_exceeded": 1}
+    # malformed values are skipped, not fatal
+    rose = max_merge_counters(
+        merged, {"retries": "junk", "unavailable": 2}, watch={"unavailable"}
+    )
+    assert rose and merged["unavailable"] == 2 and merged["retries"] == 3
+
+
+def test_max_merge_phase_stats_nested_monotone():
+    from elasticdl_tpu.utils.merge import max_merge_phase_stats
+
+    merged: dict[str, dict] = {}
+    max_merge_phase_stats(
+        merged,
+        {
+            "device_compute": {
+                "ms": 10.0,
+                "count": 4,
+                "buckets": {"0.1": 4},
+            }
+        },
+    )
+    max_merge_phase_stats(
+        merged,
+        {
+            "device_compute": {"ms": 8.0, "count": 3, "buckets": {"0.1": 3}},
+            "h2d_transfer": {"ms": 1.5, "count": 4, "buckets": {}},
+            "garbage": "not-a-dict",
+        },
+    )
+    assert merged["device_compute"] == {
+        "ms": 10.0,
+        "count": 4,
+        "buckets": {"0.1": 4},
+    }
+    assert merged["h2d_transfer"]["ms"] == 1.5
+    assert "garbage" not in merged
+
+
+def test_servicer_heartbeat_uses_shared_merge():
+    """End-to-end pin: reordered heartbeats cannot walk the servicer's
+    exposed totals backward (the shared-rule consumers)."""
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.rpc import messages as msg
+
+    servicer = MasterServicer(
+        minibatch_size=4,
+        task_dispatcher=TaskDispatcher({"s": (0, 8)}, records_per_task=8),
+    )
+    servicer.heartbeat(
+        msg.HeartbeatRequest(worker_id=0, rpc={"retries": 5})
+    )
+    servicer.heartbeat(
+        msg.HeartbeatRequest(worker_id=0, rpc={"retries": 2})
+    )
+    assert servicer.rpc_stats_totals()["retries"] == 5
+    servicer.heartbeat(
+        msg.HeartbeatRequest(
+            worker_id=0,
+            phases={"assemble": {"ms": 7.0, "count": 2, "buckets": {}}},
+        )
+    )
+    servicer.heartbeat(
+        msg.HeartbeatRequest(
+            worker_id=0,
+            phases={"assemble": {"ms": 6.0, "count": 1, "buckets": {}}},
+        )
+    )
+    assert servicer.phase_stats_totals()["assemble"]["ms"] == 7.0

@@ -1,0 +1,21 @@
+"""A clean fixture: every checker passes here (rc 0)."""
+
+import threading
+
+
+class Quiet:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}  # guarded-by: _lock
+
+    def set(self, key, value):
+        with self._lock:
+            self._state[key] = value
+
+
+def spawn(fn):
+    threading.Thread(target=fn, daemon=True).start()
+
+
+def emit(registry):
+    registry.counter("clean_total")

@@ -1,0 +1,39 @@
+"""Seeded flag-hygiene violations in a miniature flag module."""
+
+import argparse
+
+
+def _add_train_params(parser):
+    parser.add_argument("--minibatch_size", type=int, default=64)
+    # VIOLATION (FH3): optional shared flag whose default is not None —
+    # when unset it still lands in every reconstructed worker argv
+    parser.add_argument(
+        "--new_feature", type=int, default=0, required=False
+    )
+
+
+def _add_master_params(parser):
+    parser.add_argument("--port", type=int, default=0)
+    # VIOLATION (FH1): master-group flag missing from _MASTER_ONLY_FLAGS
+    parser.add_argument("--leaky_master_knob", default="")
+
+
+_MASTER_GROUPS = (_add_train_params, _add_master_params)
+_WORKER_GROUPS = (_add_train_params,)
+
+_MASTER_ONLY_FLAGS = frozenset(
+    {
+        "port",
+        # VIOLATION (FH2): stale entry no add_argument defines
+        "removed_long_ago",
+    }
+)
+
+
+def build_arguments_from_parsed_result(args, filter_args=frozenset()):
+    argv = []
+    for key, value in sorted(vars(args).items()):
+        if key in filter_args or value is None:
+            continue
+        argv.extend([f"--{key}", str(value)])
+    return argv

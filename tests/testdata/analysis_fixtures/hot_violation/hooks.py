@@ -1,0 +1,31 @@
+"""Seeded hot-path violations: a clock read on the disabled fast path
+and a stray print outside the CLI allowlist."""
+
+import time
+
+_active = None
+
+
+def record_step(step):  # elastic-lint: hot-path
+    t0 = time.monotonic()  # VIOLATION: clock read before the gate
+    recorder = _active
+    if recorder is None:
+        return
+    recorder.record(step, t0)
+
+
+def helper():
+    print("debugging")  # VIOLATION: print outside CLI modules
+
+
+def _decorator(fn):
+    return fn
+
+
+@_decorator
+def decorated_gate():  # elastic-lint: hot-path
+    items = [1, 2, 3]  # VIOLATION: allocation on a decorated hot gate
+    recorder = _active
+    if recorder is None:
+        return None
+    return recorder.use(items)

@@ -1,0 +1,21 @@
+"""The same shape, clean: every access under the lock or documented."""
+
+import threading
+
+
+class CleanStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}  # guarded-by: _lock
+
+    def put(self, key, value):
+        with self._lock:
+            self._items[key] = value
+
+    # lock-holding: _lock
+    def _drop_locked(self, key):
+        self._items.pop(key, None)
+
+    def drop(self, key):
+        with self._lock:
+            self._drop_locked(key)

@@ -1,0 +1,33 @@
+"""Seeded lock-discipline violation: unlocked write of a guarded attr."""
+
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}  # guarded-by: _lock
+        self._count = 0  # guarded-by: _lock (writes)
+
+    def put(self, key, value):
+        with self._lock:
+            self._items[key] = value
+
+    def drop(self, key):
+        # VIOLATION: guarded write outside the lock
+        self._items.pop(key, None)
+
+    def peek_count(self):
+        return self._count  # fine: writes-only guard, GIL-atomic read
+
+    def bump(self):
+        # VIOLATION: writes-guarded attr written unlocked
+        self._count += 1
+
+    # lock-holding: _other_lock — callers: __init__ (single-threaded
+    # construction); the prose above must NOT exempt this method
+    def sneaky(self, key):
+        # VIOLATION: _items is _lock-guarded, and the lock-holding
+        # annotation names a DIFFERENT lock; the "(single-threaded)"
+        # prose inside it must not disable analysis either
+        self._items.pop(key, None)

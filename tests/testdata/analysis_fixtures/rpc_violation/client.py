@@ -1,0 +1,32 @@
+"""Seeded rpc-contract violations: a deadline-less client construction
+and a server method table naming a method the registry never classified."""
+
+
+class RpcClient:
+    def __init__(self, addr, deadlines=None):
+        self._addr = addr
+        self._deadlines = deadlines
+
+    def _call(self, name, request, timeout=None):
+        if timeout is None and self._deadlines is not None:
+            timeout = self._deadlines.deadline_for(name)
+        return None
+
+
+class FixtureClient(RpcClient):
+    pass
+
+
+_METHODS = (
+    "classified_call",
+    "brand_new_unclassified_call",  # VIOLATION: not in IDEMPOTENCY
+)
+
+
+def connect(addr):
+    # VIOLATION: no deadlines= — this client can hang forever
+    return FixtureClient(addr)
+
+
+def connect_properly(addr, policy):
+    return FixtureClient(addr, deadlines=policy)

@@ -1,0 +1,16 @@
+"""Miniature retry-safety registry for the fixture tree."""
+
+IDEMPOTENCY = {
+    "classified_call": ("read-only", "fixture: no server-side effect"),
+    "forbidden_call": ("not-retryable", "fixture: duplicates double"),
+}
+
+# deliberately the computed frozenset(<name>) shape the real repo uses
+# (MASTER_RETRYABLE_METHODS = frozenset(_METHODS)): the checker must
+# resolve the reference, or the not-retryable rule goes vacuous exactly
+# where the master's retryable set lives
+_ALL_CALLS = (
+    "classified_call",
+    "forbidden_call",  # VIOLATION: not-retryable in a retryable set
+)
+RETRYABLE_METHODS = frozenset(_ALL_CALLS)

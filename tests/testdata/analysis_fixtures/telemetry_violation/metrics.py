@@ -1,0 +1,10 @@
+"""Seeded telemetry-names violations: non-snake-case + two-site name."""
+
+
+def register(registry):
+    registry.counter("BadCamelName")  # VIOLATION: not snake_case
+    registry.counter("twice_registered")
+
+
+def register_again(registry):
+    registry.counter("twice_registered")  # VIOLATION: second site

@@ -1,0 +1,21 @@
+"""Seeded thread-discipline violation: non-daemon, never-joined thread."""
+
+import threading
+
+
+def fire_and_forget(fn):
+    # VIOLATION: not daemon, never joined — hangs interpreter exit
+    orphan = threading.Thread(target=fn)
+    orphan.start()
+    return orphan
+
+
+def joined(fn):
+    worker = threading.Thread(target=fn)
+    worker.start()
+    worker.join()
+    return worker
+
+
+def daemonized(fn):
+    threading.Thread(target=fn, daemon=True).start()
