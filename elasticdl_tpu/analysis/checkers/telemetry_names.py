@@ -92,6 +92,9 @@ REQUIRED_METRIC_NAMES = frozenset(
         "elasticdl_rpc_latency_seconds",
         "elasticdl_step_phase_ms_total",
         "elasticdl_step_phase_seconds",
+        "elasticdl_device_prefetch_groups_total",
+        "elasticdl_device_prefetch_stall_ms_total",
+        "elasticdl_device_prefetch_stage_ms_total",
     }
 )
 

@@ -59,6 +59,15 @@ def build_master(args) -> Master:
             from elasticdl_tpu.telemetry.anatomy import STEP_ANATOMY_ENV
 
             envs.setdefault(STEP_ANATOMY_ENV, "1")
+        if getattr(args, "device_prefetch", None):
+            # device-path pipelining: same env-forwarding contract —
+            # and because it changes the compiled step program (batch
+            # donation), the env keeps the whole world uniform
+            from elasticdl_tpu.trainer.device_pipeline import (
+                DEVICE_PREFETCH_ENV,
+            )
+
+            envs.setdefault(DEVICE_PREFETCH_ENV, "1")
         journal_dir = getattr(args, "master_journal_dir", None) or ""
         retry_secs = getattr(args, "rpc_retry_secs", None)
         if journal_dir:

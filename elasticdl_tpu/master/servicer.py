@@ -81,6 +81,7 @@ class MasterServicer:
         # field, telemetry/anatomy.py): same monotone max-merge
         # discipline, mirrored onto the elasticdl_step_phase_* families
         self._worker_phase_stats: dict[int, dict] = {}  # guarded-by: _lock
+        self._worker_prefetch_stats: dict[int, dict] = {}  # guarded-by: _lock
         # liveness-vs-progress split (/healthz): when any worker last
         # ADVANCED its step sample (heartbeat `step` / version report) —
         # a hung-but-alive job heartbeats forever but this stops moving
@@ -506,6 +507,15 @@ class MasterServicer:
                     ),
                     request.phases,
                 )
+            if request.prefetch:
+                # device-prefetch staging totals: the same monotone
+                # max-merge rule as the RPC outcome counters
+                max_merge_counters(
+                    self._worker_prefetch_stats.setdefault(
+                        request.worker_id, {}
+                    ),
+                    request.prefetch,
+                )
         if self._instance_manager is not None:
             self._instance_manager.on_heartbeat(request.worker_id)
         replica_peers: dict = {}
@@ -714,6 +724,18 @@ class MasterServicer:
         with self._lock:
             totals: dict[str, int] = {}
             for stats in self._worker_rpc_stats.values():
+                for key, value in stats.items():
+                    totals[key] = totals.get(key, 0) + value
+            return totals
+
+    def prefetch_stats_totals(self) -> dict[str, int]:
+        """Fleet-wide device-prefetch staging totals (groups staged,
+        consumer stall ms, overlapped staging ms): per-worker monotone
+        maxima summed across workers — what /metrics mirrors onto the
+        ``elasticdl_device_prefetch_*`` counters."""
+        with self._lock:
+            totals: dict[str, int] = {}
+            for stats in self._worker_prefetch_stats.values():
                 for key, value in stats.items():
                     totals[key] = totals.get(key, 0) + value
             return totals

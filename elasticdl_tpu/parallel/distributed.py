@@ -58,11 +58,20 @@ class SPMDTrainer:
         rng_seed: int = 0,
         embedding_threshold: int | None = EMBEDDING_AUTO_DISTRIBUTE_BYTES,
         device_parse: Callable | None = None,
+        donate_batch: bool = False,
     ):
         """``embedding_threshold``: tables bigger than this many bytes are
         auto-distributed over the mesh (the reference's 2MB model-handler
         policy); pass ``None`` when a ModelHandler supplies the rules
-        explicitly, so the policy has exactly one owner."""
+        explicitly, so the policy has exactly one owner.
+
+        ``donate_batch`` (``--device_prefetch``): batch/mask buffers are
+        donated to the train-step dispatch alongside the state — a
+        placed batch is consumed by its dispatch and must never be
+        re-read (the device-pipeline staging layer enforces single-take
+        ownership).  Lockstep worlds must agree on this setting: it is
+        part of the compiled program, and the enabling env is
+        master-forwarded so they always do."""
         self.mesh = mesh
 
         sample_features = _host_slice_for_init(sample_features)
@@ -116,6 +125,7 @@ class SPMDTrainer:
 
         # the SAME builders LocalExecutor uses (trainer/step.py) — the only
         # SPMD addition is pinning the updated state to the mesh layout
+        self._donate_batch = bool(donate_batch)
         self._train_step = build_train_step(
             loss_fn,
             compute_dtype=compute_dtype,
@@ -123,6 +133,7 @@ class SPMDTrainer:
             donate=donate,
             state_shardings=self.state_shardings,
             device_parse=device_parse,
+            donate_batch=self._donate_batch,
         )
         self._eval_step = build_eval_step(loss_fn, device_parse=device_parse)
         self._predict_step = build_predict_step(device_parse=device_parse)
@@ -302,10 +313,15 @@ class SPMDTrainer:
             # pin the updated state to the mesh layout exactly like
             # build_train_step does — without it the scan output's
             # sharding can drift from state_shardings and multi-process
-            # host reads (checkpoint, dump) fail on the re-laid-out tree
+            # host reads (checkpoint, dump) fail on the re-laid-out tree.
+            # donate_batch extends donation to the stacked (k, rows, ...)
+            # batch/weight inputs: dead after the scan, their memory is
+            # reused for outputs (zero steady-state h2d allocations)
             scan_fn = jax.jit(
                 scan_steps,
-                donate_argnums=(0,),
+                donate_argnums=(0, 1, 2, 3)
+                if self._donate_batch
+                else (0,),
                 out_shardings=(self.state_shardings, None),
             )
             self._stacked_scan_cache[key] = scan_fn

@@ -135,6 +135,12 @@ class HeartbeatRequest:
     # elasticdl_step_phase_* metric families.  Empty when --step_anatomy
     # is off; old payloads decode to {} so the field is wire-compatible
     phases: dict = field(default_factory=dict)
+    # device-prefetch staging totals (trainer/device_pipeline.py):
+    # monotone {groups, stall_ms, stage_ms} the master mirrors onto the
+    # elasticdl_device_prefetch_* counters.  Empty when
+    # --device_prefetch is off; old payloads decode to {} so the field
+    # is wire-compatible
+    prefetch: dict = field(default_factory=dict)
 
 
 @dataclass

@@ -11,7 +11,14 @@ phases measured on the dispatching thread:
   the consumer thread blocked in ``next()``; with a healthy prefetcher
   this is residual stall, not raw decode cost);
 - ``assemble``      — pad/stack to the canonical shape (host numpy);
-- ``h2d_transfer``  — ``device_put`` / sharded placement of the batch;
+- ``h2d_transfer``  — ``device_put`` / sharded placement of the batch.
+  With ``--device_prefetch`` (trainer/device_pipeline.py) assembly and
+  placement run on a staging thread while the previous group computes,
+  so the CONSUMER-VISIBLE ``h2d_transfer`` becomes the wait for a
+  staged group — the residual stall after overlap, whatever its
+  upstream cause — and ``host_fetch``/``assemble`` go to ~0 on the
+  dispatching thread.  That is the honest consumer view: the goodput
+  smoke gates that this share DROPS when the prefetcher is on;
 - ``device_compute``— jitted dispatch to ready: the *enqueue* segment
   (the async dispatch call returning) and the *ready-wait* segment
   (``block_until_ready`` on the dispatch's outputs) are recorded
@@ -55,7 +62,11 @@ no wrapper allocation (tests poison the clock to prove it).  With the
 recorder on, each dispatch additionally blocks on its outputs
 (``block_until_ready``), trading a little async-dispatch pipelining for
 exact attribution — the documented cost of measuring (see
-docs/designs/step_anatomy.md).
+docs/designs/step_anatomy.md).  ``--device_prefetch``'s retire-behind
+window likewise collapses to 1 under anatomy
+(``device_pipeline.stage_depth``): the ``enqueue``/``ready_wait``
+split stays sum-exact because every phase interval still lives inside
+its own group's dispatch window.
 """
 
 from __future__ import annotations
@@ -93,6 +104,24 @@ ALL_PHASES = TRACKED_PHASES + (PHASE_UNTRACKED,)
 # phases: they SUM to device_compute, they don't add to it)
 SUB_ENQUEUE = "enqueue"
 SUB_READY_WAIT = "ready_wait"
+
+
+def timed_device_dispatch(recorder, dispatch):
+    """THE instrumented device dispatch: run ``dispatch()`` with its
+    wall attributed to ``device_compute`` as the ``enqueue`` sub-segment
+    (the async dispatch call returning) and then block on its outputs
+    as ``ready_wait``.  One definition site for the sub-segment split —
+    every runtime's anatomy branch (serial flush, device-pipeline
+    dispatch, task-stream staged/anatomized steps) calls this, so the
+    sum-exactness contract (enqueue + ready_wait == device_compute)
+    cannot drift between call sites.  Returns the dispatch outputs."""
+    import jax
+
+    with recorder.phase(PHASE_DEVICE_COMPUTE, sub=SUB_ENQUEUE):
+        out = dispatch()
+    with recorder.phase(PHASE_DEVICE_COMPUTE, sub=SUB_READY_WAIT):
+        jax.block_until_ready(out)
+    return out
 
 # ---- model-FLOPs table (goodput MFU) ----------------------------------------
 #

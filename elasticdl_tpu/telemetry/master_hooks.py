@@ -283,6 +283,28 @@ class MasterTelemetry:
                     agg.get("ms", 0.0) / 1000.0,
                     agg.get("count", 0),
                 )
+            # device-prefetch staging totals (heartbeat-shipped,
+            # trainer/device_pipeline.py): the one registration site of
+            # the elasticdl_device_prefetch_* counters
+            prefetch_totals = getattr(
+                self._servicer, "prefetch_stats_totals", lambda: {}
+            )()
+            if prefetch_totals:
+                self.registry.counter(
+                    "elasticdl_device_prefetch_groups_total",
+                    "Dispatch groups staged onto device by the "
+                    "prefetch thread",
+                ).set_total(prefetch_totals.get("groups", 0))
+                self.registry.counter(
+                    "elasticdl_device_prefetch_stall_ms_total",
+                    "Consumer-visible wait for a staged group (the "
+                    "residual h2d stall after overlap)",
+                ).set_total(prefetch_totals.get("stall_ms", 0))
+                self.registry.counter(
+                    "elasticdl_device_prefetch_stage_ms_total",
+                    "Background staging time overlapped with device "
+                    "compute",
+                ).set_total(prefetch_totals.get("stage_ms", 0))
 
     def build_health_fn(self, job_type: str, instance_manager_fn=lambda: None):
         """The ``/healthz`` payload closure (also used directly by

@@ -1,0 +1,702 @@
+"""Device-path pipelining: double-buffered h2d staging, batch-buffer
+donation and async retire-behind dispatch.
+
+BENCH_r04 measured every e2e config binding on ``device_path`` with
+``vs_step_only`` ~0.1: the jitted step standalone is ~10x faster than
+the end-to-end record flow, and PR 9's anatomy says the gap is
+host-side serialization — every dispatch group's batch is padded,
+stacked and placed on device ON the dispatching thread, between
+dispatches.  This module closes that gap for the canonical-shape path
+(shapes are pure functions of config since PR 5, so staging buffers
+never change shape):
+
+- **Staging** (:class:`DeviceStager`): a daemon thread pulls host
+  batches from the upstream stream (the ``TaskPrefetcher`` host queue,
+  so decode -> stage -> compute form a three-deep pipeline), assembles
+  them to the canonical dispatch shape and places them on device while
+  the CURRENT group computes.  The queue is bounded (double buffering:
+  one group being consumed, one staged, one in assembly) so device
+  memory stays bounded.
+- **Donation**: the runtimes construct their ``SPMDTrainer`` with
+  ``donate_batch=True`` when the feature is on, extending ``jax.jit``
+  donation from state-only to the batch/mask buffers — XLA reuses the
+  staged input buffers for outputs, so steady-state dispatches do zero
+  fresh h2d allocations.  A donated buffer is dead after its dispatch;
+  :class:`StagedGroup` enforces single ``take()`` ownership so a
+  read-after-retire is caught at the staging layer too (and JAX itself
+  raises on a deleted Array — both are pinned by falsification tests).
+- **Retire-behind** (:func:`run_pipelined_steps`): dispatch outputs are
+  retired one group behind inside a bounded in-flight window
+  (:data:`RETIRE_WINDOW`), so XLA async dispatch actually overlaps; the
+  full barrier is retained at task boundaries (the function drains
+  before returning, so a task is only ever reported after every one of
+  its groups retired), and ``--step_anatomy`` collapses the window to 1
+  (:func:`stage_depth`) because exact per-group walls need the
+  per-group block — the documented cost of measuring.
+
+Enablement: the master's ``--device_prefetch`` flag, env-forwarded to
+workers as ``ELASTICDL_TPU_DEVICE_PREFETCH`` (never argv — worker
+command lines stay byte-identical with the feature off).  Disabled
+cost: the runtimes resolve the flag ONCE at build time and
+``run_stacked_steps`` takes one boolean branch per call — no thread, no
+queue, no clock reads (the annotated gates below are machine-checked by
+elastic-lint's hot-path checker).
+
+Lockstep safety: staging changes WHEN placement happens, never what is
+dispatched — dispatch order, shapes and programs remain pure functions
+of (task data, k, canonical rows), identical on every process.  The
+enabling env is master-forwarded, so a world can never mix donated and
+undonated step programs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import queue
+import threading
+import time
+from collections import deque
+from typing import Callable, Iterable
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.trainer.stacking import (
+    PreStacked,
+    assemble_canonical_group,
+    prestacked_weights,
+    resolve_steps_per_dispatch,
+)
+
+DEVICE_PREFETCH_ENV = "ELASTICDL_TPU_DEVICE_PREFETCH"
+
+# bounded in-flight dispatch window: how many dispatched groups may be
+# un-retired before the consumer blocks on the oldest.  2 = the classic
+# one-behind pipeline (group N computes while group N+1 enqueues).
+RETIRE_WINDOW = 2
+# staging queue depth: 1 = double buffering (one staged group ready
+# while the consumer's current group dispatches; the stager may be
+# assembling a third).
+STAGE_DEPTH = 1
+
+_STAGE_KIND_GROUP = "group"
+_STAGE_KIND_ERROR = "error"
+_STAGE_KIND_DONE = "done"
+
+
+# ---- flag resolution (shared by all three runtimes) -------------------------
+
+
+# explicit spellings the env accepts — the env must parse like the
+# flag's parse_bool, not truthy-string: "0"/"false" silently ENABLING
+# the feature on some hosts would build the mixed donated/undonated
+# world the uniformity contract forbids, and an unrecognized spelling
+# (typo) must fail SAFE (off, with an error log), never silently on
+_FALSEY_ENV = frozenset({"", "0", "false", "no", "off"})
+_TRUTHY_ENV = frozenset({"1", "true", "yes", "on"})
+
+
+def resolve_device_prefetch(flag=None) -> bool:
+    """THE enablement rule: the master's ``--device_prefetch`` flag when
+    set, else the master-forwarded env (workers never see the flag in
+    argv; parse_bool spellings — ``1``/``true``/``yes``/``on`` on,
+    ``0``/``false``/``no``/``off``/unset off, anything else logs an
+    ERROR and stays off).  Resolved once per runtime at build time."""
+    if flag is not None:
+        return bool(flag)
+    raw = os.environ.get(DEVICE_PREFETCH_ENV, "").strip().lower()
+    if raw in _TRUTHY_ENV:
+        return True
+    if raw not in _FALSEY_ENV:
+        from elasticdl_tpu.utils.log_utils import default_logger
+
+        default_logger.error(
+            "Unrecognized %s=%r; device prefetch stays OFF (use "
+            "1/true/yes/on or 0/false/no/off)",
+            DEVICE_PREFETCH_ENV,
+            raw,
+        )
+    return False
+
+
+def resolve_donate_state(args) -> bool:
+    """THE ``--donate_state`` resolution — one definition site for what
+    was copied verbatim into all three runtimes (local_executor, worker,
+    lockstep).  Default True: the state buffers are always dead after
+    the optimizer update."""
+    return bool(getattr(args, "donate_state", True))
+
+
+def stage_depth(anatomy) -> int:  # elastic-lint: hot-path
+    """The retire window for a dispatch loop: ``RETIRE_WINDOW`` groups
+    in flight normally; 1 (retire every group before the next dispatch)
+    under ``--step_anatomy``, whose ``enqueue``/``ready_wait`` split
+    needs exact per-group walls — the barrier the design doc documents
+    as the cost of measuring."""
+    if anatomy is None:
+        return RETIRE_WINDOW
+    return 1
+
+
+# ---- heartbeat-shipped staging totals ---------------------------------------
+
+_TOTALS_LOCK = threading.Lock()
+# monotone process-lifetime totals; ms accumulate as floats here and
+# ship as ints (the wire merge is utils.merge.max_merge_counters,
+# integer-only — truncating per-event sub-ms samples would lose them)
+_TOTALS = {"groups": 0, "stall_ms": 0.0, "stage_ms": 0.0}
+_active = False
+
+
+def _note_staged(stage_secs: float):
+    global _active
+    with _TOTALS_LOCK:
+        _active = True
+        _TOTALS["groups"] += 1
+        _TOTALS["stage_ms"] += stage_secs * 1000.0
+
+
+def _note_stall(stall_secs: float):
+    global _active
+    with _TOTALS_LOCK:
+        _active = True
+        _TOTALS["stall_ms"] += stall_secs * 1000.0
+
+
+def heartbeat_snapshot() -> dict:  # elastic-lint: hot-path
+    """Monotone staging totals for ``HeartbeatRequest.prefetch``; ``{}``
+    when no stager ever ran in this process (the off state costs one
+    global load, like the anatomy snapshot)."""
+    if not _active:
+        return {}
+    with _TOTALS_LOCK:
+        return {
+            "groups": int(_TOTALS["groups"]),
+            "stall_ms": int(_TOTALS["stall_ms"]),
+            "stage_ms": int(_TOTALS["stage_ms"]),
+        }
+
+
+def _reset_totals_for_tests():
+    global _active
+    with _TOTALS_LOCK:
+        _active = False
+        for key in _TOTALS:
+            _TOTALS[key] = 0
+
+
+# ---- staged groups ----------------------------------------------------------
+
+
+class RetiredBufferError(RuntimeError):
+    """A staged group's device buffers were taken twice.
+
+    With ``donate_batch`` the buffers are DONATED to the first dispatch
+    — XLA reuses their memory for outputs — so a second consumer would
+    read garbage (or trip JAX's deleted-Array check).  Single ``take()``
+    ownership turns that read-after-retire into a loud, immediate
+    error at the staging layer."""
+
+
+class StagedGroup:
+    """One dispatch group, already assembled and device-resident.
+
+    ``kind``: ``KIND_STACKED`` — ``placed`` is the ``(features, labels,
+    weights)`` stacked ``(k, rows, ...)`` tuple for one
+    ``train_steps_stacked`` dispatch; ``KIND_SINGLES`` — ``placed`` is a
+    list of per-batch ``(features, labels, mask)`` tuples (a trailing
+    partial group, dispatched through the single-step program).
+
+    ``hook_features``: one host features ref per STEP, for the
+    consumer's ``pre_batch`` hook cadence.  ``host``: the original host
+    item(s), kept so a failed dispatch can retry from host memory after
+    the staged buffers were donated.
+
+    ``error``: staging itself (assemble or placement) failed — no
+    placed buffers exist, but ``host`` still carries the group, so the
+    task-stream worker can fall back to its serial per-minibatch
+    retry/containment path instead of losing the error policy the
+    serial loop had (the grouped runtimes re-raise, which is exactly
+    what their serial path would have done)."""
+
+    KIND_STACKED = "stacked"
+    KIND_SINGLES = "singles"
+
+    __slots__ = (
+        "kind",
+        "steps",
+        "records",
+        "hook_features",
+        "host",
+        "error",
+        "_placed",
+    )
+
+    def __init__(
+        self, kind, placed, steps, records, hook_features, host=None,
+        error=None,
+    ):
+        self.kind = kind
+        self.steps = int(steps)
+        self.records = int(records)
+        self.hook_features = hook_features
+        self.host = host
+        self.error = error
+        self._placed = placed
+
+    def take(self):
+        """Transfer ownership of the placed buffers to the caller —
+        exactly once.  The dispatch donates them; a second take is a
+        read-after-retire and raises :class:`RetiredBufferError`."""
+        if self._placed is None:
+            raise RetiredBufferError(
+                "staged dispatch group already taken: its device buffers "
+                "were donated to the dispatch and no longer exist"
+            )
+        placed, self._placed = self._placed, None
+        return placed
+
+
+def _batch_rows(tree) -> int:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return int(np.shape(leaves[0])[0]) if leaves else 0
+
+
+def _assemble_prestacked(item: PreStacked):
+    """A ready-made ``(k, B, ...)`` group with its all-ones scan-shape
+    weights (``stacking.prestacked_weights`` — the shared policy)."""
+    return (item.features, item.labels, prestacked_weights(item))
+
+
+def _place_assembled(trainer, kind, assembled):
+    if kind == StagedGroup.KIND_STACKED:
+        feats, labels, weights = assembled
+        return (
+            trainer.place_stacked(feats),
+            trainer.place_stacked(labels),
+            trainer.place_stacked(weights),
+        )
+    return [
+        (
+            trainer.place_batch(f),
+            trainer.place_batch(l),
+            trainer.place_batch(m),
+        )
+        for f, l, m in assembled
+    ]
+
+
+# ---- the staging thread -----------------------------------------------------
+
+
+class DeviceStager:
+    """Background host->device staging for a canonical-shape batch
+    stream.
+
+    A daemon thread walks ``batches`` (plain ``(features, labels)``
+    pairs and/or :class:`~elasticdl_tpu.trainer.stacking.PreStacked`
+    groups), forms dispatch groups of ``k`` under the shared grouping
+    policy, assembles and PLACES them on device, and hands
+    :class:`StagedGroup` objects to the consumer through a bounded
+    queue (:data:`STAGE_DEPTH`) — so the h2d transfer of group N+1
+    overlaps the device compute of group N.  Groups arrive in exact
+    stream order (single producer, FIFO queue); a producer-side error
+    is re-raised by :meth:`next_staged` at its position in the stream.
+
+    Placement from a non-dispatch thread is safe: ``device_put`` /
+    ``make_array_from_callback`` are process-local (no collectives), and
+    the trainer's placement caches are pure memoizations (a benign
+    double-compute under the GIL).  The lockstep dispatch ORDER stays on
+    the consumer thread, untouched.
+    """
+
+    def __init__(
+        self,
+        get_trainer: Callable,
+        batches: Iterable,
+        k,
+        canonical_rows: int,
+        deterministic_auto: bool = False,
+        depth: int = STAGE_DEPTH,
+    ):
+        self._get_trainer = get_trainer
+        self._batches = batches
+        self._k = k
+        self._rows = int(canonical_rows)
+        self._deterministic_auto = deterministic_auto
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._done = False
+        self._thread = threading.Thread(
+            target=self._produce, name="device-stage", daemon=True
+        )
+        self._thread.start()
+
+    # ---- producer ----------------------------------------------------------
+
+    def _put(self, item) -> bool:
+        """Bounded put that aborts when the consumer closed us (the
+        queue bound is the device-memory bound: at most ``depth`` staged
+        groups wait while one more is in assembly)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _stage(self, trainer, assemble, steps, records, hooks, host):
+        """Assemble + place one group; a STAGING failure (bad batch
+        shape, transient placement error) degrades to an error-carrying
+        group instead of poisoning the stream — upstream ITERATOR
+        errors (decode) keep the crash contract via ``_produce``'s
+        outer handler."""
+        t0 = time.monotonic()
+        try:
+            kind, assembled = assemble()
+            placed = _place_assembled(trainer, kind, assembled)
+        except Exception as e:  # noqa: BLE001 — consumer decides policy
+            staged = StagedGroup(
+                StagedGroup.KIND_SINGLES,
+                None,
+                steps=steps,
+                records=records,
+                hook_features=hooks,
+                host=host,
+                error=e,
+            )
+            return self._put((_STAGE_KIND_GROUP, staged))
+        staged = StagedGroup(
+            kind,
+            placed,
+            steps=steps,
+            records=records,
+            hook_features=hooks,
+            host=host,
+        )
+        _note_staged(time.monotonic() - t0)
+        return self._put((_STAGE_KIND_GROUP, staged))
+
+    def _stage_plain(self, trainer, group) -> bool:
+        return self._stage(
+            trainer,
+            lambda: assemble_canonical_group(
+                trainer, group, self._k, self._rows
+            ),
+            steps=len(group),
+            records=sum(n for _f, _l, n in group),
+            hooks=[f for f, _l, _n in group],
+            host=list(group),
+        )
+
+    def _stage_prestacked(self, trainer, item: PreStacked) -> bool:
+        return self._stage(
+            trainer,
+            lambda: (
+                StagedGroup.KIND_STACKED,
+                _assemble_prestacked(item),
+            ),
+            steps=item.num_steps,
+            records=item.num_records,
+            hooks=[item.sample_features] * item.num_steps,
+            host=item,
+        )
+
+    def _produce(self):
+        group: list = []
+        try:
+            trainer = self._get_trainer()
+            for item in self._batches:
+                if self._stop.is_set():
+                    return
+                if isinstance(item, PreStacked):
+                    # ready-made group: flush pending plain batches first
+                    # (stream order is the contract)
+                    if group:
+                        if not self._stage_plain(trainer, group):
+                            return
+                        group = []
+                    if not self._stage_prestacked(trainer, item):
+                        return
+                    continue
+                features, labels = item
+                if self._k == "auto":
+                    self._k = resolve_steps_per_dispatch(
+                        self._k,
+                        (features, labels),
+                        deterministic=self._deterministic_auto,
+                    )
+                group.append((features, labels, _batch_rows(labels)))
+                if len(group) == self._k:
+                    if not self._stage_plain(trainer, group):
+                        return
+                    group = []
+            if group and not self._stage_plain(trainer, group):
+                return
+        except BaseException as e:  # noqa: BLE001 — re-raised by consumer
+            self._put((_STAGE_KIND_ERROR, e))
+            return
+        self._put((_STAGE_KIND_DONE, None))
+
+    # ---- consumer ----------------------------------------------------------
+
+    def next_staged(self, anatomy=None) -> StagedGroup | None:
+        """The next :class:`StagedGroup` in stream order, or None at end
+        of stream; a producer-side error (decode failure, placement
+        failure) is re-raised here, at its position in the stream.
+
+        The blocking wait is the CONSUMER-VISIBLE h2d cost — everything
+        the stager overlapped is gone from this thread's critical path —
+        so under ``--step_anatomy`` it is attributed to the
+        ``h2d_transfer`` phase (whose share dropping vs prefetch-off is
+        the goodput smoke's gate)."""
+        if self._done:
+            return None
+        if anatomy is None:
+            t0 = time.monotonic()
+            kind, payload = self._q.get()
+            _note_stall(time.monotonic() - t0)
+        else:
+            from elasticdl_tpu.telemetry.anatomy import PHASE_H2D_TRANSFER
+
+            with anatomy.phase(PHASE_H2D_TRANSFER):
+                t0 = time.monotonic()
+                kind, payload = self._q.get()
+                _note_stall(time.monotonic() - t0)
+        if kind == _STAGE_KIND_DONE:
+            self._done = True
+            return None
+        if kind == _STAGE_KIND_ERROR:
+            self._done = True
+            raise payload
+        return payload
+
+    def __iter__(self):
+        while True:
+            staged = self.next_staged()
+            if staged is None:
+                return
+            yield staged
+
+    def close(self):
+        """Stop the producer and release it if blocked on a full
+        queue."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+
+# ---- the pipelined dispatch loop --------------------------------------------
+
+
+def run_pipelined_steps(
+    get_trainer: Callable,
+    batches: Iterable,
+    k,
+    pre_batch: Callable | None = None,
+    post_group: Callable | None = None,
+    dispatch_ctx: Callable | None = None,
+    deterministic_auto: bool = False,
+    canonical_rows: int | None = None,
+    anatomy=None,
+) -> int:
+    """The ``--device_prefetch`` body of
+    :func:`~elasticdl_tpu.trainer.stacking.run_stacked_steps`
+    (canonical-shape mode only — staging requires shapes that are pure
+    functions of config).  Same grouping policy, same hook cadence
+    (``pre_batch`` once per step before its group dispatches — the
+    PreStacked precedent — ``post_group`` after every dispatch), same
+    accounting; what changes is the execution discipline:
+
+    - the FIRST group runs on the serial path (its ``pre_batch`` lazily
+      creates the trainer the stager needs for placement), then a
+      :class:`DeviceStager` stages every later group off-thread;
+    - dispatch outputs retire one group behind in a window of
+      :func:`stage_depth` (2 normally; 1 — the per-group barrier —
+      under ``--step_anatomy``), and the function DRAINS before
+      returning, so the caller's task report never covers an un-retired
+      group (exactly-once holds across the async window).
+    """
+    from elasticdl_tpu.telemetry.anatomy import (
+        PHASE_ASSEMBLE,
+        PHASE_H2D_TRANSFER,
+        PHASE_HOST_FETCH,
+        timed_device_dispatch,
+    )
+
+    ctx = dispatch_ctx or contextlib.nullcontext
+    rows = int(canonical_rows)
+    depth = stage_depth(anatomy)
+    if anatomy is not None:
+        pre_batch = anatomy.wrapped_hook(pre_batch)
+        post_group = anatomy.wrapped_hook(post_group)
+    processed = 0
+    inflight: deque = deque()
+
+    def _retire_push(out):
+        # async retire-behind: keep at most `depth` dispatched groups
+        # un-retired; blocking on the OLDEST keeps the device queue
+        # bounded while group N+1's enqueue overlaps group N's compute
+        inflight.append(out)
+        if len(inflight) > depth:
+            jax.block_until_ready(inflight.popleft())
+
+    def _dispatch_stacked(trainer, placed):
+        if anatomy is None:
+            with ctx():
+                out = trainer.train_steps_stacked(*placed)
+            _retire_push(out)
+            return
+        with ctx():
+            timed_device_dispatch(
+                anatomy, lambda: trainer.train_steps_stacked(*placed)
+            )
+
+    def _dispatch_singles(trainer, placed_list):
+        for placed in placed_list:
+            if anatomy is None:
+                with ctx():
+                    out = trainer.train_step(*placed)
+                _retire_push(out)
+            else:
+                with ctx():
+                    timed_device_dispatch(
+                        anatomy,
+                        lambda placed=placed: trainer.train_step(*placed),
+                    )
+
+    def _dispatch(staged: StagedGroup, run_hooks: bool = True):
+        nonlocal processed
+        if staged.error is not None:
+            # staging failed: the serial path would have raised from the
+            # same pad/place call on this thread — keep that contract
+            # (lockstep report-and-crash, LocalExecutor propagation)
+            raise staged.error
+        if run_hooks and pre_batch is not None:
+            for feats in staged.hook_features:
+                pre_batch(feats)
+        trainer = get_trainer()
+        if staged.kind == StagedGroup.KIND_STACKED:
+            _dispatch_stacked(trainer, staged.take())
+        else:
+            _dispatch_singles(trainer, staged.take())
+        processed += staged.records
+        if post_group is not None:
+            post_group()
+        if anatomy is not None:
+            anatomy.commit(
+                steps=staged.steps,
+                records=staged.records,
+                step=getattr(trainer, "step", None),
+            )
+
+    it = iter(batches)
+
+    def _pull():
+        if anatomy is None:
+            return next(it, None)
+        with anatomy.phase(PHASE_HOST_FETCH):
+            return next(it, None)
+
+    # ---- warmup: first group on the serial path (creates the trainer) ------
+    warm: list = []
+    warm_prestacked = None
+    ended = False
+    while True:
+        item = _pull()
+        if item is None:
+            ended = True
+            break
+        if isinstance(item, PreStacked):
+            warm_prestacked = item
+            break
+        features, labels = item
+        if pre_batch is not None:
+            pre_batch(features)
+        if k == "auto":
+            k = resolve_steps_per_dispatch(
+                k, (features, labels), deterministic=deterministic_auto
+            )
+        warm.append((features, labels, _batch_rows(labels)))
+        if len(warm) == k:
+            break
+
+    def _warm_stage(trainer, kind_assembled):
+        kind, assembled = kind_assembled
+        if anatomy is None:
+            return kind, _place_assembled(trainer, kind, assembled)
+        with anatomy.phase(PHASE_H2D_TRANSFER):
+            return kind, _place_assembled(trainer, kind, assembled)
+
+    if warm:
+        trainer = get_trainer()
+        if anatomy is None:
+            kind_assembled = assemble_canonical_group(trainer, warm, k, rows)
+        else:
+            with anatomy.phase(PHASE_ASSEMBLE):
+                kind_assembled = assemble_canonical_group(trainer, warm, k, rows)
+        kind, placed = _warm_stage(trainer, kind_assembled)
+        _dispatch(
+            StagedGroup(
+                kind,
+                placed,
+                steps=len(warm),
+                records=sum(n for _f, _l, n in warm),
+                hook_features=(),
+            ),
+            run_hooks=False,  # already ran as the batches arrived
+        )
+    if warm_prestacked is not None:
+        if pre_batch is not None:
+            # one call per STEP, the plain path's hook cadence
+            for _ in range(warm_prestacked.num_steps):
+                pre_batch(warm_prestacked.sample_features)
+        trainer = get_trainer()
+        kind, placed = _warm_stage(
+            trainer,
+            (StagedGroup.KIND_STACKED, _assemble_prestacked(warm_prestacked)),
+        )
+        _dispatch(
+            StagedGroup(
+                kind,
+                placed,
+                steps=warm_prestacked.num_steps,
+                records=warm_prestacked.num_records,
+                hook_features=(),
+            ),
+            run_hooks=False,
+        )
+
+    if ended:
+        while inflight:
+            jax.block_until_ready(inflight.popleft())
+        return processed
+
+    # ---- steady state: stage off-thread, retire one group behind -----------
+    stager = DeviceStager(
+        get_trainer,
+        it,
+        k,
+        rows,
+        deterministic_auto=deterministic_auto,
+        depth=STAGE_DEPTH,
+    )
+    try:
+        while True:
+            staged = stager.next_staged(anatomy)
+            if staged is None:
+                break
+            _dispatch(staged)
+    finally:
+        stager.close()
+        # the task-boundary barrier: every dispatched group retires
+        # before the caller can report the task (exactly-once)
+        while inflight:
+            jax.block_until_ready(inflight.popleft())
+    return processed

@@ -17,6 +17,12 @@ batches arrive in task order, a task's batches are contiguous, and the
 caller reports each task only after consuming all its batches — so
 exactly-once accounting, milestone hooks, and lockstep's deterministic
 batch stream behave identically.
+
+With ``--device_prefetch`` (trainer/device_pipeline.py) this queue is
+the DECODE stage of a three-deep pipeline: the TaskPrefetcher reads and
+decodes task N+1's records while the device-side stager pads/places the
+next dispatch group of task N and the device computes the current one —
+decode -> stage -> compute, each on its own thread, each bounded.
 """
 
 from __future__ import annotations

@@ -115,6 +115,16 @@ class LocalExecutor:
         self._canonical_rows = canonical_batch_rows(
             args.minibatch_size, batch_divisor(self._mesh)
         )
+        # device-path pipelining (--device_prefetch or the forwarded
+        # env): resolved ONCE here; it selects the staged dispatch loop
+        # and turns on batch-buffer donation in the trainer
+        from elasticdl_tpu.trainer.device_pipeline import (
+            resolve_device_prefetch,
+        )
+
+        self._device_prefetch = resolve_device_prefetch(
+            getattr(args, "device_prefetch", None)
+        )
         if getattr(args, "steps_per_dispatch", 1) == "auto":
             # measure the link overhead off the first dispatch's
             # critical path (the probe result feeds the auto-k sizing)
@@ -216,6 +226,10 @@ class LocalExecutor:
             if self._spec.sharding_rules is not None:
                 rules = tuple(self._spec.sharding_rules(self._mesh))
             compute_dtype = getattr(self._args, "compute_dtype", "float32")
+            from elasticdl_tpu.trainer.device_pipeline import (
+                resolve_donate_state,
+            )
+
             self._trainer = SPMDTrainer(
                 self._mesh,
                 self._model,
@@ -227,8 +241,9 @@ class LocalExecutor:
                 if compute_dtype == "float32"
                 else compute_dtype,
                 remat=bool(getattr(self._args, "remat", False)),
-                donate=bool(getattr(self._args, "donate_state", True)),
+                donate=resolve_donate_state(self._args),
                 device_parse=self._spec.device_parse,
+                donate_batch=self._device_prefetch,
             )
             version = restore_trainer_state(self._trainer, self._args)
         if version is not None:
@@ -285,6 +300,7 @@ class LocalExecutor:
             dispatch_ctx=lambda: self._timing.record("batch_process"),
             canonical_rows=self._canonical_rows,
             anatomy=self._anatomy_mod.get_recorder(),
+            device_prefetch=self._device_prefetch,
         )
 
     def _post_step_hooks(self):

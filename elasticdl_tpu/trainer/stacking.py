@@ -209,6 +209,46 @@ def resolve_steps_per_dispatch(
     )
 
 
+def assemble_canonical_group(trainer, group, k, rows):
+    """THE canonical-group assembly policy — one definition site shared
+    by the serial flush below and the device-pipeline stager, so the
+    pipelined path can never drift from the serial baseline its parity
+    is gated against.  ``group`` is ``[(features, labels, n_real)]``;
+    returns ``("stacked", (feats, labels, weights))`` — a full group of
+    k >= 2 padded and stacked into one scan input — or
+    ``("singles", [(feats, labels, mask)])`` for anything shorter (the
+    trailing-partial rule: those dispatch through the already-compiled
+    single-step program, never a new scan length)."""
+    padded = [
+        (
+            trainer.pad_to(f, rows),
+            trainer.pad_to(l, rows),
+            trainer.row_mask(n, rows),
+        )
+        for f, l, n in group
+    ]
+    if len(padded) >= 2 and len(padded) == k:
+        stacked_f = jax.tree_util.tree_map(
+            lambda *xs: np.stack(xs), *[p[0] for p in padded]
+        )
+        stacked_l = jax.tree_util.tree_map(
+            lambda *xs: np.stack(xs), *[p[1] for p in padded]
+        )
+        stacked_w = np.stack([p[2] for p in padded])
+        return "stacked", (stacked_f, stacked_l, stacked_w)
+    return "singles", padded
+
+
+def prestacked_weights(item: PreStacked) -> np.ndarray:
+    """The all-ones ``(k, B)`` weight mask every PreStacked dispatch
+    carries: ready-made groups hold full batches only, and the weights
+    keep the ONE weighted scan shape shared with canonical plain
+    groups.  One definition site for what was copied into each
+    runtime's PreStacked branch."""
+    leaf = jax.tree_util.tree_leaves(item.features)[0]
+    return np.ones(leaf.shape[:2], np.float32)
+
+
 def run_stacked_steps(
     get_trainer: Callable,
     batches: Iterable,
@@ -219,6 +259,7 @@ def run_stacked_steps(
     deterministic_auto: bool = False,
     canonical_rows: int | None = None,
     anatomy=None,
+    device_prefetch: bool = False,
 ) -> int:
     """Drive ``batches`` of ``(features, labels)`` through the trainer in
     groups of ``k`` steps per dispatch; returns records processed.
@@ -253,7 +294,34 @@ def run_stacked_steps(
     its members through the already-compiled single-step program rather
     than compiling a third scan length.  ``None`` preserves the legacy
     pad-to-divisor behavior (tails flush the group early).
+
+    ``device_prefetch`` (the runtimes resolve ``--device_prefetch`` /
+    its forwarded env once at build): canonical-shape groups are
+    assembled and PLACED on a background staging thread while the
+    current group computes, and dispatch outputs retire one group
+    behind in a bounded window (trainer/device_pipeline.py) — same
+    grouping policy, same hook cadence, same accounting; the window is
+    drained before this function returns, so callers report tasks only
+    over retired groups.  Requires ``canonical_rows`` (staging buffers
+    must never change shape); ignored — one boolean branch, right here
+    — on the legacy path and when off.
     """
+    if device_prefetch and canonical_rows is not None:
+        from elasticdl_tpu.trainer.device_pipeline import (
+            run_pipelined_steps,
+        )
+
+        return run_pipelined_steps(
+            get_trainer,
+            batches,
+            k,
+            pre_batch=pre_batch,
+            post_group=post_group,
+            dispatch_ctx=dispatch_ctx,
+            deterministic_auto=deterministic_auto,
+            canonical_rows=canonical_rows,
+            anatomy=anatomy,
+        )
     ctx = dispatch_ctx or contextlib.nullcontext
     group: list = []
     first_shape = None
@@ -267,10 +335,8 @@ def run_stacked_steps(
         # branch per flush, no clock reads)
         from elasticdl_tpu.telemetry.anatomy import (
             PHASE_ASSEMBLE,
-            PHASE_DEVICE_COMPUTE,
             PHASE_H2D_TRANSFER,
-            SUB_ENQUEUE,
-            SUB_READY_WAIT,
+            timed_device_dispatch,
         )
 
         batches = anatomy.wrap_fetches(batches)
@@ -285,32 +351,20 @@ def run_stacked_steps(
         steps = len(group)
         n_records = sum(n for _f, _l, n in group)
         if anatomy is None:
-            padded = [
-                (
-                    trainer.pad_to(f, canonical_rows),
-                    trainer.pad_to(l, canonical_rows),
-                    trainer.row_mask(n, canonical_rows),
-                )
-                for f, l, n in group
-            ]
-            if len(padded) >= 2 and len(padded) == k:
-                stacked_f = jax.tree_util.tree_map(
-                    lambda *xs: np.stack(xs), *[p[0] for p in padded]
-                )
-                stacked_l = jax.tree_util.tree_map(
-                    lambda *xs: np.stack(xs), *[p[1] for p in padded]
-                )
-                stacked_w = np.stack([p[2] for p in padded])
+            kind, assembled = assemble_canonical_group(
+                trainer, group, k, canonical_rows
+            )
+            if kind == "stacked":
                 with ctx():
                     trainer.train_steps_stacked(
-                        trainer.place_stacked(stacked_f),
-                        trainer.place_stacked(stacked_l),
-                        trainer.place_stacked(stacked_w),
+                        trainer.place_stacked(assembled[0]),
+                        trainer.place_stacked(assembled[1]),
+                        trainer.place_stacked(assembled[2]),
                     )
             else:
                 # trailing partial group: k' single weighted steps through
                 # the one compiled program — never a scan-k' compile
-                for features, labels, mask in padded:
+                for features, labels, mask in assembled:
                     with ctx():
                         trainer.train_step(
                             trainer.place_batch(features),
@@ -322,41 +376,23 @@ def run_stacked_steps(
             # trailing block_until_ready trades a little async overlap
             # for a measured (not queued) device_compute phase
             with anatomy.phase(PHASE_ASSEMBLE):
-                padded = [
-                    (
-                        trainer.pad_to(f, canonical_rows),
-                        trainer.pad_to(l, canonical_rows),
-                        trainer.row_mask(n, canonical_rows),
-                    )
-                    for f, l, n in group
-                ]
-                stack_full = len(padded) >= 2 and len(padded) == k
-                if stack_full:
-                    stacked_f = jax.tree_util.tree_map(
-                        lambda *xs: np.stack(xs), *[p[0] for p in padded]
-                    )
-                    stacked_l = jax.tree_util.tree_map(
-                        lambda *xs: np.stack(xs), *[p[1] for p in padded]
-                    )
-                    stacked_w = np.stack([p[2] for p in padded])
-            if stack_full:
+                kind, assembled = assemble_canonical_group(
+                    trainer, group, k, canonical_rows
+                )
+            if kind == "stacked":
                 with anatomy.phase(PHASE_H2D_TRANSFER):
                     placed = (
-                        trainer.place_stacked(stacked_f),
-                        trainer.place_stacked(stacked_l),
-                        trainer.place_stacked(stacked_w),
+                        trainer.place_stacked(assembled[0]),
+                        trainer.place_stacked(assembled[1]),
+                        trainer.place_stacked(assembled[2]),
                     )
                 with ctx():
-                    with anatomy.phase(
-                        PHASE_DEVICE_COMPUTE, sub=SUB_ENQUEUE
-                    ):
-                        out = trainer.train_steps_stacked(*placed)
-                    with anatomy.phase(
-                        PHASE_DEVICE_COMPUTE, sub=SUB_READY_WAIT
-                    ):
-                        jax.block_until_ready(out)
+                    timed_device_dispatch(
+                        anatomy,
+                        lambda: trainer.train_steps_stacked(*placed),
+                    )
             else:
-                for features, labels, mask in padded:
+                for features, labels, mask in assembled:
                     with anatomy.phase(PHASE_H2D_TRANSFER):
                         placed = (
                             trainer.place_batch(features),
@@ -364,14 +400,12 @@ def run_stacked_steps(
                             trainer.place_batch(mask),
                         )
                     with ctx():
-                        with anatomy.phase(
-                            PHASE_DEVICE_COMPUTE, sub=SUB_ENQUEUE
-                        ):
-                            out = trainer.train_step(*placed)
-                        with anatomy.phase(
-                            PHASE_DEVICE_COMPUTE, sub=SUB_READY_WAIT
-                        ):
-                            jax.block_until_ready(out)
+                        timed_device_dispatch(
+                            anatomy,
+                            lambda placed=placed: trainer.train_step(
+                                *placed
+                            ),
+                        )
         processed += n_records
         group.clear()
         if post_group is not None:
@@ -442,15 +476,10 @@ def run_stacked_steps(
             if anatomy is None:
                 with ctx():
                     if canonical:
-                        # PreStacked groups hold full batches only — an
-                        # all-ones mask keeps the ONE weighted scan shape
-                        leaf = jax.tree_util.tree_leaves(item.features)[0]
                         trainer.train_steps_stacked(
                             trainer.place_stacked(item.features),
                             trainer.place_stacked(item.labels),
-                            trainer.place_stacked(
-                                np.ones(leaf.shape[:2], np.float32)
-                            ),
+                            trainer.place_stacked(prestacked_weights(item)),
                         )
                     else:
                         trainer.train_steps_stacked(
@@ -463,13 +492,10 @@ def run_stacked_steps(
                 # already attributed at the seams)
                 with anatomy.phase(PHASE_H2D_TRANSFER):
                     if canonical:
-                        leaf = jax.tree_util.tree_leaves(item.features)[0]
                         placed = (
                             trainer.place_stacked(item.features),
                             trainer.place_stacked(item.labels),
-                            trainer.place_stacked(
-                                np.ones(leaf.shape[:2], np.float32)
-                            ),
+                            trainer.place_stacked(prestacked_weights(item)),
                         )
                     else:
                         placed = (
@@ -477,14 +503,10 @@ def run_stacked_steps(
                             trainer.place_stacked(item.labels),
                         )
                 with ctx():
-                    with anatomy.phase(
-                        PHASE_DEVICE_COMPUTE, sub=SUB_ENQUEUE
-                    ):
-                        out = trainer.train_steps_stacked(*placed)
-                    with anatomy.phase(
-                        PHASE_DEVICE_COMPUTE, sub=SUB_READY_WAIT
-                    ):
-                        jax.block_until_ready(out)
+                    timed_device_dispatch(
+                        anatomy,
+                        lambda: trainer.train_steps_stacked(*placed),
+                    )
             processed += item.num_records
             if post_group is not None:
                 post_group()

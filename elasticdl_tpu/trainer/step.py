@@ -95,6 +95,36 @@ def weighted_mean_loss(loss_fn, labels, outputs, weights):
     return jnp.sum(weights * per_row) / jnp.maximum(jnp.sum(weights), 1.0)
 
 
+_DONATION_WARNING_PATTERN = "Some donated buffers were not usable"
+
+
+def _silence_unusable_donation_warning():
+    """Batch donation is BEST-EFFORT by design: XLA aliases a donated
+    batch into an output only when shapes/layouts permit and frees it
+    early otherwise — on small models nothing aliases and jax warns per
+    compile, so opting in makes that warning noise, not news.  The
+    filter installs at most one live entry: repeated trainer builds
+    (bench runs many configs per process) must not accumulate
+    duplicates, and the presence CHECK (rather than a module latch)
+    keeps it working after a ``catch_warnings`` block reset the global
+    filter list.  Scope caveat: the filter is process-global, so it
+    also mutes the same warning for state-only trainers built later —
+    accepted, since state donation aliases by construction and has
+    never fired it."""
+    import warnings
+
+    for entry in warnings.filters:
+        if (
+            entry[0] == "ignore"
+            and getattr(entry[1], "pattern", None)
+            == _DONATION_WARNING_PATTERN
+        ):
+            return
+    warnings.filterwarnings(
+        "ignore", message=_DONATION_WARNING_PATTERN
+    )
+
+
 def build_train_step(
     loss_fn: Callable,
     compute_dtype=None,
@@ -103,6 +133,7 @@ def build_train_step(
     extra_grad_fn: Callable | None = None,
     state_shardings=None,
     device_parse: Callable | None = None,
+    donate_batch: bool = False,
 ) -> Callable:
     """Build ``(state, features, labels[, weights]) -> (state, step_metrics)``.
 
@@ -113,6 +144,12 @@ def build_train_step(
         it (``None``) keeps the reference semantics bit-for-bit; the two
         call patterns are distinct jit cache entries, and the runtimes
         always pass a weight vector so they hold exactly one.
+    donate_batch: extend donation from state-only to the batch and mask
+        buffers (``--device_prefetch``, trainer/device_pipeline.py): a
+        batch is dead after its dispatch, so XLA reuses its memory for
+        outputs and steady-state dispatches allocate no fresh device
+        buffers.  Callers must treat placed batch arrays as consumed —
+        a read after the dispatch raises on the deleted Array.
     compute_dtype: cast float inputs (e.g. bfloat16) before the forward;
         parameters and optimizer state stay float32 (mixed precision on the
         MXU without loss-scale bookkeeping, since bf16 keeps fp32 range).
@@ -165,9 +202,13 @@ def build_train_step(
         )
         return new_state, {"loss": loss}
 
+    donate_argnums = (0,) if donate else ()
+    if donate_batch:
+        donate_argnums = donate_argnums + (1, 2, 3)
+        _silence_unusable_donation_warning()
     return jax.jit(
         train_step,
-        donate_argnums=(0,) if donate else (),
+        donate_argnums=donate_argnums,
         out_shardings=None
         if state_shardings is None
         else (state_shardings, None),

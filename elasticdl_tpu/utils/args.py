@@ -315,6 +315,23 @@ def _add_train_params(parser: argparse.ArgumentParser):
         ),
     )
     parser.add_argument(
+        "--device_prefetch",
+        type=parse_bool,
+        default=None,
+        required=False,
+        help=(
+            "Device-path pipelining: stage the NEXT canonical batch "
+            "onto the device on a background thread while the current "
+            "dispatch group computes, donate batch/mask buffers to the "
+            "jitted step (steady-state dispatches allocate no fresh "
+            "device buffers), and retire dispatch outputs one group "
+            "behind in a bounded in-flight window of 2 — with the full "
+            "barrier kept at task boundaries and under --step_anatomy.  "
+            "Workers inherit it via ELASTICDL_TPU_DEVICE_PREFETCH "
+            "(never argv); default off"
+        ),
+    )
+    parser.add_argument(
         "--profile_dir",
         default="",
         help=(
@@ -864,6 +881,9 @@ _MASTER_ONLY_FLAGS = frozenset(
         # step anatomy travels by ELASTICDL_TPU_STEP_ANATOMY (never
         # argv) so worker command lines stay byte-identical when off
         "step_anatomy",
+        # device-path pipelining travels by
+        # ELASTICDL_TPU_DEVICE_PREFETCH, same contract
+        "device_prefetch",
     }
 )
 

@@ -151,6 +151,19 @@ class LockstepWorker:
         self._canonical_rows = canonical_batch_rows(
             self._minibatch_size, batch_divisor(self._mesh)
         )
+        # device-path pipelining: resolved from the master-forwarded env
+        # (the flag never reaches worker argv).  Uniform across the
+        # world by construction — it changes the compiled step program
+        # (batch-buffer donation), so processes must not disagree; the
+        # staging thread itself is lockstep-safe (dispatch order stays
+        # on this thread, placement is process-local)
+        from elasticdl_tpu.trainer.device_pipeline import (
+            resolve_device_prefetch,
+        )
+
+        self._device_prefetch = resolve_device_prefetch(
+            getattr(args, "device_prefetch", None)
+        )
         # deterministic fault injection (chaos subsystem): a no-op unless
         # the master exported a plan into this process's environment
         from elasticdl_tpu.chaos import hooks as chaos_hooks
@@ -325,6 +338,10 @@ class LockstepWorker:
                 self._spec, getattr(self._args, "learning_rate", None)
             )
             compute_dtype = getattr(self._args, "compute_dtype", "float32")
+            from elasticdl_tpu.trainer.device_pipeline import (
+                resolve_donate_state,
+            )
+
             self._trainer = SPMDTrainer(
                 self._mesh,
                 self._model,
@@ -336,8 +353,9 @@ class LockstepWorker:
                 if compute_dtype == "float32"
                 else compute_dtype,
                 remat=bool(getattr(self._args, "remat", False)),
-                donate=bool(getattr(self._args, "donate_state", True)),
+                donate=resolve_donate_state(self._args),
                 device_parse=self._spec.device_parse,
+                donate_batch=self._device_prefetch,
             )
             version = self._restore_state()
         if version is not None:
@@ -500,6 +518,10 @@ class LockstepWorker:
                 # the lockstep schedule agreement is preserved even if
                 # only some processes had it enabled
                 anatomy=self._anatomy_mod.get_recorder(),
+                # staging/retire-behind also change only WHEN host work
+                # happens — the dispatch sequence stays a pure function
+                # of (task data, k), identical on every process
+                device_prefetch=self._device_prefetch,
             )
         self._report_task_result(
             task.task_id, include_timing=True, trace=task.trace
@@ -652,6 +674,9 @@ class LockstepWorker:
         from elasticdl_tpu.telemetry.anatomy import (
             heartbeat_snapshot as anatomy_snapshot,
         )
+        from elasticdl_tpu.trainer.device_pipeline import (
+            heartbeat_snapshot as prefetch_snapshot,
+        )
 
         def beat():
             while not self._stopped:
@@ -683,6 +708,9 @@ class LockstepWorker:
                             # step-anatomy phase totals ({} when off):
                             # the master mirrors them onto /metrics
                             phases=anatomy_snapshot(),
+                            # device-prefetch staging totals ({} when
+                            # off), mirrored the same way
+                            prefetch=prefetch_snapshot(),
                         )
                     )
                     if self._replicator is not None and resp is not None:

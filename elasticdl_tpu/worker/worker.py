@@ -150,6 +150,16 @@ class Worker:
         self._canonical_rows = canonical_batch_rows(
             self._minibatch_size, batch_divisor(self._mesh)
         )
+        # device-path pipelining: resolved from the master-forwarded
+        # env (the flag never reaches worker argv) — stages the next
+        # batch's placement off-thread and donates batch buffers
+        from elasticdl_tpu.trainer.device_pipeline import (
+            resolve_device_prefetch,
+        )
+
+        self._device_prefetch = resolve_device_prefetch(
+            getattr(args, "device_prefetch", None)
+        )
         if getattr(args, "steps_per_dispatch", 1) == "auto":
             # measure the link overhead off the first dispatch's
             # critical path (feeds the pipeline's auto-k sizing)
@@ -284,6 +294,10 @@ class Worker:
                 self._spec, getattr(self._args, "learning_rate", None)
             )
             compute_dtype = getattr(self._args, "compute_dtype", "float32")
+            from elasticdl_tpu.trainer.device_pipeline import (
+                resolve_donate_state,
+            )
+
             self._trainer = SPMDTrainer(
                 self._mesh,
                 self._model,
@@ -295,8 +309,9 @@ class Worker:
                 if compute_dtype == "float32"
                 else compute_dtype,
                 remat=bool(getattr(self._args, "remat", False)),
-                donate=bool(getattr(self._args, "donate_state", True)),
+                donate=resolve_donate_state(self._args),
                 device_parse=self._spec.device_parse,
+                donate_batch=self._device_prefetch,
             )
             version = restore_trainer_state(self._trainer, self._args)
         if version is not None:
@@ -311,13 +326,20 @@ class Worker:
     def _place(self, tree):
         return self._trainer.place_canonical(tree, self._canonical_rows)
 
-    def _process_minibatch(self, task_type, features, labels):
+    def _process_minibatch(self, task_type, features, labels, staged=None):
         """One minibatch with retry (reference worker.py:800-840; retries
         there re-pull from the PS — here the state is device-resident, so a
-        retry is just a re-run after a transient failure)."""
+        retry is just a re-run after a transient failure).
+
+        ``staged`` (a device-pipeline
+        :class:`~elasticdl_tpu.trainer.device_pipeline.StagedGroup`):
+        the batch was already placed on device by the staging thread —
+        the FIRST attempt dispatches those buffers (donated to the
+        step); any retry falls back to re-placing from the host arrays,
+        because the staged buffers are dead after attempt one."""
         err = ""
         anat = self._anatomy_mod.get_recorder()
-        for _ in range(MAX_MINIBATCH_RETRY_NUM):
+        for attempt in range(MAX_MINIBATCH_RETRY_NUM):
             try:
                 if task_type == int(TaskType.TRAINING):
                     self._ensure_trainer(features)
@@ -331,7 +353,9 @@ class Worker:
                     record_step_span(int(self._trainer.step))
                     self._timing.start_record_time("batch_process")
                     n = _batch_len(labels)
-                    if anat is None:
+                    if staged is not None and attempt == 0:
+                        self._staged_train_step(anat, staged)
+                    elif anat is None:
                         self._trainer.train_step(
                             self._place(features),
                             self._place(labels),
@@ -353,20 +377,31 @@ class Worker:
                 traceback.print_exc()
         return err
 
+    def _staged_train_step(self, anat, staged):
+        """Dispatch a pre-staged single batch: its pad/placement already
+        happened off-thread (the consumer-visible wait was attributed to
+        h2d_transfer at the stager seam), so only the dispatch itself —
+        and, under anatomy, its enqueue/ready-wait split — remains."""
+        placed = staged.take()[0]  # a singles group of exactly one batch
+        if anat is None:
+            self._trainer.train_step(*placed)
+            return
+        from elasticdl_tpu.telemetry.anatomy import timed_device_dispatch
+
+        timed_device_dispatch(
+            anat, lambda: self._trainer.train_step(*placed)
+        )
+
     def _anatomized_train_step(self, anat, features, labels, n):
         """The same train_step feed as the uninstrumented branch, each
         segment attributed: pad (assemble) / placement (h2d) / dispatch
         + block (device_compute enqueue/ready-wait).  ``place_canonical``
         is pad_to + place_batch, split here so the two phases are
         separable."""
-        import jax as _jax
-
         from elasticdl_tpu.telemetry.anatomy import (
             PHASE_ASSEMBLE,
-            PHASE_DEVICE_COMPUTE,
             PHASE_H2D_TRANSFER,
-            SUB_ENQUEUE,
-            SUB_READY_WAIT,
+            timed_device_dispatch,
         )
 
         trainer = self._trainer
@@ -380,10 +415,7 @@ class Worker:
                 trainer.place_batch(padded_l),
                 trainer.place_batch(mask),
             )
-        with anat.phase(PHASE_DEVICE_COMPUTE, sub=SUB_ENQUEUE):
-            out = trainer.train_step(*placed)
-        with anat.phase(PHASE_DEVICE_COMPUTE, sub=SUB_READY_WAIT):
-            _jax.block_until_ready(out)
+        timed_device_dispatch(anat, lambda: trainer.train_step(*placed))
 
     def _predict_minibatch(self, features):
         n = _batch_len(features)
@@ -489,44 +521,116 @@ class Worker:
                 if self._job_type == JobType.TRAINING_WITH_EVALUATION:
                     self._evaluate_only()
 
+        def account(n, steps, err):
+            if anat is None:
+                boundary(n, err)
+            else:
+                with anat.phase(PHASE_STEP_BOOKKEEPING):
+                    boundary(n, err)
+                anat.commit(
+                    steps=steps,
+                    records=n,
+                    step=self._trainer.step
+                    if self._trainer is not None
+                    else None,
+                )
+
         total = 0
+
+        def run_serial(task, batches):
+            nonlocal total
+            if anat is not None:
+                # the time this thread blocks on the prefetcher is
+                # the dispatch's host_fetch phase
+                batches = anat.wrap_fetches(batches)
+            for batch in batches:
+                if isinstance(batch, PreStacked):
+                    err = self._process_stacked_group(batch)
+                    n = batch.num_records
+                    steps = batch.num_steps
+                else:
+                    features, labels = batch
+                    err = self._process_minibatch(
+                        task.type, features, labels
+                    )
+                    n = _batch_len(labels)
+                    steps = 1
+                total += n
+                account(n, steps, err)
+
+        def run_staged(task, batches):
+            # device-path pipelining: a staging thread pads + places the
+            # NEXT batch while the current one dispatches; the consumer-
+            # visible wait lands in the h2d_transfer phase at the stager
+            # seam.  Plain batches stage as singles groups of one (the
+            # per-batch accounting below is unchanged), PreStacked
+            # groups stage whole.
+            nonlocal total
+            from elasticdl_tpu.trainer.device_pipeline import DeviceStager
+
+            stager = DeviceStager(
+                lambda: self._trainer,
+                iter(batches),
+                1,
+                self._canonical_rows,
+            )
+            try:
+                while True:
+                    staged = stager.next_staged(anat)
+                    if staged is None:
+                        break
+                    host = staged.host
+                    if staged.error is not None:
+                        # staging (pad/place) failed off-thread: fall
+                        # back to the serial path for this group, which
+                        # re-places from host under the per-minibatch
+                        # retry — the exact containment the serial loop
+                        # gives these errors (decode errors still crash
+                        # via the stager's upstream handler, the
+                        # documented contract)
+                        logger.warning(
+                            "Device staging failed (%s); retrying the "
+                            "group from host",
+                            staged.error,
+                        )
+                        staged = None
+                    if isinstance(host, PreStacked):
+                        err = self._process_stacked_group(
+                            host, staged=staged
+                        )
+                        n = host.num_records
+                        steps = host.num_steps
+                    else:
+                        features, labels, n = host[0]
+                        err = self._process_minibatch(
+                            task.type, features, labels, staged=staged
+                        )
+                        steps = 1
+                    total += n
+                    account(n, steps, err)
+            finally:
+                stager.close()
+
         try:
             for _tid, task, batches in prefetcher:
-                if anat is not None:
-                    # the time this thread blocks on the prefetcher is
-                    # the dispatch's host_fetch phase
-                    batches = anat.wrap_fetches(batches)
                 with trace_span(
                     SPAN_TASK_EXECUTE,
                     trace_ctx=task.trace,
                     task_id=task.task_id,
                     shard=task.shard_name,
                 ):
-                    for batch in batches:
-                        if isinstance(batch, PreStacked):
-                            err = self._process_stacked_group(batch)
-                            n = batch.num_records
-                            steps = batch.num_steps
-                        else:
-                            features, labels = batch
-                            err = self._process_minibatch(
-                                task.type, features, labels
-                            )
-                            n = _batch_len(labels)
-                            steps = 1
-                        total += n
-                        if anat is None:
-                            boundary(n, err)
-                        else:
-                            with anat.phase(PHASE_STEP_BOOKKEEPING):
-                                boundary(n, err)
-                            anat.commit(
-                                steps=steps,
-                                records=n,
-                                step=self._trainer.step
-                                if self._trainer is not None
-                                else None,
-                            )
+                    if (
+                        self._device_prefetch
+                        and self._trainer is not None
+                        and task.type == int(TaskType.TRAINING)
+                    ):
+                        run_staged(task, batches)
+                    else:
+                        # first task (the trainer is created by its
+                        # first batch — staging needs it for placement),
+                        # non-training task types (their batches are not
+                        # canonical train groups), and the off path
+                        run_serial(task, batches)
         finally:
             prefetcher.close()
         return total
@@ -580,12 +684,14 @@ class Worker:
             trace_ctx=task.trace,
         )
 
-    def _process_stacked_group(self, group) -> str:
+    def _process_stacked_group(self, group, staged=None) -> str:
         """A PreStacked dispatch group (k steps, one scanned dispatch)
-        with the same retry contract as ``_process_minibatch``."""
+        with the same retry contract as ``_process_minibatch`` — and the
+        same ``staged`` contract: pre-placed buffers dispatch once, a
+        retry re-places from the host arrays."""
         err = ""
         anat = self._anatomy_mod.get_recorder()
-        for _ in range(MAX_MINIBATCH_RETRY_NUM):
+        for attempt in range(MAX_MINIBATCH_RETRY_NUM):
             try:
                 self._ensure_trainer(group.sample_features)
                 for _ in range(group.num_steps):
@@ -594,24 +700,28 @@ class Worker:
 
                 record_step_span(int(self._trainer.step))
                 self._timing.start_record_time("batch_process")
-                # all-ones mask: PreStacked groups hold only full
-                # batches, and the weights keep the ONE weighted scan
-                # shape shared with canonical plain groups
-                leaf = jax.tree_util.tree_leaves(group.features)[0]
+                if staged is not None and attempt == 0:
+                    self._staged_stacked_dispatch(anat, staged)
+                    self._timing.end_record_time("batch_process")
+                    return ""
+                # all-ones mask: the shared PreStacked weight policy
+                # (stacking.prestacked_weights, one definition site)
+                from elasticdl_tpu.trainer.stacking import (
+                    prestacked_weights,
+                )
+
                 if anat is None:
                     self._trainer.train_steps_stacked(
                         self._trainer.place_stacked(group.features),
                         self._trainer.place_stacked(group.labels),
                         self._trainer.place_stacked(
-                            np.ones(leaf.shape[:2], np.float32)
+                            prestacked_weights(group)
                         ),
                     )
                 else:
                     from elasticdl_tpu.telemetry.anatomy import (
-                        PHASE_DEVICE_COMPUTE,
                         PHASE_H2D_TRANSFER,
-                        SUB_ENQUEUE,
-                        SUB_READY_WAIT,
+                        timed_device_dispatch,
                     )
 
                     with anat.phase(PHASE_H2D_TRANSFER):
@@ -619,21 +729,32 @@ class Worker:
                             self._trainer.place_stacked(group.features),
                             self._trainer.place_stacked(group.labels),
                             self._trainer.place_stacked(
-                                np.ones(leaf.shape[:2], np.float32)
+                                prestacked_weights(group)
                             ),
                         )
-                    with anat.phase(PHASE_DEVICE_COMPUTE, sub=SUB_ENQUEUE):
-                        out = self._trainer.train_steps_stacked(*placed)
-                    with anat.phase(
-                        PHASE_DEVICE_COMPUTE, sub=SUB_READY_WAIT
-                    ):
-                        jax.block_until_ready(out)
+                    timed_device_dispatch(
+                        anat,
+                        lambda: self._trainer.train_steps_stacked(*placed),
+                    )
                 self._timing.end_record_time("batch_process")
                 return ""
             except Exception as ex:  # noqa: BLE001 — report upstream
                 err = str(ex)
                 traceback.print_exc()
         return err
+
+    def _staged_stacked_dispatch(self, anat, staged):
+        """Dispatch a pre-staged scan group (placement already happened
+        off-thread); mirrors ``_staged_train_step``."""
+        placed = staged.take()
+        if anat is None:
+            self._trainer.train_steps_stacked(*placed)
+            return
+        from elasticdl_tpu.telemetry.anatomy import timed_device_dispatch
+
+        timed_device_dispatch(
+            anat, lambda: self._trainer.train_steps_stacked(*placed)
+        )
 
     def _evaluate_only(self, wait: bool = False) -> bool:
         """Drain evaluation tasks (reference worker.py:1029-1048).
@@ -860,6 +981,9 @@ class Worker:
         from elasticdl_tpu.telemetry.anatomy import (
             heartbeat_snapshot as anatomy_snapshot,
         )
+        from elasticdl_tpu.trainer.device_pipeline import (
+            heartbeat_snapshot as prefetch_snapshot,
+        )
 
         def beat():
             while not self._stopped:
@@ -876,6 +1000,9 @@ class Worker:
                             # step-anatomy phase totals ({} when off):
                             # the master mirrors them onto /metrics
                             phases=anatomy_snapshot(),
+                            # device-prefetch staging totals ({} when
+                            # off), mirrored the same way
+                            prefetch=prefetch_snapshot(),
                         )
                     )
                     if resp is not None:

@@ -2,7 +2,8 @@
 """Tier-1 goodput smoke (wired into scripts/run_tier1.sh).
 
 Runs a tiny LocalExecutor mnist job with ``--step_anatomy`` + telemetry
-on the CPU backend, then requires the step-anatomy contract to hold:
+on the CPU backend TWICE — device prefetch off, then on — and requires
+the step-anatomy contract to hold in both windows:
 
 1. every dispatch emitted a ``step_anatomy`` event whose phases
    (host_fetch / assemble / h2d_transfer / device_compute /
@@ -15,9 +16,13 @@ on the CPU backend, then requires the step-anatomy contract to hold:
    (0, 1]), with per-phase p50/p95/p99 — the measured numerator ROADMAP
    item 2's ">= 0.9" gate needs;
 4. the span log carries sampled ``step_anatomy`` phase spans and
-   ``trace analyze`` exposes the steady-state section.
+   ``trace analyze`` exposes the steady-state section (off window);
+5. with ``--device_prefetch`` on, the CONSUMER-VISIBLE ``h2d_transfer``
+   share is measurably lower than the prefetch-off run's (staging
+   moved assembly + placement off the dispatch thread) — or already
+   negligible (< 0.5% of wall, the intended end state).
 
-Fast by construction: 512 records, one epoch, one process.
+Fast by construction: 512 records, one epoch, one process per window.
 """
 
 from __future__ import annotations
@@ -36,21 +41,166 @@ sys.path.insert(
 UNTRACKED_GATE = 0.02
 # float noise bound for the per-event sum-exactness re-check (ms)
 SUM_RESIDUAL_MS = 1e-3
+# an ON h2d share below this is "negligible" even if the OFF share was
+# also tiny (CPU memcpy placement): the pipeline did its job
+H2D_NEGLIGIBLE_SHARE = 0.005
+
+
+def _run_window(workdir: str, train: str, prefetch: bool) -> dict | int:
+    """One instrumented LocalExecutor window; returns the measured
+    sums + report section, or a non-zero rc on a gate failure."""
+    from elasticdl_tpu.telemetry import anatomy as anatomy_mod
+    from elasticdl_tpu.telemetry import tracing, worker_hooks
+    from elasticdl_tpu.telemetry.anatomy import TRACKED_PHASES
+    from elasticdl_tpu.telemetry.events import read_events
+    from elasticdl_tpu.telemetry.report import build_report
+    from elasticdl_tpu.trainer.local_executor import LocalExecutor
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    mode = "on" if prefetch else "off"
+    rundir = os.path.join(workdir, f"prefetch_{mode}")
+    os.makedirs(rundir, exist_ok=True)
+    telemetry_dir = os.path.join(rundir, "telemetry")
+    args = parse_master_args(
+        [
+            "--model_def",
+            "mnist_functional_api.mnist_functional_api.custom_model",
+            "--training_data",
+            train,
+            "--minibatch_size",
+            "64",
+            "--records_per_task",
+            "128",
+            "--num_epochs",
+            "1",
+            "--compute_dtype",
+            "float32",
+            "--steps_per_dispatch",
+            "2",
+            "--telemetry_dir",
+            telemetry_dir,
+            "--trace_sample_rate",
+            "1.0",
+            "--step_anatomy",
+            "true",
+            "--device_prefetch",
+            "true" if prefetch else "false",
+        ]
+    )
+    try:
+        LocalExecutor(args).run()
+    finally:
+        # each window installs process-global recorders bound to its
+        # run dir; the next window must not inherit them
+        anatomy_mod.uninstall()
+        worker_hooks.uninstall()
+        tracing.uninstall()
+
+    events = read_events(os.path.join(telemetry_dir, "events.jsonl"))
+    anat = [e for e in events if e.get("event") == "step_anatomy"]
+    if not anat:
+        print(
+            f"goodput_smoke[{mode}]: no step_anatomy events",
+            file=sys.stderr,
+        )
+        return 1
+
+    # 1. sum-exactness, re-derived from the raw events
+    wall_total = 0.0
+    untracked_total = 0.0
+    h2d_total = 0.0
+    for event in anat:
+        wall = float(event["wall_ms"])
+        tracked = sum(
+            float(event.get(f"{p}_ms", 0.0)) for p in TRACKED_PHASES
+        )
+        untracked = float(event.get("untracked_ms", 0.0))
+        residual = abs(wall - (tracked + untracked))
+        if residual > SUM_RESIDUAL_MS:
+            print(
+                f"goodput_smoke[{mode}]: phases do not sum to wall "
+                f"(residual {residual:.6f}ms in {event})",
+                file=sys.stderr,
+            )
+            return 1
+        wall_total += wall
+        untracked_total += untracked
+        h2d_total += float(event.get("h2d_transfer_ms", 0.0))
+    if not wall_total:
+        print(
+            f"goodput_smoke[{mode}]: zero wall time measured",
+            file=sys.stderr,
+        )
+        return 1
+
+    # 2. the untracked residual is bounded
+    untracked_share = untracked_total / wall_total
+    if untracked_share >= UNTRACKED_GATE:
+        print(
+            f"goodput_smoke[{mode}]: untracked residual "
+            f"{untracked_share * 100:.2f}% >= "
+            f"{UNTRACKED_GATE * 100:.0f}% of wall",
+            file=sys.stderr,
+        )
+        return 1
+
+    # 3. the report computes the goodput ledger from the events
+    report = build_report(rundir)
+    goodput = None
+    for run in report["runs"].values():
+        goodput = run.get("goodput")
+        if goodput:
+            break
+    if not goodput:
+        print(
+            f"goodput_smoke[{mode}]: telemetry.report emitted no "
+            "goodput section",
+            file=sys.stderr,
+        )
+        return 1
+    overall = goodput["overall"]
+    roofline = overall.get("e2e_vs_roofline")
+    if not isinstance(roofline, float) or not (0.0 < roofline <= 1.0):
+        print(
+            f"goodput_smoke[{mode}]: e2e_vs_roofline not computed "
+            f"(got {roofline!r})",
+            file=sys.stderr,
+        )
+        return 1
+    for phase in ("device_compute", "host_fetch"):
+        stats = overall["phases"].get(phase)
+        if not stats or "p50_ms" not in stats or "p99_ms" not in stats:
+            print(
+                f"goodput_smoke[{mode}]: phase percentiles missing for "
+                f"{phase}: {stats!r}",
+                file=sys.stderr,
+            )
+            return 1
+    if overall.get("max_sum_residual_ms", 1.0) > SUM_RESIDUAL_MS:
+        print(
+            f"goodput_smoke[{mode}]: report's own residual check "
+            f"failed: {overall.get('max_sum_residual_ms')}ms",
+            file=sys.stderr,
+        )
+        return 1
+
+    return {
+        "telemetry_dir": telemetry_dir,
+        "overall": overall,
+        "roofline": roofline,
+        "untracked_share": untracked_share,
+        "h2d_share": h2d_total / wall_total,
+    }
 
 
 def main() -> int:
     from elasticdl_tpu.data.recordio_gen import synthetic
     from elasticdl_tpu.telemetry import trace as trace_cli
-    from elasticdl_tpu.telemetry.anatomy import TRACKED_PHASES
-    from elasticdl_tpu.telemetry.events import read_events
-    from elasticdl_tpu.telemetry.report import build_report
     from elasticdl_tpu.telemetry.tracing import (
         SPAN_STEP_ANATOMY,
         SPANS_FILENAME,
         read_spans,
     )
-    from elasticdl_tpu.trainer.local_executor import LocalExecutor
-    from elasticdl_tpu.utils.args import parse_master_args
 
     with tempfile.TemporaryDirectory() as workdir:
         train = synthetic.gen_mnist(
@@ -59,137 +209,67 @@ def main() -> int:
             num_shards=1,
             seed=7,
         )
-        telemetry_dir = os.path.join(workdir, "telemetry")
-        args = parse_master_args(
-            [
-                "--model_def",
-                "mnist_functional_api.mnist_functional_api.custom_model",
-                "--training_data",
-                train,
-                "--minibatch_size",
-                "64",
-                "--records_per_task",
-                "128",
-                "--num_epochs",
-                "1",
-                "--compute_dtype",
-                "float32",
-                "--steps_per_dispatch",
-                "2",
-                "--telemetry_dir",
-                telemetry_dir,
-                "--trace_sample_rate",
-                "1.0",
-                "--step_anatomy",
-                "true",
-            ]
-        )
-        LocalExecutor(args).run()
+        off = _run_window(workdir, train, prefetch=False)
+        if isinstance(off, int):
+            return off
+        on = _run_window(workdir, train, prefetch=True)
+        if isinstance(on, int):
+            return on
 
-        events = read_events(os.path.join(telemetry_dir, "events.jsonl"))
-        anat = [e for e in events if e.get("event") == "step_anatomy"]
-        if not anat:
-            print("goodput_smoke: no step_anatomy events", file=sys.stderr)
-            return 1
-
-        # 1. sum-exactness, re-derived from the raw events
-        wall_total = 0.0
-        untracked_total = 0.0
-        for event in anat:
-            wall = float(event["wall_ms"])
-            tracked = sum(
-                float(event.get(f"{p}_ms", 0.0)) for p in TRACKED_PHASES
+        # 4. sampled phase spans + the analyzer's steady-state section —
+        # gated in BOTH windows, so the pipelined (production) path's
+        # trace output is validated too, not just the serial baseline
+        for mode, window in (("off", off), ("on", on)):
+            spans = read_spans(
+                os.path.join(window["telemetry_dir"], SPANS_FILENAME)
             )
-            untracked = float(event.get("untracked_ms", 0.0))
-            residual = abs(wall - (tracked + untracked))
-            if residual > SUM_RESIDUAL_MS:
+            if not any(
+                s.get("span") == SPAN_STEP_ANATOMY for s in spans
+            ):
                 print(
-                    f"goodput_smoke: phases do not sum to wall "
-                    f"(residual {residual:.6f}ms in {event})",
+                    f"goodput_smoke[{mode}]: no step_anatomy spans in "
+                    "the trace",
                     file=sys.stderr,
                 )
                 return 1
-            wall_total += wall
-            untracked_total += untracked
-        if not wall_total:
-            print("goodput_smoke: zero wall time measured", file=sys.stderr)
-            return 1
-
-        # 2. the untracked residual is bounded
-        untracked_share = untracked_total / wall_total
-        if untracked_share >= UNTRACKED_GATE:
-            print(
-                f"goodput_smoke: untracked residual "
-                f"{untracked_share * 100:.2f}% >= "
-                f"{UNTRACKED_GATE * 100:.0f}% of wall",
-                file=sys.stderr,
+            analysis = trace_cli.analyze_telemetry_dir(
+                window["telemetry_dir"]
             )
-            return 1
-
-        # 3. the report computes the goodput ledger from the events
-        report = build_report(workdir)
-        goodput = None
-        for run in report["runs"].values():
-            goodput = run.get("goodput")
-            if goodput:
-                break
-        if not goodput:
-            print(
-                "goodput_smoke: telemetry.report emitted no goodput "
-                "section",
-                file=sys.stderr,
-            )
-            return 1
-        overall = goodput["overall"]
-        roofline = overall.get("e2e_vs_roofline")
-        if not isinstance(roofline, float) or not (0.0 < roofline <= 1.0):
-            print(
-                f"goodput_smoke: e2e_vs_roofline not computed "
-                f"(got {roofline!r})",
-                file=sys.stderr,
-            )
-            return 1
-        for phase in ("device_compute", "host_fetch"):
-            stats = overall["phases"].get(phase)
-            if not stats or "p50_ms" not in stats or "p99_ms" not in stats:
+            if not analysis.get("steady_state"):
                 print(
-                    f"goodput_smoke: phase percentiles missing for "
-                    f"{phase}: {stats!r}",
+                    f"goodput_smoke[{mode}]: trace analyze has no "
+                    "steady_state section",
                     file=sys.stderr,
                 )
                 return 1
-        if overall.get("max_sum_residual_ms", 1.0) > SUM_RESIDUAL_MS:
-            print(
-                "goodput_smoke: report's own residual check failed: "
-                f"{overall.get('max_sum_residual_ms')}ms",
-                file=sys.stderr,
-            )
-            return 1
 
-        # 4. sampled phase spans + the analyzer's steady-state section
-        spans = read_spans(os.path.join(telemetry_dir, SPANS_FILENAME))
-        if not any(s.get("span") == SPAN_STEP_ANATOMY for s in spans):
+        # 5. pipelining moved staging off the dispatch thread: the
+        # consumer-visible h2d share must DROP (or be negligible)
+        if not (
+            on["h2d_share"] < off["h2d_share"]
+            or on["h2d_share"] < H2D_NEGLIGIBLE_SHARE
+        ):
             print(
-                "goodput_smoke: no step_anatomy spans in the trace",
-                file=sys.stderr,
-            )
-            return 1
-        analysis = trace_cli.analyze_telemetry_dir(telemetry_dir)
-        if not analysis.get("steady_state"):
-            print(
-                "goodput_smoke: trace analyze has no steady_state "
-                "section",
+                "goodput_smoke: --device_prefetch did not lower the "
+                f"consumer-visible h2d share (off "
+                f"{off['h2d_share'] * 100:.2f}% -> on "
+                f"{on['h2d_share'] * 100:.2f}%)",
                 file=sys.stderr,
             )
             return 1
 
     print(
-        "goodput_smoke: OK ({} dispatches, e2e_vs_roofline {:.3f}, "
-        "binding {}, untracked {:.2f}%)".format(
-            overall["dispatches"],
-            roofline,
-            overall.get("binding"),
-            untracked_share * 100.0,
+        "goodput_smoke: OK (off: {} dispatches, roofline {:.3f}, h2d "
+        "{:.2f}%, untracked {:.2f}% | on: {} dispatches, roofline "
+        "{:.3f}, h2d {:.2f}%, untracked {:.2f}%)".format(
+            off["overall"]["dispatches"],
+            off["roofline"],
+            off["h2d_share"] * 100.0,
+            off["untracked_share"] * 100.0,
+            on["overall"]["dispatches"],
+            on["roofline"],
+            on["h2d_share"] * 100.0,
+            on["untracked_share"] * 100.0,
         )
     )
     return 0

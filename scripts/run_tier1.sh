@@ -19,10 +19,12 @@
 # retry budget outlasts: every call must degrade to DEADLINE_EXCEEDED,
 # retry, and complete — deadline-exceeded counter > 0, zero reforms,
 # zero hung threads at exit)
-# + goodput smoke (tiny LocalExecutor run with --step_anatomy: every
-# dispatch's phases must sum exactly to its wall time with < 2%
-# untracked residual, and telemetry.report must emit a goodput section
-# whose e2e_vs_roofline is computed from measured phases)
+# + goodput smoke (tiny LocalExecutor runs with --step_anatomy, device
+# prefetch off THEN on: every dispatch's phases must sum exactly to its
+# wall time with < 2% untracked residual, telemetry.report must emit a
+# goodput section whose e2e_vs_roofline is computed from measured
+# phases, and the prefetch-on window's consumer-visible h2d share must
+# drop vs off)
 # + the ROADMAP.md test command, verbatim.
 # Run from the repo root: scripts/run_tier1.sh
 cd "$(dirname "$0")/.." || exit 2

@@ -47,6 +47,12 @@ def _fully_populated_models():
     tokens = dict(
         step, tokens_per_sec_per_chip=137000, vs_baseline=None
     )
+    anatomy_overall = {
+        "dispatches": 32,
+        "e2e_vs_roofline": 0.912,
+        "binding": "device_path",
+        "phases": {"device_compute": {"p50_ms": 210.0, "p99_ms": 260.0}},
+    }
     e2e = {
         "e2e_samples_per_sec_per_chip": 234517.3,
         "batch": 4096,
@@ -55,6 +61,11 @@ def _fully_populated_models():
         "vs_step_only": 0.211,
         "link_degraded": True,
         "retry_samples_per_sec": 9000.0,
+        # the instrumented anatomy windows: device prefetch on AND off
+        "anatomy": {
+            "prefetch_on": dict(anatomy_overall),
+            "prefetch_off": dict(anatomy_overall, e2e_vs_roofline=0.695),
+        },
         "budget": {
             "host_pipeline_records_per_sec": 1650000,
             "device_path_records_per_sec": 282000,
@@ -134,6 +145,9 @@ def test_compact_line_fits_the_driver_tail(bench):
     assert compact["mnist_e2e"]["roof"] == 0.831
     assert compact["mnist_e2e"]["vs"] == 0.211
     assert compact["mnist_e2e"]["bind"] == "d"
+    # measured anatomy ratios: prefetch ON is roofm, OFF is roofm0
+    assert compact["mnist_e2e"]["roofm"] == 0.912
+    assert compact["mnist_e2e"]["roofm0"] == 0.695
     assert compact["transformer_seq8192"]["tok"] == 137000
     assert compact["accuracy"]["mnist"] == [0.9712, 1]
     assert compact["elastic_reform"]["ok"] == 1
@@ -244,24 +258,29 @@ def test_e2e_typical_prefers_in_run_roofline(bench):
 
 
 def test_device_preflight_detects_hang_and_failure(bench, monkeypatch):
-    """A hung TPU tunnel must fail the bench FAST with a parseable
-    error line, not hang the driver's whole bench window (observed: a
-    multi-hour outage where jax.devices() blocked indefinitely)."""
+    """A hung TPU tunnel must fail the bench FAST with a structured
+    ``device_unreachable`` payload (stamped into BENCH_full.json by
+    main()), not hang the driver's whole bench window (observed: a
+    multi-hour outage where jax.devices() blocked indefinitely) — and
+    BENCH_r05-style transient failures get a bounded retry first."""
     import sys as _sys
 
-    # an ambient kill-switch/override on the dev box must not leak in
+    # ambient kill-switches/overrides on the dev box must not leak in
     monkeypatch.delenv("EDL_BENCH_PREFLIGHT_SECS", raising=False)
+    monkeypatch.delenv("EDL_BENCH_PREFLIGHT_ATTEMPTS", raising=False)
     # healthy device: no error
     ok = bench._device_preflight(
         timeout_secs=30, probe_argv=[_sys.executable, "-c", "print('v5')"]
     )
     assert ok is None
-    # hang: subprocess exceeds the timeout
+    # hang: subprocess exceeds the timeout -> structured payload
     err = bench._device_preflight(
         timeout_secs=0.5,
         probe_argv=[_sys.executable, "-c", "import time; time.sleep(30)"],
+        attempts=1,
     )
-    assert "did not answer" in err
+    assert "did not answer" in err["reason"]
+    assert err["timeout_secs"] == 0.5 and err["attempts"] == 1
     # hard failure: nonzero exit propagates the stderr tail
     err = bench._device_preflight(
         timeout_secs=30,
@@ -270,8 +289,9 @@ def test_device_preflight_detects_hang_and_failure(bench, monkeypatch):
             "-c",
             "import sys; sys.stderr.write('tunnel exploded'); sys.exit(3)",
         ],
+        attempts=1,
     )
-    assert "tunnel exploded" in err
+    assert "tunnel exploded" in err["reason"]
     # env kill-switch
     monkeypatch.setenv("EDL_BENCH_PREFLIGHT_SECS", "0")
     assert bench._device_preflight(probe_argv=["/bin/false"]) is None
@@ -281,6 +301,49 @@ def test_device_preflight_detects_hang_and_failure(bench, monkeypatch):
         bench._device_preflight(
             timeout_secs=30,
             probe_argv=[_sys.executable, "-c", "print('v5')"],
+        )
+        is None
+    )
+
+
+def test_device_preflight_retries_transient_failures(
+    bench, monkeypatch, tmp_path
+):
+    """A flapping tunnel that answers on the second try must not cost
+    the run (BENCH_r05 died on one transient init timeout)."""
+    import sys as _sys
+
+    monkeypatch.delenv("EDL_BENCH_PREFLIGHT_SECS", raising=False)
+    monkeypatch.delenv("EDL_BENCH_PREFLIGHT_ATTEMPTS", raising=False)
+    flag = tmp_path / "second_try"
+    probe = (
+        "import os, sys\n"
+        f"p = {str(flag)!r}\n"
+        "if os.path.exists(p):\n"
+        "    print('v5')\n"
+        "else:\n"
+        "    open(p, 'w').close()\n"
+        "    sys.stderr.write('first try down')\n"
+        "    sys.exit(3)\n"
+    )
+    assert (
+        bench._device_preflight(
+            timeout_secs=30,
+            probe_argv=[_sys.executable, "-c", probe],
+            attempts=2,
+            backoff_secs=0.01,
+        )
+        is None
+    )
+    # the env can widen the budget without code changes
+    flag.unlink()
+    monkeypatch.setenv("EDL_BENCH_PREFLIGHT_ATTEMPTS", "2")
+    assert (
+        bench._device_preflight(
+            timeout_secs=30,
+            probe_argv=[_sys.executable, "-c", probe],
+            attempts=1,
+            backoff_secs=0.01,
         )
         is None
     )

@@ -1,0 +1,713 @@
+"""Device-path pipelining (ISSUE 12): double-buffered h2d staging,
+batch-buffer donation, async retire-behind — and the three load-bearing
+contracts it must preserve: bit-exact masked parity with the serial
+path, read-after-retire of a donated buffer is caught, and
+stream-order/error-propagation through the staging thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import flax.linen as nn
+import jax
+import numpy as np
+import optax
+import pytest
+
+from elasticdl_tpu.parallel.distributed import SPMDTrainer
+from elasticdl_tpu.parallel.mesh import MeshConfig
+from elasticdl_tpu.trainer import device_pipeline
+from elasticdl_tpu.trainer.device_pipeline import (
+    DEVICE_PREFETCH_ENV,
+    DeviceStager,
+    RetiredBufferError,
+    StagedGroup,
+    resolve_device_prefetch,
+    resolve_donate_state,
+    run_pipelined_steps,
+    stage_depth,
+)
+from elasticdl_tpu.trainer.stacking import PreStacked, run_stacked_steps
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(DEVICE_PREFETCH_ENV, raising=False)
+    device_pipeline._reset_totals_for_tests()
+    yield
+
+
+# ---- flag / helper resolution ----------------------------------------------
+
+
+def test_resolve_device_prefetch_flag_wins_and_env_falls_back(monkeypatch):
+    assert resolve_device_prefetch(None) is False
+    assert resolve_device_prefetch(True) is True
+    assert resolve_device_prefetch(False) is False
+    monkeypatch.setenv(DEVICE_PREFETCH_ENV, "1")
+    assert resolve_device_prefetch(None) is True
+    # an explicit flag still beats the env (bench on/off overrides)
+    assert resolve_device_prefetch(False) is False
+    # the env parses like parse_bool: falsey spellings mean OFF — a
+    # truthy-string read would let "=0" build a donated step program on
+    # some hosts only (the mixed-world hazard the uniformity contract
+    # forbids)
+    for falsey in ("0", "false", "FALSE", "no", "off", " "):
+        monkeypatch.setenv(DEVICE_PREFETCH_ENV, falsey)
+        assert resolve_device_prefetch(None) is False
+    monkeypatch.setenv(DEVICE_PREFETCH_ENV, "true")
+    assert resolve_device_prefetch(None) is True
+    # an unrecognized spelling (typo) fails SAFE: off, never silently on
+    monkeypatch.setenv(DEVICE_PREFETCH_ENV, "flase")
+    assert resolve_device_prefetch(None) is False
+
+
+def test_resolve_donate_state_is_the_one_definition_site():
+    class A:
+        donate_state = False
+
+    class B:
+        pass
+
+    assert resolve_donate_state(A()) is False
+    assert resolve_donate_state(B()) is True
+    # the three runtimes now resolve through this helper, not their own
+    # getattr copies
+    import inspect
+
+    from elasticdl_tpu.trainer import local_executor
+    from elasticdl_tpu.worker import lockstep, worker
+
+    for module in (local_executor, worker, lockstep):
+        source = inspect.getsource(module)
+        assert 'getattr(self._args, "donate_state"' not in source
+        assert "resolve_donate_state" in source
+
+
+def test_device_prefetch_flag_never_reaches_worker_argv():
+    from elasticdl_tpu.utils.args import (
+        build_worker_arguments,
+        parse_master_args,
+    )
+
+    base = [
+        "--model_def",
+        "mnist_functional_api.mnist_functional_api.custom_model",
+        "--training_data",
+        "/tmp/x",
+    ]
+    off = parse_master_args(base)
+    on = parse_master_args(base + ["--device_prefetch", "true"])
+    argv_off = build_worker_arguments(off, 0, "localhost:1")
+    argv_on = build_worker_arguments(on, 0, "localhost:1")
+    # even when SET it travels by env, never worker argv — and the off
+    # argv is byte-identical to a build without the flag
+    assert "--device_prefetch" not in argv_on
+    assert argv_on == argv_off
+
+
+def test_stage_depth_collapses_to_barrier_under_anatomy():
+    assert stage_depth(None) == device_pipeline.RETIRE_WINDOW
+    assert stage_depth(object()) == 1
+
+
+def test_disabled_gates_take_no_clock_reads(monkeypatch):
+    def boom():
+        raise AssertionError("clock read on the disabled path")
+
+    monkeypatch.setattr("time.monotonic", boom)
+    assert device_pipeline.heartbeat_snapshot() == {}
+    assert stage_depth(None) == device_pipeline.RETIRE_WINDOW
+
+
+# ---- real-trainer parity ----------------------------------------------------
+
+
+class _Dense(nn.Module):
+    """Deterministic per-row model (no batch stats, no dropout), so
+    masked parity is exact — the test_compile_canonical idiom."""
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        return nn.Dense(3)(x)
+
+
+def _loss(labels, predictions):
+    labels = labels.reshape(-1)
+    return optax.softmax_cross_entropy_with_integer_labels(
+        predictions, labels
+    ).mean()
+
+
+def _mesh():
+    return MeshConfig.from_string("dp=1").create()
+
+
+def _trainer(mesh, donate_batch=False):
+    feats = np.zeros((1, 4), np.float32)
+    return SPMDTrainer(
+        mesh,
+        _Dense(),
+        _loss,
+        optax.sgd(0.1, momentum=0.9),
+        feats,
+        embedding_threshold=None,
+        donate_batch=donate_batch,
+    )
+
+
+def _batches(sizes, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            rng.randn(n, 4).astype(np.float32),
+            rng.randint(0, 3, size=(n,)).astype(np.int32),
+        )
+        for n in sizes
+    ]
+
+
+def _assert_params_bitexact(a, b):
+    for x, y in zip(
+        jax.tree_util.tree_leaves(jax.device_get(a.state.params)),
+        jax.tree_util.tree_leaves(jax.device_get(b.state.params)),
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestPipelinedParity:
+    def test_train_parity_full_groups_and_masked_tail(self):
+        mesh = _mesh()
+        batches = _batches([8, 8, 8, 8, 5])
+        serial = _trainer(mesh)
+        n1 = run_stacked_steps(
+            lambda: serial, iter(batches), 2, canonical_rows=8
+        )
+        piped = _trainer(mesh, donate_batch=True)
+        n2 = run_stacked_steps(
+            lambda: piped,
+            iter(batches),
+            2,
+            canonical_rows=8,
+            device_prefetch=True,
+        )
+        assert n1 == n2 == 37
+        assert serial.step == piped.step == 5
+        _assert_params_bitexact(serial, piped)
+
+    def test_train_parity_prestacked_and_trailing_singles(self):
+        mesh = _mesh()
+        plain = _batches([8, 8, 8, 5], seed=3)
+        feats = np.stack([plain[0][0], plain[1][0]])
+        labels = np.stack([plain[0][1], plain[1][1]])
+        stream = [
+            PreStacked(feats, labels, 16, feats[0]),
+            plain[2],
+            plain[3],
+        ]
+        serial = _trainer(mesh)
+        n1 = run_stacked_steps(
+            lambda: serial, iter(stream), 2, canonical_rows=8
+        )
+        piped = _trainer(mesh, donate_batch=True)
+        n2 = run_stacked_steps(
+            lambda: piped,
+            iter(stream),
+            2,
+            canonical_rows=8,
+            device_prefetch=True,
+        )
+        assert n1 == n2 == 29
+        _assert_params_bitexact(serial, piped)
+
+    def test_eval_parity_with_donating_trainer(self):
+        """Donation covers the TRAIN step only: the eval step of a
+        donate_batch trainer returns the same masked loss as the
+        serial trainer's, and its inputs stay readable."""
+        mesh = _mesh()
+        batches = _batches([8, 8], seed=5)
+        serial = _trainer(mesh)
+        piped = _trainer(mesh, donate_batch=True)
+        run_stacked_steps(lambda: serial, iter(batches), 2, canonical_rows=8)
+        run_stacked_steps(
+            lambda: piped,
+            iter(batches),
+            2,
+            canonical_rows=8,
+            device_prefetch=True,
+        )
+        feats, labels = _batches([5], seed=9)[0]
+        results = []
+        for tr in (serial, piped):
+            pf = tr.place_canonical(feats, 8)
+            pl = tr.place_canonical(labels, 8)
+            outputs, loss = tr.eval_step(pf, pl, tr.place_mask(5, 8))
+            jax.block_until_ready(outputs)
+            np.asarray(pf)  # eval inputs are NOT donated: still readable
+            results.append(float(jax.device_get(loss)))
+        assert results[0] == results[1]
+
+    def test_hook_cadence_matches_serial(self):
+        mesh = _mesh()
+        batches = _batches([8, 8, 8], seed=7)
+        calls_serial, calls_piped = [], []
+        posts_serial, posts_piped = [], []
+        serial = _trainer(mesh)
+        run_stacked_steps(
+            lambda: serial,
+            iter(batches),
+            2,
+            pre_batch=lambda f: calls_serial.append(f.shape),
+            post_group=lambda: posts_serial.append(1),
+            canonical_rows=8,
+        )
+        piped = _trainer(mesh, donate_batch=True)
+        run_stacked_steps(
+            lambda: piped,
+            iter(batches),
+            2,
+            pre_batch=lambda f: calls_piped.append(f.shape),
+            post_group=lambda: posts_piped.append(1),
+            canonical_rows=8,
+            device_prefetch=True,
+        )
+        # one pre_batch per STEP, one post_group per dispatch group
+        assert calls_serial == calls_piped
+        assert len(posts_serial) == len(posts_piped) == 2
+
+
+def test_local_executor_e2e_parity_bitexact(tmp_path):
+    """The whole executor path (reader -> decode -> TaskPrefetcher ->
+    grouping -> dispatch) with --device_prefetch on is bit-identical to
+    off: same step program, same k, same pinned shuffle — only the
+    execution discipline differs."""
+    import jax as _jax
+
+    from elasticdl_tpu.data.recordio_gen import synthetic
+    from elasticdl_tpu.trainer.local_executor import LocalExecutor
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    train_dir = synthetic.gen_mnist(
+        str(tmp_path / "train"), num_records=256, num_shards=2, seed=0
+    )
+
+    def run(prefetch: str):
+        args = parse_master_args(
+            [
+                "--model_def",
+                "mnist_functional_api.mnist_functional_api.custom_model",
+                "--training_data",
+                train_dir,
+                "--minibatch_size",
+                "32",
+                "--records_per_task",
+                "64",
+                "--num_epochs",
+                "1",
+                "--compute_dtype",
+                "float32",
+                "--steps_per_dispatch",
+                "2",
+                "--shuffle_seed",
+                "7",
+                "--device_prefetch",
+                prefetch,
+            ]
+        )
+        ex = LocalExecutor(args)
+        ex.run()
+        return _jax.device_get(ex.state.params), int(ex.state.step)
+
+    params_off, steps_off = run("false")
+    params_on, steps_on = run("true")
+    assert steps_off == steps_on == 8
+    for x, y in zip(
+        _jax.tree_util.tree_leaves(params_off),
+        _jax.tree_util.tree_leaves(params_on),
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---- donation falsification -------------------------------------------------
+
+
+class TestDonationFalsification:
+    def test_staged_group_take_twice_is_caught(self):
+        staged = StagedGroup(
+            StagedGroup.KIND_STACKED,
+            ("placed",),
+            steps=1,
+            records=8,
+            hook_features=(),
+        )
+        assert staged.take() == ("placed",)
+        with pytest.raises(RetiredBufferError):
+            staged.take()
+
+    def test_jax_read_after_donate_raises_on_aliased_buffer(self):
+        """The backend-level half of the contract: where XLA does alias
+        a donated buffer, a read-after-retire raises on the deleted
+        Array (the staging layer's single-take discipline exists so the
+        runtimes never reach this error)."""
+        f = jax.jit(lambda x: x * 2, donate_argnums=(0,))
+        x = jax.device_put(np.ones(8, np.float32))
+        jax.block_until_ready(f(x))
+        if not x.is_deleted():
+            pytest.skip("backend did not consume the donation")
+        with pytest.raises(RuntimeError, match="deleted"):
+            np.asarray(x)
+
+    def test_donated_train_batch_is_dead_when_aliased(self):
+        """If the backend aliases the train batch, a retired buffer
+        must be unreadable; if it cannot alias (tiny models), the
+        buffer survives — either way the dispatch math is unchanged
+        (parity tests above)."""
+        mesh = _mesh()
+        tr = _trainer(mesh, donate_batch=True)
+        feats, labels = _batches([8], seed=11)[0]
+        pf = tr.place_batch(feats)
+        pl = tr.place_batch(labels)
+        pm = tr.place_batch(np.ones(8, np.float32))
+        jax.block_until_ready(tr.train_step(pf, pl, pm))
+        if pf.is_deleted():
+            with pytest.raises(RuntimeError, match="deleted"):
+                np.asarray(pf)
+
+
+# ---- stager: order, errors, lifecycle ---------------------------------------
+
+
+class _FakeTrainer:
+    """Host-only trainer double: real padding, identity placement."""
+
+    step = 0
+
+    def pad_to(self, tree, rows):
+        def _pad(x):
+            x = np.asarray(x)
+            if x.shape[0] == rows:
+                return x
+            return np.concatenate(
+                [x, np.repeat(x[-1:], rows - x.shape[0], axis=0)]
+            )
+
+        return jax.tree_util.tree_map(_pad, tree)
+
+    def row_mask(self, n, rows):
+        mask = np.zeros(rows, np.float32)
+        mask[:n] = 1.0
+        return mask
+
+    def place_batch(self, tree):
+        return tree
+
+    def place_stacked(self, tree):
+        return tree
+
+    def train_step(self, f, l, w=None):
+        return np.float32(0.0)
+
+    def train_steps_stacked(self, f, l, w=None):
+        return np.float32(0.0)
+
+
+def test_stager_preserves_stream_order_and_group_policy():
+    batches = _batches([8, 8, 8, 8, 5], seed=1)
+    stager = DeviceStager(
+        lambda: _FakeTrainer(), iter(batches), 2, canonical_rows=8
+    )
+    try:
+        groups = list(stager)
+    finally:
+        stager.close()
+    # [8,8] [8,8] stacked + [5] trailing singles — in stream order
+    assert [g.kind for g in groups] == [
+        StagedGroup.KIND_STACKED,
+        StagedGroup.KIND_STACKED,
+        StagedGroup.KIND_SINGLES,
+    ]
+    assert [g.records for g in groups] == [16, 16, 5]
+    first = groups[0].take()
+    np.testing.assert_array_equal(first[0][0], batches[0][0])
+    np.testing.assert_array_equal(first[0][1], batches[1][0])
+
+
+def test_stager_propagates_upstream_error_in_stream_position():
+    good = _batches([8, 8], seed=2)
+
+    def stream():
+        yield good[0]
+        yield good[1]
+        raise ValueError("decode exploded")
+
+    stager = DeviceStager(
+        lambda: _FakeTrainer(), stream(), 2, canonical_rows=8
+    )
+    try:
+        first = stager.next_staged()
+        assert first is not None and first.records == 16
+        with pytest.raises(ValueError, match="decode exploded"):
+            while True:
+                if stager.next_staged() is None:
+                    raise AssertionError("stream ended without the error")
+    finally:
+        stager.close()
+    stager._thread.join(timeout=5)
+    assert not stager._thread.is_alive()
+
+
+def test_stager_degrades_staging_failures_to_error_groups():
+    """A pad/place failure during STAGING must not poison the stream:
+    the group arrives carrying the error + its host batches (the
+    task-stream worker falls back to its serial retry path; the grouped
+    runtimes re-raise, matching their serial behavior)."""
+
+    class _BadPad(_FakeTrainer):
+        def pad_to(self, tree, rows):
+            raise ValueError("batch exceeds the canonical shape")
+
+    batches = _batches([8, 8], seed=21)
+    stager = DeviceStager(
+        lambda: _BadPad(), iter(batches), 2, canonical_rows=8
+    )
+    try:
+        staged = stager.next_staged()
+        assert staged is not None and staged.error is not None
+        assert "canonical shape" in str(staged.error)
+        # the host group survives for the serial fallback
+        assert len(staged.host) == 2
+        np.testing.assert_array_equal(staged.host[0][0], batches[0][0])
+        # the stream then ends cleanly (no crash contract for staging)
+        assert stager.next_staged() is None
+    finally:
+        stager.close()
+
+
+def test_run_pipelined_reraises_staging_failures_like_serial():
+    class _BadPadAfterWarmup(_FakeTrainer):
+        calls = 0
+
+        def pad_to(self, tree, rows):
+            type(self).calls += 1
+            if type(self).calls > 2:  # warmup group pads fine
+                raise ValueError("bad batch")
+            return super().pad_to(tree, rows)
+
+    trainer = _BadPadAfterWarmup()
+    with pytest.raises(ValueError, match="bad batch"):
+        run_pipelined_steps(
+            lambda: trainer,
+            iter(_batches([8] * 4, seed=22)),
+            2,
+            canonical_rows=8,
+        )
+
+
+def test_stager_close_releases_a_blocked_producer():
+    many = _batches([8] * 32, seed=4)
+    stager = DeviceStager(
+        lambda: _FakeTrainer(), iter(many), 1, canonical_rows=8
+    )
+    time.sleep(0.05)  # let the producer fill the bounded queue
+    stager.close()
+    stager._thread.join(timeout=5)
+    assert not stager._thread.is_alive()
+
+
+def test_task_prefetcher_feeds_stager_errors_and_order():
+    """The three-deep pipeline seam: a decode error raised on the
+    TaskPrefetcher's producer thread crosses BOTH queues and surfaces
+    on the consumer, and batches keep task order on the way."""
+    from elasticdl_tpu.trainer.host_pipeline import TaskPrefetcher
+
+    tasks = [(1, "t1"), (2, "t2")]
+
+    def next_task():
+        return tasks.pop(0) if tasks else (0, None)
+
+    def make_batches(task):
+        if task == "t2":
+            raise ValueError("shard corrupt")
+        return _batches([8, 8], seed=6)
+
+    prefetcher = TaskPrefetcher(next_task, make_batches)
+    seen = []
+    with pytest.raises(ValueError, match="shard corrupt"):
+        for _tid, _task, batches in prefetcher:
+            stager = DeviceStager(
+                lambda: _FakeTrainer(), iter(batches), 2, canonical_rows=8
+            )
+            try:
+                for staged in stager:
+                    seen.append(staged.records)
+            finally:
+                stager.close()
+    prefetcher.close()
+    assert seen == [16]
+
+
+# ---- retire-behind window ---------------------------------------------------
+
+
+def test_retire_window_bounds_inflight_and_drains_at_end(monkeypatch):
+    retired = []
+    dispatched = []
+
+    real_block = jax.block_until_ready
+    monkeypatch.setattr(
+        device_pipeline.jax,
+        "block_until_ready",
+        lambda out: retired.append(len(dispatched)) or real_block(out),
+    )
+
+    class _Tracking(_FakeTrainer):
+        def train_steps_stacked(self, f, l, w=None):
+            dispatched.append(1)
+            return np.float32(0.0)
+
+        def train_step(self, f, l, w=None):
+            dispatched.append(1)
+            return np.float32(0.0)
+
+    trainer = _Tracking()
+    n = run_pipelined_steps(
+        lambda: trainer,
+        iter(_batches([8] * 10, seed=8)),
+        2,
+        canonical_rows=8,
+    )
+    assert n == 80
+    assert len(dispatched) == 5
+    # a retire only ever happens once the window (2) is exceeded: the
+    # first block came after the third dispatch, and every dispatched
+    # group was retired by the time the function returned (the task-
+    # boundary barrier)
+    assert retired[0] == 3
+    assert len(retired) == 5
+
+
+def test_post_group_runs_per_dispatch_not_per_retire():
+    posts = []
+    trainer = _FakeTrainer()
+    run_pipelined_steps(
+        lambda: trainer,
+        iter(_batches([8] * 6, seed=10)),
+        2,
+        post_group=lambda: posts.append(1),
+        canonical_rows=8,
+    )
+    assert len(posts) == 3
+
+
+# ---- anatomy under pipelining -----------------------------------------------
+
+
+def test_anatomy_commits_sum_exact_under_pipelined_path(tmp_path):
+    from elasticdl_tpu.telemetry import worker_hooks
+    from elasticdl_tpu.telemetry.anatomy import ALL_PHASES, AnatomyRecorder
+    from elasticdl_tpu.telemetry.events import read_events
+
+    worker_hooks.install(str(tmp_path), worker_id=1, generation=0)
+    try:
+        rec = AnatomyRecorder()
+        trainer = _FakeTrainer()
+        n = run_pipelined_steps(
+            lambda: trainer,
+            iter(_batches([8, 8, 8, 5], seed=12)),
+            2,
+            canonical_rows=8,
+            anatomy=rec,
+        )
+        assert n == 29
+        # [8,8] warmup + [8,5] staged (the masked tail joins its group)
+        assert rec.dispatches == 2
+        events = [
+            e
+            for e in read_events(str(tmp_path / "events.jsonl"))
+            if e["event"] == "step_anatomy"
+        ]
+        assert len(events) == 2
+        for event in events:
+            tracked = sum(
+                event.get(f"{p}_ms", 0.0) for p in ALL_PHASES
+            )
+            assert abs(event["wall_ms"] - tracked) < 1e-6
+            split = event.get("enqueue_ms", 0.0) + event.get(
+                "ready_wait_ms", 0.0
+            )
+            assert abs(split - event["device_compute_ms"]) < 1e-6
+    finally:
+        worker_hooks.uninstall()
+
+
+# ---- heartbeat totals: worker -> servicer -> /metrics -----------------------
+
+
+def test_heartbeat_snapshot_monotone_after_staging():
+    assert device_pipeline.heartbeat_snapshot() == {}
+    stager = DeviceStager(
+        lambda: _FakeTrainer(),
+        iter(_batches([8, 8], seed=13)),
+        2,
+        canonical_rows=8,
+    )
+    try:
+        assert list(stager)  # drain
+    finally:
+        stager.close()
+    snap = device_pipeline.heartbeat_snapshot()
+    assert snap["groups"] == 1
+    assert snap["stall_ms"] >= 0 and snap["stage_ms"] >= 0
+
+
+def _servicer():
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+    shards = {"s": (0, 8)}
+    return MasterServicer(4, TaskDispatcher(shards, records_per_task=4))
+
+
+def test_servicer_prefetch_merge_is_monotone_and_summed():
+    from elasticdl_tpu.rpc import messages as msg
+
+    servicer = _servicer()
+    beat = {"groups": 10, "stall_ms": 5, "stage_ms": 40}
+    servicer.heartbeat(
+        msg.HeartbeatRequest(worker_id=0, step=1, prefetch=beat)
+    )
+    # a REORDERED (older) beat can't walk anything backward
+    servicer.heartbeat(
+        msg.HeartbeatRequest(
+            worker_id=0,
+            step=1,
+            prefetch={"groups": 4, "stall_ms": 2, "stage_ms": 11},
+        )
+    )
+    servicer.heartbeat(
+        msg.HeartbeatRequest(worker_id=1, step=1, prefetch=beat)
+    )
+    totals = servicer.prefetch_stats_totals()
+    assert totals == {"groups": 20, "stall_ms": 10, "stage_ms": 80}
+
+
+def test_master_telemetry_mirrors_prefetch_counters():
+    from elasticdl_tpu.rpc import messages as msg
+    from elasticdl_tpu.telemetry.master_hooks import MasterTelemetry
+
+    servicer = _servicer()
+    servicer.heartbeat(
+        msg.HeartbeatRequest(
+            worker_id=0,
+            step=1,
+            prefetch={"groups": 7, "stall_ms": 3, "stage_ms": 29},
+        )
+    )
+    telemetry = MasterTelemetry()
+    telemetry._servicer = servicer
+    text = telemetry.registry.exposition()
+    assert "elasticdl_device_prefetch_groups_total 7" in text
+    assert "elasticdl_device_prefetch_stall_ms_total 3" in text
+    assert "elasticdl_device_prefetch_stage_ms_total 29" in text
