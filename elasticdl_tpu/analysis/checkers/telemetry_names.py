@@ -57,6 +57,8 @@ REQUIRED_EVENT_NAMES = frozenset(
         "autoscale_decision",
         "rpc_fault_injected",
         "step_anatomy",
+        "serving_request",
+        "model_swap",
     }
 )
 REQUIRED_SPAN_NAMES = frozenset(
@@ -73,6 +75,8 @@ REQUIRED_SPAN_NAMES = frozenset(
         "autoscale_decision",
         "rpc_degraded",
         "step_anatomy",
+        "serving_request",
+        "model_swap",
     }
 )
 REQUIRED_PHASE_NAMES = frozenset(
@@ -83,6 +87,8 @@ REQUIRED_PHASE_NAMES = frozenset(
         "device_compute",
         "step_bookkeeping",
         "untracked",
+        "queue_wait",
+        "d2h_transfer",
     }
 )
 REQUIRED_METRIC_NAMES = frozenset(
@@ -95,6 +101,9 @@ REQUIRED_METRIC_NAMES = frozenset(
         "elasticdl_device_prefetch_groups_total",
         "elasticdl_device_prefetch_stall_ms_total",
         "elasticdl_device_prefetch_stage_ms_total",
+        "elasticdl_serving_latency_seconds",
+        "elasticdl_serving_requests_total",
+        "elasticdl_serving_swaps_total",
     }
 )
 

@@ -100,11 +100,18 @@ def evaluate(args) -> dict:
 
 
 def predict(args) -> dict:
-    """Reference api.py:87-135."""
+    """Reference api.py:87-135.  With ``--serving_addr`` the batch
+    predict becomes a client of a running serving endpoint
+    (elasticdl_tpu/serving): shards decode locally, batches predict
+    remotely; unset keeps the offline in-process path unchanged."""
     if not getattr(args, "prediction_data", ""):
         raise ValueError("predict requires --prediction_data")
     args.training_data = ""
     args.validation_data = ""
+    if getattr(args, "serving_addr", None):
+        from elasticdl_tpu.serving.predict_client import run_remote_predict
+
+        return run_remote_predict(args)
     return _dispatch(args)
 
 

@@ -66,6 +66,13 @@ IDEMPOTENCY: dict[str, tuple[str, str]] = {
         "liveness timestamp overwrite + max-merged rpc/phase counters; "
         "replays are absorbed",
     ),
+    "predict": (
+        "read-only",
+        "pure forward pass over replicated state; no server-side effect, "
+        "so the router may re-send it to another replica after a "
+        "deadline/UNAVAILABLE without double-counting anything (the "
+        "request counters it bumps are observability, not accounting)",
+    ),
     "push_replica": (
         "versioned-put",
         "keyed by (source, version, generation) with checksum; a replay "
@@ -90,6 +97,17 @@ IDEMPOTENCY: dict[str, tuple[str, str]] = {
     "report_version": (
         "monotone-merge",
         "server takes max(version); replays are absorbed",
+    ),
+    "serving_status": (
+        "read-only",
+        "pure snapshot of replica counters/version; doubles as the "
+        "serving plane's liveness probe, so it MUST be retry-safe",
+    ),
+    "swap_model": (
+        "versioned-put",
+        "a swap to a version <= the replica's current one is refused as "
+        "stale (engine guard), so a re-delivered swap is absorbed — the "
+        "router fans it to every replica with retries on",
     ),
 }
 

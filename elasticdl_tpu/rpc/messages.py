@@ -269,6 +269,117 @@ class FetchReplicaResponse:
     versions: list = field(default_factory=list)
 
 
+# ---- serving plane (elasticdl_tpu/serving) ----------------------------------
+#
+# Feature/output trees ride as tensor frames like the eval-metrics
+# payload: ``pack_array_tree``/``unpack_array_tree`` flatten a bare
+# ndarray or a {name: ndarray} dict into the serialize_tensors form (a
+# bare array travels under the reserved name below), so msgpack never
+# copies large binary blobs.
+
+BARE_ARRAY_KEY = "__bare__"
+
+
+def pack_array_tree(tree) -> bytes:
+    """Serialize a bare ndarray or a flat {name: ndarray} dict."""
+    import numpy as np
+
+    if isinstance(tree, dict):
+        named = {
+            str(k): Tensor(str(k), np.asarray(v)) for k, v in tree.items()
+        }
+    else:
+        named = {BARE_ARRAY_KEY: Tensor(BARE_ARRAY_KEY, np.asarray(tree))}
+    return serialize_tensors(named)
+
+
+def unpack_array_tree(buf: bytes):
+    """Inverse of :func:`pack_array_tree`."""
+    tensors = deserialize_tensors(buf)
+    if set(tensors) == {BARE_ARRAY_KEY}:
+        return tensors[BARE_ARRAY_KEY].values
+    return {name: t.values for name, t in tensors.items()}
+
+
+@dataclass
+class PredictRequest:
+    """One inference request: ``rows`` rows of features (any row count —
+    the replica's micro-batcher coalesces/splits them into the one
+    canonical batch shape).  ``request_id`` is the client-chosen
+    identity (router retries re-send the SAME id; predict is read-only
+    so a re-delivery is harmless either way)."""
+
+    request_id: str = ""
+    features: bytes = b""  # pack_array_tree frames
+    rows: int = 0
+    trace: dict = field(default_factory=dict)
+
+
+@dataclass
+class PredictResponse:
+    outputs: bytes = b""  # pack_array_tree frames
+    model_version: int = -1
+    rows: int = 0
+    # sum-exact per-request anatomy, ms keyed by serving phase name
+    # (queue_wait/assemble/h2d_transfer/device_compute/d2h_transfer/
+    # untracked) plus total_ms; empty on error responses
+    phases: dict = field(default_factory=dict)
+    # non-empty = the request failed (overload, shape mismatch, ...);
+    # the error classes a client may retry are marked retryable=True
+    error: str = ""
+    retryable: bool = False
+
+
+@dataclass
+class ServingStatusRequest:
+    """Replica/router status snapshot; doubles as the liveness probe."""
+
+    detail: bool = False
+
+
+@dataclass
+class ServingStatusResponse:
+    replica_id: int = -1
+    model_version: int = -1
+    # process-wide XLA compile count (telemetry/compile_tracker): the
+    # observable face of the serving compile-once guarantee — flat
+    # across steady-state traffic, whatever the request-size mix
+    compile_count: int = 0
+    requests: int = 0
+    rows: int = 0
+    rejected: int = 0
+    swaps: int = 0
+    queue_rows: int = 0
+    canonical_rows: int = 0
+    # router responses: one status dict per live replica (detail=True)
+    replicas: list = field(default_factory=list)
+
+
+@dataclass
+class SwapModelRequest:
+    """Hot-swap the served model.  ``model_dir`` names an export
+    directory (manifest + npz); ``min_version`` guards staleness — the
+    replica refuses a swap that would not advance its version, which is
+    what makes the method a safe versioned-put under re-delivery."""
+
+    model_dir: str = ""
+    min_version: int = -1
+
+
+@dataclass
+class SwapModelResponse:
+    accepted: bool = False
+    model_version: int = -1
+    reason: str = ""
+    # structured staleness marker: True when the refusal means "already
+    # at/past this version" — the absorbed-replay case of the
+    # versioned-put contract.  A FIELD, not a reason-string prefix, so
+    # the router's convergence logic cannot be broken by rewording
+    stale: bool = False
+    # router fan-out: per-replica outcomes
+    replicas: list = field(default_factory=list)
+
+
 @dataclass
 class GetRestoreStateRequest:
     """A re-formed world asks the master for the harvested in-memory
@@ -305,6 +416,12 @@ _SIMPLE_TYPES = {
     "FetchReplicaResponse": FetchReplicaResponse,
     "GetRestoreStateRequest": GetRestoreStateRequest,
     "RestoreStateResponse": RestoreStateResponse,
+    "PredictRequest": PredictRequest,
+    "PredictResponse": PredictResponse,
+    "ServingStatusRequest": ServingStatusRequest,
+    "ServingStatusResponse": ServingStatusResponse,
+    "SwapModelRequest": SwapModelRequest,
+    "SwapModelResponse": SwapModelResponse,
 }
 
 

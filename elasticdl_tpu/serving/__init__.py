@@ -1,0 +1,29 @@
+"""Online serving plane: dynamic masked batching over pre-compiled XLA.
+
+The training side of this repo stops at an export artifact
+(``utils/export_utils.py``: manifest + name-keyed npz).  This package is
+what SERVES it — the "heavy traffic from millions of users" half of the
+north star:
+
+- :mod:`.batcher` — a bounded micro-batching queue: arriving requests
+  (1 row or 10,000) coalesce/split into the ONE canonical batch shape
+  (PR 5's ``canonical_batch_rows``) under a max-wait + max-rows policy,
+  so every dispatch reuses a single pre-compiled XLA program and each
+  request gets its exact per-row outputs sliced back out.
+- :mod:`.engine` — the pre-compiled predict engine over an exported
+  model, with hot model swap (new versions slide in under in-flight
+  traffic with zero recompiles: same shapes, same program, new leaves)
+  and sum-exact per-request latency anatomy
+  (queue_wait/assemble/h2d_transfer/device_compute/d2h_transfer).
+- :mod:`.replica` — one serving worker: engine + batcher + dispatch
+  thread behind the generic msgpack/gRPC transport (``rpc/service.py``),
+  sharing the training plane's deadline policy, retry loop, idempotency
+  registry and chaos netem seam.
+- :mod:`.router` — the master-side load balancer: least-outstanding
+  lease-style routing over live replicas, liveness probing with
+  dead-replica eviction, read-only predict retried on a surviving
+  replica, model swaps fanned to the fleet.
+- :mod:`.main` — ``python -m elasticdl_tpu.serving.main``.
+
+Design doc: ``docs/designs/serving.md``.
+"""

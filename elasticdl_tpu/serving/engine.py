@@ -1,0 +1,574 @@
+"""The pre-compiled predict engine: one XLA program, hot-swappable state.
+
+Compile-once is the whole design: the engine pads every dispatch group
+to the SAME canonical row count (``trainer.stacking.canonical_batch_rows``
+— the shape training compiled for), conforms every request leaf to the
+model's feature spec (dtype cast + per-row shape check, because a dtype
+drift IS a new XLA program), and runs one jitted predict step whose
+cache key therefore never changes.  A hot model swap replaces the state
+PYTREE LEAVES under the same treedef — same shapes, same program, zero
+recompiles — so new versions slide in under live traffic: the dispatch
+loop reads the state pointer once per group, and in-flight groups finish
+on the version they started with.
+
+Per-request anatomy (the PR-9 discipline applied per request):
+``queue_wait`` (submit -> first dispatch group opens) + the batch-level
+phases its rows traversed (``assemble``/``h2d_transfer``/
+``device_compute``/``d2h_transfer``, shared by every request in the
+group, accumulated across groups for requests that span several) +
+``untracked`` (the exact residual to its measured total).  Every
+completed request emits a ``serving_request`` event, feeds the
+``elasticdl_serving_latency_seconds{phase=}`` histograms, and records a
+sampled ``serving_request`` span.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.serving.batcher import Group, ShapeMismatchError
+from elasticdl_tpu.serving.metrics import ServingMetrics
+from elasticdl_tpu.telemetry.anatomy import (
+    PHASE_ASSEMBLE,
+    PHASE_D2H_TRANSFER,
+    PHASE_DEVICE_COMPUTE,
+    PHASE_H2D_TRANSFER,
+    PHASE_QUEUE_WAIT,
+    PHASE_UNTRACKED,
+)
+from elasticdl_tpu.telemetry.events import (
+    EVENT_MODEL_SWAP,
+    EVENT_SERVING_REQUEST,
+)
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+_PHASE_TOTAL = "total"
+
+# the one composition site of the stale-refusal reason; the servicer
+# classifies against this constant to set SwapModelResponse.stale
+STALE_SWAP_PREFIX = "stale swap"
+
+
+def _pad_rows(tree, rows: int):
+    """Pad a feature tree's leading dim to exactly ``rows`` (repeat-last
+    fill; the padded rows' outputs are never sliced back to a request,
+    which is the serving face of the PR-5 zero/one row mask)."""
+
+    def _pad(x):
+        x = np.asarray(x)
+        n = x.shape[0]
+        if n == rows:
+            return x
+        if n > rows:
+            raise ShapeMismatchError(
+                f"group of {n} rows exceeds the canonical shape ({rows})"
+            )
+        return np.concatenate(
+            [x, np.repeat(x[-1:], rows - n, axis=0)], axis=0
+        )
+
+    if isinstance(tree, dict):
+        return {k: _pad(v) for k, v in tree.items()}
+    return _pad(tree)
+
+
+def _place_like(new_tree, old_tree):
+    """Device-put every leaf of ``new_tree`` with the matching leaf of
+    ``old_tree``'s sharding (identity layout swap: the jit cache key —
+    shapes, dtypes, committed shardings — is unchanged)."""
+
+    def _put(new, old):
+        sharding = getattr(old, "sharding", None)
+        if sharding is not None:
+            return jax.device_put(np.asarray(new), sharding)
+        return jax.device_put(np.asarray(new))
+
+    return jax.tree_util.tree_map(_put, new_tree, old_tree)
+
+
+def _place_with(tree, sharding):
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(np.asarray(x), sharding), tree
+    )
+
+
+class ServingEngine:
+    """Loads an export (``utils/export_utils.py`` manifest + npz), lazily
+    builds model variables on the first request (the ``_ensure_trainer``
+    idiom — the export does not carry a feature spec, the first request
+    does), and serves padded canonical-shape dispatch groups."""
+
+    def __init__(
+        self,
+        model_dir: str,
+        canonical_rows: int,
+        mesh=None,
+        metrics: ServingMetrics | None = None,
+        replica_id: int = 0,
+    ):
+        from elasticdl_tpu.parallel.mesh import MeshConfig
+        from elasticdl_tpu.utils.export_utils import read_manifest
+        from elasticdl_tpu.utils.model_utils import get_model_spec
+
+        self.model_dir = model_dir
+        self.canonical_rows = int(canonical_rows)
+        self.replica_id = int(replica_id)
+        self.metrics = metrics or ServingMetrics()
+        manifest = read_manifest(model_dir)
+        self._manifest = manifest
+        self._spec = get_model_spec(
+            manifest.get("model_zoo", ""),
+            manifest["model_def"],
+            model_params=manifest.get("model_params", {}),
+        )
+        self._model = self._spec.build_model()
+        self._mesh = (
+            mesh if mesh is not None else MeshConfig.from_string("").create()
+        )
+        from elasticdl_tpu.trainer.step import build_predict_step
+
+        self._predict_fn = build_predict_step(
+            device_parse=self._spec.device_parse
+        )
+        # state + version swap atomically under the swap lock; the
+        # dispatch loop snapshots (state, version) once per group
+        self._swap_lock = threading.Lock()
+        self._state = None  # guarded-by: _swap_lock (writes)
+        self._version = int(manifest.get("model_version", 0))
+        # flat param/state dicts pending the lazy build (replaced by a
+        # pre-build swap; None once built)
+        self._pending_flats = self._load_flats(model_dir)
+        self._feature_spec = None  # {key: (row_shape, dtype)} or (shape, dtype)
+        self._batch_sharding_cache: dict = {}
+        self.requests_served = 0
+        self.rows_served = 0
+        self.swaps_applied = 0
+        self.metrics.model_version.set(self._version)
+
+    # ---- build -------------------------------------------------------------
+
+    @staticmethod
+    def _load_flats(model_dir: str):
+        import os
+
+        flat_params = {}
+        with np.load(os.path.join(model_dir, "params.npz")) as z:
+            flat_params = {k: z[k] for k in z.files}
+        state_path = os.path.join(model_dir, "model_state.npz")
+        flat_state = {}
+        if os.path.exists(state_path):
+            with np.load(state_path) as z:
+                flat_state = {k: z[k] for k in z.files}
+        return flat_params, flat_state
+
+    @property
+    def built(self) -> bool:
+        return self._state is not None
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def ensure_built(self, sample_features):
+        """Build variables + record the feature spec from the first
+        request's features (one row is enough to trace init)."""
+        if self._state is not None:
+            return
+        with self._swap_lock:
+            if self._state is not None:
+                return
+            from elasticdl_tpu.telemetry.tracing import (
+                SPAN_TRAINER_BUILD,
+                trace_span,
+            )
+            from elasticdl_tpu.trainer.state import TrainState
+            from elasticdl_tpu.utils.export_utils import rebuild_variables
+
+            with trace_span(SPAN_TRAINER_BUILD):
+                sample_row = (
+                    {k: np.asarray(v)[:1] for k, v in sample_features.items()}
+                    if isinstance(sample_features, dict)
+                    else np.asarray(sample_features)[:1]
+                )
+                flat_params, flat_state = self._pending_flats
+                params, model_state = rebuild_variables(
+                    self._model, sample_row, flat_params, flat_state
+                )
+                # COMMIT the variables to the mesh (replicated) at build:
+                # rebuild_variables returns host numpy leaves, and
+                # feeding those to the jitted step would both re-ship
+                # the whole model per dispatch AND leave the jit cache
+                # key unstable (uncommitted args let the compiler pick,
+                # and a later committed leaf is a recompile — the smoke
+                # caught exactly that under traffic)
+                replicated = self._replicated_sharding()
+                params = _place_with(params, replicated)
+                model_state = _place_with(model_state, replicated)
+                import optax
+
+                self._state = TrainState.create(
+                    self._model.apply, params, optax.identity(), model_state
+                )
+                self._pending_flats = None
+                self._feature_spec = self._spec_of(sample_features)
+            logger.info(
+                "Serving engine built: %s version %d, canonical rows %d",
+                self._manifest.get("model_def", "?"),
+                self._version,
+                self.canonical_rows,
+            )
+
+    @staticmethod
+    def _spec_of(features):
+        def leaf_spec(x):
+            x = np.asarray(x)
+            return tuple(x.shape[1:]), x.dtype
+
+        if isinstance(features, dict):
+            return {k: leaf_spec(v) for k, v in features.items()}
+        return leaf_spec(features)
+
+    def conform(self, features):
+        """Validate a request's feature tree against the served model's
+        spec and cast leaves to the built dtypes — a silent dtype drift
+        would compile a SECOND program and break compile-once."""
+        if self._feature_spec is None:
+            return features  # first request defines the spec
+        spec = self._feature_spec
+
+        def conform_leaf(x, row_shape, dtype, name=""):
+            x = np.asarray(x)
+            if tuple(x.shape[1:]) != row_shape:
+                raise ShapeMismatchError(
+                    f"feature {name or '<array>'} row shape "
+                    f"{tuple(x.shape[1:])} != served {row_shape}"
+                )
+            return x.astype(dtype, copy=False)
+
+        if isinstance(spec, dict):
+            if not isinstance(features, dict) or set(features) != set(spec):
+                got = sorted(features) if isinstance(features, dict) else type(features).__name__
+                raise ShapeMismatchError(
+                    f"feature keys {got} != served {sorted(spec)}"
+                )
+            return {
+                k: conform_leaf(features[k], *spec[k], name=k) for k in spec
+            }
+        if isinstance(features, dict):
+            raise ShapeMismatchError(
+                "served model takes a bare feature array, got a dict"
+            )
+        return conform_leaf(features, *spec)
+
+    # ---- placement ---------------------------------------------------------
+
+    def _replicated_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self._mesh, PartitionSpec())
+
+    def _place(self, tree):
+        from elasticdl_tpu.parallel import sharding as sharding_lib
+
+        def _put(x):
+            x = np.asarray(x)
+            sh = self._batch_sharding_cache.get(x.ndim)
+            if sh is None:
+                sh = sharding_lib.batch_sharding(
+                    self._mesh, x.ndim, sp_dim=None
+                )
+                self._batch_sharding_cache[x.ndim] = sh
+            return jax.device_put(x, sh)
+
+        if isinstance(tree, dict):
+            return {k: _put(v) for k, v in tree.items()}
+        return _put(tree)
+
+    # ---- the dispatch body -------------------------------------------------
+
+    def run_group(self, group: Group):
+        """Execute one dispatch group end to end: assemble (concat +
+        pad to canonical), h2d, compute, d2h, slice per-row outputs back
+        to their tickets.  Every phase is timed; tickets completed here
+        are finalized (metrics/event/span)."""
+        tickets = group.tickets()
+        try:
+            t_c0 = time.monotonic()
+            conformed = self.conform(group.features())
+            t_c1 = time.monotonic()
+            # one-time lazy build (init + weight injection) sits OUTSIDE
+            # the phase windows: it is startup cost, not request anatomy
+            # — the first dispatch's device_compute still honestly
+            # carries the XLA compile (that IS the warmup request)
+            self.ensure_built(conformed)
+            t0 = time.monotonic()
+            features = _pad_rows(conformed, self.canonical_rows)
+            with self._swap_lock:
+                state, version = self._state, self._version
+            t1 = time.monotonic()
+            placed = self._place(features)
+            t2 = time.monotonic()
+            outputs = self._predict_fn(state, placed)
+            jax.block_until_ready(outputs)
+            t3 = time.monotonic()
+            host = jax.device_get(outputs)
+            t4 = time.monotonic()
+        except Exception as ex:  # noqa: BLE001 — a poisoned group must
+            # fail ITS tickets, not the dispatch thread
+            for ticket in tickets:
+                ticket.fail(ex)
+                self.metrics.errors.inc()
+            logger.exception("Serving dispatch group failed")
+            return
+        phases = {
+            PHASE_ASSEMBLE: (t_c1 - t_c0) + (t1 - t0),
+            PHASE_H2D_TRANSFER: t2 - t1,
+            PHASE_DEVICE_COMPUTE: t3 - t2,
+            PHASE_D2H_TRANSFER: t4 - t3,
+        }
+        self.metrics.dispatches.inc()
+        self.metrics.batch_fill.observe(group.n_real / self.canonical_rows)
+        offset = 0
+        for ticket, lo, hi in group.segments:
+            n = hi - lo
+            rows = jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[offset : offset + n], host
+            )
+            offset += n
+            ticket.add_phases(phases)
+            if ticket.deliver(rows, n, version):
+                # close the anatomy BEFORE releasing the waiter: the
+                # RPC handler ships ticket.phases_secs the moment it
+                # wakes, and it must see the sum-exact set
+                try:
+                    self._finalize(ticket)
+                finally:
+                    ticket.finish()
+
+    def _finalize(self, ticket):
+        """Close a completed request's anatomy (sum-exact residual) and
+        fan out to metrics / event log / sampled span."""
+        total = ticket.total_secs()
+        queue_wait = max(
+            0.0, (ticket.first_dispatch_at or ticket.submitted_at) - ticket.submitted_at
+        )
+        phases = dict(ticket.phases_secs)
+        phases[PHASE_QUEUE_WAIT] = queue_wait
+        tracked = sum(phases.values())
+        phases[PHASE_UNTRACKED] = max(0.0, total - tracked)
+        # write the CLOSED decomposition back: the RPC response ships
+        # ticket.phases_secs, and it must be the sum-exact set
+        ticket.phases_secs = phases
+        self.requests_served += 1
+        self.rows_served += ticket.rows
+        metrics = self.metrics
+        metrics.requests.inc()
+        metrics.rows.inc(ticket.rows)
+        metrics.observe_latency(_PHASE_TOTAL, total)
+        for name, secs in phases.items():
+            metrics.observe_latency(name, secs)
+        from elasticdl_tpu.telemetry import worker_hooks
+
+        fields = {
+            "request_id": ticket.request_id,
+            "rows": int(ticket.rows),
+            "dispatches": int(ticket.dispatches),
+            "model_version": int(ticket.model_version),
+            "replica_id": self.replica_id,
+            "total_ms": total * 1000.0,
+        }
+        for name, secs in phases.items():
+            fields[f"{name}_ms"] = secs * 1000.0
+        worker_hooks.emit_event(EVENT_SERVING_REQUEST, **fields)
+        from elasticdl_tpu.telemetry import tracing
+
+        tracer = tracing.get_tracer()
+        if tracer is not None:
+            tracer.record_span(
+                tracing.SPAN_SERVING_REQUEST,
+                ticket.submitted_at,
+                ticket.finished_at,
+                sampled=True,
+                rows=int(ticket.rows),
+                model_version=int(ticket.model_version),
+            )
+
+    # ---- hot swap ----------------------------------------------------------
+
+    def swap_from_export(self, model_dir: str, min_version: int = -1):
+        """Swap to the model exported at ``model_dir``.  Refuses a
+        version that would not ADVANCE the served one — that staleness
+        guard is what makes ``swap_model`` a safe versioned-put under
+        RPC re-delivery.  Returns ``(accepted, version, reason)``."""
+        from elasticdl_tpu.utils.export_utils import read_manifest
+
+        manifest = read_manifest(model_dir)
+        version = int(manifest.get("model_version", 0))
+        if manifest.get("model_def") != self._manifest.get("model_def"):
+            return False, self._version, (
+                f"model_def mismatch: serving "
+                f"{self._manifest.get('model_def')!r}, export has "
+                f"{manifest.get('model_def')!r}"
+            )
+        if min_version >= 0 and version < min_version:
+            return False, self._version, (
+                f"export version {version} < required {min_version}"
+            )
+        flat_params, flat_state = self._load_flats(model_dir)
+        return self._swap_flats(flat_params, flat_state, version, model_dir)
+
+    def swap_state_dicts(
+        self, flat_params: dict, flat_state: dict, version: int,
+        source: str = "in-memory",
+    ):
+        """Swap from flat name-keyed arrays — the same form the export
+        npz, the checkpoint files and the replication blobs all carry,
+        so a training job's ``ReplicaStore``/checkpoint stream can feed
+        a serving replica without touching disk."""
+        return self._swap_flats(flat_params, flat_state, int(version), source)
+
+    def _swap_flats(self, flat_params, flat_state, version, source):
+        t0 = time.monotonic()
+        with self._swap_lock:
+            if version <= self._version:
+                return False, self._version, (
+                    f"{STALE_SWAP_PREFIX}: version {version} <= served "
+                    f"{self._version}"
+                )
+            if self._state is None:
+                # not built yet: the pending flats ARE the model
+                self._pending_flats = (dict(flat_params), dict(flat_state))
+                old = self._version
+                self._version = version
+            else:
+                from elasticdl_tpu.utils import tree_utils
+
+                try:
+                    params = tree_utils.dict_to_tree(
+                        flat_params, self._state.params
+                    )
+                    model_state = (
+                        tree_utils.dict_to_tree(
+                            flat_state, self._state.model_state
+                        )
+                        if flat_state and self._state.model_state
+                        else self._state.model_state
+                    )
+                except (KeyError, ValueError) as ex:
+                    return False, self._version, f"incompatible state: {ex}"
+                # re-place the new leaves EXACTLY like the old ones: a
+                # host numpy leaf where a committed device Array sat
+                # changes the jit cache key and silently recompiles —
+                # the compile-once contract the smoke gates on
+                params = _place_like(params, self._state.params)
+                model_state = _place_like(
+                    model_state, self._state.model_state
+                )
+                old = self._version
+                # same treedef, same shapes -> the jitted program is
+                # reused; in-flight groups keep the state they snapshot
+                self._state = self._state.replace(
+                    params=params, model_state=model_state
+                )
+                self._version = version
+        secs = time.monotonic() - t0
+        self.swaps_applied += 1
+        self.metrics.swaps.inc()
+        self.metrics.model_version.set(version)
+        from elasticdl_tpu.telemetry import tracing, worker_hooks
+
+        worker_hooks.emit_event(
+            EVENT_MODEL_SWAP,
+            old_version=int(old),
+            model_version=int(version),
+            replica_id=self.replica_id,
+            source=str(source),
+            swap_ms=secs * 1000.0,
+        )
+        tracer = tracing.get_tracer()
+        if tracer is not None:
+            tracer.record_span(
+                tracing.SPAN_MODEL_SWAP,
+                t0,
+                t0 + secs,
+                model_version=int(version),
+            )
+        logger.info(
+            "Hot model swap: version %d -> %d (%s, %.1fms)",
+            old,
+            version,
+            source,
+            secs * 1000.0,
+        )
+        return True, version, ""
+
+    # ---- direct (in-process) convenience ------------------------------------
+
+    def predict_rows(self, features):
+        """One-shot synchronous predict of a conformed feature tree,
+        bypassing the batcher (tests, parity checks): pads to canonical,
+        returns the real rows' outputs."""
+        features = self.conform(features)
+        from elasticdl_tpu.serving.batcher import tree_rows
+
+        n = tree_rows(features)
+        self.ensure_built(features)
+        with self._swap_lock:
+            state = self._state
+        placed = self._place(_pad_rows(features, self.canonical_rows))
+        outputs = jax.device_get(self._predict_fn(state, placed))
+        return jax.tree_util.tree_map(lambda x: np.asarray(x)[:n], outputs)
+
+
+class ExportDirWatcher:
+    """Poll an export directory's manifest for a newer ``model_version``
+    and hot-swap the engine when one lands — the train->serve seam: a
+    training job re-exporting into the watched directory (or a sibling
+    versioned subdirectory) updates live serving with no restart."""
+
+    def __init__(self, engine: ServingEngine, watch_dir: str,
+                 poll_secs: float = 2.0):
+        self._engine = engine
+        self._dir = watch_dir
+        self._poll_secs = max(0.1, float(poll_secs))
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="serving-export-watch", daemon=True
+        )
+        self._thread.start()
+
+    def close(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def poll_once(self) -> bool:
+        """One check; True when a swap was applied (tests drive this
+        directly, the thread loops it)."""
+        from elasticdl_tpu.utils.export_utils import read_manifest
+
+        try:
+            manifest = read_manifest(self._dir)
+        except (OSError, ValueError):
+            return False
+        if int(manifest.get("model_version", 0)) <= self._engine.version:
+            return False
+        accepted, _version, reason = self._engine.swap_from_export(self._dir)
+        if not accepted and reason:
+            logger.warning("Export watcher swap refused: %s", reason)
+        return accepted
+
+    def _loop(self):
+        while not self._stop.wait(self._poll_secs):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — the watcher must outlive
+                # a torn mid-write export; the next poll sees it whole
+                logger.exception("Export watcher poll failed")

@@ -1,0 +1,77 @@
+"""Serving-plane metrics: the one registration site per family.
+
+A replica (or the router front) owns ONE :class:`ServingMetrics` over
+its own registry, served on its ``/metrics`` endpoint — serving
+processes never share the training master's registry.  The latency
+family uses the sub-millisecond ``SERVING_LATENCY_BUCKETS``: the step
+buckets floor at 1ms, which would flatten every warm predict dispatch
+into one slot (the satellite fix of PR 12's registry).
+"""
+
+from __future__ import annotations
+
+from elasticdl_tpu.telemetry.anatomy import SERVING_REQUEST_PHASES
+from elasticdl_tpu.telemetry.registry import (
+    SERVING_LATENCY_BUCKETS,
+    MetricsRegistry,
+)
+
+# the per-request latency decomposition is exposed per phase= label,
+# plus the end-to-end "total" and the residual "untracked" slots
+LATENCY_PHASE_LABELS = SERVING_REQUEST_PHASES + ("untracked", "total")
+
+
+class ServingMetrics:
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self.registry = registry or MetricsRegistry()
+        self.requests = self.registry.counter(
+            "elasticdl_serving_requests_total",
+            "Completed predict requests",
+        )
+        self.rows = self.registry.counter(
+            "elasticdl_serving_rows_total",
+            "Predicted rows (real rows only, padding excluded)",
+        )
+        self.rejected = self.registry.counter(
+            "elasticdl_serving_rejected_total",
+            "Requests shed by the bounded micro-batch queue",
+        )
+        self.errors = self.registry.counter(
+            "elasticdl_serving_errors_total",
+            "Requests failed by a dispatch/shape error",
+        )
+        self.swaps = self.registry.counter(
+            "elasticdl_serving_swaps_total",
+            "Hot model swaps applied",
+        )
+        self.dispatches = self.registry.counter(
+            "elasticdl_serving_dispatches_total",
+            "Dispatch groups executed (1..canonical_rows real rows each)",
+        )
+        self.model_version = self.registry.gauge(
+            "elasticdl_serving_model_version",
+            "Model version currently served",
+        )
+        self.queue_rows = self.registry.gauge(
+            "elasticdl_serving_queue_rows",
+            "Rows waiting in the micro-batch queue",
+        )
+        self.batch_fill = self.registry.histogram(
+            "elasticdl_serving_batch_fill_ratio",
+            "Real rows / canonical rows per dispatch group",
+            buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+        )
+        self._latency = {
+            phase: self.registry.histogram(
+                "elasticdl_serving_latency_seconds",
+                "Per-request latency by anatomy phase",
+                labels={"phase": phase},
+                buckets=SERVING_LATENCY_BUCKETS,
+            )
+            for phase in LATENCY_PHASE_LABELS
+        }
+
+    def observe_latency(self, phase: str, secs: float):
+        hist = self._latency.get(phase)
+        if hist is not None:
+            hist.observe(secs)

@@ -1,0 +1,253 @@
+"""One serving worker: engine + micro-batcher behind the shared RPC plane.
+
+The replica binds the serving method table through the SAME generic
+msgpack/gRPC transport the control plane uses (``rpc/service.py``
+``create_server`` with its own service name) — which buys it, for free,
+the PR-8 machinery the training plane already trusts: per-method
+deadlines, the chaos netem fault seam (a blackholed serving link
+degrades to DEADLINE_EXCEEDED, a duplicated ``predict`` re-executes a
+read-only method), and the server-side handler latency observer.
+
+Threads: the gRPC handler pool submits tickets and blocks on them; ONE
+dispatch thread drains the batcher.  ``serving_status`` is the liveness
+probe the router beats on (read-only, retry-safe) and carries the
+process compile count — the observable face of compile-once serving.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from elasticdl_tpu.rpc import messages as msg
+from elasticdl_tpu.rpc.service import RpcClient, create_server
+from elasticdl_tpu.serving.batcher import (
+    MicroBatcher,
+    ServingError,
+    ServingOverloadError,
+)
+from elasticdl_tpu.serving.engine import ServingEngine
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+SERVING_SERVICE_NAME = "elasticdl_tpu.Serving"
+
+# the serving method table (every name classified in rpc/idempotency.py
+# — the rpc-contract checker enforces it, same as the master table)
+SERVING_METHODS = (
+    "predict",
+    "serving_status",
+    "swap_model",
+)
+
+# predict is read-only, status is read-only, swap is a versioned-put:
+# the whole table is retry-safe, so routers/clients opt everything in
+SERVING_RETRYABLE_METHODS = frozenset(SERVING_METHODS)
+
+# a request's end-to-end wait inside ONE replica is bounded by the
+# batcher wait + dispatch time; the ticket wait below is a backstop for
+# a wedged dispatch thread, not a latency target
+TICKET_WAIT_SECS = 60.0
+
+
+class ServingReplicaServicer:
+    """Transport-agnostic servicer (the in-process-master pattern:
+    tests call these methods directly, gRPC wraps them)."""
+
+    def __init__(self, engine: ServingEngine, batcher: MicroBatcher,
+                 replica_id: int = 0):
+        self.engine = engine
+        self.batcher = batcher
+        self.replica_id = int(replica_id)
+
+    def predict(self, request: msg.PredictRequest) -> msg.PredictResponse:
+        try:
+            features = msg.unpack_array_tree(request.features)
+            if not self.engine.built:
+                # cold start: build + LOCK the feature spec from this
+                # request BEFORE anything enters the queue — otherwise
+                # a malformed concurrent first request could coalesce
+                # into (and poison) a valid request's dispatch group,
+                # and conform() below would have no spec to check
+                self.engine.ensure_built(features)
+            features = self.engine.conform(features)
+            ticket = self.batcher.submit(request.request_id, features)
+        except ServingOverloadError as ex:
+            # rejected == load shed by the bounded queue, ONLY: status
+            # consumers size capacity off this counter, so a malformed
+            # request must not inflate it (those land in errors below)
+            self.engine.metrics.rejected.inc()
+            return msg.PredictResponse(error=str(ex), retryable=True)
+        except ServingError as ex:
+            self.engine.metrics.errors.inc()
+            return msg.PredictResponse(
+                error=str(ex), retryable=bool(getattr(ex, "retryable", False))
+            )
+        except Exception as ex:  # noqa: BLE001 — malformed payloads must
+            # answer, not kill the handler thread
+            return msg.PredictResponse(error=f"bad request: {ex}")
+        try:
+            outputs = ticket.result(TICKET_WAIT_SECS)
+        except ServingError as ex:
+            return msg.PredictResponse(
+                error=str(ex), retryable=bool(getattr(ex, "retryable", False))
+            )
+        except TimeoutError as ex:
+            return msg.PredictResponse(error=str(ex), retryable=True)
+        except Exception as ex:  # noqa: BLE001 — dispatch errors carry over
+            return msg.PredictResponse(error=f"dispatch failed: {ex}")
+        phases_ms = {
+            name: secs * 1000.0 for name, secs in ticket.phases_secs.items()
+        }
+        phases_ms["total_ms"] = ticket.total_secs() * 1000.0
+        return msg.PredictResponse(
+            outputs=msg.pack_array_tree(outputs),
+            model_version=int(ticket.model_version),
+            rows=int(ticket.rows),
+            phases=phases_ms,
+        )
+
+    def serving_status(
+        self, request: msg.ServingStatusRequest
+    ) -> msg.ServingStatusResponse:
+        from elasticdl_tpu.telemetry import compile_tracker
+
+        engine = self.engine
+        return msg.ServingStatusResponse(
+            replica_id=self.replica_id,
+            model_version=int(engine.version),
+            compile_count=int(compile_tracker.compile_count()),
+            requests=int(engine.requests_served),
+            rows=int(engine.rows_served),
+            rejected=int(engine.metrics.rejected.value),
+            swaps=int(engine.swaps_applied),
+            queue_rows=int(self.batcher.queue_rows()),
+            canonical_rows=int(engine.canonical_rows),
+        )
+
+    def swap_model(self, request: msg.SwapModelRequest) -> msg.SwapModelResponse:
+        from elasticdl_tpu.serving.engine import STALE_SWAP_PREFIX
+
+        try:
+            accepted, version, reason = self.engine.swap_from_export(
+                request.model_dir, min_version=request.min_version
+            )
+        except (OSError, ValueError, KeyError) as ex:
+            return msg.SwapModelResponse(
+                accepted=False,
+                model_version=int(self.engine.version),
+                reason=f"swap failed: {ex}",
+            )
+        return msg.SwapModelResponse(
+            accepted=accepted,
+            model_version=int(version),
+            reason=reason,
+            stale=reason.startswith(STALE_SWAP_PREFIX),
+        )
+
+
+class ServingReplica:
+    """The running replica: dispatch thread + (optionally) the gRPC
+    server.  ``start``/``close`` bracket the lifetime; tests may use it
+    in-process without a port."""
+
+    def __init__(
+        self,
+        model_dir: str,
+        canonical_rows: int,
+        max_wait_secs: float = 0.002,
+        max_queue_rows: int | None = None,
+        replica_id: int = 0,
+        port: int | None = None,
+    ):
+        self.engine = ServingEngine(
+            model_dir, canonical_rows, replica_id=replica_id
+        )
+        self.batcher = MicroBatcher(
+            canonical_rows,
+            max_wait_secs=max_wait_secs,
+            max_queue_rows=max_queue_rows,
+        )
+        self.engine.metrics.registry.add_collect_callback(
+            lambda _registry: self.engine.metrics.queue_rows.set(
+                self.batcher.queue_rows()
+            )
+        )
+        self.servicer = ServingReplicaServicer(
+            self.engine, self.batcher, replica_id=replica_id
+        )
+        self._port_requested = port
+        self._server = None
+        self._thread: threading.Thread | None = None
+        self._stopping = threading.Event()
+
+    @property
+    def port(self) -> int | None:
+        return getattr(self._server, "_edl_bound_port", None)
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._dispatch_loop,
+            name=f"serving-dispatch-{self.servicer.replica_id}",
+            daemon=True,
+        )
+        self._thread.start()
+        if self._port_requested is not None:
+            self._server = create_server(
+                self.servicer,
+                self._port_requested,
+                methods=SERVING_METHODS,
+                service_name=SERVING_SERVICE_NAME,
+            )
+            self._server.start()
+            logger.info(
+                "Serving replica %d up on port %d",
+                self.servicer.replica_id,
+                self.port,
+            )
+        return self
+
+    def _dispatch_loop(self):
+        while not self._stopping.is_set():
+            group = self.batcher.next_group(0.05)
+            if group is None:
+                continue
+            self.engine.run_group(group)
+
+    def close(self, grace: float = 1.0):
+        self._stopping.set()
+        self.batcher.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._server is not None:
+            self._server.stop(grace).wait(grace)
+
+
+class ServingClient(RpcClient):
+    """Client stub over the serving method table — the router's
+    downstream hop and ``elasticdl_tpu predict --serving_addr``'s
+    upstream.  An :class:`~elasticdl_tpu.rpc.service.RpcClient`
+    subclass, so deadlines/retry/netem — and the rpc-contract checker's
+    deadline rule at every construction site — apply exactly as on the
+    control plane."""
+
+    def __init__(self, addr: str, retry=None, deadlines=None):
+        super().__init__(
+            addr,
+            methods=SERVING_METHODS,
+            service_name=SERVING_SERVICE_NAME,
+            retry=retry,
+            retryable_methods=SERVING_RETRYABLE_METHODS,
+            deadlines=deadlines,
+        )
+
+    def predict(self, request: msg.PredictRequest) -> msg.PredictResponse:
+        return self._call("predict", request)
+
+    def serving_status(
+        self, request: msg.ServingStatusRequest | None = None
+    ) -> msg.ServingStatusResponse:
+        return self._call(
+            "serving_status", request or msg.ServingStatusRequest()
+        )
+
+    def swap_model(self, request: msg.SwapModelRequest) -> msg.SwapModelResponse:
+        return self._call("swap_model", request)

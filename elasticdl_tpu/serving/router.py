@@ -1,0 +1,359 @@
+"""Master-side serving load balancer: lease-style routing + eviction.
+
+The router is the serving plane's "master": it owns the replica
+registry the way the training master owns worker liveness — a
+monotonic last-seen timestamp per replica, refreshed by a background
+``serving_status`` probe beat (the serving heartbeat), with replicas
+evicted from rotation after ``evict_after_secs`` of silence and
+re-admitted the moment a probe lands again (gray failure is not death:
+an evicted replica is only FORGOTTEN after ``forget_after_secs``).
+
+Routing is lease-style least-outstanding: each in-flight request holds
+a slot on its replica (the lease); a replica's death with leases held
+is absorbed by re-sending — ``predict`` is classified read-only in
+``rpc/idempotency.py``, so the retry cannot double any effect, exactly
+the contract the training dispatcher's duplicate-report dedup proves
+from the other side.  Model swaps fan out to every registered replica
+and report per-replica outcomes; ``swap_model`` is a versioned-put, so
+a replica that already took the version absorbs the re-delivery.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from elasticdl_tpu.rpc import messages as msg
+from elasticdl_tpu.serving.replica import ServingClient
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+DEFAULT_EVICT_AFTER_SECS = 10.0
+DEFAULT_FORGET_AFTER_SECS = 120.0
+# per-request routing attempts across DISTINCT replicas before giving up
+MAX_ROUTE_ATTEMPTS = 3
+
+
+class _ReplicaHandle:
+    __slots__ = (
+        "replica_id",
+        "addr",
+        "client",
+        "outstanding",
+        "last_seen",
+        "last_status",
+    )
+
+    def __init__(self, replica_id: int, addr: str, client: ServingClient):
+        self.replica_id = replica_id
+        self.addr = addr
+        self.client = client
+        self.outstanding = 0  # guarded-by: router._lock
+        self.last_seen = time.monotonic()  # guarded-by: router._lock
+        self.last_status: msg.ServingStatusResponse | None = None
+
+
+def _retryable_failure(ex) -> bool:
+    """Outage-class transport failures worth re-routing (the same set
+    the control-plane retry loop backs off on)."""
+    from elasticdl_tpu.rpc.service import _retryable_grpc_error
+
+    return _retryable_grpc_error(ex)
+
+
+class ServingRouter:
+    """The front door: implements the SAME servicer protocol as a
+    replica (predict / serving_status / swap_model), so one endpoint
+    serves whether it fronts 1 replica or 40."""
+
+    def __init__(
+        self,
+        deadlines=None,
+        evict_after_secs: float = DEFAULT_EVICT_AFTER_SECS,
+        forget_after_secs: float = DEFAULT_FORGET_AFTER_SECS,
+        probe_interval_secs: float = 1.0,
+    ):
+        self._deadlines = deadlines
+        self._evict_after = float(evict_after_secs)
+        self._forget_after = float(forget_after_secs)
+        self._probe_interval = max(0.05, float(probe_interval_secs))
+        self._lock = threading.Lock()
+        self._replicas: dict[int, _ReplicaHandle] = {}  # guarded-by: _lock
+        self._next_id = 0  # guarded-by: _lock
+        self._stop = threading.Event()
+        self._probe_thread: threading.Thread | None = None
+
+    # ---- registry ----------------------------------------------------------
+
+    def add_replica(self, addr: str) -> int:
+        client = ServingClient(addr, deadlines=self._deadlines)
+        with self._lock:
+            replica_id = self._next_id
+            self._next_id += 1
+            self._replicas[replica_id] = _ReplicaHandle(
+                replica_id, addr, client
+            )
+        logger.info("Serving router: replica %d at %s", replica_id, addr)
+        return replica_id
+
+    def remove_replica(self, replica_id: int):
+        with self._lock:
+            handle = self._replicas.pop(replica_id, None)
+        if handle is not None:
+            try:
+                handle.client.close()
+            except Exception:  # noqa: BLE001 — closing a dead channel
+                pass
+
+    def live_replicas(self) -> list[int]:
+        now = time.monotonic()
+        with self._lock:
+            return [
+                h.replica_id
+                for h in self._replicas.values()
+                if now - h.last_seen <= self._evict_after
+            ]
+
+    # ---- the probe beat (liveness) ------------------------------------------
+
+    def start(self):
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="serving-router-probe", daemon=True
+        )
+        self._probe_thread.start()
+        return self
+
+    def probe_once(self):
+        """One liveness sweep (the thread loops this; tests drive it
+        directly): refresh last_seen per replica, forget replicas silent
+        past the forget horizon.  Probes run CONCURRENTLY: a dead
+        replica blocks its probe for the full RPC deadline, and a
+        serial sweep would let two dead replicas delay a healthy
+        replica's refresh past the eviction horizon — a partial failure
+        escalated into a spurious fleet-wide eviction."""
+        with self._lock:
+            handles = list(self._replicas.values())
+        now = time.monotonic()
+
+        def probe(handle):
+            try:
+                status = handle.client.serving_status(
+                    msg.ServingStatusRequest()
+                )
+            except Exception:  # noqa: BLE001 — a dead replica IS the
+                # signal; the eviction horizon decides, not one failure
+                return
+            with self._lock:
+                handle.last_seen = time.monotonic()
+                handle.last_status = status
+
+        if handles:
+            with ThreadPoolExecutor(
+                max_workers=min(8, len(handles))
+            ) as pool:
+                list(pool.map(probe, handles))
+        with self._lock:
+            forgotten = [
+                rid
+                for rid, h in self._replicas.items()
+                if now - h.last_seen > self._forget_after
+            ]
+        for rid in forgotten:
+            logger.warning(
+                "Serving router: forgetting replica %d (silent > %.0fs)",
+                rid,
+                self._forget_after,
+            )
+            self.remove_replica(rid)
+
+    def _probe_loop(self):
+        while not self._stop.wait(self._probe_interval):
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 — the beat must not die
+                logger.exception("Serving router probe sweep failed")
+
+    # ---- routing -----------------------------------------------------------
+
+    def _pick(self, exclude: set[int]) -> _ReplicaHandle | None:
+        """Least-outstanding live replica not yet tried; takes the
+        lease (outstanding += 1) under the lock."""
+        now = time.monotonic()
+        with self._lock:
+            candidates = [
+                h
+                for h in self._replicas.values()
+                if h.replica_id not in exclude
+                and now - h.last_seen <= self._evict_after
+            ]
+            if not candidates:
+                return None
+            handle = min(candidates, key=lambda h: h.outstanding)
+            handle.outstanding += 1
+            return handle
+
+    def _release(self, handle: _ReplicaHandle, ok: bool):
+        with self._lock:
+            handle.outstanding = max(0, handle.outstanding - 1)
+            if ok:
+                handle.last_seen = time.monotonic()
+
+    def predict(self, request: msg.PredictRequest) -> msg.PredictResponse:
+        tried: set[int] = set()
+        last_error = "no live serving replicas"
+        for _attempt in range(MAX_ROUTE_ATTEMPTS):
+            handle = self._pick(tried)
+            if handle is None:
+                break
+            tried.add(handle.replica_id)
+            try:
+                response = handle.client.predict(request)
+            except Exception as ex:  # noqa: BLE001 — transport failures
+                # route around; anything else is a bug worth surfacing
+                self._release(handle, ok=False)
+                if not _retryable_failure(ex):
+                    raise
+                last_error = f"replica {handle.replica_id}: {ex}"
+                continue
+            self._release(handle, ok=True)
+            if response.error and response.retryable:
+                # an overloaded replica sheds; try a less loaded one
+                last_error = (
+                    f"replica {handle.replica_id}: {response.error}"
+                )
+                continue
+            return response
+        return msg.PredictResponse(error=last_error, retryable=True)
+
+    def serving_status(
+        self, request: msg.ServingStatusRequest
+    ) -> msg.ServingStatusResponse:
+        """Aggregate status: max model version across live replicas (the
+        fleet converges there), summed counters, per-replica detail.
+
+        Statuses are fetched LIVE and CONCURRENTLY (the read doubles as
+        a probe): the beat's cached copy can lag by a probe interval,
+        which is enough to misreport a counter a caller is gating on
+        (the serving smoke compares compile counts across traffic), and
+        a serial fan-out would add a full RPC deadline per dead replica
+        to every /healthz read.  The cache serves only as the fallback
+        for a replica that fails the live read."""
+        now = time.monotonic()
+        with self._lock:
+            handles = list(self._replicas.values())
+
+        def fetch(h):
+            try:
+                return h, h.client.serving_status(request)
+            except Exception:  # noqa: BLE001 — fall back to the beat's
+                # cached copy; the eviction horizon decides liveness
+                return h, None
+
+        fetched = []
+        if handles:
+            with ThreadPoolExecutor(
+                max_workers=min(8, len(handles))
+            ) as pool:
+                fetched = list(pool.map(fetch, handles))
+        live = []
+        for h, status in fetched:
+            if status is not None:
+                with self._lock:
+                    h.last_seen = time.monotonic()
+                    h.last_status = status
+                live.append(h)
+            elif (
+                now - h.last_seen <= self._evict_after
+                and h.last_status is not None
+            ):
+                live.append(h)
+        out = msg.ServingStatusResponse(replica_id=-1)
+        for h in live:
+            s = h.last_status
+            out.model_version = max(out.model_version, s.model_version)
+            out.compile_count += s.compile_count
+            out.requests += s.requests
+            out.rows += s.rows
+            out.rejected += s.rejected
+            out.swaps += s.swaps
+            out.queue_rows += s.queue_rows
+            out.canonical_rows = s.canonical_rows
+            if request.detail:
+                out.replicas.append(
+                    {
+                        "replica_id": h.replica_id,
+                        "addr": h.addr,
+                        "model_version": s.model_version,
+                        "requests": s.requests,
+                        "queue_rows": s.queue_rows,
+                        "outstanding": h.outstanding,
+                    }
+                )
+        return out
+
+    def swap_model(self, request: msg.SwapModelRequest) -> msg.SwapModelResponse:
+        """Fan the swap to every REGISTERED replica (evicted ones too —
+        if they come back they must come back current).
+
+        ``accepted`` means the fleet is consistently at the version:
+        every replica was reachable AND either took the swap or refused
+        it as STALE (already at/past the version — how a re-delivered
+        swap is absorbed, the versioned-put contract).  An unreachable
+        replica or a non-stale refusal (wrong model, bad export) makes
+        the fan-out not-accepted."""
+        with self._lock:
+            handles = list(self._replicas.values())
+        outcomes = []
+        all_converged = bool(handles)
+        version = -1
+        for handle in handles:
+            try:
+                response = handle.client.swap_model(request)
+            except Exception as ex:  # noqa: BLE001 — an unreachable
+                # replica's swap outcome is reported, not raised
+                all_converged = False
+                outcomes.append(
+                    {
+                        "replica_id": handle.replica_id,
+                        "accepted": False,
+                        "absorbed": False,
+                        "reason": f"unreachable: {ex}",
+                    }
+                )
+                continue
+            # a stale refusal IS convergence: the replica already
+            # serves this version or newer (replay absorbed) — read
+            # from the structured field, never the reason wording
+            absorbed = not response.accepted and response.stale
+            if not (response.accepted or absorbed):
+                all_converged = False
+            version = max(version, response.model_version)
+            outcomes.append(
+                {
+                    "replica_id": handle.replica_id,
+                    "accepted": response.accepted,
+                    "absorbed": absorbed,
+                    "reason": response.reason,
+                }
+            )
+        return msg.SwapModelResponse(
+            accepted=all_converged,
+            model_version=version,
+            reason=""
+            if all_converged
+            else "; ".join(o["reason"] for o in outcomes if o["reason"])
+            or "no replicas registered",
+            replicas=outcomes,
+        )
+
+    def close(self):
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5)
+        with self._lock:
+            handles, self._replicas = list(self._replicas.values()), {}
+        for handle in handles:
+            try:
+                handle.client.close()
+            except Exception:  # noqa: BLE001
+                pass

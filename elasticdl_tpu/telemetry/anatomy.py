@@ -89,6 +89,13 @@ PHASE_H2D_TRANSFER = "h2d_transfer"
 PHASE_DEVICE_COMPUTE = "device_compute"
 PHASE_STEP_BOOKKEEPING = "step_bookkeeping"
 PHASE_UNTRACKED = "untracked"
+# serving-plane phases (elasticdl_tpu/serving): a request's latency
+# decomposes as queue_wait (submit -> its first dispatch group opens)
+# followed by the shared batch phases (assemble/h2d_transfer/
+# device_compute) plus d2h_transfer (outputs device -> host) — same
+# sum-exact residual discipline, per REQUEST instead of per dispatch
+PHASE_QUEUE_WAIT = "queue_wait"
+PHASE_D2H_TRANSFER = "d2h_transfer"
 
 # the measured (timer-covered) phases, in pipeline order
 TRACKED_PHASES = (
@@ -99,6 +106,17 @@ TRACKED_PHASES = (
     PHASE_STEP_BOOKKEEPING,
 )
 ALL_PHASES = TRACKED_PHASES + (PHASE_UNTRACKED,)
+
+# a serving request's phases, in pipeline order (serving/engine.py is
+# the one consumer; defined HERE so the phase vocabulary keeps a single
+# linted definition site)
+SERVING_REQUEST_PHASES = (
+    PHASE_QUEUE_WAIT,
+    PHASE_ASSEMBLE,
+    PHASE_H2D_TRANSFER,
+    PHASE_DEVICE_COMPUTE,
+    PHASE_D2H_TRANSFER,
+)
 
 # device_compute sub-segments (recorded as extra event fields, not
 # phases: they SUM to device_compute, they don't add to it)

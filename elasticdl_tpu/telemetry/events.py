@@ -73,6 +73,13 @@ EVENT_RPC_FAULT_INJECTED = "rpc_fault_injected"
 # h2d_transfer / device_compute / step_bookkeeping / untracked, in ms)
 # — the data the report's goodput section is computed from
 EVENT_STEP_ANATOMY = "step_anatomy"
+# online serving plane (elasticdl_tpu/serving): one event per completed
+# predict request carrying its sum-exact phase decomposition
+# (queue_wait / assemble / h2d_transfer / device_compute / d2h_transfer
+# / untracked, in ms) / a replica hot-swapped its model state to a new
+# version with in-flight requests still draining on the old one
+EVENT_SERVING_REQUEST = "serving_request"
+EVENT_MODEL_SWAP = "model_swap"
 
 EVENTS_FILENAME = "events.jsonl"
 

@@ -54,6 +54,34 @@ STEP_LATENCY_BUCKETS = (
     60.0,
 )
 
+# log-spaced ONLINE-SERVING latency buckets (seconds): the step buckets
+# floor at 1ms, which is where a warm in-process predict dispatch LIVES —
+# every serving observation would land in the first one or two slots and
+# a p99 would be unreadable.  Serving extends the same 1-2.5-5 ladder two
+# decades down (100us resolution) and caps at 10s (anything slower than
+# that is an outage, not a latency).  Existing step histograms keep
+# STEP_LATENCY_BUCKETS unchanged (boundary-pinned by
+# tests/test_serving.py): a bucket change there would desynchronize the
+# monotone set_totals mirror between old and new processes mid-run.
+SERVING_LATENCY_BUCKETS = (
+    0.0001,
+    0.00025,
+    0.0005,
+    0.001,
+    0.0025,
+    0.005,
+    0.01,
+    0.025,
+    0.05,
+    0.1,
+    0.25,
+    0.5,
+    1.0,
+    2.5,
+    5.0,
+    10.0,
+)
+
 
 def _validate_name(name: str) -> str:
     if not _NAME_RE.match(name):

@@ -81,6 +81,8 @@ SPAN_MESH_RESIZE = "mesh_resize"  # master: hybrid mesh re-plan (resize)
 SPAN_AUTOSCALE_DECISION = "autoscale_decision"  # master: one SLO decision
 SPAN_RPC_DEGRADED = "rpc_degraded"  # netem window: link slow/blackholed
 SPAN_STEP_ANATOMY = "step_anatomy"  # one dispatch phase (phase= attr)
+SPAN_SERVING_REQUEST = "serving_request"  # serving: one request (sampled)
+SPAN_MODEL_SWAP = "model_swap"  # serving: one hot model swap
 
 
 def gen_trace_id() -> str:

@@ -209,6 +209,20 @@ def _add_data_params(parser: argparse.ArgumentParser):
             "(convenience; reference derives similarly)"
         ),
     )
+    parser.add_argument(
+        "--serving_addr",
+        default=None,
+        required=False,
+        help=(
+            "predict only: target a RUNNING serving endpoint "
+            "(elasticdl_tpu.serving.main router or replica, host:port) "
+            "instead of loading the model in-process — prediction "
+            "shards are decoded locally, batches predict remotely.  "
+            "Unset keeps the offline batch path (and, per the "
+            "flag-hygiene contract, is dropped from any reconstructed "
+            "argv)"
+        ),
+    )
 
 
 def _add_train_params(parser: argparse.ArgumentParser):

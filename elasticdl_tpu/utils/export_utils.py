@@ -55,9 +55,16 @@ def export_model(output_dir: str, state, spec, args) -> str:
     return output_dir
 
 
-def load_exported_model(output_dir: str):
+def read_manifest(output_dir: str) -> dict:
+    """The export's manifest dict (cheap: no npz load) — the serving
+    plane polls this to learn a directory grew a newer
+    ``model_version`` before paying for the parameter bytes."""
     with open(os.path.join(output_dir, _MANIFEST)) as f:
-        manifest = json.load(f)
+        return json.load(f)
+
+
+def load_exported_model(output_dir: str):
+    manifest = read_manifest(output_dir)
     spec = get_model_spec(
         manifest.get("model_zoo", ""),
         manifest["model_def"],

@@ -25,6 +25,12 @@
 # goodput section whose e2e_vs_roofline is computed from measured
 # phases, and the prefetch-on window's consumer-visible h2d share must
 # drop vs off)
+# + serving smoke (train+export MNIST, serve it through the real CLI
+# [frontend + 1 replica subprocess over gRPC]: mixed-size concurrent
+# requests per-row identical to the trainer's direct forward with
+# sum-exact per-request phases, compile counter FLAT across arbitrary
+# request sizes AND across a hot model swap under in-flight traffic
+# with zero failed requests)
 # + the ROADMAP.md test command, verbatim.
 # Run from the repo root: scripts/run_tier1.sh
 cd "$(dirname "$0")/.." || exit 2
@@ -47,4 +53,5 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/compile_smoke.py || exit 
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/replication_smoke.py || exit 1
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/master_ha_smoke.py || exit 1
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/multislice_smoke.py || exit 1
+timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/serving_smoke.py || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

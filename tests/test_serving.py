@@ -1,0 +1,965 @@
+"""Serving plane: micro-batcher policy, pre-compiled engine + hot swap,
+replica servicer over gRPC, router routing/eviction, telemetry buckets,
+and the ``predict --serving_addr`` client path.
+
+The model under serve is the iris linear classifier (4-float features,
+3 logits) — small enough that every engine build is cheap on CPU while
+exercising the full export -> load -> conform -> canonical-pad ->
+predict -> slice-out chain the heavier zoo models share.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elasticdl_tpu.rpc import messages as msg
+from elasticdl_tpu.rpc.deadline import DeadlinePolicy
+from elasticdl_tpu.serving.batcher import (
+    MicroBatcher,
+    ServingError,
+    ServingOverloadError,
+    ShapeMismatchError,
+    tree_rows,
+)
+from elasticdl_tpu.serving.engine import ExportDirWatcher, ServingEngine
+from elasticdl_tpu.serving.metrics import ServingMetrics
+from elasticdl_tpu.serving.replica import (
+    SERVING_METHODS,
+    ServingClient,
+    ServingReplica,
+    ServingReplicaServicer,
+)
+from elasticdl_tpu.serving.router import ServingRouter, _ReplicaHandle
+from elasticdl_tpu.telemetry.registry import (
+    SERVING_LATENCY_BUCKETS,
+    STEP_LATENCY_BUCKETS,
+    Histogram,
+)
+from elasticdl_tpu.trainer.state import TrainState, init_model
+from elasticdl_tpu.trainer.step import resolve_optimizer
+from elasticdl_tpu.utils.export_utils import export_model, read_manifest
+from elasticdl_tpu.utils.model_utils import get_model_spec
+
+IRIS_DEF = "odps_iris_dnn_model.odps_iris_dnn_model.custom_model"
+ROWS = 8  # canonical batch shape for these tests
+
+
+def _iris_args(**overrides) -> argparse.Namespace:
+    ns = argparse.Namespace(
+        model_zoo="",
+        model_def=IRIS_DEF,
+        model_params_dict={},
+    )
+    for key, value in overrides.items():
+        setattr(ns, key, value)
+    return ns
+
+
+def _export_iris(out_dir: str, version: int, scale: float = 1.0) -> str:
+    """Export an iris model at ``version`` (deterministic params scaled
+    by ``scale``, so distinct exports give distinct outputs)."""
+    spec = get_model_spec("", IRIS_DEF)
+    model = spec.build_model()
+    sample = {"features": np.zeros((1, 4), np.float32)}
+    params, model_state = init_model(model, sample)
+    params = jax.tree_util.tree_map(lambda x: x * scale + 0.01, params)
+    state = TrainState.create(
+        model.apply, params, resolve_optimizer(spec.optimizer), model_state
+    )
+    state = state.replace(step=jnp.asarray(version, jnp.int32))
+    return export_model(out_dir, state, spec, _iris_args())
+
+
+def _feats(n: int, seed: int = 0) -> dict:
+    rng = np.random.RandomState(seed)
+    return {"features": rng.rand(n, 4).astype(np.float32)}
+
+
+@pytest.fixture
+def export_v1(tmp_path):
+    return _export_iris(str(tmp_path / "export_v1"), version=3)
+
+
+# ---- micro-batcher ----------------------------------------------------------
+
+
+def test_tree_rows_validates():
+    assert tree_rows(np.zeros((5, 2))) == 5
+    assert tree_rows({"a": np.zeros((3, 1)), "b": np.zeros(3)}) == 3
+    with pytest.raises(ShapeMismatchError):
+        tree_rows({"a": np.zeros((3, 1)), "b": np.zeros(4)})
+    with pytest.raises(ShapeMismatchError):
+        tree_rows({})
+
+
+def test_batcher_coalesces_small_requests():
+    batcher = MicroBatcher(ROWS, max_wait_secs=10.0)
+    t1 = batcher.submit("a", _feats(3))
+    t2 = batcher.submit("b", _feats(5))
+    group = batcher.next_group(0.1)
+    assert group.n_real == ROWS  # full: dispatched without waiting
+    assert [(t.request_id, lo, hi) for t, lo, hi in group.segments] == [
+        ("a", 0, 3),
+        ("b", 0, 5),
+    ]
+    assert t1.rows == 3 and t2.rows == 5
+
+
+def test_batcher_splits_large_request_across_groups():
+    batcher = MicroBatcher(ROWS, max_wait_secs=0.0)
+    ticket = batcher.submit("big", _feats(ROWS * 2 + 3))
+    sizes = []
+    for _ in range(3):
+        group = batcher.next_group(0.1)
+        sizes.append(group.n_real)
+        assert group.segments[0][0] is ticket
+    assert sizes == [ROWS, ROWS, 3]
+    assert batcher.queue_rows() == 0
+
+
+def test_batcher_max_wait_flushes_partial():
+    batcher = MicroBatcher(ROWS, max_wait_secs=0.01)
+    batcher.submit("a", _feats(2))
+    t0 = time.monotonic()
+    group = batcher.next_group(1.0)
+    waited = time.monotonic() - t0
+    assert group.n_real == 2
+    assert waited < 0.5  # flushed by max-wait, not the poll timeout
+
+
+def test_batcher_zero_wait_dispatches_immediately():
+    batcher = MicroBatcher(ROWS, max_wait_secs=0.0)
+    batcher.submit("a", _feats(1))
+    group = batcher.next_group(0.1)
+    assert group.n_real == 1
+
+
+def test_batcher_overload_rejects_with_retryable_error():
+    batcher = MicroBatcher(ROWS, max_wait_secs=10.0, max_queue_rows=10)
+    batcher.submit("a", _feats(8))
+    with pytest.raises(ServingOverloadError) as exc:
+        batcher.submit("b", _feats(3))
+    assert exc.value.retryable
+    batcher.submit("c", _feats(2))  # still fits
+
+
+def test_batcher_admits_single_request_larger_than_bound():
+    """'1 row or 10,000': a request bigger than max_queue_rows must be
+    servable against an empty queue (it spans groups), and shed only
+    when real backlog sits in front of it."""
+    batcher = MicroBatcher(ROWS, max_wait_secs=0.0, max_queue_rows=10)
+    big = batcher.submit("big", _feats(25))  # > bound, empty queue: in
+    assert big.rows == 25
+    with pytest.raises(ServingOverloadError):
+        batcher.submit("late", _feats(25))  # backlog in front: shed
+    drained = 0
+    while drained < 25:
+        group = batcher.next_group(0.1)
+        drained += group.n_real
+    batcher.submit("again", _feats(25))  # drained: admitted again
+
+
+def test_batcher_close_fails_pending_tickets_retryably():
+    """Draining is RETRYABLE: predict is read-only, so the router must
+    be allowed to re-route a rolling-restart casualty."""
+    batcher = MicroBatcher(ROWS, max_wait_secs=10.0)
+    ticket = batcher.submit("a", _feats(2))
+    batcher.close()
+    with pytest.raises(ServingError) as exc:
+        ticket.result(1.0)
+    assert exc.value.retryable
+    with pytest.raises(ServingError) as exc:
+        batcher.submit("b", _feats(1))
+    assert exc.value.retryable
+    assert batcher.next_group(0.01) is None
+
+
+def test_ticket_completion_deferred_until_finish():
+    """deliver() must NOT wake the waiter: the engine closes the phase
+    decomposition first, then finish() releases — otherwise a response
+    can ship a half-closed (non-sum-exact) phase set."""
+    from elasticdl_tpu.serving.batcher import Ticket
+
+    ticket = Ticket("x", np.zeros((2, 1), np.float32), 2)
+    assert ticket.deliver(np.zeros((2, 3), np.float32), 2, 1) is True
+    assert not ticket.done
+    ticket.finish()
+    assert ticket.done
+
+
+def test_predict_with_retry_retries_only_retryable():
+    from elasticdl_tpu.serving.predict_client import _predict_with_retry
+
+    calls = []
+
+    class _Shedding:
+        def predict(self, _request):
+            calls.append(1)
+            return msg.PredictResponse(error="queue full", retryable=True)
+
+    response = _predict_with_retry(_Shedding(), None, attempts=3)
+    assert response.error and len(calls) == 3  # retried to exhaustion
+
+    calls.clear()
+
+    class _Broken:
+        def predict(self, _request):
+            calls.append(1)
+            return msg.PredictResponse(error="bad request", retryable=False)
+
+    response = _predict_with_retry(_Broken(), None, attempts=3)
+    assert response.error and len(calls) == 1  # not retried
+
+
+def test_group_features_concatenates_in_row_order():
+    batcher = MicroBatcher(ROWS, max_wait_secs=10.0)
+    a, b = _feats(3, seed=1), _feats(5, seed=2)
+    batcher.submit("a", a)
+    batcher.submit("b", b)
+    group = batcher.next_group(0.1)
+    feats = group.features()
+    np.testing.assert_array_equal(feats["features"][:3], a["features"])
+    np.testing.assert_array_equal(feats["features"][3:], b["features"])
+
+
+# ---- engine -----------------------------------------------------------------
+
+
+def _run_one(engine, request_id, features, max_wait=0.0):
+    """Drive one request through a private batcher + the engine (the
+    dispatch-loop body, synchronously)."""
+    batcher = MicroBatcher(engine.canonical_rows, max_wait_secs=max_wait)
+    ticket = batcher.submit(request_id, features)
+    while not ticket.done:
+        group = batcher.next_group(0.1)
+        if group is None:
+            break
+        engine.run_group(group)
+    return ticket
+
+
+def test_engine_parity_with_direct_apply(export_v1):
+    engine = ServingEngine(export_v1, ROWS)
+    feats = _feats(5)
+    served = engine.predict_rows(feats)
+    spec = get_model_spec("", IRIS_DEF)
+    model = spec.build_model()
+    from elasticdl_tpu.utils.export_utils import (
+        load_exported_model,
+        rebuild_variables,
+    )
+
+    model2, flat_params, flat_state = load_exported_model(export_v1)
+    params, model_state = rebuild_variables(
+        model2, {"features": feats["features"][:1]}, flat_params, flat_state
+    )
+    direct = model.apply(
+        {"params": params, **model_state}, feats, training=False
+    )
+    np.testing.assert_allclose(served, np.asarray(direct), atol=1e-5)
+    assert served.shape == (5, 3)
+
+
+def test_engine_zero_recompiles_across_mixed_sizes(export_v1):
+    from elasticdl_tpu.telemetry import compile_tracker
+
+    compile_tracker.install()
+    engine = ServingEngine(export_v1, ROWS)
+    _run_one(engine, "warm", _feats(ROWS))  # warmup compiles here
+    flat0 = compile_tracker.compile_count()
+    for i, n in enumerate([1, 7, ROWS, ROWS + 3, 2, ROWS * 3 + 1]):
+        ticket = _run_one(engine, f"r{i}", _feats(n, seed=i))
+        assert ticket.error is None
+        assert np.asarray(ticket.result(1.0)).shape == (n, 3)
+    assert compile_tracker.compile_count() == flat0  # compile-once
+
+
+def test_engine_conform_rejects_mismatches(export_v1):
+    engine = ServingEngine(export_v1, ROWS)
+    engine.predict_rows(_feats(2))  # locks the feature spec
+    with pytest.raises(ShapeMismatchError):
+        engine.conform({"features": np.zeros((2, 5), np.float32)})
+    with pytest.raises(ShapeMismatchError):
+        engine.conform({"wrong_key": np.zeros((2, 4), np.float32)})
+    with pytest.raises(ShapeMismatchError):
+        engine.conform(np.zeros((2, 4), np.float32))  # bare vs dict
+
+
+def test_engine_conform_casts_dtype_instead_of_recompiling(export_v1):
+    from elasticdl_tpu.telemetry import compile_tracker
+
+    compile_tracker.install()
+    engine = ServingEngine(export_v1, ROWS)
+    engine.predict_rows(_feats(2))
+    flat0 = compile_tracker.compile_count()
+    out = engine.predict_rows({"features": np.ones((3, 4), np.float64)})
+    assert out.shape == (3, 3)
+    assert compile_tracker.compile_count() == flat0
+
+
+def test_engine_request_anatomy_sums_exactly(export_v1):
+    engine = ServingEngine(export_v1, ROWS)
+    _run_one(engine, "warm", _feats(ROWS))
+    ticket = _run_one(engine, "r", _feats(ROWS * 2 + 1))  # spans 3 groups
+    assert ticket.dispatches == 3
+    phases = ticket.phases_secs
+    from elasticdl_tpu.telemetry.anatomy import (
+        PHASE_QUEUE_WAIT,
+        PHASE_UNTRACKED,
+        SERVING_REQUEST_PHASES,
+    )
+
+    assert set(SERVING_REQUEST_PHASES) <= set(phases)
+    assert PHASE_QUEUE_WAIT in phases and PHASE_UNTRACKED in phases
+    assert abs(sum(phases.values()) - ticket.total_secs()) < 1e-6
+
+
+def test_engine_hot_swap_advances_and_refuses_stale(export_v1, tmp_path):
+    export_v2 = _export_iris(str(tmp_path / "export_v2"), version=9, scale=3.0)
+    engine = ServingEngine(export_v1, ROWS)
+    feats = _feats(4)
+    before = engine.predict_rows(feats)
+    accepted, version, reason = engine.swap_from_export(export_v2)
+    assert accepted and version == 9 and not reason
+    after = engine.predict_rows(feats)
+    assert not np.allclose(before, after)  # new leaves actually serve
+    # stale re-delivery (the versioned-put contract) is absorbed
+    accepted2, version2, reason2 = engine.swap_from_export(export_v2)
+    assert not accepted2 and version2 == 9 and "stale" in reason2
+    # and a swap to a DIFFERENT model family is refused outright
+    other = _export_iris(str(tmp_path / "export_v3"), version=20)
+    manifest = read_manifest(other)
+    manifest["model_def"] = "mnist_functional_api.something"
+    import json
+
+    with open(os.path.join(other, "manifest.json"), "w") as f:
+        json.dump(manifest, f)
+    accepted3, _v, reason3 = engine.swap_from_export(other)
+    assert not accepted3 and "model_def mismatch" in reason3
+
+
+def test_engine_swap_zero_recompiles_and_prebuild_swap(export_v1, tmp_path):
+    from elasticdl_tpu.telemetry import compile_tracker
+
+    export_v2 = _export_iris(str(tmp_path / "export_v2"), version=7, scale=2.0)
+    # swap BEFORE the lazy build: the pending flats are replaced
+    engine = ServingEngine(export_v1, ROWS)
+    accepted, version, _ = engine.swap_from_export(export_v2)
+    assert accepted and version == 7 and not engine.built
+    out = engine.predict_rows(_feats(2))
+    assert engine.version == 7 and out.shape == (2, 3)
+    # swap AFTER build: program reused, compile counter flat
+    compile_tracker.install()
+    export_v3 = _export_iris(str(tmp_path / "export_v3"), version=11, scale=4.0)
+    flat0 = compile_tracker.compile_count()
+    accepted, _, _ = engine.swap_from_export(export_v3)
+    assert accepted
+    engine.predict_rows(_feats(3))
+    assert compile_tracker.compile_count() == flat0
+
+
+def test_engine_swap_state_dicts_in_memory(export_v1):
+    """The ReplicaStore/checkpoint-stream seam: flat name-keyed arrays
+    swap in without any disk artifact."""
+    from elasticdl_tpu.utils import tree_utils
+
+    engine = ServingEngine(export_v1, ROWS)
+    feats = _feats(3)
+    before = engine.predict_rows(feats)
+    flat = tree_utils.tree_to_dict(engine._state.params)
+    flat = {k: v * 5.0 for k, v in flat.items()}
+    accepted, version, _ = engine.swap_state_dicts(
+        flat, {}, engine.version + 4, source="replica-store"
+    )
+    assert accepted and version == engine.version
+    after = engine.predict_rows(feats)
+    assert not np.allclose(before, after)
+
+
+def test_engine_swap_incompatible_state_refused(export_v1):
+    engine = ServingEngine(export_v1, ROWS)
+    engine.predict_rows(_feats(2))
+    accepted, _v, reason = engine.swap_state_dicts(
+        {"not_a_param": np.zeros(3)}, {}, engine.version + 1
+    )
+    assert not accepted and "incompatible state" in reason
+
+
+def test_export_watcher_applies_new_version(export_v1):
+    engine = ServingEngine(export_v1, ROWS)
+    watcher = ExportDirWatcher(engine, export_v1)
+    assert not watcher.poll_once()  # same version: no-op
+    _export_iris(export_v1, version=21, scale=2.0)  # re-export in place
+    assert watcher.poll_once()
+    assert engine.version == 21
+    assert not watcher.poll_once()
+
+
+def test_serving_events_and_metrics_emitted(export_v1, tmp_path):
+    from elasticdl_tpu.telemetry import worker_hooks
+    from elasticdl_tpu.telemetry.events import (
+        EVENT_MODEL_SWAP,
+        EVENT_SERVING_REQUEST,
+        read_events,
+    )
+
+    telemetry_dir = str(tmp_path / "telemetry")
+    worker_hooks.install(telemetry_dir)
+    try:
+        metrics = ServingMetrics()
+        engine = ServingEngine(export_v1, ROWS, metrics=metrics)
+        _run_one(engine, "req-1", _feats(5))
+        export_v2 = _export_iris(
+            str(tmp_path / "export_v2"), version=30, scale=2.0
+        )
+        engine.swap_from_export(export_v2)
+        events = read_events(
+            os.path.join(telemetry_dir, "events.jsonl")
+        )
+        requests = [
+            e for e in events if e["event"] == EVENT_SERVING_REQUEST
+        ]
+        swaps = [e for e in events if e["event"] == EVENT_MODEL_SWAP]
+        assert len(requests) == 1 and len(swaps) == 1
+        req = requests[0]
+        assert req["rows"] == 5 and req["request_id"] == "req-1"
+        tracked = sum(
+            v for k, v in req.items() if k.endswith("_ms") and k != "total_ms"
+        )
+        assert abs(tracked - req["total_ms"]) < 1e-3  # sum-exact in ms
+        assert swaps[0]["model_version"] == 30
+        assert metrics.requests.value == 1
+        assert metrics.rows.value == 5
+        assert metrics.swaps.value == 1
+        assert metrics.model_version.value == 30
+    finally:
+        worker_hooks.uninstall()
+
+
+# ---- messages ---------------------------------------------------------------
+
+
+def test_pack_array_tree_roundtrip():
+    bare = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = msg.unpack_array_tree(msg.pack_array_tree(bare))
+    np.testing.assert_array_equal(out, bare)
+    tree = {"a": np.ones((2, 3)), "b": np.zeros(2, np.int64)}
+    out = msg.unpack_array_tree(msg.pack_array_tree(tree))
+    assert set(out) == {"a", "b"}
+    np.testing.assert_array_equal(out["a"], tree["a"])
+    assert out["b"].dtype == np.int64
+
+
+def test_replica_stale_swap_response_carries_structured_field(
+    replica, export_v1, tmp_path
+):
+    """The router's convergence logic reads SwapModelResponse.stale,
+    never the reason wording — pin that the replica sets it."""
+    export_v2 = _export_iris(str(tmp_path / "v2"), version=40, scale=2.0)
+    first = replica.servicer.swap_model(
+        msg.SwapModelRequest(model_dir=export_v2)
+    )
+    assert first.accepted and not first.stale
+    replay = replica.servicer.swap_model(
+        msg.SwapModelRequest(model_dir=export_v2)
+    )
+    assert not replay.accepted and replay.stale
+    assert replay.model_version == 40
+
+
+def test_serving_messages_encode_decode_roundtrip():
+    request = msg.PredictRequest(
+        request_id="r1", features=msg.pack_array_tree(np.ones((2, 4))), rows=2
+    )
+    decoded = msg.decode(msg.encode(request))
+    assert decoded.request_id == "r1" and decoded.rows == 2
+    np.testing.assert_array_equal(
+        msg.unpack_array_tree(decoded.features), np.ones((2, 4))
+    )
+    response = msg.PredictResponse(
+        outputs=msg.pack_array_tree({"y": np.zeros(3)}),
+        model_version=7,
+        rows=3,
+        phases={"queue_wait": 0.5, "total_ms": 2.0},
+    )
+    decoded = msg.decode(msg.encode(response))
+    assert decoded.model_version == 7 and decoded.phases["total_ms"] == 2.0
+    status = msg.decode(
+        msg.encode(msg.ServingStatusResponse(replica_id=2, compile_count=5))
+    )
+    assert status.replica_id == 2 and status.compile_count == 5
+    swap = msg.decode(
+        msg.encode(msg.SwapModelRequest(model_dir="/x", min_version=3))
+    )
+    assert swap.model_dir == "/x" and swap.min_version == 3
+
+
+def test_serving_methods_all_classified():
+    from elasticdl_tpu.rpc.idempotency import IDEMPOTENCY
+
+    for method in SERVING_METHODS:
+        assert method in IDEMPOTENCY, method
+
+
+# ---- replica servicer + gRPC ------------------------------------------------
+
+
+@pytest.fixture
+def replica(export_v1):
+    rep = ServingReplica(
+        export_v1, ROWS, max_wait_secs=0.002, replica_id=0, port=0
+    ).start()
+    yield rep
+    rep.close()
+
+
+def test_replica_grpc_mixed_sizes_concurrent(replica):
+    client = ServingClient(
+        f"localhost:{replica.port}", deadlines=DeadlinePolicy.from_secs(10)
+    )
+    try:
+        sizes = [1, 7, ROWS, ROWS + 3]
+        with ThreadPoolExecutor(4) as pool:
+            futures = [
+                pool.submit(
+                    client.predict,
+                    msg.PredictRequest(
+                        request_id=f"q{i}",
+                        features=msg.pack_array_tree(_feats(n, seed=i)),
+                    ),
+                )
+                for i, n in enumerate(sizes)
+            ]
+            responses = [f.result() for f in futures]
+        for n, response in zip(sizes, responses):
+            assert not response.error, response.error
+            out = msg.unpack_array_tree(response.outputs)
+            assert np.asarray(out).shape == (n, 3)
+            assert response.phases["total_ms"] > 0
+        status = client.serving_status()
+        assert status.requests == len(sizes)
+        assert status.rows == sum(sizes)
+        assert status.canonical_rows == ROWS
+    finally:
+        client.close()
+
+
+def test_replica_grpc_parity_per_row(replica, export_v1):
+    engine = ServingEngine(export_v1, ROWS)
+    client = ServingClient(
+        f"localhost:{replica.port}", deadlines=DeadlinePolicy.from_secs(10)
+    )
+    try:
+        feats = _feats(6, seed=42)
+        response = client.predict(
+            msg.PredictRequest(
+                request_id="p", features=msg.pack_array_tree(feats)
+            )
+        )
+        assert not response.error
+        np.testing.assert_allclose(
+            msg.unpack_array_tree(response.outputs),
+            engine.predict_rows(feats),
+            atol=1e-5,
+        )
+    finally:
+        client.close()
+
+
+def test_replica_overload_response_is_retryable(export_v1):
+    # no dispatch thread: the queue only fills
+    rep = ServingReplica(export_v1, ROWS, max_queue_rows=8)
+    servicer = rep.servicer
+    first = threading.Thread(
+        target=servicer.predict,
+        args=(
+            msg.PredictRequest(
+                request_id="fill", features=msg.pack_array_tree(_feats(8))
+            ),
+        ),
+        daemon=True,
+    )
+    first.start()
+    for _ in range(100):
+        if rep.batcher.queue_rows() == 8:
+            break
+        time.sleep(0.01)
+    response = servicer.predict(
+        msg.PredictRequest(
+            request_id="shed", features=msg.pack_array_tree(_feats(1))
+        )
+    )
+    assert response.error and response.retryable
+    assert rep.engine.metrics.rejected.value == 1
+    rep.batcher.close()  # releases the filler thread
+    first.join(timeout=5)
+
+
+def test_replica_bad_payload_answers_not_crashes(replica):
+    response = replica.servicer.predict(
+        msg.PredictRequest(request_id="bad", features=b"not tensors")
+    )
+    assert response.error and not response.retryable
+
+
+# ---- router -----------------------------------------------------------------
+
+
+class _FakeClient:
+    def __init__(self, outcome):
+        self.outcome = outcome  # callable or response
+        self.calls = 0
+        self.swaps = []
+        self.closed = False
+
+    def predict(self, request):
+        self.calls += 1
+        if callable(self.outcome):
+            return self.outcome(request)
+        return self.outcome
+
+    def serving_status(self, request=None):
+        return msg.ServingStatusResponse(replica_id=0, model_version=1)
+
+    def swap_model(self, request):
+        self.swaps.append(request)
+        return msg.SwapModelResponse(accepted=True, model_version=5)
+
+    def close(self):
+        self.closed = True
+
+
+def _inject(router, replica_id, client, last_seen=None):
+    handle = _ReplicaHandle(replica_id, f"fake:{replica_id}", client)
+    if last_seen is not None:
+        handle.last_seen = last_seen
+    router._replicas[replica_id] = handle
+    return handle
+
+
+def _unavailable_error():
+    from elasticdl_tpu.chaos.netem import InjectedRpcError
+    import grpc
+
+    return InjectedRpcError(grpc.StatusCode.UNAVAILABLE, "down")
+
+
+def test_router_routes_around_dead_replica():
+    router = ServingRouter()
+    ok = msg.PredictResponse(outputs=b"", model_version=1, rows=1)
+
+    def dead(_request):
+        raise _unavailable_error()
+
+    dead_client = _FakeClient(dead)
+    live_client = _FakeClient(ok)
+    _inject(router, 0, dead_client)
+    _inject(router, 1, live_client)
+    # make replica 0 preferred (least outstanding tie -> first found);
+    # force deterministic: give live one outstanding so dead is tried
+    router._replicas[1].outstanding = 1
+    response = router.predict(msg.PredictRequest(request_id="r"))
+    assert not response.error
+    assert dead_client.calls == 1 and live_client.calls == 1
+    # leases released either way (replica 1 keeps its preset baseline)
+    assert router._replicas[0].outstanding == 0
+    assert router._replicas[1].outstanding == 1
+
+
+def test_router_nonretryable_error_raises():
+    router = ServingRouter()
+
+    def boom(_request):
+        raise ValueError("bug, not outage")
+
+    _inject(router, 0, _FakeClient(boom))
+    with pytest.raises(ValueError):
+        router.predict(msg.PredictRequest(request_id="r"))
+
+
+def test_router_skips_evicted_replica():
+    router = ServingRouter(evict_after_secs=0.5)
+    ok = msg.PredictResponse(outputs=b"", model_version=1, rows=1)
+    stale_client = _FakeClient(ok)
+    _inject(router, 0, stale_client, last_seen=time.monotonic() - 10)
+    response = router.predict(msg.PredictRequest(request_id="r"))
+    assert response.error and response.retryable  # nothing live
+    assert stale_client.calls == 0
+    assert router.live_replicas() == []
+
+
+def test_router_retryable_overload_tries_next_replica():
+    router = ServingRouter()
+    shed = msg.PredictResponse(error="queue full", retryable=True)
+    ok = msg.PredictResponse(outputs=b"", model_version=1, rows=1)
+    a, b = _FakeClient(shed), _FakeClient(ok)
+    _inject(router, 0, a)
+    _inject(router, 1, b)
+    router._replicas[1].outstanding = 1  # a first
+    response = router.predict(msg.PredictRequest(request_id="r"))
+    assert not response.error
+    assert a.calls == 1 and b.calls == 1
+
+
+def test_router_swap_fans_to_all_and_merges():
+    router = ServingRouter()
+    a, b = _FakeClient(None), _FakeClient(None)
+    _inject(router, 0, a)
+    _inject(router, 1, b)
+    response = router.swap_model(msg.SwapModelRequest(model_dir="/m"))
+    assert response.accepted and response.model_version == 5
+    assert len(a.swaps) == 1 and len(b.swaps) == 1
+    assert len(response.replicas) == 2
+
+
+def test_router_swap_redelivery_absorbed_and_unreachable_not():
+    """The versioned-put contract at the ROUTER level: a re-delivered
+    swap every replica refuses as stale IS converged (accepted); an
+    unreachable replica means the fleet is NOT consistently swapped."""
+    router = ServingRouter()
+
+    class _StaleClient(_FakeClient):
+        def swap_model(self, request):
+            return msg.SwapModelResponse(
+                accepted=False,
+                model_version=5,
+                reason="stale swap: version 5 <= served 5",
+                stale=True,
+            )
+
+    _inject(router, 0, _StaleClient(None))
+    _inject(router, 1, _StaleClient(None))
+    response = router.swap_model(msg.SwapModelRequest(model_dir="/m"))
+    assert response.accepted  # replay fully absorbed
+    assert all(o["absorbed"] for o in response.replicas)
+
+    class _DownClient(_FakeClient):
+        def swap_model(self, request):
+            raise _unavailable_error()
+
+    _inject(router, 2, _DownClient(None))
+    response = router.swap_model(msg.SwapModelRequest(model_dir="/m"))
+    assert not response.accepted  # one replica missed the swap
+    assert "unreachable" in response.reason
+
+
+def test_router_probe_refreshes_and_forgets():
+    router = ServingRouter(evict_after_secs=0.5, forget_after_secs=1.0)
+    ok_client = _FakeClient(msg.PredictResponse())
+    handle = _inject(router, 0, ok_client, last_seen=time.monotonic() - 0.9)
+
+    class _DeadStatus:
+        def serving_status(self, request=None):
+            raise _unavailable_error()
+
+        def close(self):
+            pass
+
+    dead = _DeadStatus()
+    _inject(router, 1, dead, last_seen=time.monotonic() - 5.0)
+    router.probe_once()
+    assert 0 in router.live_replicas()  # probe refreshed it
+    assert handle.last_status is not None
+    assert 1 not in router._replicas  # silent past forget horizon
+
+
+def test_router_e2e_grpc(replica):
+    router = ServingRouter(deadlines=DeadlinePolicy.from_secs(10))
+    try:
+        router.add_replica(f"localhost:{replica.port}")
+        router.probe_once()
+        response = router.predict(
+            msg.PredictRequest(
+                request_id="r", features=msg.pack_array_tree(_feats(3))
+            )
+        )
+        assert not response.error
+        assert np.asarray(
+            msg.unpack_array_tree(response.outputs)
+        ).shape == (3, 3)
+        status = router.serving_status(msg.ServingStatusRequest(detail=True))
+        assert status.model_version == 3
+        assert len(status.replicas) == 1
+    finally:
+        router.close()
+
+
+# ---- chaos: the netem seam applies to serving RPCs --------------------------
+
+
+def test_serving_predict_survives_injected_unavailable(replica):
+    """A client-side injected UNAVAILABLE rides the SAME retry loop as
+    control-plane RPCs — predict is classified retry-safe."""
+    from elasticdl_tpu.chaos.netem import NetemShim
+    from elasticdl_tpu.chaos.plan import Fault, FaultKind
+    from elasticdl_tpu.rpc import service as rpc_service
+    from elasticdl_tpu.rpc.retry import RetryPolicy
+
+    shim = NetemShim(
+        [
+            Fault(
+                kind=FaultKind.NET_UNAVAILABLE,
+                fault_id="u",
+                method="predict",
+                count=1,
+            )
+        ],
+        plan_seed=1,
+    )
+    rpc_service.set_client_fault_shim(shim)
+    try:
+        client = ServingClient(
+            f"localhost:{replica.port}",
+            retry=RetryPolicy(max_attempts=5),
+            deadlines=DeadlinePolicy.from_secs(10),
+        )
+        try:
+            response = client.predict(
+                msg.PredictRequest(
+                    request_id="r", features=msg.pack_array_tree(_feats(2))
+                )
+            )
+        finally:
+            client.close()
+        assert not response.error  # the injected failure was retried
+    finally:
+        rpc_service.set_client_fault_shim(None)
+
+
+def test_serving_predict_duplicate_delivery_harmless(replica):
+    """Server-side duplicate delivery re-executes predict — read-only,
+    so the caller still gets one correct answer."""
+    from elasticdl_tpu.chaos.netem import NetemShim
+    from elasticdl_tpu.chaos.plan import Fault, FaultKind
+    from elasticdl_tpu.rpc import service as rpc_service
+
+    shim = NetemShim(
+        [
+            Fault(
+                kind=FaultKind.NET_DUPLICATE,
+                fault_id="d",
+                method="predict",
+                count=1,
+            )
+        ],
+        plan_seed=1,
+    )
+    rpc_service.set_server_fault_shim(shim)
+    try:
+        client = ServingClient(
+            f"localhost:{replica.port}",
+            deadlines=DeadlinePolicy.from_secs(10),
+        )
+        try:
+            feats = _feats(4, seed=9)
+            response = client.predict(
+                msg.PredictRequest(
+                    request_id="dup", features=msg.pack_array_tree(feats)
+                )
+            )
+        finally:
+            client.close()
+        assert not response.error
+        assert np.asarray(
+            msg.unpack_array_tree(response.outputs)
+        ).shape == (4, 3)
+    finally:
+        rpc_service.set_server_fault_shim(None)
+
+
+# ---- histogram buckets (satellite: sub-ms serving resolution) ---------------
+
+
+def test_step_buckets_pinned_unchanged():
+    """The monotone set_totals mirror depends on stable step-bucket
+    boundaries; serving got its OWN family instead of changing these."""
+    assert STEP_LATENCY_BUCKETS == (
+        0.001,
+        0.0025,
+        0.005,
+        0.01,
+        0.025,
+        0.05,
+        0.1,
+        0.25,
+        0.5,
+        1.0,
+        2.5,
+        5.0,
+        10.0,
+        30.0,
+        60.0,
+    )
+    assert Histogram().bounds == STEP_LATENCY_BUCKETS
+
+
+def test_serving_buckets_sub_millisecond_resolution():
+    assert SERVING_LATENCY_BUCKETS[0] == pytest.approx(0.0001)
+    assert sum(1 for b in SERVING_LATENCY_BUCKETS if b < 0.001) == 3
+    assert SERVING_LATENCY_BUCKETS == tuple(sorted(SERVING_LATENCY_BUCKETS))
+    assert SERVING_LATENCY_BUCKETS[-1] == 10.0
+    metrics = ServingMetrics()
+    metrics.observe_latency("total", 0.0004)
+    hist = metrics._latency["total"]
+    assert hist.bounds == SERVING_LATENCY_BUCKETS
+    snap = hist.snapshot()
+    assert snap["buckets"][0.0005] == 1  # sub-ms observation resolved
+    assert snap["buckets"][0.00025] == 0
+
+
+# ---- predict --serving_addr (satellite) -------------------------------------
+
+
+def test_serving_addr_flag_preserves_argv_byte_identity():
+    from elasticdl_tpu.utils.args import (
+        build_arguments_from_parsed_result,
+        parse_master_args,
+    )
+
+    base = ["--model_def", IRIS_DEF, "--prediction_data", "/tmp/x"]
+    args_unset = parse_master_args(base)
+    args_set = parse_master_args(base + ["--serving_addr", "localhost:1"])
+    rebuilt_unset = build_arguments_from_parsed_result(args_unset)
+    rebuilt_set = build_arguments_from_parsed_result(args_set)
+    assert "--serving_addr" not in rebuilt_unset  # None is dropped
+    assert "--serving_addr" in rebuilt_set
+    assert [a for a in rebuilt_set if a != "--serving_addr"
+            and a != "localhost:1"] == rebuilt_unset
+
+
+def test_predict_cli_targets_serving_endpoint(replica, tmp_path):
+    from elasticdl_tpu import api
+    from elasticdl_tpu.data.recordio_gen import synthetic
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    data_dir = synthetic.gen_iris(
+        str(tmp_path / "iris"), num_records=24, num_shards=1, seed=3
+    )
+    args = parse_master_args(
+        [
+            "--model_def",
+            IRIS_DEF,
+            "--prediction_data",
+            data_dir,
+            "--minibatch_size",
+            "8",
+            "--records_per_task",
+            "24",
+            "--serving_addr",
+            f"localhost:{replica.port}",
+        ]
+    )
+    result = api.predict(args)
+    assert result["rows"] == 24
+    assert result["failures"] == 0
+    assert result["model_version"] == 3
+    assert replica.engine.requests_served >= 3
