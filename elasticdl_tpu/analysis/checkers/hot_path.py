@@ -63,6 +63,7 @@ _MAX_ATTR_DEPTH = 3
 PRINT_ALLOWLIST = (
     "elasticdl_tpu/analysis/",
     "elasticdl_tpu/chaos/runner.py",
+    "elasticdl_tpu/fleetsim/runner.py",
     "elasticdl_tpu/telemetry/report.py",
     "elasticdl_tpu/telemetry/trace.py",
     "elasticdl_tpu/client.py",

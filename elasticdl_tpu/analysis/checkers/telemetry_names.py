@@ -59,6 +59,7 @@ REQUIRED_EVENT_NAMES = frozenset(
         "step_anatomy",
         "serving_request",
         "model_swap",
+        "fleet_fault",
     }
 )
 REQUIRED_SPAN_NAMES = frozenset(
@@ -77,6 +78,7 @@ REQUIRED_SPAN_NAMES = frozenset(
         "step_anatomy",
         "serving_request",
         "model_swap",
+        "fleet_fault",
     }
 )
 REQUIRED_PHASE_NAMES = frozenset(
@@ -104,6 +106,13 @@ REQUIRED_METRIC_NAMES = frozenset(
         "elasticdl_serving_latency_seconds",
         "elasticdl_serving_requests_total",
         "elasticdl_serving_swaps_total",
+        # thousand-worker control plane (coalesced heartbeat fan-in,
+        # incremental dead-worker sweep, cardinality-bounded per-worker
+        # series) — the fleetsim scale budgets scrape these
+        "elasticdl_heartbeats_total",
+        "elasticdl_heartbeat_batches_total",
+        "elasticdl_dead_worker_sweep_ms_total",
+        "elasticdl_worker_heartbeat_age_secs",
     }
 )
 

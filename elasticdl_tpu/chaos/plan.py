@@ -129,6 +129,11 @@ class Fault:
     # network-kind fields (defaults keep old plan JSONs loading)
     method: str = ""
     direction: str = "request"
+    # fleet-scale mass-fault target (elasticdl_tpu.fleetsim): the
+    # fraction of the live fleet a PREEMPT kills in ONE tick when no
+    # single process_id is named.  0.0 (the default) keeps every
+    # process-targeted plan and old plan JSON byte-identical.
+    fraction: float = 0.0
 
     def __post_init__(self):
         if self.kind not in FaultKind.ALL:

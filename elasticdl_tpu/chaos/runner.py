@@ -354,6 +354,10 @@ def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
     if args.list or args.list_plans:
         from elasticdl_tpu.chaos.plan import builtin_plans
+        from elasticdl_tpu.fleetsim.plans import (
+            FLEET_INVARIANT_DESCRIPTIONS,
+            builtin_fleet_plans,
+        )
 
         print("Plans:")
         for name, plan in sorted(
@@ -361,9 +365,19 @@ def main(argv=None) -> int:
         ):
             note = " ".join(plan.notes.split())
             print(f"  {name:26s} {note}")
+        # fleet-scale plans run through the deterministic simulator
+        # (python -m elasticdl_tpu.fleetsim), not this runner's
+        # process-level harness — but they are one catalogue: same
+        # FaultPlan data model, same chaos_result.json verdict schema
+        print("Fleet plans (python -m elasticdl_tpu.fleetsim):")
+        for name, plan in sorted(builtin_fleet_plans().items()):
+            note = " ".join(plan.notes.split())
+            print(f"  {name:26s} {note}")
         if args.list:
             print("Invariants:")
-            for name, desc in sorted(INVARIANT_DESCRIPTIONS.items()):
+            merged = dict(INVARIANT_DESCRIPTIONS)
+            merged.update(FLEET_INVARIANT_DESCRIPTIONS)
+            for name, desc in sorted(merged.items()):
                 print(f"  {name:26s} {desc}")
         return 0
 

@@ -1,0 +1,27 @@
+"""Deterministic thousand-worker control-plane simulation.
+
+Every robustness claim before this subsystem was measured at <= 4 local
+processes.  ``fleetsim`` drives the REAL control plane — the production
+:class:`~elasticdl_tpu.master.servicer.MasterServicer`,
+:class:`~elasticdl_tpu.master.task_dispatcher.TaskDispatcher`, the
+:mod:`~elasticdl_tpu.master.journal` write-ahead journal and the
+telemetry mirrors — with thousands of lightweight simulated workers on
+a seeded virtual clock: no JAX, no subprocesses, no sleeps.  Worker
+traffic (heartbeats, task leases, reports, version pings) flows through
+the PR-8 netem seam objects, so transport faults (duplicate delivery,
+delay) inject exactly as they do in a real run.
+
+Two products per run:
+
+- **invariants** — exactly-once task accounting (the real
+  :class:`~elasticdl_tpu.chaos.invariants.InvariantChecker`), fleet
+  recovery, and max-merge monotonicity under coalesced/duplicated
+  heartbeats, reported in the same ``chaos_result.json`` verdict
+  schema the chaos runner writes;
+- **scaling budgets** — master CPU per heartbeat, dead-worker sweep
+  latency, mass-fault reform-fence latency, journal bytes per event,
+  and ``/metrics`` scrape time + series cardinality at world size,
+  each a falsifiable PASS/FAIL gate.
+
+See ``docs/designs/fleet_simulation.md``.
+"""

@@ -1,0 +1,8 @@
+"""``python -m elasticdl_tpu.fleetsim`` — the fleet-simulator CLI."""
+
+import sys
+
+from elasticdl_tpu.fleetsim.runner import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,38 @@
+"""Virtual monotonic clock — the determinism backbone of fleetsim.
+
+Every control-plane object the simulator drives takes an injectable
+clock (``MasterServicer(clock=...)``, ``TaskDispatcher(clock=...)``,
+``NetemShim(clock=..., sleep=...)``), so heartbeat timeouts, lease
+expiry and netem windows all read THIS clock and the whole run is a
+pure function of (plan, seed, world size) — wall time never enters the
+event order.  Real CPU time is still measured (``time.perf_counter``)
+around the calls, but only as a budget OUTPUT, never an input.
+"""
+
+from __future__ import annotations
+
+
+class VirtualClock:
+    """A monotonically advancing virtual time in seconds."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def now(self) -> float:
+        return self._now
+
+    # the injectable ``clock`` callable (time.monotonic drop-in)
+    def __call__(self) -> float:
+        return self._now
+
+    def sleep(self, secs: float):
+        """The injectable ``sleep``: advances virtual time.  Netem
+        delays therefore stretch the simulated timeline instead of the
+        real one."""
+        if secs > 0:
+            self._now += float(secs)
+
+    def advance_to(self, at: float):
+        """Jump forward to ``at`` (event-loop pops); never rewinds."""
+        if at > self._now:
+            self._now = float(at)

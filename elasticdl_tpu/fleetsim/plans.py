@@ -1,0 +1,112 @@
+"""Fleet-scale fault plans — the signature chaos scenarios at n >= 1000.
+
+Same :class:`~elasticdl_tpu.chaos.plan.FaultPlan` data model and JSON
+discipline as ``chaos/`` (seeded, replayable, ``--plan`` named), with
+one interpretation shift the simulator owns: ``at_step`` on a
+fleet-plan fault is the VIRTUAL-TIME second it fires (the simulator has
+a clock, not a trainer step), and a ``PREEMPT`` with ``fraction`` set
+kills that fraction of the live fleet in one tick.  ``chaos.runner
+--list`` lists these next to the process-scale plans; running them goes
+through ``python -m elasticdl_tpu.fleetsim.runner``.
+"""
+
+from __future__ import annotations
+
+from elasticdl_tpu.chaos.plan import Fault, FaultKind, FaultPlan
+
+# the three tier-1 gate plans (scripts/fleetsim_smoke.py runs them all)
+GATE_PLANS = (
+    "fleet_mass_preemption",
+    "fleet_rolling_slice_loss",
+    "fleet_master_kill_fanin",
+)
+
+# how the simulated fleet is partitioned for SLICE_LOSS faults
+DEFAULT_FLEET_SLICES = 8
+
+# fleet-scale invariants the simulator can emit, for --list
+# discoverability (chaos/runner.py merges these with its own table)
+FLEET_INVARIANT_DESCRIPTIONS = {
+    "fleet_recovery": "the fleet-scale job completed within the virtual "
+    "deadline and exactly the planned survivors stayed live",
+    "heartbeat_merge_monotone": "coalesced/batched/duplicated heartbeat "
+    "fan-in produced exactly the per-worker monotone maxima the workers "
+    "shipped (utils/merge.py contract at world size)",
+    "budget_compliance": "every control-plane scaling budget held: "
+    "master CPU per heartbeat, sweep and reform-fence latency, journal "
+    "bytes per event, /metrics scrape time and series cardinality",
+    "determinism": "the same (plan, seed, world size) reproduced the "
+    "same virtual event log (digest equality across runs)",
+}
+
+
+def builtin_fleet_plans() -> dict[str, FaultPlan]:
+    """The named fleet-scale plans.  Deliberately world-size-free:
+    mass faults target FRACTIONS (``Fault.fraction``) or slices, so
+    one plan JSON replays identically at any ``--workers``."""
+    plans = {
+        "fleet_mass_preemption": FaultPlan(
+            name="fleet_mass_preemption",
+            faults=[
+                Fault(
+                    kind=FaultKind.PREEMPT,
+                    fault_id="mass-preempt-30pct",
+                    at_step=20,  # virtual seconds
+                    fraction=0.30,
+                ),
+                Fault(
+                    kind=FaultKind.NET_DUPLICATE,
+                    fault_id="dup-heartbeat-storm",
+                    at_step=100,  # matched heartbeat calls to skip
+                    method="heartbeat",
+                    count=500,
+                ),
+            ],
+            notes="30% of the fleet dies in ONE virtual tick while 500 "
+            "heartbeats are re-delivered server-side: the sweep must "
+            "detect and the dispatcher requeue every lost lease with "
+            "exactly-once accounting, and max-merge must absorb every "
+            "duplicate beat",
+        ),
+        "fleet_rolling_slice_loss": FaultPlan(
+            name="fleet_rolling_slice_loss",
+            faults=[
+                Fault(
+                    kind=FaultKind.SLICE_LOSS,
+                    fault_id=f"rolling-slice-{slice_id}",
+                    at_step=15 + 12 * wave,  # virtual seconds
+                    slice_id=slice_id,
+                )
+                for wave, slice_id in enumerate((1, 2, 3))
+            ],
+            notes="three whole slices (an eighth of the fleet each) die "
+            "in rolling waves: every wave's leases requeue onto the "
+            "survivors and no record is lost or double-trained across "
+            "the shrinking fleet",
+        ),
+        "fleet_master_kill_fanin": FaultPlan(
+            name="fleet_master_kill_fanin",
+            faults=[
+                Fault(
+                    kind=FaultKind.MASTER_KILL,
+                    fault_id="master-kill-under-fanin",
+                    at_step=20,  # virtual seconds
+                    duration_secs=5.0,
+                )
+            ],
+            notes="SIGKILL the master under full thousand-worker "
+            "heartbeat fan-in: journal replay restores the dispatcher, "
+            "every surviving worker re-homes presenting its leases, and "
+            "exactly-once accounting spans the outage at fleet scale",
+        ),
+    }
+    return plans
+
+
+def named_fleet_plan(name: str) -> FaultPlan:
+    plans = builtin_fleet_plans()
+    if name not in plans:
+        raise KeyError(
+            f"unknown fleet plan {name!r}; available: {sorted(plans)}"
+        )
+    return plans[name]

@@ -1,0 +1,186 @@
+"""Fleet-simulator CLI.
+
+Run a fleet-scale plan against the real control plane, check the
+elastic + scaling-budget invariants, write ``fleetsim_result.json``
+(same verdict schema as ``chaos_result.json``), print one JSON report,
+and exit non-zero if any invariant failed::
+
+    python -m elasticdl_tpu.fleetsim --plan fleet_mass_preemption
+    python -m elasticdl_tpu.fleetsim --plan fleet_master_kill_fanin --workers 1000
+    python -m elasticdl_tpu.fleetsim --plan fleet_mass_preemption --corrupt slow_sweep
+    python -m elasticdl_tpu.fleetsim --list
+
+``--corrupt`` seeds a deliberate regression (a slow sweep, a dropped
+recovery, an unbounded metrics series set) to prove the corresponding
+gate actually trips — a corrupted run MUST exit 1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+RESULT_FILENAME = "fleetsim_result.json"
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    from elasticdl_tpu.fleetsim.sim import CORRUPTIONS
+
+    parser = argparse.ArgumentParser(
+        prog="python -m elasticdl_tpu.fleetsim",
+        description="Deterministic thousand-worker control-plane "
+        "simulation against the real master",
+    )
+    parser.add_argument(
+        "--plan",
+        default="fleet_mass_preemption",
+        help="Named fleet plan (see --list)",
+    )
+    parser.add_argument(
+        "--list",
+        action="store_true",
+        help="List fleet plans AND invariants with one-line "
+        "descriptions, then exit 0",
+    )
+    parser.add_argument("--workers", type=int, default=1000)
+    parser.add_argument("--seed", type=int, default=1234)
+    parser.add_argument("--num-tasks", type=int, default=1500)
+    parser.add_argument("--records-per-task", type=int, default=64)
+    parser.add_argument(
+        "--corrupt",
+        default="",
+        choices=[c for c in CORRUPTIONS if c] + [""],
+        help="Deliberately corrupt the run to prove the gates fail "
+        "when they should",
+    )
+    parser.add_argument(
+        "--budget",
+        action="append",
+        default=[],
+        metavar="NAME=VALUE",
+        help="Override one scaling budget (repeatable), e.g. "
+        "--budget sweep_ms_max=20",
+    )
+    parser.add_argument(
+        "--workdir",
+        default="",
+        help="Keep artifacts (result JSON, journal, telemetry) here; "
+        "default: a temp dir, deleted on exit",
+    )
+    parser.add_argument(
+        "--output", default="", help="Also write the report JSON here"
+    )
+    parser.add_argument("--max-virtual-secs", type=float, default=600.0)
+    return parser
+
+
+def _parse_budgets(entries: list[str]) -> dict:
+    budgets = {}
+    for entry in entries:
+        name, _, value = entry.partition("=")
+        if not value:
+            raise ValueError(f"budget override {entry!r} is not NAME=VALUE")
+        budgets[name.strip()] = float(value)
+    return budgets
+
+
+def run_plan(
+    plan_name: str,
+    workdir: str,
+    *,
+    workers: int = 1000,
+    seed: int = 1234,
+    num_tasks: int = 1500,
+    records_per_task: int = 64,
+    corrupt: str = "",
+    budgets: dict | None = None,
+    max_virtual_secs: float = 600.0,
+) -> dict:
+    """One simulation run; returns the result dict and leaves
+    ``fleetsim_result.json`` plus telemetry artifacts in ``workdir``."""
+    from elasticdl_tpu.chaos.plan import FaultKind
+    from elasticdl_tpu.fleetsim.plans import named_fleet_plan
+    from elasticdl_tpu.fleetsim.sim import FleetConfig, FleetSimulator
+    from elasticdl_tpu.telemetry.master_hooks import MasterTelemetry
+
+    plan = named_fleet_plan(plan_name)
+    # stamp the seed the plan replays under (the chaos-plan discipline:
+    # a run is reproducible from its report alone)
+    plan.seed = seed
+    needs_journal = any(
+        f.kind == FaultKind.MASTER_KILL for f in plan.faults
+    )
+    telemetry_dir = os.path.join(workdir, "telemetry")
+    config = FleetConfig(
+        num_workers=workers,
+        seed=seed,
+        num_tasks=num_tasks,
+        records_per_task=records_per_task,
+        corrupt=corrupt,
+        budgets=dict(budgets or {}),
+        max_virtual_secs=max_virtual_secs,
+        journal_dir=os.path.join(workdir, "journal")
+        if needs_journal
+        else "",
+    )
+    sim = FleetSimulator(
+        plan, config, telemetry=MasterTelemetry(telemetry_dir)
+    )
+    result = sim.run()
+    sim.telemetry.job_end(result["rc"])
+    plan.save(os.path.join(workdir, "fleet_plan.json"))
+    with open(
+        os.path.join(workdir, RESULT_FILENAME), "w", encoding="utf-8"
+    ) as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    return result
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    if args.list:
+        from elasticdl_tpu.fleetsim.plans import (
+            FLEET_INVARIANT_DESCRIPTIONS,
+            builtin_fleet_plans,
+        )
+
+        print("Fleet plans:")
+        for name, plan in sorted(builtin_fleet_plans().items()):
+            note = " ".join(plan.notes.split())
+            print(f"  {name:26s} {note}")
+        print("Fleet invariants:")
+        for name, desc in sorted(FLEET_INVARIANT_DESCRIPTIONS.items()):
+            print(f"  {name:26s} {desc}")
+        return 0
+
+    budgets = _parse_budgets(args.budget)
+    kwargs = dict(
+        workers=args.workers,
+        seed=args.seed,
+        num_tasks=args.num_tasks,
+        records_per_task=args.records_per_task,
+        corrupt=args.corrupt,
+        budgets=budgets,
+        max_virtual_secs=args.max_virtual_secs,
+    )
+    if args.workdir:
+        os.makedirs(args.workdir, exist_ok=True)
+        result = run_plan(args.plan, args.workdir, **kwargs)
+    else:
+        with tempfile.TemporaryDirectory() as workdir:
+            result = run_plan(args.plan, workdir, **kwargs)
+
+    text = json.dumps(result, indent=2)
+    print(text)
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+    return 0 if result["invariants_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,960 @@
+"""The fleet simulator: the REAL master driven by N simulated workers.
+
+No forked control-plane logic: the simulator constructs the production
+:class:`MasterServicer`, :class:`TaskDispatcher`,
+:class:`~elasticdl_tpu.master.journal.MasterJournal` and
+:class:`~elasticdl_tpu.telemetry.master_hooks.MasterTelemetry`, and
+calls their public RPC surface exactly as the transport would — every
+worker call passes through a PR-8 :class:`~elasticdl_tpu.chaos.netem.
+NetemShim` seam (clock/sleep injected), so duplicate delivery and delay
+faults behave as on a real link.  Workers are state machines on a
+seeded event heap over a :class:`~elasticdl_tpu.fleetsim.clock.
+VirtualClock`: heartbeats, task pulls, reports and version pings, with
+deterministic jitter — the whole run is a pure function of (plan, seed,
+world size) and its virtual event log hashes to a stable digest.
+
+Real CPU time is measured AROUND the control-plane calls
+(``time.perf_counter``) and gated by scaling budgets; virtual time
+never reads the real clock, so the budgets are outputs, not inputs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import json
+import os
+import time
+from dataclasses import dataclass, field
+
+from elasticdl_tpu.chaos.invariants import InvariantChecker
+from elasticdl_tpu.chaos.netem import NetemShim
+from elasticdl_tpu.chaos.plan import FaultKind, FaultPlan
+from elasticdl_tpu.fleetsim.clock import VirtualClock
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.rpc import messages as msg
+from elasticdl_tpu.utils.constants import TaskType
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+from elasticdl_tpu.utils.merge import max_merge_counters
+
+# deliberate corruptions proving the gates trip (runner --corrupt):
+# slow_sweep inflates the measured sweep latency past its budget;
+# lost_task silently steals one pending shard (exactly-once must FAIL
+# with a lost shard — note that merely skipping a dead worker's
+# recovery is NOT a corruption: the lease-timeout backstop reclaims it
+# and the job legitimately self-heals); series_flood lifts the /metrics
+# per-worker series cap (the cardinality budget must FAIL at n=1000)
+CORRUPTIONS = ("", "slow_sweep", "lost_task", "series_flood")
+
+# default scaling budgets — generous enough for shared CI hardware,
+# tight enough that an O(world_size)-per-event regression at n=1000
+# blows through them (each is overridable via FleetConfig.budgets)
+DEFAULT_BUDGETS = {
+    # mean real master CPU per heartbeat call (ms)
+    "heartbeat_cpu_ms": 2.0,
+    # p99 dead-worker sweep latency (ms, simulator-measured; p99 not
+    # max, so one CI scheduler blip cannot fail a healthy run — the
+    # slow_sweep corruption slows EVERY sweep and still trips it)
+    "sweep_ms_p99": 50.0,
+    # slowest mass-fault fence: detect -> every lease requeued (ms)
+    "fence_ms_max": 2000.0,
+    # journal growth per appended record (bytes; only gated when the
+    # plan journals)
+    "journal_bytes_per_event": 4096.0,
+    # one full /metrics exposition at world size (ms)
+    "scrape_ms_max": 250.0,
+    # labeled per-worker series on /metrics for the heartbeat-age
+    # family: the aggregate-above-threshold cap must hold — a fleet
+    # over the series budget renders aggregate children (2), a fleet
+    # under it renders one per worker, so the cap value itself is the
+    # ceiling in both regimes (series_flood forces 1000 and trips it)
+    "scrape_worker_series": 64.0,
+}
+
+
+@dataclass
+class FleetConfig:
+    num_workers: int = 1000
+    seed: int = 1234
+    records_per_task: int = 64
+    num_tasks: int = 1500
+    num_epochs: int = 1
+    minibatch_size: int = 32
+    hb_period_secs: float = 5.0
+    hb_timeout_secs: float = 15.0
+    # long enough that the fleet still holds leases when the plan's
+    # faults fire (1500 x 30s over 1000 workers ~ a 60-90 virtual-sec
+    # job; every gate plan's faults land inside it)
+    task_secs: float = 30.0
+    poll_secs: float = 1.0
+    max_virtual_secs: float = 600.0
+    num_slices: int = 8
+    journal_dir: str = ""  # "" = no journal (MASTER_KILL plans need one)
+    # backlog SLO for the REAL in-loop autoscaler (None = off).  Only
+    # the backlog trigger is wired: p95 step time derives from REAL
+    # wall clocks inside StepTimeTracker, and a real-time input would
+    # leak into the decision stream and break the determinism digest.
+    autoscale_backlog_tasks: int | None = 200
+    corrupt: str = ""
+    budgets: dict = field(default_factory=dict)
+
+    @property
+    def num_records(self) -> int:
+        return self.num_tasks * self.records_per_task
+
+
+@dataclass
+class _SimWorker:
+    worker_id: int
+    slice_id: int
+    alive: bool = True
+    done: bool = False
+    step: int = 0
+    known_boot: str = ""
+    beats: int = 0
+    leases: dict = field(default_factory=dict)  # task_id -> records
+    rpc: dict = field(default_factory=dict)  # synthetic monotone totals
+    shipped_rpc: dict = field(default_factory=dict)  # last applied beat
+
+
+class FleetSimulator:
+    """One deterministic run of a fleet plan against the real master."""
+
+    def __init__(
+        self, plan: FaultPlan, config: FleetConfig, telemetry=None
+    ):
+        if config.corrupt not in CORRUPTIONS:
+            raise ValueError(
+                f"unknown corruption {config.corrupt!r}; "
+                f"valid: {[c for c in CORRUPTIONS if c]}"
+            )
+        self.plan = plan
+        self.config = config
+        self.clock = VirtualClock()
+        self._heap: list = []
+        self._seq = 0
+        self._digest = hashlib.sha256()
+        self.event_count = 0
+        self._boot_count = 0
+        self._master_down = False
+        self._completed_at: float | None = None
+        self._job_rc: int | None = None
+        self._shards = {"fleet_shard": (0, config.num_records)}
+        self._cpu: dict[str, list] = {}  # method -> [calls, secs]
+        self._sweep_samples_ms: list[float] = []
+        self._fence_samples_ms: list[float] = []
+        self._dead_detected = 0
+        self._rehomes = 0
+        self._scrape: dict = {}
+        self._current_slices = config.num_slices
+        self._autoscale_decisions: list[dict] = []
+
+        # ---- the REAL control plane ------------------------------------
+        self.checker = InvariantChecker(
+            expected_records=config.num_records * config.num_epochs
+        )
+        self.task_d = self._build_dispatcher()
+        self.servicer = self._build_servicer(self.task_d)
+        self.journal = None
+        if config.journal_dir:
+            self._attach_journal(restored_callbacks=0, start=True)
+        self._attach_observers()
+        # the REAL autoscaler rides the tick like Master.run's
+        # _autoscale_tick: backlog in, decision out.  Decisions are
+        # RECORDED (event log + telemetry), and the slice ledger tracks
+        # them; growing the simulated fleet on a grant is a follow-up.
+        # The version-report tracker is deliberately NOT attached — its
+        # p95 derives from real wall clocks and would leak real time
+        # into the deterministic decision stream.
+        self.autoscaler = None
+        if config.autoscale_backlog_tasks is not None:
+            from elasticdl_tpu.master.autoscaler import Autoscaler
+
+            self.autoscaler = Autoscaler(
+                backlog_tasks=config.autoscale_backlog_tasks,
+                min_slices=1,
+                max_slices=config.num_slices + 2,
+            )
+        from elasticdl_tpu.telemetry.master_hooks import MasterTelemetry
+
+        self.telemetry = (
+            telemetry if telemetry is not None else MasterTelemetry("")
+        )
+        self.telemetry.attach(self.task_d, self.servicer)
+
+        # ---- the PR-8 netem seam (virtual clock/sleep injected) --------
+        server_faults = plan.network_server_faults()
+        self._server_shim = (
+            NetemShim(
+                server_faults,
+                plan_seed=plan.seed,
+                telemetry_sink=self.telemetry.events.emit,
+                sleep=self.clock.sleep,
+                clock=self.clock,
+            )
+            if server_faults
+            else None
+        )
+        client_faults = plan.network_client_faults()
+        self._client_shim = (
+            NetemShim(
+                client_faults,
+                plan_seed=plan.seed,
+                sleep=self.clock.sleep,
+                clock=self.clock,
+            )
+            if client_faults
+            else None
+        )
+
+        # ---- the fleet --------------------------------------------------
+        import random
+
+        self._rng = random.Random(f"fleetsim:{plan.seed}:{config.seed}")
+        self.workers = {
+            wid: _SimWorker(
+                worker_id=wid, slice_id=wid % config.num_slices
+            )
+            for wid in range(config.num_workers)
+        }
+        self._log(
+            "fleet_start",
+            plan=plan.name,
+            workers=config.num_workers,
+            tasks=config.num_tasks,
+            slices=config.num_slices,
+        )
+
+    # ---- construction helpers -----------------------------------------------
+
+    def _build_dispatcher(self) -> TaskDispatcher:
+        return TaskDispatcher(
+            dict(self._shards),
+            records_per_task=self.config.records_per_task,
+            num_epochs=self.config.num_epochs,
+            # leases must outlive the heartbeat timeout: dead workers
+            # are evicted by the sweep, not silently by lease expiry
+            task_timeout_secs=6.0 * self.config.hb_timeout_secs,
+            shuffle_seed=self.config.seed,
+            clock=self.clock,
+        )
+
+    def _build_servicer(self, task_d) -> MasterServicer:
+        servicer = MasterServicer(
+            self.config.minibatch_size, task_d, clock=self.clock
+        )
+        # deterministic boot identity (the real master draws uuid4; the
+        # simulator must replay bit-identically by seed)
+        servicer.set_boot_id(f"sim-boot-{self._boot_count}")
+        self._boot_count += 1
+        return servicer
+
+    def _attach_observers(self):
+        self.task_d.add_observer(self.checker)
+        self.task_d.add_observer(_DigestObserver(self))
+        self.servicer.add_version_observer(self.checker.on_version_report)
+
+    def _attach_journal(self, restored_callbacks: int, start: bool):
+        from elasticdl_tpu.master import journal as journal_mod
+
+        # background fsync disabled (huge batch/interval): every flush
+        # happens INLINE at a critical record (success report, fence,
+        # snapshot), so the journal content at any abort point — and
+        # therefore the replayed state — is a pure function of the
+        # simulated schedule, never of the real-time flusher's racing.
+        # Production keeps the batched flusher; the abort-tail semantics
+        # are identical (non-critical records since the last critical
+        # flush are the loss window either way).
+        self.journal = journal_mod.MasterJournal(
+            self.config.journal_dir,
+            fsync_batch=10**9,
+            fsync_interval_secs=3600.0,
+        )
+        self.journal.set_callbacks_invoked(restored_callbacks)
+        self.servicer.set_journal(self.journal)
+        self.task_d.add_observer(self.journal)
+        self.servicer.add_version_observer(self.journal.on_version_report)
+        self.journal.set_snapshot_provider(self._journal_snapshot)
+        if start:
+            self.journal.start()
+
+    def _journal_snapshot(self, append):
+        """Same snapshot shape Master._journal_snapshot assembles — the
+        replay contract is the production one."""
+        servicer_state = {
+            "cluster_version": self.servicer.cluster_version,
+            "model_version": self.servicer.get_model_version(),
+            "stream": self.servicer.stream_snapshot(),
+        }
+        self.task_d.atomic_state_snapshot(
+            lambda dispatcher_state: append(
+                {
+                    "dispatcher": dispatcher_state,
+                    "servicer": servicer_state,
+                    "callbacks_invoked": self.journal.callbacks_invoked
+                    if self.journal is not None
+                    else 0,
+                    "world": None,
+                }
+            )
+        )
+        self.servicer.journal_stream_snapshot()
+
+    # ---- deterministic event log --------------------------------------------
+
+    def _log(self, event: str, **fields):
+        record = {"t": round(self.clock.now(), 6), "event": event}
+        record.update(fields)
+        self._digest.update(
+            json.dumps(record, sort_keys=True).encode("utf-8")
+        )
+        self._digest.update(b"\n")
+        self.event_count += 1
+
+    @property
+    def event_log_digest(self) -> str:
+        return self._digest.hexdigest()
+
+    # ---- the RPC surface (through the netem seam) ---------------------------
+
+    def _invoke(self, method: str, request):
+        """One worker->master call: server-seam faults re-execute the
+        real handler (duplicate delivery); real CPU time is accumulated
+        per method for the budget section."""
+        handler = getattr(self.servicer, method)
+        started = time.perf_counter()
+        try:
+            if self._client_shim is not None:
+                return self._client_shim.client_call(
+                    "elasticdl_tpu.Master",
+                    method,
+                    lambda: self._server_dispatch(method, handler, request),
+                    None,
+                )
+            return self._server_dispatch(method, handler, request)
+        finally:
+            slot = self._cpu.setdefault(method, [0, 0.0])
+            slot[0] += 1
+            slot[1] += time.perf_counter() - started
+
+    def _server_dispatch(self, method: str, handler, request):
+        if self._server_shim is not None:
+            return self._server_shim.server_call(
+                "elasticdl_tpu.Master", method, handler, request
+            )
+        return handler(request)
+
+    # ---- event heap ---------------------------------------------------------
+
+    def _schedule(self, at: float, kind: str, *args):
+        self._seq += 1
+        heapq.heappush(self._heap, (at, self._seq, kind, args))
+
+    def run(self) -> dict:
+        """Drive the event loop to job completion (or the virtual
+        deadline) and return the result dict (see ``build_result``)."""
+        config = self.config
+        for wid, worker in self.workers.items():
+            # staggered first beats/pulls so fan-in spreads like a real
+            # fleet ramp-up rather than one synchronized thundering herd
+            self._schedule(
+                (wid / max(1, config.num_workers)) * config.hb_period_secs,
+                "hb",
+                wid,
+            )
+            self._schedule(
+                0.2 + (wid / max(1, config.num_workers)), "pull", wid
+            )
+        for fault in self.plan.faults:
+            if fault.kind in FaultKind.NETWORK_SIDE:
+                continue  # armed at the netem seam, not the timeline
+            self._schedule(float(fault.at_step), "fault", fault)
+        if config.corrupt == "lost_task":
+            self._schedule(5.0, "corrupt_lost_task")
+        self._schedule(config.poll_secs, "tick")
+
+        dispatch = {
+            "hb": self._on_hb,
+            "pull": self._on_pull,
+            "report": self._on_report,
+            "tick": self._on_tick,
+            "fault": self._on_fault,
+            "master_up": self._on_master_up,
+            "corrupt_lost_task": self._on_corrupt_lost_task,
+        }
+        while self._heap and self._completed_at is None:
+            at, _seq, kind, args = heapq.heappop(self._heap)
+            if at > config.max_virtual_secs:
+                break
+            self.clock.advance_to(at)
+            dispatch[kind](*args)
+        if self._completed_at is None:
+            self._log("deadline_exceeded", at=self.clock.now())
+        if self.journal is not None:
+            self.journal.record_job_end(
+                0 if self._completed_at is not None else 1
+            )
+        self._measure_scrape()
+        return self.build_result()
+
+    # ---- worker state machine -----------------------------------------------
+
+    def _on_hb(self, wid: int):
+        worker = self.workers[wid]
+        if not worker.alive:
+            return
+        worker.beats += 1
+        # synthetic monotone RPC outcome totals: every worker's counters
+        # keep rising so the merge rule is exercised by every beat
+        if worker.beats % 3 == 0:
+            worker.rpc["retries"] = worker.rpc.get("retries", 0) + 1
+        if self._master_down:
+            worker.rpc["unavailable"] = worker.rpc.get("unavailable", 0) + 1
+            self._schedule(
+                self.clock.now() + 1.0, "hb", wid
+            )  # fast retry during the outage
+            return
+        request = msg.HeartbeatRequest(
+            worker_id=wid, step=worker.step, rpc=dict(worker.rpc)
+        )
+        response = self._invoke("heartbeat", request)
+        worker.shipped_rpc = dict(worker.rpc)
+        if worker.known_boot and response.boot_id != worker.known_boot:
+            self._rehome(worker, response)
+        worker.known_boot = response.boot_id
+        self._schedule(
+            self.clock.now() + self.config.hb_period_secs, "hb", wid
+        )
+
+    def _rehome(self, worker: _SimWorker, response):
+        """The worker outlived a master: present in-flight leases to the
+        restarted master; drop whatever it does not re-accept."""
+        reply = self._invoke(
+            "rehome_worker",
+            msg.RehomeRequest(
+                worker_id=worker.worker_id,
+                cluster_version=response.cluster_version,
+                lease_ids=sorted(worker.leases),
+            ),
+        )
+        kept = set(reply.accepted_leases) if reply.accepted else set()
+        dropped = [tid for tid in worker.leases if tid not in kept]
+        for tid in dropped:
+            del worker.leases[tid]
+        if dropped:
+            # the real task-stream worker returns to get_task after
+            # losing a lease; its dropped task is pending on the master
+            # (the re-homing handshake requeued it) and somebody must
+            # pull it or the job hangs
+            worker.done = False
+            self._schedule(
+                self.clock.now() + 0.5, "pull", worker.worker_id
+            )
+        self._rehomes += 1
+        self._log(
+            "worker_rehome",
+            worker_id=worker.worker_id,
+            kept=sorted(kept),
+            dropped=dropped,
+        )
+
+    def _on_pull(self, wid: int):
+        worker = self.workers[wid]
+        if not worker.alive or worker.done:
+            return
+        if self._master_down:
+            worker.rpc["unavailable"] = worker.rpc.get("unavailable", 0) + 1
+            self._schedule(self.clock.now() + 1.0, "pull", wid)
+            return
+        response = self._invoke(
+            "get_task", msg.GetTaskRequest(worker_id=wid)
+        )
+        if response.task_id >= 0:
+            worker.leases[response.task_id] = (
+                response.end - response.start
+            )
+            jitter = self._rng.uniform(0.0, self.config.task_secs / 2.0)
+            self._schedule(
+                self.clock.now() + self.config.task_secs + jitter,
+                "report",
+                wid,
+                response.task_id,
+            )
+        elif response.is_wait:
+            self._schedule(self.clock.now() + 2.0, "pull", wid)
+        else:
+            worker.done = True
+            self._log("worker_drained", worker_id=wid)
+
+    def _on_report(self, wid: int, task_id: int):
+        worker = self.workers[wid]
+        if not worker.alive:
+            return
+        if task_id not in worker.leases:
+            return  # dropped by a re-home reconciliation
+        if self._master_down:
+            worker.rpc["deadline_exceeded"] = (
+                worker.rpc.get("deadline_exceeded", 0) + 1
+            )
+            self._schedule(self.clock.now() + 1.0, "report", wid, task_id)
+            return
+        records = worker.leases.pop(task_id)
+        self._invoke(
+            "report_task_result",
+            msg.ReportTaskResultRequest(task_id=task_id),
+        )
+        worker.step += max(1, records // self.config.minibatch_size)
+        self._invoke(
+            "report_version",
+            msg.ReportVersionRequest(
+                model_version=worker.step, worker_id=wid
+            ),
+        )
+        self._schedule(self.clock.now() + 0.001, "pull", wid)
+
+    # ---- master driver ------------------------------------------------------
+
+    def _on_tick(self):
+        if self._completed_at is not None:
+            return
+        if not self._master_down:
+            started = time.perf_counter()
+            if self.config.corrupt == "slow_sweep":
+                # seeded regression: an O(world_size)-grade stall in the
+                # sweep path — the budget gate must trip on this
+                time.sleep(0.08)
+            dead = self.servicer.dead_workers(self.config.hb_timeout_secs)
+            self._sweep_samples_ms.append(
+                (time.perf_counter() - started) * 1000.0
+            )
+            if dead:
+                self._dead_detected += len(dead)
+                self._log("dead_detected", workers=sorted(dead))
+                fence_started = time.perf_counter()
+                for wid in dead:
+                    self.task_d.recover_tasks(wid)
+                    self.servicer.forget_worker(wid)
+                self._fence_samples_ms.append(
+                    (time.perf_counter() - fence_started) * 1000.0
+                )
+                self.telemetry.worker_dead(
+                    dead, self.servicer.cluster_version
+                )
+            if self.autoscaler is not None:
+                snap = self.task_d.snapshot()
+                decision = self.autoscaler.evaluate(
+                    snap["pending"],
+                    self._current_slices,
+                    now=self.clock.now(),
+                )
+                if decision is not None:
+                    self._current_slices = decision["to_slices"]
+                    self._autoscale_decisions.append(decision)
+                    self._log(
+                        "autoscale_decision",
+                        action=decision["action"],
+                        from_slices=decision["from_slices"],
+                        to_slices=decision["to_slices"],
+                        backlog=decision["backlog"],
+                    )
+                    self.telemetry.autoscale_decision(
+                        generation=self.servicer.cluster_version,
+                        started_at=time.monotonic(),
+                        action=decision["action"],
+                        from_slices=decision["from_slices"],
+                        to_slices=decision["to_slices"],
+                        reason=decision["reason"],
+                        backlog=decision["backlog"],
+                    )
+            if self.journal is not None:
+                self.journal.maybe_snapshot()
+            if self.task_d.finished():
+                self._completed_at = self.clock.now()
+                self._log("job_complete", at=self._completed_at)
+                return
+        self._schedule(self.clock.now() + self.config.poll_secs, "tick")
+
+    def _on_fault(self, fault):
+        from elasticdl_tpu.telemetry.events import EVENT_FLEET_FAULT
+        from elasticdl_tpu.telemetry.tracing import SPAN_FLEET_FAULT
+
+        started = time.monotonic()
+        if fault.kind == FaultKind.PREEMPT:
+            alive = [w for w in self.workers.values() if w.alive]
+            count = (
+                1
+                if fault.fraction <= 0
+                else max(1, int(fault.fraction * len(alive)))
+            )
+            victims = self._rng.sample(
+                sorted(w.worker_id for w in alive), min(count, len(alive))
+            )
+            self._kill(victims, fault.fault_id)
+        elif fault.kind == FaultKind.SLICE_LOSS:
+            victims = [
+                w.worker_id
+                for w in self.workers.values()
+                if w.alive and w.slice_id == fault.slice_id
+            ]
+            self._kill(victims, fault.fault_id)
+            # the slice ledger the in-loop autoscaler sizes against
+            self._current_slices = max(1, self._current_slices - 1)
+        elif fault.kind == FaultKind.MASTER_KILL:
+            self._master_down = True
+            if self.journal is not None:
+                self.journal.abort()
+            self._log("master_kill", fault_id=fault.fault_id)
+            self._schedule(
+                self.clock.now() + (fault.duration_secs or 2.0),
+                "master_up",
+            )
+        else:
+            logger.warning(
+                "fleetsim ignores fault kind %s (%s)",
+                fault.kind,
+                fault.fault_id,
+            )
+            return
+        self.telemetry.events.emit(
+            EVENT_FLEET_FAULT,
+            fault_id=fault.fault_id,
+            kind=fault.kind,
+            virtual_time=self.clock.now(),
+        )
+        self.telemetry.tracer.record_span(
+            SPAN_FLEET_FAULT,
+            started,
+            time.monotonic(),
+            fault_id=fault.fault_id,
+            kind=fault.kind,
+        )
+
+    def _on_corrupt_lost_task(self):
+        """Falsification hook: steal one pending shard out of the
+        dispatcher, bypassing every observer — the exactly-once checker
+        MUST flag the lost shard and the run MUST exit 1 (the forging
+        discipline of ``chaos --corrupt``)."""
+        with self.task_d._lock:
+            stolen = (
+                self.task_d._pending.pop()
+                if self.task_d._pending
+                else None
+            )
+        self._log(
+            "corrupt_lost_task",
+            uid=getattr(stolen, "uid", -1),
+        )
+
+    def _kill(self, victims, fault_id: str):
+        for wid in victims:
+            self.workers[wid].alive = False
+        self._log(
+            "fault_injected", fault_id=fault_id, victims=sorted(victims)
+        )
+
+    def _on_master_up(self):
+        """Relaunch the master from its journal: the production replay
+        path (journal.load_state -> restore_state/restore_control_state),
+        new boot id, observers re-attached.  Workers detect the boot-id
+        change on their next beat and re-home."""
+        from elasticdl_tpu.master import journal as journal_mod
+
+        state = (
+            journal_mod.load_state(self.config.journal_dir)
+            if self.config.journal_dir
+            else None
+        )
+        self.task_d = self._build_dispatcher()
+        self.servicer = self._build_servicer(self.task_d)
+        generation = 0
+        if state is not None:
+            control = state.get("servicer", {})
+            generation = int(control.get("cluster_version", 0))
+            self.task_d.restore_state(state["dispatcher"])
+            self.servicer.restore_control_state(
+                cluster_version=generation,
+                model_version=int(control.get("model_version", 0)),
+                stream=control.get("stream"),
+            )
+        if self.config.journal_dir:
+            self._attach_journal(
+                restored_callbacks=int(
+                    (state or {}).get("callbacks_invoked", 0)
+                ),
+                start=True,
+            )
+        self._attach_observers()
+        self.telemetry.attach(self.task_d, self.servicer)
+        self._master_down = False
+        snap = self.task_d.snapshot()
+        self._log(
+            "master_restart",
+            generation=generation,
+            pending=snap["pending"],
+            active=len(snap["active"]),
+        )
+        self.telemetry.master_restart(generation)
+
+    # ---- measurement + verdicts ---------------------------------------------
+
+    def _measure_scrape(self):
+        """One full /metrics exposition at world size: wall time plus
+        the rendered per-worker series count for the cardinality gate.
+        ``series_flood`` corruption lifts the cap to prove the gate."""
+        from elasticdl_tpu.telemetry.master_hooks import WORKER_SERIES_MAX_ENV
+
+        flood = self.config.corrupt == "series_flood"
+        previous = os.environ.get(WORKER_SERIES_MAX_ENV)
+        if flood:
+            os.environ[WORKER_SERIES_MAX_ENV] = str(10**6)
+        try:
+            started = time.perf_counter()
+            text = self.telemetry.registry.exposition()
+            elapsed_ms = (time.perf_counter() - started) * 1000.0
+        finally:
+            if flood:
+                if previous is None:
+                    os.environ.pop(WORKER_SERIES_MAX_ENV, None)
+                else:
+                    os.environ[WORKER_SERIES_MAX_ENV] = previous
+        series = sum(
+            1
+            for line in text.splitlines()
+            if line.startswith("elasticdl_worker_heartbeat_age_secs{")
+        )
+        self._scrape = {
+            "ms": round(elapsed_ms, 3),
+            "bytes": len(text),
+            "worker_series": series,
+        }
+
+    def _expected_rpc_totals(self) -> dict:
+        """Ground truth for the merge invariant: sum over workers of
+        the LAST counters each actually shipped (max over beats of a
+        monotone counter == its final shipped value)."""
+        totals: dict[str, int] = {}
+        for worker in self.workers.values():
+            max_merge_counters({}, worker.shipped_rpc, totals=totals)
+        return totals
+
+    def _budget_values(self) -> dict:
+        hb = self._cpu.get("heartbeat", [0, 0.0])
+        values = {
+            "heartbeat_cpu_ms": round(
+                (hb[1] / hb[0] * 1000.0) if hb[0] else 0.0, 4
+            ),
+            "sweep_ms_p99": self._percentiles(self._sweep_samples_ms).get(
+                "p99", 0.0
+            ),
+            "fence_ms_max": round(
+                max(self._fence_samples_ms, default=0.0), 3
+            ),
+            "scrape_ms_max": self._scrape.get("ms", 0.0),
+            "scrape_worker_series": float(
+                self._scrape.get("worker_series", 0)
+            ),
+        }
+        if self.journal is not None:
+            path = self.config.journal_dir
+            size = 0
+            lines = 0
+            from elasticdl_tpu.master.journal import journal_path
+
+            for shard in self._journal_shards(journal_path(path)):
+                try:
+                    size += os.path.getsize(shard)
+                    with open(shard, encoding="utf-8") as f:
+                        lines += sum(1 for _ in f)
+                except OSError:
+                    continue
+            values["journal_bytes_per_event"] = round(
+                size / lines if lines else 0.0, 1
+            )
+        return values
+
+    @staticmethod
+    def _journal_shards(path: str) -> list[str]:
+        shards = [path]
+        i = 1
+        while os.path.exists(f"{path}.{i}"):
+            shards.append(f"{path}.{i}")
+            i += 1
+        return shards
+
+    def _percentiles(self, samples: list[float]) -> dict:
+        if not samples:
+            return {}
+        ordered = sorted(samples)
+
+        def pick(q: float) -> float:
+            idx = min(len(ordered) - 1, int(round(q * (len(ordered) - 1))))
+            return round(ordered[idx], 3)
+
+        return {
+            "p50": pick(0.50),
+            "p95": pick(0.95),
+            "p99": pick(0.99),
+            "max": round(ordered[-1], 3),
+            "count": len(ordered),
+        }
+
+    def scale_section(self) -> dict:
+        """The control-plane scale section: mirrored verbatim into the
+        result artifact AND surfaced by ``telemetry.report``."""
+        hb_stats = self.servicer.heartbeat_stats()
+        sweep = self.servicer.sweep_stats()
+        hb = self._cpu.get("heartbeat", [0, 0.0])
+        cpu_ms = {
+            method: {
+                "calls": slot[0],
+                "mean_ms": round(slot[1] / slot[0] * 1000.0, 4)
+                if slot[0]
+                else 0.0,
+            }
+            for method, slot in sorted(self._cpu.items())
+        }
+        return {
+            "world_size": self.config.num_workers,
+            "virtual_secs": round(self.clock.now(), 3),
+            "completed_at": self._completed_at,
+            "heartbeats": {
+                "total": hb_stats.get("beats", 0),
+                "batches": hb_stats.get("batches", 0),
+                "max_batch": hb_stats.get("max_batch", 0),
+                "mean_batch": round(
+                    hb_stats.get("beats", 0)
+                    / max(1, hb_stats.get("batches", 1)),
+                    3,
+                ),
+                "cpu_ms_per_call": round(
+                    (hb[1] / hb[0] * 1000.0) if hb[0] else 0.0, 4
+                ),
+            },
+            "master_cpu_ms": cpu_ms,
+            "sweep_ms": self._percentiles(self._sweep_samples_ms),
+            "servicer_sweep": sweep,
+            "fence_ms": self._percentiles(self._fence_samples_ms),
+            "dead_detected": self._dead_detected,
+            "rehomes": self._rehomes,
+            "autoscale_decisions": list(self._autoscale_decisions),
+            "scrape": dict(self._scrape),
+        }
+
+    def build_result(self) -> dict:
+        """The verdict artifact — same core schema as
+        ``chaos_result.json`` (plan/seed/corrupt/invariants/
+        invariants_ok/rc) plus the budgets and scale sections."""
+        completed = self._completed_at is not None
+        survivors = sorted(
+            w.worker_id for w in self.workers.values() if w.alive
+        )
+        live = set(self.servicer.live_workers())
+        summary = self.checker.summary(
+            self.task_d.counters(TaskType.TRAINING)
+        )
+        invariants = list(summary["invariants"])
+
+        recovery_violations = []
+        if not completed:
+            recovery_violations.append(
+                f"job did not complete within {self.config.max_virtual_secs}"
+                " virtual seconds"
+            )
+        ghosts = sorted(live - set(survivors))
+        if ghosts:
+            recovery_violations.append(
+                f"dead workers still counted live at end: {ghosts}"
+            )
+        invariants.append(
+            {
+                "name": "fleet_recovery",
+                "status": "PASS" if not recovery_violations else "FAIL",
+                "violations": recovery_violations,
+            }
+        )
+
+        expected = self._expected_rpc_totals()
+        merged = self.servicer.rpc_stats_totals()
+        merge_violations = []
+        for key, value in expected.items():
+            if merged.get(key, 0) != value:
+                merge_violations.append(
+                    f"{key}: merged {merged.get(key, 0)} != shipped "
+                    f"maxima sum {value}"
+                )
+        invariants.append(
+            {
+                "name": "heartbeat_merge_monotone",
+                "status": "PASS" if not merge_violations else "FAIL",
+                "violations": merge_violations,
+            }
+        )
+
+        budgets = {**DEFAULT_BUDGETS, **self.config.budgets}
+        values = self._budget_values()
+        budget_report = {}
+        budget_violations = []
+        for name, value in values.items():
+            limit = budgets.get(name)
+            ok = limit is None or value <= limit
+            budget_report[name] = {
+                "value": value,
+                "budget": limit,
+                "ok": ok,
+            }
+            if not ok:
+                budget_violations.append(
+                    f"{name}: {value} exceeds budget {limit}"
+                )
+        invariants.append(
+            {
+                "name": "budget_compliance",
+                "status": "PASS" if not budget_violations else "FAIL",
+                "violations": budget_violations,
+            }
+        )
+
+        ok = all(i["status"] == "PASS" for i in invariants)
+        return {
+            "plan": self.plan.name,
+            "seed": self.plan.seed
+            if self.plan.seed is not None
+            else self.config.seed,
+            "corrupt": self.config.corrupt,
+            "world_size": self.config.num_workers,
+            "invariants": invariants,
+            "invariants_ok": ok,
+            "rc": 0 if ok else 1,
+            "budgets": budget_report,
+            "scale": self.scale_section(),
+            "event_log_digest": self.event_log_digest,
+            "event_count": self.event_count,
+            "tasks_tracked": summary["tasks_tracked"],
+            "survivors": len(survivors),
+        }
+
+
+class _DigestObserver:
+    """Dispatcher observer feeding the deterministic event log: every
+    lease/report/reclaim lands in the digest with its virtual time."""
+
+    def __init__(self, sim: FleetSimulator):
+        self._sim = sim
+
+    def on_task_leased(self, task_id, worker_id, task):
+        self._sim._log(
+            "lease", task_id=task_id, worker_id=worker_id, uid=task.uid
+        )
+
+    def on_task_reported(self, task_id, task, success, counted):
+        self._sim._log(
+            "report",
+            task_id=task_id,
+            uid=getattr(task, "uid", -1),
+            success=bool(success),
+            counted=bool(counted),
+        )
+
+    def on_task_reclaimed(self, task_id, task):
+        self._sim._log("reclaim", task_id=task_id, uid=task.uid)

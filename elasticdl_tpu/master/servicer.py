@@ -18,8 +18,10 @@ object with zero transport.
 
 from __future__ import annotations
 
+import heapq
 import threading
 import time
+from collections import deque
 
 from elasticdl_tpu.rpc import messages as msg
 from elasticdl_tpu.utils.constants import TaskType
@@ -41,11 +43,16 @@ class MasterServicer:
         task_dispatcher,
         evaluation_service=None,
         instance_manager=None,
+        clock=time.monotonic,
     ):
         self._task_d = task_dispatcher
         self._minibatch_size = minibatch_size
         self._evaluation_service = evaluation_service
         self._instance_manager = instance_manager
+        # injectable monotonic clock: the fleet simulator
+        # (elasticdl_tpu.fleetsim) drives this REAL servicer on a
+        # virtual clock; production always passes the default
+        self._clock = clock
         self._lock = threading.Lock()
         # GIL-atomic int: unlocked reads (get_task responses, the
         # get_model_version/cluster_version properties) are the
@@ -53,6 +60,27 @@ class MasterServicer:
         self._version = 0  # guarded-by: _lock (writes)
         # worker_id -> last heartbeat wall-clock
         self._heartbeats: dict[int, float] = {}  # guarded-by: _lock
+        # expiry-ordered (beat_time, worker_id) min-heap over the SAME
+        # beats: the dead-worker sweep pops only entries at/past the
+        # timeout cutoff instead of scanning every worker per poll.
+        # Entries are lazily invalidated — a newer beat makes the old
+        # entry stale, detected by comparing against _heartbeats
+        self._hb_heap: list[tuple[float, int]] = []  # guarded-by: _lock
+        # heartbeat fan-in coalescing: handlers ENQUEUE (GIL-atomic
+        # deque append, no lock) and one drainer at a time applies the
+        # whole backlog under ONE _lock acquisition — per-call lock work
+        # is O(1) amortized at any world size.  Readers of heartbeat-fed
+        # state drain first (blocking), so visibility is unchanged:
+        # a beat enqueued before a read is applied before it.
+        self._hb_pending: deque = deque()
+        self._hb_drain_lock = threading.Lock()
+        # fan-in shape observability: beats applied, batches drained,
+        # largest batch (mirrored onto the elasticdl_heartbeat_*
+        # metrics; the fleetsim scale budgets read them too)
+        self._hb_stats = {"beats": 0, "batches": 0, "max_batch": 0}  # guarded-by: _lock
+        # dead-worker sweep cost (real time, perf_counter): count,
+        # total ms, max ms — the sweep-latency scaling budget's source
+        self._sweep_stats = {"count": 0, "ms": 0.0, "max_ms": 0.0}  # guarded-by: _lock
         # externally-reported failures (pod events); cleared only by
         # forget_worker so a racing in-flight heartbeat can't erase them
         self._marked_dead: set[int] = set()  # guarded-by: _lock
@@ -82,6 +110,12 @@ class MasterServicer:
         # discipline, mirrored onto the elasticdl_step_phase_* families
         self._worker_phase_stats: dict[int, dict] = {}  # guarded-by: _lock
         self._worker_prefetch_stats: dict[int, dict] = {}  # guarded-by: _lock
+        # fleet-wide aggregates maintained INCREMENTALLY by the merge
+        # rule (utils/merge.py ``totals=``): scrape-time reads are
+        # O(keys), not an O(world_size) walk under the lock
+        self._rpc_totals: dict[str, int] = {}  # guarded-by: _lock
+        self._phase_totals: dict[str, dict] = {}  # guarded-by: _lock
+        self._prefetch_totals: dict[str, int] = {}  # guarded-by: _lock
         # liveness-vs-progress split (/healthz): when any worker last
         # ADVANCED its step sample (heartbeat `step` / version report) —
         # a hung-but-alive job heartbeats forever but this stops moving
@@ -206,7 +240,7 @@ class MasterServicer:
         # every task pull is a liveness signal (cheap implicit heartbeat;
         # the worker's background heartbeat covers long compute gaps)
         with self._lock:
-            self._heartbeats[request.worker_id] = time.monotonic()
+            self._note_beat_locked(request.worker_id, self._clock())
         if request.task_type == int(TaskType.EVALUATION):
             task_id, task = self._task_d.get_eval_task(request.worker_id)
         else:
@@ -255,7 +289,7 @@ class MasterServicer:
                     model_version=self._version,
                     minibatch_size=self._minibatch_size,
                 )
-            self._heartbeats[request.worker_id] = time.monotonic()
+            self._note_beat_locked(request.worker_id, self._clock())
         with self._stream_lock:
             if request.cluster_version != self._cluster_version:
                 # re-checked here because the fence test above runs under
@@ -268,7 +302,7 @@ class MasterServicer:
                     minibatch_size=self._minibatch_size,
                 )
             if self._first_stream_pull_at is None:
-                self._first_stream_pull_at = time.monotonic()
+                self._first_stream_pull_at = self._clock()
             memo = self._step_stream.get(request.seq)
             if memo is not None:
                 return memo
@@ -420,7 +454,7 @@ class MasterServicer:
                 # a version report is the strongest progress signal —
                 # it advances the /healthz staleness clock too
                 self._last_step_sample = int(request.model_version)
-                self._last_step_sample_at = time.monotonic()
+                self._last_step_sample_at = self._clock()
         for callback in self._version_observers:
             try:
                 callback(request.worker_id, request.model_version)
@@ -471,53 +505,29 @@ class MasterServicer:
             )
 
     def heartbeat(self, request: msg.HeartbeatRequest) -> msg.HeartbeatResponse:
-        now = time.monotonic()
-        with self._lock:
-            self._heartbeats[request.worker_id] = now
-            generation = self._cluster_version
-            if request.step > self._last_step_sample:
-                # progress, not mere liveness: the /healthz staleness
-                # clock resets only when the fleet's step ADVANCES
-                self._last_step_sample = int(request.step)
-                self._last_step_sample_at = now
-            first_contact = request.worker_id not in self._rpc_seen
-            self._rpc_seen.add(request.worker_id)
-            if request.rpc:
-                # worker-shipped RPC outcome totals: max-merge (one
-                # shared rule, utils/merge.py) so a reordered beat can
-                # never walk a counter backward
-                rose = max_merge_counters(
-                    self._worker_rpc_stats.setdefault(
-                        request.worker_id, {}
-                    ),
-                    request.rpc,
-                    watch=_OUTAGE_CLASS_COUNTERS,
-                )
-                if rose and not first_contact:
-                    # an outage-class counter moved SINCE THE LAST beat:
-                    # the link is degraded as of now (the /healthz flag)
-                    self._net_degraded_at = now
-            if request.phases:
-                # step-anatomy phase totals: nested max-merge (ms,
-                # count, and each log bucket are all monotone per
-                # worker), summed across workers at scrape time
-                max_merge_phase_stats(
-                    self._worker_phase_stats.setdefault(
-                        request.worker_id, {}
-                    ),
-                    request.phases,
-                )
-            if request.prefetch:
-                # device-prefetch staging totals: the same monotone
-                # max-merge rule as the RPC outcome counters
-                max_merge_counters(
-                    self._worker_prefetch_stats.setdefault(
-                        request.worker_id, {}
-                    ),
-                    request.prefetch,
-                )
+        """Coalesced heartbeat fan-in.
+
+        The handler ENQUEUES the beat (a GIL-atomic deque append) and
+        triggers a drain; whichever thread wins the drain lock applies
+        the WHOLE backlog under one ``_lock`` acquisition, so at fleet
+        scale the per-beat lock work amortizes to O(1) instead of a
+        lock handshake per RPC.  Losers return immediately — their beat
+        is already enqueued and the holder's post-release re-check (or
+        any reader's blocking drain) applies it.  The response needs
+        only GIL-atomic reads (``_quiesce``/``_cluster_version``/
+        ``_boot_id`` are writes-guarded), so it never waits on the lock
+        either.  ``utils/merge.py`` max-merge makes batched application
+        order-insensitive: a drained batch produces the same totals as
+        per-request application (test-pinned).
+        """
+        self._hb_pending.append((request, self._clock()))
+        self._drain_heartbeats()
+        # per-beat side effects that take OTHER locks stay per-request
+        # (the instance manager and replica directory synchronize
+        # themselves; folding them into the _lock batch would nest locks)
         if self._instance_manager is not None:
             self._instance_manager.on_heartbeat(request.worker_id)
+        generation = self._cluster_version
         replica_peers: dict = {}
         if self._replica_directory is not None:
             if request.replica:
@@ -531,6 +541,127 @@ class MasterServicer:
             replica_peers=replica_peers,
             boot_id=self._boot_id,
         )
+
+    def _drain_heartbeats(self, block: bool = False):
+        """Apply the pending heartbeat backlog: one ``_lock``
+        acquisition per drained batch.  ``block=True`` (readers of
+        heartbeat-fed state) ALWAYS acquires the drain lock — even when
+        the deque looks empty — because a concurrent drainer may have
+        popped a beat it has not yet applied; batches are applied while
+        the drain lock is held, so acquiring it synchronizes with every
+        in-flight drain and the guarantee holds: a beat whose handler
+        enqueued it before the read is applied before the read."""
+        if block:
+            while True:
+                self._hb_drain_lock.acquire()
+                try:
+                    self._drain_batch_locked()
+                finally:
+                    self._hb_drain_lock.release()
+                if not self._hb_pending:
+                    return
+        while self._hb_pending:
+            if not self._hb_drain_lock.acquire(blocking=False):
+                # another thread is draining; it re-checks the deque
+                # after releasing, so the beat this caller enqueued
+                # cannot be stranded
+                return
+            try:
+                self._drain_batch_locked()
+            finally:
+                self._hb_drain_lock.release()
+
+    # lock-holding: _hb_drain_lock
+    def _drain_batch_locked(self):
+        batch = []
+        while True:
+            try:
+                batch.append(self._hb_pending.popleft())
+            except IndexError:
+                break
+        if batch:
+            self._apply_heartbeat_batch(batch)
+
+    def _apply_heartbeat_batch(self, batch: list):
+        """One lock acquisition for the whole drained batch, FIFO."""
+        with self._lock:
+            self._hb_stats["beats"] += len(batch)
+            self._hb_stats["batches"] += 1
+            if len(batch) > self._hb_stats["max_batch"]:
+                self._hb_stats["max_batch"] = len(batch)
+            for request, now in batch:
+                self._apply_heartbeat_locked(request, now)
+
+    # lock-holding: _lock
+    def _apply_heartbeat_locked(self, request, now: float):
+        self._note_beat_locked(request.worker_id, now)
+        if request.step > self._last_step_sample:
+            # progress, not mere liveness: the /healthz staleness
+            # clock resets only when the fleet's step ADVANCES
+            self._last_step_sample = int(request.step)
+            self._last_step_sample_at = now
+        first_contact = request.worker_id not in self._rpc_seen
+        self._rpc_seen.add(request.worker_id)
+        if request.rpc:
+            # worker-shipped RPC outcome totals: max-merge (one
+            # shared rule, utils/merge.py) so a reordered beat can
+            # never walk a counter backward; the fleet aggregate is
+            # maintained incrementally for O(keys) scrapes
+            rose = max_merge_counters(
+                self._worker_rpc_stats.setdefault(request.worker_id, {}),
+                request.rpc,
+                watch=_OUTAGE_CLASS_COUNTERS,
+                totals=self._rpc_totals,
+            )
+            if rose and not first_contact:
+                # an outage-class counter moved SINCE THE LAST beat:
+                # the link is degraded as of now (the /healthz flag)
+                self._net_degraded_at = now
+        if request.phases:
+            # step-anatomy phase totals: nested max-merge (ms,
+            # count, and each log bucket are all monotone per
+            # worker), aggregated across workers incrementally
+            max_merge_phase_stats(
+                self._worker_phase_stats.setdefault(request.worker_id, {}),
+                request.phases,
+                totals=self._phase_totals,
+            )
+        if request.prefetch:
+            # device-prefetch staging totals: the same monotone
+            # max-merge rule as the RPC outcome counters
+            max_merge_counters(
+                self._worker_prefetch_stats.setdefault(
+                    request.worker_id, {}
+                ),
+                request.prefetch,
+                totals=self._prefetch_totals,
+            )
+
+    # lock-holding: _lock
+    def _note_beat_locked(self, worker_id: int, now: float):
+        """Record one liveness signal: the latest-beat map AND the
+        expiry-ordered heap the incremental dead-worker sweep pops.
+
+        The heap self-compacts when stale (superseded) entries dominate:
+        the sweep only removes entries when heartbeat-timeout detection
+        is ON (``dead_workers(timeout > 0)``), so a deployment running
+        on external failure events alone (``--heartbeat_timeout_secs
+        0``) would otherwise leak one tuple per beat forever.  The
+        rebuild is O(live workers) and runs at most once per ~3n
+        pushes — amortized O(1) per beat.
+        """
+        self._heartbeats[worker_id] = now
+        heapq.heappush(self._hb_heap, (now, worker_id))
+        if len(self._hb_heap) > 64 and (
+            len(self._hb_heap) > 4 * len(self._heartbeats)
+        ):
+            # every live worker's newest beat is in _heartbeats, and
+            # the sweep's re-pushed expired entries carry exactly that
+            # time too — the rebuilt heap preserves sweep semantics
+            self._hb_heap = [
+                (at, wid) for wid, at in self._heartbeats.items()
+            ]
+            heapq.heapify(self._hb_heap)
 
     # ---- master high availability: the re-homing handshake -----------------
 
@@ -557,7 +688,7 @@ class MasterServicer:
             request.worker_id, presented
         )
         with self._lock:
-            self._heartbeats[request.worker_id] = time.monotonic()
+            self._note_beat_locked(request.worker_id, self._clock())
         if self._rehome_sink is not None:
             try:
                 self._rehome_sink(
@@ -692,20 +823,47 @@ class MasterServicer:
 
     def dead_workers(self, timeout_secs: float) -> list[int]:
         """Workers externally marked dead, plus (when ``timeout_secs >
-        0``) workers whose last heartbeat is older than the timeout."""
-        now = time.monotonic()
+        0``) workers whose last heartbeat is older than the timeout.
+
+        Incremental: the sweep pops the expiry-ordered heap only down
+        to the cutoff — stale entries (a newer beat exists) are
+        discarded, expired ones are reported AND re-pushed so every
+        subsequent sweep keeps reporting them until ``forget_worker``.
+        Cost is O(beats since the last sweep + expired), not
+        O(world_size), per poll."""
+        sweep_started = time.perf_counter()
+        self._drain_heartbeats(block=True)
+        now = self._clock()
         with self._lock:
             dead = set(self._marked_dead)
             if timeout_secs > 0:
-                dead.update(
-                    wid
-                    for wid, at in self._heartbeats.items()
-                    if now - at > timeout_secs
-                )
+                cutoff = now - timeout_secs
+                repush: list[tuple[float, int]] = []
+                seen: set[int] = set()
+                while self._hb_heap and self._hb_heap[0][0] < cutoff:
+                    at, wid = heapq.heappop(self._hb_heap)
+                    current = self._heartbeats.get(wid)
+                    if current is None or current > at:
+                        # forgotten, or beat again later: entry stale
+                        # (the newer beat pushed its own heap entry)
+                        continue
+                    dead.add(wid)
+                    if wid not in seen:
+                        seen.add(wid)
+                        repush.append((at, wid))
+                for entry in repush:
+                    heapq.heappush(self._hb_heap, entry)
+            elapsed_ms = (time.perf_counter() - sweep_started) * 1000.0
+            self._sweep_stats["count"] += 1
+            self._sweep_stats["ms"] += elapsed_ms
+            if elapsed_ms > self._sweep_stats["max_ms"]:
+                self._sweep_stats["max_ms"] = elapsed_ms
             return sorted(dead)
 
     def forget_worker(self, worker_id: int):
         with self._lock:
+            # the heap entry is left to die lazily: the next sweep pops
+            # it, sees no _heartbeats entry, and discards it
             self._heartbeats.pop(worker_id, None)
             self._marked_dead.discard(worker_id)
         if self._replica_directory is not None:
@@ -714,60 +872,78 @@ class MasterServicer:
     def live_workers(self) -> list[int]:
         """Workers with a recorded heartbeat that are not marked dead
         (the /healthz liveness view)."""
+        self._drain_heartbeats(block=True)
         with self._lock:
             return sorted(set(self._heartbeats) - self._marked_dead)
+
+    def heartbeat_ages(self) -> dict[int, float]:
+        """Seconds since each live worker's last beat (scrape-time
+        source of the cardinality-bounded per-worker age series)."""
+        self._drain_heartbeats(block=True)
+        now = self._clock()
+        with self._lock:
+            return {
+                wid: max(0.0, now - at)
+                for wid, at in self._heartbeats.items()
+                if wid not in self._marked_dead
+            }
+
+    def heartbeat_stats(self) -> dict:
+        """Fan-in shape: ``{"beats", "batches", "max_batch"}`` (beats
+        applied, drain batches, largest single batch)."""
+        self._drain_heartbeats(block=True)
+        with self._lock:
+            return dict(self._hb_stats)
+
+    def sweep_stats(self) -> dict:
+        """Dead-worker sweep cost: ``{"count", "ms", "max_ms"}`` (real
+        perf_counter time, monotone totals)."""
+        with self._lock:
+            return dict(self._sweep_stats)
 
     def rpc_stats_totals(self) -> dict[str, int]:
         """Fleet-wide RPC outcome totals (retries, deadline_exceeded,
         unavailable): per-worker monotone maxima summed across every
-        worker ever heard from — what /metrics mirrors."""
+        worker ever heard from — what /metrics mirrors.  Maintained
+        incrementally by the merge rule, so this is O(keys), never an
+        O(world_size) walk under the lock."""
+        self._drain_heartbeats(block=True)
         with self._lock:
-            totals: dict[str, int] = {}
-            for stats in self._worker_rpc_stats.values():
-                for key, value in stats.items():
-                    totals[key] = totals.get(key, 0) + value
-            return totals
+            return dict(self._rpc_totals)
 
     def prefetch_stats_totals(self) -> dict[str, int]:
         """Fleet-wide device-prefetch staging totals (groups staged,
-        consumer stall ms, overlapped staging ms): per-worker monotone
-        maxima summed across workers — what /metrics mirrors onto the
-        ``elasticdl_device_prefetch_*`` counters."""
+        consumer stall ms, overlapped staging ms) — what /metrics
+        mirrors onto the ``elasticdl_device_prefetch_*`` counters."""
+        self._drain_heartbeats(block=True)
         with self._lock:
-            totals: dict[str, int] = {}
-            for stats in self._worker_prefetch_stats.values():
-                for key, value in stats.items():
-                    totals[key] = totals.get(key, 0) + value
-            return totals
+            return dict(self._prefetch_totals)
 
     def phase_stats_totals(self) -> dict[str, dict]:
-        """Fleet-wide step-anatomy phase totals: per-worker monotone
-        maxima summed across workers — ``{phase: {"ms": float, "count":
-        int, "buckets": {str(bound): int}}}``, what /metrics mirrors
-        onto the ``elasticdl_step_phase_*`` families."""
+        """Fleet-wide step-anatomy phase totals — ``{phase: {"ms":
+        float, "count": int, "buckets": {str(bound): int}}}``, what
+        /metrics mirrors onto the ``elasticdl_step_phase_*`` families.
+        Incrementally aggregated; the copy is per-phase deep."""
+        self._drain_heartbeats(block=True)
         with self._lock:
-            totals: dict[str, dict] = {}
-            for stats in self._worker_phase_stats.values():
-                for phase, slot in stats.items():
-                    agg = totals.setdefault(
-                        phase, {"ms": 0.0, "count": 0, "buckets": {}}
-                    )
-                    agg["ms"] += slot["ms"]
-                    agg["count"] += slot["count"]
-                    for bound, n in slot["buckets"].items():
-                        agg["buckets"][bound] = (
-                            agg["buckets"].get(bound, 0) + n
-                        )
-            return totals
+            return {
+                phase: {
+                    "ms": agg["ms"],
+                    "count": agg["count"],
+                    "buckets": dict(agg["buckets"]),
+                }
+                for phase, agg in self._phase_totals.items()
+            }
 
     def last_step_age_secs(self) -> float | None:
         """Seconds since any worker last ADVANCED its step sample
         (heartbeat step / version report); None before the first
         advance.  The /healthz field that tells a hung-but-alive job
         (heartbeats flowing, this growing) from a progressing one."""
+        self._drain_heartbeats(block=True)
         with self._lock:
             at = self._last_step_sample_at
-        return None if at is None else max(0.0, time.monotonic() - at)
+        return None if at is None else max(0.0, self._clock() - at)
 
     # how recently an outage-class RPC counter must have moved for
     # /healthz to flag the network as degraded
@@ -777,6 +953,7 @@ class MasterServicer:
         """True when a worker-shipped deadline_exceeded / unavailable
         total rose within the window (PR-8's gray-failure counters,
         surfaced as a point-in-time /healthz flag)."""
+        self._drain_heartbeats(block=True)
         with self._lock:
             at = self._net_degraded_at
         if at is None:
@@ -786,7 +963,7 @@ class MasterServicer:
             if window_secs is None
             else window_secs
         )
-        return (time.monotonic() - at) <= window
+        return (self._clock() - at) <= window
 
     @property
     def duplicate_eval_drops(self) -> int:

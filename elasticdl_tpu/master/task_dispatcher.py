@@ -105,12 +105,17 @@ class TaskDispatcher:
         num_epochs: int = 1,
         task_timeout_secs: float = 0.0,
         shuffle_seed: int | None = None,
+        clock=time.monotonic,
     ):
         """Shard dicts map ``shard_name -> (start_index, num_records)``
-        (the output of a data reader's ``create_shards()``)."""
+        (the output of a data reader's ``create_shards()``).  ``clock``
+        is the lease clock — injectable so the fleet simulator
+        (elasticdl_tpu.fleetsim) can drive lease timeouts on a virtual
+        clock; production always passes the default."""
         self._lock = threading.Lock()
         self._callback_lock = threading.Lock()
         self._rng = random.Random(shuffle_seed)
+        self._clock = clock
 
         self._shards = {
             TaskType.TRAINING: dict(training_shards or {}),
@@ -252,7 +257,7 @@ class TaskDispatcher:
     def _lease(self, worker_id: int, task: Task) -> int:
         self._next_task_id += 1
         self._active[self._next_task_id] = _Assignment(
-            worker_id, task, time.monotonic()
+            worker_id, task, self._clock()
         )
         self._notify("on_task_leased", self._next_task_id, worker_id, task)
         return self._next_task_id
@@ -367,7 +372,7 @@ class TaskDispatcher:
                 )
                 return
             self._reported_task_ids.add(task_id)
-            now = time.monotonic()
+            now = self._clock()
             for a in self._active.values():
                 if a.worker_id == assignment.worker_id:
                     a.leased_at = now
@@ -436,7 +441,7 @@ class TaskDispatcher:
         """Lease-timeout reclaim (the reference's TODO at :255)."""
         if self._task_timeout_secs <= 0:
             return
-        now = time.monotonic()
+        now = self._clock()
         expired = [
             tid
             for tid, a in self._active.items()
@@ -649,7 +654,7 @@ class TaskDispatcher:
         journal-restored master never double-counts the initial slice.
         Restored leases get a fresh clock: a lease that survived the
         outage must not be reclaimed the instant the master is back."""
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             self._epoch = int(state["epoch"])
             self._next_task_id = int(state["next_task_id"])
@@ -688,7 +693,7 @@ class TaskDispatcher:
         the task (still pending here) trains exactly once."""
         kept: list[int] = []
         requeued: list[tuple[int, Task]] = []
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             for tid, a in list(self._active.items()):
                 if a.worker_id != worker_id:

@@ -80,6 +80,11 @@ EVENT_STEP_ANATOMY = "step_anatomy"
 # version with in-flight requests still draining on the old one
 EVENT_SERVING_REQUEST = "serving_request"
 EVENT_MODEL_SWAP = "model_swap"
+# fleet-scale control-plane simulation (elasticdl_tpu.fleetsim): one
+# event per injected mass fault (mass preemption wave, rolling slice
+# loss, master kill) with its virtual firing time — the source of the
+# report's control-plane scale section fault timeline
+EVENT_FLEET_FAULT = "fleet_fault"
 
 EVENTS_FILENAME = "events.jsonl"
 

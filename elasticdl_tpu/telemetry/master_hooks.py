@@ -45,6 +45,24 @@ from elasticdl_tpu.telemetry.tracing import (
 _TASKS_DISPATCHED = "elasticdl_tasks_dispatched_total"
 _TASKS_COMPLETED = "elasticdl_tasks_completed_total"
 _WORKER_TIME_MS = "elasticdl_worker_time_ms_total"
+_WORKER_HB_AGE = "elasticdl_worker_heartbeat_age_secs"
+
+# per-worker label-cardinality budget for /metrics: a fleet at or under
+# this size exposes one heartbeat-age series per worker; above it the
+# individual series collapse into aggregate children (worker="max" /
+# worker="p50") so a 1000-worker scrape renders O(1) series for this
+# family instead of O(world_size).  The env override exists for
+# deployments whose scrape budget differs from the default.
+WORKER_SERIES_MAX_ENV = "ELASTICDL_TPU_WORKER_SERIES_MAX"
+DEFAULT_WORKER_SERIES_MAX = 64
+
+
+def worker_series_budget() -> int:
+    raw = os.environ.get(WORKER_SERIES_MAX_ENV, "")
+    try:
+        return int(raw) if raw else DEFAULT_WORKER_SERIES_MAX
+    except ValueError:
+        return DEFAULT_WORKER_SERIES_MAX
 
 
 class MasterTelemetry:
@@ -286,6 +304,38 @@ class MasterTelemetry:
             # device-prefetch staging totals (heartbeat-shipped,
             # trainer/device_pipeline.py): the one registration site of
             # the elasticdl_device_prefetch_* counters
+            # heartbeat fan-in shape (coalesced drain batches) and the
+            # incremental dead-worker sweep cost: the control-plane
+            # scale counters the fleetsim budgets gate
+            hb = getattr(self._servicer, "heartbeat_stats", lambda: {})()
+            if hb:
+                self.registry.counter(
+                    "elasticdl_heartbeats_total",
+                    "Heartbeats applied by the coalesced fan-in",
+                ).set_total(hb.get("beats", 0))
+                self.registry.counter(
+                    "elasticdl_heartbeat_batches_total",
+                    "Drain batches (one lock acquisition each)",
+                ).set_total(hb.get("batches", 0))
+                self.registry.gauge(
+                    "elasticdl_heartbeat_batch_max",
+                    "Largest heartbeat batch applied in one drain",
+                ).set(hb.get("max_batch", 0))
+            sweep = getattr(self._servicer, "sweep_stats", lambda: {})()
+            if sweep:
+                self.registry.counter(
+                    "elasticdl_dead_worker_sweeps_total",
+                    "Incremental dead-worker sweep invocations",
+                ).set_total(sweep.get("count", 0))
+                self.registry.counter(
+                    "elasticdl_dead_worker_sweep_ms_total",
+                    "Cumulative dead-worker sweep wall time",
+                ).set_total(sweep.get("ms", 0.0))
+                self.registry.gauge(
+                    "elasticdl_dead_worker_sweep_max_ms",
+                    "Slowest single dead-worker sweep",
+                ).set(sweep.get("max_ms", 0.0))
+            self._collect_worker_ages()
             prefetch_totals = getattr(
                 self._servicer, "prefetch_stats_totals", lambda: {}
             )()
@@ -305,6 +355,36 @@ class MasterTelemetry:
                     "Background staging time overlapped with device "
                     "compute",
                 ).set_total(prefetch_totals.get("stage_ms", 0))
+
+    def _collect_worker_ages(self):
+        """Per-worker heartbeat-age series, cardinality-bounded.
+
+        At or under the series budget every worker gets its own labeled
+        gauge (the small-fleet debugging view); above it the family
+        collapses to aggregate-above-threshold children — worker="max"
+        and worker="p50" — so scrape cost for this family is O(1) at
+        any world size.  Stale children (forgotten workers, or the
+        whole individual set after crossing the budget) are pruned so
+        the exposition never accumulates dead series."""
+        ages = getattr(self._servicer, "heartbeat_ages", lambda: {})()
+        if len(ages) <= worker_series_budget():
+            series = {str(wid): age for wid, age in ages.items()}
+        else:
+            ordered = sorted(ages.values())
+            series = {
+                "max": ordered[-1],
+                "p50": ordered[len(ordered) // 2],
+            }
+        self.registry.prune_children(
+            _WORKER_HB_AGE, [{"worker": key} for key in series]
+        )
+        for key, value in series.items():
+            self.registry.gauge(
+                "elasticdl_worker_heartbeat_age_secs",
+                "Seconds since each worker's last heartbeat (per-worker "
+                "under the series budget, aggregate max/p50 above it)",
+                labels={"worker": key},
+            ).set(value)
 
     def build_health_fn(self, job_type: str, instance_manager_fn=lambda: None):
         """The ``/healthz`` payload closure (also used directly by

@@ -284,6 +284,26 @@ class MetricsRegistry:
         """``callback(registry)`` runs before every exposition."""
         self._collect_callbacks.append(callback)
 
+    def prune_children(self, name: str, keep_labels) -> int:
+        """Drop every child of family ``name`` whose label set is not in
+        ``keep_labels`` (an iterable of label dicts); returns how many
+        were dropped.  This exists for CARDINALITY-BOUNDED families
+        (per-worker series): when the fleet outgrows the series budget
+        the per-worker children are replaced by aggregate ones, and the
+        stale individual series must leave the exposition — Prometheus
+        would otherwise keep scraping a thousand frozen gauges."""
+        keep = {
+            tuple(sorted((labels or {}).items())) for labels in keep_labels
+        }
+        with self._lock:
+            family = self._families.get(name)
+            if family is None:
+                return 0
+            drop = [key for key in family.children if key not in keep]
+            for key in drop:
+                del family.children[key]
+            return len(drop)
+
     # ---- exposition --------------------------------------------------------
 
     def family_names(self) -> list[str]:

@@ -611,6 +611,32 @@ def replication_section(events: list[dict]) -> dict | None:
     }
 
 
+def control_plane_section(run_dir: str) -> dict | None:
+    """Control-plane scale: heartbeat fan-in shape, per-event master
+    CPU, sweep/fence latency and scrape cost vs world size — read from
+    every ``fleetsim_result.json`` under the run dir.  The simulator
+    mirrors its ``scale`` section into that artifact, so this section
+    and the artifact stay one schema (the chaos_result discipline)."""
+    runs = []
+    for path in _find_files(run_dir, "fleetsim_result.json"):
+        try:
+            with open(path, encoding="utf-8") as f:
+                result = json.load(f)
+        except (OSError, ValueError):
+            continue
+        runs.append(
+            {
+                "plan": result.get("plan"),
+                "seed": result.get("seed"),
+                "world_size": result.get("world_size"),
+                "invariants_ok": result.get("invariants_ok"),
+                "budgets": result.get("budgets", {}),
+                "scale": result.get("scale", {}),
+            }
+        )
+    return {"runs": runs} if runs else None
+
+
 def build_report(run_dir: str) -> dict:
     from elasticdl_tpu.telemetry.tracing import SPANS_FILENAME
     from elasticdl_tpu.telemetry.trace import analyze_telemetry_dir
@@ -633,6 +659,9 @@ def build_report(run_dir: str) -> dict:
             break
         except (OSError, ValueError):
             continue
+    control_plane = control_plane_section(run_dir)
+    if control_plane is not None:
+        report["control_plane"] = control_plane
     return report
 
 
@@ -649,6 +678,60 @@ def _format_text(report: dict) -> str:
         )
         if verdicts:
             lines.append(f"  invariants: {verdicts}")
+    control_plane = report.get("control_plane")
+    if control_plane:
+        for sim in control_plane["runs"]:
+            scale = sim.get("scale", {})
+            hb = scale.get("heartbeats", {})
+            sweep = scale.get("sweep_ms", {})
+            fence = scale.get("fence_ms", {})
+            scrape = scale.get("scrape", {})
+            lines.append(
+                "control plane (fleetsim {}): {} workers  ok={}".format(
+                    sim.get("plan"),
+                    sim.get("world_size"),
+                    sim.get("invariants_ok"),
+                )
+            )
+            lines.append(
+                "  heartbeats: {} in {} batches (mean {} max {})  "
+                "cpu/call {}ms".format(
+                    hb.get("total"),
+                    hb.get("batches"),
+                    hb.get("mean_batch"),
+                    hb.get("max_batch"),
+                    hb.get("cpu_ms_per_call"),
+                )
+            )
+            if sweep:
+                lines.append(
+                    "  sweep: p50={}ms p95={}ms p99={}ms max={}ms  "
+                    "fence max={}ms  dead={}".format(
+                        sweep.get("p50"),
+                        sweep.get("p95"),
+                        sweep.get("p99"),
+                        sweep.get("max"),
+                        fence.get("max"),
+                        scale.get("dead_detected"),
+                    )
+                )
+            if scrape:
+                lines.append(
+                    "  scrape: {}ms, {} bytes, {} worker series".format(
+                        scrape.get("ms"),
+                        scrape.get("bytes"),
+                        scrape.get("worker_series"),
+                    )
+                )
+            for name, budget in sorted(sim.get("budgets", {}).items()):
+                lines.append(
+                    "  budget {:<24s} {} / {}  [{}]".format(
+                        name,
+                        budget.get("value"),
+                        budget.get("budget"),
+                        "ok" if budget.get("ok") else "EXCEEDED",
+                    )
+                )
     if not report["runs"]:
         lines.append(
             "no telemetry event logs found (run the master with "

@@ -83,6 +83,7 @@ SPAN_RPC_DEGRADED = "rpc_degraded"  # netem window: link slow/blackholed
 SPAN_STEP_ANATOMY = "step_anatomy"  # one dispatch phase (phase= attr)
 SPAN_SERVING_REQUEST = "serving_request"  # serving: one request (sampled)
 SPAN_MODEL_SWAP = "model_swap"  # serving: one hot model swap
+SPAN_FLEET_FAULT = "fleet_fault"  # fleetsim: one mass-fault injection
 
 
 def gen_trace_id() -> str:

@@ -2,14 +2,24 @@
 
 Workers ship process-lifetime monotone counters on the heartbeat (PR-8
 RPC outcome totals, PR-9 step-anatomy phase totals).  Beats can be
-reordered, duplicated, or replayed after a master restart, so the
-server-side merge must be ``max``, never ``sum`` or overwrite: a stale
-beat can then never walk an exposed total backward, and a duplicate is
-absorbed.  That rule used to live as two hand-rolled loops inside
-``MasterServicer.heartbeat`` — one flat, one nested — which is one more
-copy than a correctness rule should have.  This module is the single
-definition site; the unit test pins the monotonicity and
-malformed-input tolerance both call sites rely on.
+reordered, duplicated, batched by the servicer's coalesced fan-in, or
+replayed after a master restart, so the server-side merge must be
+``max``, never ``sum`` or overwrite: a stale beat can then never walk
+an exposed total backward, and a duplicate is absorbed.  That rule used
+to live as two hand-rolled loops inside ``MasterServicer.heartbeat`` —
+one flat, one nested — which is one more copy than a correctness rule
+should have.  This module is the single definition site; the unit test
+pins the monotonicity and malformed-input tolerance both call sites
+rely on.
+
+Both functions optionally maintain a fleet-wide AGGREGATE alongside the
+per-worker maxima: pass ``totals`` and every rise of a per-worker
+counter adds its delta there.  That is what lets the servicer answer
+"sum of per-worker maxima across the fleet" in O(keys) at scrape time
+instead of an O(world_size) walk under its lock — the 1000-worker
+scrape path.  The aggregate is exactly ``sum over workers of max over
+beats``; the order or batching of beats cannot change it (pinned by
+tests/test_fleetsim.py).
 """
 
 from __future__ import annotations
@@ -19,13 +29,15 @@ def max_merge_counters(
     merged: dict[str, int],
     update: dict,
     watch: frozenset[str] | set[str] = frozenset(),
+    totals: dict[str, int] | None = None,
 ) -> bool:
     """Max-merge ``update`` into ``merged`` in place.
 
     Non-int values are skipped (wire payloads are untrusted).  Returns
     True when any ``watch`` key ROSE above its merged value — the
     "an outage-class counter moved since the last beat" signal the
-    /healthz degraded-network flag keys off.
+    /healthz degraded-network flag keys off.  ``totals``, when given,
+    accumulates each rise's delta (the fleet-wide aggregate).
     """
     rose = False
     for key, value in update.items():
@@ -33,13 +45,21 @@ def max_merge_counters(
             value = int(value)
         except (TypeError, ValueError):
             continue
-        if key in watch and value > merged.get(key, 0):
-            rose = True
-        merged[key] = max(merged.get(key, 0), value)
+        old = merged.get(key, 0)
+        if value > old:
+            if key in watch:
+                rose = True
+            if totals is not None:
+                totals[key] = totals.get(key, 0) + (value - old)
+            merged[key] = value
     return rose
 
 
-def max_merge_phase_stats(merged: dict[str, dict], update: dict) -> None:
+def max_merge_phase_stats(
+    merged: dict[str, dict],
+    update: dict,
+    totals: dict[str, dict] | None = None,
+) -> None:
     """Max-merge step-anatomy phase totals in place.
 
     Shape: ``{phase: {"ms": float, "count": int, "buckets": {str(bound):
@@ -47,6 +67,9 @@ def max_merge_phase_stats(merged: dict[str, dict], update: dict) -> None:
     worker, so each merges independently by max.  A malformed phase
     entry is skipped whole; a malformed bucket value skips the rest of
     that phase's entry (same tolerance the servicer always had).
+    ``totals``, when given, accumulates every slot's rise delta — the
+    fleet-wide aggregate mirrored onto the elasticdl_step_phase_*
+    families without a per-worker walk at scrape time.
     """
     for phase, stats in update.items():
         if not isinstance(stats, dict):
@@ -54,12 +77,32 @@ def max_merge_phase_stats(merged: dict[str, dict], update: dict) -> None:
         slot = merged.setdefault(
             phase, {"ms": 0.0, "count": 0, "buckets": {}}
         )
+        agg = (
+            None
+            if totals is None
+            else totals.setdefault(
+                phase, {"ms": 0.0, "count": 0, "buckets": {}}
+            )
+        )
         try:
-            slot["ms"] = max(slot["ms"], float(stats.get("ms", 0.0)))
-            slot["count"] = max(slot["count"], int(stats.get("count", 0)))
+            ms = float(stats.get("ms", 0.0))
+            if ms > slot["ms"]:
+                if agg is not None:
+                    agg["ms"] += ms - slot["ms"]
+                slot["ms"] = ms
+            count = int(stats.get("count", 0))
+            if count > slot["count"]:
+                if agg is not None:
+                    agg["count"] += count - slot["count"]
+                slot["count"] = count
             for bound, n in (stats.get("buckets") or {}).items():
-                slot["buckets"][bound] = max(
-                    slot["buckets"].get(bound, 0), int(n)
-                )
+                n = int(n)
+                old = slot["buckets"].get(bound, 0)
+                if n > old:
+                    if agg is not None:
+                        agg["buckets"][bound] = (
+                            agg["buckets"].get(bound, 0) + (n - old)
+                        )
+                    slot["buckets"][bound] = n
         except (TypeError, ValueError):
             continue

@@ -1,0 +1,207 @@
+"""Tier-1 gate: the thousand-worker control plane holds at fleet scale.
+
+Runs the three signature fleet plans against the REAL master
+(``elasticdl_tpu.fleetsim`` — production MasterServicer/TaskDispatcher/
+journal, 1000 simulated workers on a virtual clock) and asserts:
+
+1. ``fleet_mass_preemption`` (30% of the fleet in one tick + 500
+   duplicate-delivered heartbeats) PASSES exactly-once accounting,
+   max-merge monotonicity, and every scaling budget — and run twice
+   with the same seed produces the SAME event-log digest (the
+   determinism contract);
+2. ``fleet_rolling_slice_loss`` (three slice waves) PASSES;
+3. ``fleet_master_kill_fanin`` (master SIGKILL under full fan-in)
+   PASSES with every surviving worker re-homed and the journal
+   bytes-per-event budget measured;
+4. a seeded budget regression (``--corrupt slow_sweep``) and a seeded
+   accounting corruption (``--corrupt lost_task``) both FAIL — the
+   gates are falsifiable, not vacuous;
+5. the /metrics per-worker series cardinality cap engaged at 1000
+   workers (aggregate-above-threshold series, not 1000 gauges);
+6. ``telemetry.report`` surfaces the control-plane scale section from
+   the result artifact;
+7. zero non-daemon threads outlive the runs.
+
+Exit 0 = all hold.  Chained into scripts/run_tier1.sh.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORKERS = 1000
+TASKS = 1500
+SEED = 20260804
+
+
+def fail(message: str) -> "NoReturn":  # noqa: F821 — py3.10 spelling
+    print(f"FLEETSIM SMOKE FAIL: {message}")
+    sys.exit(1)
+
+
+def check_invariants(result: dict, plan: str):
+    failed = [
+        i for i in result["invariants"] if i["status"] != "PASS"
+    ]
+    if failed:
+        fail(
+            f"{plan}: invariants failed: "
+            + "; ".join(
+                f"{i['name']}: {i['violations']}" for i in failed
+            )
+        )
+    if not result["invariants_ok"] or result["rc"] != 0:
+        fail(f"{plan}: invariants_ok/rc inconsistent: {result}")
+
+
+def main() -> int:
+    from elasticdl_tpu.fleetsim.runner import run_plan
+    from elasticdl_tpu.telemetry.report import control_plane_section
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # ---- 1. mass preemption, twice: PASS + deterministic ------------
+        digests = []
+        for attempt in range(2):
+            workdir = os.path.join(tmp, f"mass_{attempt}")
+            os.makedirs(workdir)
+            result = run_plan(
+                "fleet_mass_preemption",
+                workdir,
+                workers=WORKERS,
+                num_tasks=TASKS,
+                seed=SEED,
+            )
+            check_invariants(result, "fleet_mass_preemption")
+            digests.append(result["event_log_digest"])
+            if result["world_size"] != WORKERS:
+                fail(f"expected {WORKERS} workers: {result['world_size']}")
+            if result["scale"]["dead_detected"] < int(0.25 * WORKERS):
+                fail(
+                    "mass preemption barely fired: dead="
+                    f"{result['scale']['dead_detected']}"
+                )
+            # the duplicate-heartbeat storm must have re-executed beats
+            # (applied > arriving calls) and max-merge absorbed them
+            hb = result["scale"]["heartbeats"]
+            calls = result["scale"]["master_cpu_ms"]["heartbeat"]["calls"]
+            if hb["total"] <= calls:
+                fail(
+                    f"duplicate delivery never fired: {hb['total']} "
+                    f"beats applied from {calls} calls"
+                )
+            # cardinality cap: 1000 workers must NOT mean 1000 series
+            series = result["scale"]["scrape"]["worker_series"]
+            if series > 8:
+                fail(f"per-worker series cap did not engage: {series}")
+        if digests[0] != digests[1]:
+            fail(
+                f"nondeterministic event log: {digests[0][:16]} != "
+                f"{digests[1][:16]}"
+            )
+        print(
+            f"fleetsim smoke: mass preemption PASS x2, digest "
+            f"{digests[0][:16]} (deterministic)"
+        )
+
+        # the report CLI must surface the scale section from the artifact
+        section = control_plane_section(os.path.join(tmp, "mass_0"))
+        if not section or not section["runs"]:
+            fail("telemetry.report found no control_plane section")
+        if section["runs"][0]["scale"]["world_size"] != WORKERS:
+            fail("control_plane section world_size mismatch")
+
+        # ---- 2. rolling slice loss --------------------------------------
+        workdir = os.path.join(tmp, "rolling")
+        os.makedirs(workdir)
+        result = run_plan(
+            "fleet_rolling_slice_loss",
+            workdir,
+            workers=WORKERS,
+            num_tasks=TASKS,
+            seed=SEED,
+        )
+        check_invariants(result, "fleet_rolling_slice_loss")
+        if result["scale"]["dead_detected"] < 3 * (WORKERS // 8) - 10:
+            fail(
+                "rolling slice loss killed too few: "
+                f"{result['scale']['dead_detected']}"
+            )
+        print("fleetsim smoke: rolling slice loss PASS")
+
+        # ---- 3. master kill under fan-in --------------------------------
+        workdir = os.path.join(tmp, "masterkill")
+        os.makedirs(workdir)
+        result = run_plan(
+            "fleet_master_kill_fanin",
+            workdir,
+            workers=WORKERS,
+            num_tasks=TASKS,
+            seed=SEED,
+        )
+        check_invariants(result, "fleet_master_kill_fanin")
+        if result["scale"]["rehomes"] < WORKERS:
+            fail(
+                f"only {result['scale']['rehomes']} of {WORKERS} "
+                "workers re-homed after the master kill"
+            )
+        if "journal_bytes_per_event" not in result["budgets"]:
+            fail("master-kill run measured no journal budget")
+        print(
+            "fleetsim smoke: master kill under fan-in PASS "
+            f"({result['scale']['rehomes']} re-homes, journal "
+            f"{result['budgets']['journal_bytes_per_event']['value']} "
+            "bytes/event)"
+        )
+
+        # ---- 4. falsifiability: seeded regressions MUST fail ------------
+        for corrupt, expect in (
+            ("slow_sweep", "budget_compliance"),
+            ("lost_task", "exactly_once"),
+            ("series_flood", "budget_compliance"),
+        ):
+            workdir = os.path.join(tmp, f"corrupt_{corrupt}")
+            os.makedirs(workdir)
+            result = run_plan(
+                "fleet_mass_preemption",
+                workdir,
+                workers=200,
+                num_tasks=300,
+                seed=SEED,
+                corrupt=corrupt,
+            )
+            if result["rc"] != 1:
+                fail(f"--corrupt {corrupt} did not exit 1")
+            failed = {
+                i["name"]
+                for i in result["invariants"]
+                if i["status"] == "FAIL"
+            }
+            if expect not in failed:
+                fail(
+                    f"--corrupt {corrupt} tripped {sorted(failed)}, "
+                    f"expected {expect}"
+                )
+        print("fleetsim smoke: seeded corruptions trip (rc 1) PASS")
+
+    # ---- 5. nothing non-daemon may outlive the runs ---------------------
+    lingering = [
+        t
+        for t in threading.enumerate()
+        if t is not threading.main_thread() and not t.daemon
+    ]
+    if lingering:
+        fail(f"non-daemon threads outlived the simulation: {lingering}")
+    print("fleetsim smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,12 @@
 # sum-exact per-request phases, compile counter FLAT across arbitrary
 # request sizes AND across a hot model swap under in-flight traffic
 # with zero failed requests)
+# + fleetsim smoke (1000 simulated workers drive the REAL master on a
+# virtual clock: mass preemption, rolling slice loss, and master-kill-
+# under-fan-in must all PASS exactly-once + scaling budgets [master CPU
+# per heartbeat, sweep/fence latency, journal bytes/event, /metrics
+# scrape + series cardinality], the event log must be seed-deterministic,
+# and seeded corruptions must exit 1)
 # + the ROADMAP.md test command, verbatim.
 # Run from the repo root: scripts/run_tier1.sh
 cd "$(dirname "$0")/.." || exit 2
@@ -54,4 +60,5 @@ timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/replication_smoke.py || e
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/master_ha_smoke.py || exit 1
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/multislice_smoke.py || exit 1
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/serving_smoke.py || exit 1
+timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/fleetsim_smoke.py || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

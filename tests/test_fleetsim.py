@@ -1,0 +1,698 @@
+"""Fleet-scale control plane: merge-under-batching contract, coalesced
+heartbeat fan-in, incremental dead-worker sweep, /metrics cardinality
+cap, and the deterministic fleet simulator (ISSUE 14)."""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+
+import pytest
+
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.rpc import messages as msg
+from elasticdl_tpu.utils.merge import (
+    max_merge_counters,
+    max_merge_phase_stats,
+)
+
+
+class FakeClock:
+    def __init__(self, start: float = 0.0):
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, secs: float):
+        self.now += secs
+
+
+def make_servicer(clock=None, tasks: int = 4):
+    dispatcher = TaskDispatcher(
+        {"shard": (0, 64 * tasks)}, records_per_task=64, num_epochs=1
+    )
+    kwargs = {} if clock is None else {"clock": clock}
+    return MasterServicer(32, dispatcher, **kwargs), dispatcher
+
+
+# ---- utils/merge.py: the batched/coalesced heartbeat contract ---------------
+#
+# The PR-8 dedup contract extended to BATCHES: reordered, duplicated,
+# and batched-then-replayed monotone counter sets must all merge to the
+# same totals the ordered per-beat application produces.
+
+
+def synth_beats(seed: int, workers: int = 8, beats: int = 40):
+    """Deterministic per-worker monotone counter timelines."""
+    rng = random.Random(seed)
+    timelines = []
+    counters = {w: {"retries": 0, "unavailable": 0} for w in range(workers)}
+    for _ in range(beats):
+        w = rng.randrange(workers)
+        key = rng.choice(["retries", "unavailable"])
+        counters[w][key] += rng.randint(1, 3)
+        timelines.append((w, dict(counters[w])))
+    final = counters
+    return timelines, final
+
+
+def apply_beats(beats, totals=None):
+    merged: dict[int, dict] = {}
+    for w, update in beats:
+        max_merge_counters(
+            merged.setdefault(w, {}), update, totals=totals
+        )
+    return merged
+
+
+class TestMaxMergeUnderBatching:
+    def test_ordered_vs_reordered_vs_duplicated(self):
+        beats, final = synth_beats(7)
+        ordered = apply_beats(beats)
+        rng = random.Random(99)
+        shuffled = list(beats)
+        rng.shuffle(shuffled)
+        reordered = apply_beats(shuffled)
+        duplicated = apply_beats(beats + beats[::3] + shuffled[::5])
+        assert ordered == reordered == duplicated
+        for w, expect in final.items():
+            assert ordered[w] == expect
+
+    def test_batched_then_replayed_totals_identical(self):
+        """Coalesced drains apply beats in arbitrary batch boundaries
+        and a master restart replays whole batches: the aggregate
+        (sum-of-per-worker-maxima) must be invariant to all of it."""
+        beats, final = synth_beats(11)
+        expected_totals: dict[str, int] = {}
+        apply_beats(beats, totals=expected_totals)
+
+        rng = random.Random(3)
+        batched = list(beats)
+        rng.shuffle(batched)
+        batches = []
+        i = 0
+        while i < len(batched):
+            size = rng.randint(1, 7)
+            batches.append(batched[i : i + size])
+            i += size
+        replayed = batches + [batches[0], batches[-1]]  # replay batches
+        totals: dict[str, int] = {}
+        merged: dict[int, dict] = {}
+        for batch in replayed:
+            for w, update in batch:
+                max_merge_counters(
+                    merged.setdefault(w, {}), update, totals=totals
+                )
+        assert totals == expected_totals
+        assert totals == {
+            key: sum(final[w][key] for w in final)
+            for key in ("retries", "unavailable")
+        }
+
+    def test_totals_never_walk_backward(self):
+        totals: dict[str, int] = {}
+        merged: dict[str, int] = {}
+        max_merge_counters(merged, {"retries": 10}, totals=totals)
+        max_merge_counters(merged, {"retries": 4}, totals=totals)  # stale
+        assert merged == {"retries": 10}
+        assert totals == {"retries": 10}
+
+    def test_malformed_values_skipped(self):
+        totals: dict[str, int] = {}
+        merged: dict[str, int] = {}
+        rose = max_merge_counters(
+            merged,
+            {"retries": "nope", "unavailable": 2},
+            watch=frozenset({"unavailable"}),
+            totals=totals,
+        )
+        assert rose
+        assert merged == {"unavailable": 2}
+        assert totals == {"unavailable": 2}
+
+    def test_phase_stats_batched_aggregate(self):
+        updates = [
+            {"train": {"ms": 10.0, "count": 2, "buckets": {"0.1": 2}}},
+            {"train": {"ms": 25.0, "count": 5, "buckets": {"0.1": 5}}},
+            {"train": {"ms": 25.0, "count": 5, "buckets": {"0.1": 5}}},
+            {"train": {"ms": 15.0, "count": 3, "buckets": {"0.1": 3}}},
+        ]
+        for order in (updates, updates[::-1]):
+            merged: dict = {}
+            totals: dict = {}
+            for update in order:
+                max_merge_phase_stats(merged, update, totals=totals)
+            assert merged["train"]["ms"] == 25.0
+            assert totals["train"]["ms"] == 25.0
+            assert totals["train"]["count"] == 5
+            assert totals["train"]["buckets"] == {"0.1": 5}
+
+    def test_phase_stats_malformed_entry_tolerated(self):
+        merged: dict = {}
+        totals: dict = {}
+        max_merge_phase_stats(
+            merged,
+            {"bad": "not-a-dict", "ok": {"ms": 5.0, "count": 1}},
+            totals=totals,
+        )
+        assert "bad" not in merged
+        assert merged["ok"]["ms"] == 5.0
+        assert totals["ok"]["ms"] == 5.0
+
+
+# ---- servicer: coalesced fan-in + incremental sweep -------------------------
+
+
+class TestCoalescedHeartbeat:
+    def test_immediate_visibility_single_threaded(self):
+        servicer, _ = make_servicer()
+        servicer.heartbeat(
+            msg.HeartbeatRequest(worker_id=1, rpc={"retries": 3})
+        )
+        assert servicer.rpc_stats_totals() == {"retries": 3}
+        assert servicer.live_workers() == [1]
+
+    def test_batched_drain_applies_whole_backlog(self):
+        """Concurrent arrivals enqueue; ONE drain applies them all
+        under one lock acquisition — max-merge keeps totals exact."""
+        clock = FakeClock()
+        servicer, _ = make_servicer(clock=clock)
+        for wid in range(50):
+            servicer._hb_pending.append(
+                (
+                    msg.HeartbeatRequest(
+                        worker_id=wid, rpc={"retries": wid}
+                    ),
+                    clock(),
+                )
+            )
+        servicer._drain_heartbeats(block=True)
+        stats = servicer.heartbeat_stats()
+        assert stats["beats"] == 50
+        assert stats["max_batch"] == 50
+        assert stats["batches"] == 1
+        assert servicer.rpc_stats_totals() == {
+            "retries": sum(range(50))
+        }
+        assert len(servicer.live_workers()) == 50
+
+    def test_sequence_equivalence_shuffled_duplicated(self):
+        beats, final = synth_beats(21, workers=6, beats=60)
+        sequential, _ = make_servicer()
+        for w, update in beats:
+            sequential.heartbeat(
+                msg.HeartbeatRequest(worker_id=w, rpc=update)
+            )
+        rng = random.Random(5)
+        chaosed = beats + beats[::4]
+        rng.shuffle(chaosed)
+        shuffled, _ = make_servicer()
+        for w, update in chaosed:
+            shuffled.heartbeat(
+                msg.HeartbeatRequest(worker_id=w, rpc=update)
+            )
+        assert (
+            sequential.rpc_stats_totals() == shuffled.rpc_stats_totals()
+        )
+
+    def test_concurrent_hammer_totals_exact(self):
+        servicer, _ = make_servicer()
+        per_thread_beats = 200
+        threads = []
+
+        def worker(wid: int):
+            for i in range(1, per_thread_beats + 1):
+                servicer.heartbeat(
+                    msg.HeartbeatRequest(worker_id=wid, rpc={"retries": i})
+                )
+
+        for wid in range(8):
+            t = threading.Thread(target=worker, args=(wid,))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        assert servicer.rpc_stats_totals() == {
+            "retries": 8 * per_thread_beats
+        }
+        stats = servicer.heartbeat_stats()
+        assert stats["beats"] == 8 * per_thread_beats
+        # coalescing must actually engage under contention: strictly
+        # fewer lock acquisitions than beats would be flaky to assert
+        # on a single-core runner, but batches can never exceed beats
+        assert stats["batches"] <= stats["beats"]
+
+    def test_phase_and_prefetch_totals_ride_batches(self):
+        servicer, _ = make_servicer()
+        servicer.heartbeat(
+            msg.HeartbeatRequest(
+                worker_id=0,
+                phases={
+                    "device_compute": {
+                        "ms": 12.0,
+                        "count": 3,
+                        "buckets": {"0.25": 3},
+                    }
+                },
+                prefetch={"groups": 2, "stall_ms": 5},
+            )
+        )
+        servicer.heartbeat(
+            msg.HeartbeatRequest(
+                worker_id=1,
+                phases={
+                    "device_compute": {
+                        "ms": 8.0,
+                        "count": 2,
+                        "buckets": {"0.25": 2},
+                    }
+                },
+                prefetch={"groups": 1, "stall_ms": 1},
+            )
+        )
+        totals = servicer.phase_stats_totals()
+        assert totals["device_compute"]["ms"] == 20.0
+        assert totals["device_compute"]["count"] == 5
+        assert totals["device_compute"]["buckets"] == {"0.25": 5}
+        assert servicer.prefetch_stats_totals() == {
+            "groups": 3,
+            "stall_ms": 6,
+        }
+
+
+class TestIncrementalSweep:
+    def test_expired_reported_until_forgotten(self):
+        clock = FakeClock()
+        servicer, _ = make_servicer(clock=clock)
+        servicer.heartbeat(msg.HeartbeatRequest(worker_id=1))
+        servicer.heartbeat(msg.HeartbeatRequest(worker_id=2))
+        assert servicer.dead_workers(10.0) == []
+        clock.advance(11.0)
+        assert servicer.dead_workers(10.0) == [1, 2]
+        # repeated sweeps keep reporting (the run loop may take ticks
+        # to act) — the heap re-push contract
+        assert servicer.dead_workers(10.0) == [1, 2]
+        servicer.forget_worker(1)
+        assert servicer.dead_workers(10.0) == [2]
+
+    def test_fresh_beat_revives(self):
+        clock = FakeClock()
+        servicer, _ = make_servicer(clock=clock)
+        servicer.heartbeat(msg.HeartbeatRequest(worker_id=7))
+        clock.advance(11.0)
+        assert servicer.dead_workers(10.0) == [7]
+        servicer.heartbeat(msg.HeartbeatRequest(worker_id=7))
+        assert servicer.dead_workers(10.0) == []
+
+    def test_matches_full_scan_semantics_at_scale(self):
+        clock = FakeClock()
+        servicer, _ = make_servicer(clock=clock)
+        rng = random.Random(13)
+        last_beat = {}
+        for wid in range(300):
+            clock.advance(rng.uniform(0.0, 0.1))
+            servicer.heartbeat(msg.HeartbeatRequest(worker_id=wid))
+            last_beat[wid] = clock()
+        clock.advance(5.0)
+        # a third of the fleet beats again
+        for wid in range(0, 300, 3):
+            servicer.heartbeat(msg.HeartbeatRequest(worker_id=wid))
+            last_beat[wid] = clock()
+        clock.advance(3.0)
+        timeout = 6.0
+        expected = sorted(
+            wid
+            for wid, at in last_beat.items()
+            if clock() - at > timeout
+        )
+        assert servicer.dead_workers(timeout) == expected
+
+    def test_heap_bounded_without_timeout_detection(self):
+        """A deployment on external failure events alone never runs the
+        timeout sweep — the heap must self-compact, not leak one entry
+        per beat forever."""
+        clock = FakeClock()
+        servicer, _ = make_servicer(clock=clock)
+        for beat in range(200):
+            clock.advance(1.0)
+            for wid in range(10):
+                servicer.heartbeat(msg.HeartbeatRequest(worker_id=wid))
+        assert len(servicer._hb_heap) <= max(64, 4 * 10) + 10
+        # compaction preserved sweep semantics
+        clock.advance(20.0)
+        assert servicer.dead_workers(10.0) == list(range(10))
+
+    def test_blocking_drain_synchronizes_with_inflight_drainer(self):
+        """A reader must see a beat whose handler already popped it off
+        the deque but has not yet applied it: the blocking drain always
+        takes the drain lock (never returns early on an empty deque)."""
+        servicer, _ = make_servicer()
+        started = threading.Event()
+        proceed = threading.Event()
+        original = servicer._apply_heartbeat_batch
+
+        def stalled_apply(batch):
+            started.set()
+            proceed.wait(5.0)
+            original(batch)
+
+        servicer._apply_heartbeat_batch = stalled_apply
+        handler = threading.Thread(
+            target=servicer.heartbeat,
+            args=(msg.HeartbeatRequest(worker_id=9, rpc={"retries": 4}),),
+        )
+        handler.start()
+        assert started.wait(5.0)
+        # deque is empty, the batch is in-flight; restore the real
+        # apply for the reader's own drain and release the handler
+        servicer._apply_heartbeat_batch = original
+        assert not servicer._hb_pending
+        results: list = []
+        reader = threading.Thread(
+            target=lambda: results.append(servicer.rpc_stats_totals())
+        )
+        reader.start()
+        proceed.set()
+        reader.join(5.0)
+        handler.join(5.0)
+        assert results == [{"retries": 4}]
+
+    def test_heap_does_not_leak_forgotten_workers(self):
+        clock = FakeClock()
+        servicer, _ = make_servicer(clock=clock)
+        for wid in range(100):
+            servicer.heartbeat(msg.HeartbeatRequest(worker_id=wid))
+        clock.advance(20.0)
+        assert len(servicer.dead_workers(10.0)) == 100
+        for wid in range(100):
+            servicer.forget_worker(wid)
+        clock.advance(1.0)
+        assert servicer.dead_workers(10.0) == []
+        # the lazily-invalidated entries were popped, not re-pushed
+        assert len(servicer._hb_heap) == 0
+
+    def test_sweep_stats_accumulate(self):
+        servicer, _ = make_servicer()
+        servicer.dead_workers(10.0)
+        servicer.dead_workers(10.0)
+        stats = servicer.sweep_stats()
+        assert stats["count"] == 2
+        assert stats["ms"] >= 0.0
+        assert stats["max_ms"] >= 0.0
+
+
+# ---- /metrics: per-worker series cardinality cap ----------------------------
+
+
+class TestWorkerSeriesCardinality:
+    def _wired(self, clock=None):
+        from elasticdl_tpu.telemetry.master_hooks import MasterTelemetry
+
+        servicer, dispatcher = make_servicer(clock=clock)
+        telemetry = MasterTelemetry("")
+        telemetry.attach(dispatcher, servicer)
+        return telemetry, servicer
+
+    @staticmethod
+    def _age_series(text: str) -> list[str]:
+        return [
+            line
+            for line in text.splitlines()
+            if line.startswith("elasticdl_worker_heartbeat_age_secs{")
+        ]
+
+    def test_small_fleet_gets_per_worker_series(self):
+        telemetry, servicer = self._wired()
+        for wid in range(5):
+            servicer.heartbeat(msg.HeartbeatRequest(worker_id=wid))
+        series = self._age_series(telemetry.registry.exposition())
+        assert len(series) == 5
+        assert any('worker="3"' in line for line in series)
+
+    def test_large_fleet_collapses_to_aggregates(self):
+        telemetry, servicer = self._wired()
+        for wid in range(200):
+            servicer.heartbeat(msg.HeartbeatRequest(worker_id=wid))
+        series = self._age_series(telemetry.registry.exposition())
+        assert len(series) == 2
+        labels = "".join(series)
+        assert 'worker="max"' in labels and 'worker="p50"' in labels
+
+    def test_crossing_the_budget_prunes_individual_series(self):
+        telemetry, servicer = self._wired()
+        for wid in range(5):
+            servicer.heartbeat(msg.HeartbeatRequest(worker_id=wid))
+        assert len(self._age_series(telemetry.registry.exposition())) == 5
+        for wid in range(5, 200):
+            servicer.heartbeat(msg.HeartbeatRequest(worker_id=wid))
+        series = self._age_series(telemetry.registry.exposition())
+        # the 5 individual children must be GONE, not frozen forever
+        assert len(series) == 2
+
+    def test_env_override_raises_budget(self, monkeypatch):
+        from elasticdl_tpu.telemetry.master_hooks import (
+            WORKER_SERIES_MAX_ENV,
+        )
+
+        monkeypatch.setenv(WORKER_SERIES_MAX_ENV, "500")
+        telemetry, servicer = self._wired()
+        for wid in range(200):
+            servicer.heartbeat(msg.HeartbeatRequest(worker_id=wid))
+        series = self._age_series(telemetry.registry.exposition())
+        assert len(series) == 200
+
+    def test_heartbeat_and_sweep_counters_exposed(self):
+        telemetry, servicer = self._wired()
+        servicer.heartbeat(msg.HeartbeatRequest(worker_id=0))
+        servicer.dead_workers(10.0)
+        text = telemetry.registry.exposition()
+        assert "elasticdl_heartbeats_total 1" in text
+        assert "elasticdl_heartbeat_batches_total 1" in text
+        assert "elasticdl_dead_worker_sweeps_total 1" in text
+        assert "elasticdl_dead_worker_sweep_ms_total" in text
+
+
+# ---- the fleet simulator ----------------------------------------------------
+
+
+def run_sim(plan_name: str, workdir: str, **kwargs):
+    from elasticdl_tpu.fleetsim.runner import run_plan
+
+    defaults = dict(workers=120, num_tasks=180, seed=4321)
+    defaults.update(kwargs)
+    return run_plan(plan_name, workdir, **defaults)
+
+
+class TestFleetSimulator:
+    def test_mass_preemption_passes_and_is_deterministic(self, tmp_path):
+        first = run_sim("fleet_mass_preemption", str(tmp_path / "a"))
+        second = run_sim("fleet_mass_preemption", str(tmp_path / "b"))
+        assert first["invariants_ok"] and second["invariants_ok"]
+        assert first["event_log_digest"] == second["event_log_digest"]
+        assert first["scale"]["dead_detected"] >= 30
+        # duplicate heartbeat storm applied more beats than calls
+        assert (
+            first["scale"]["heartbeats"]["total"]
+            > first["scale"]["master_cpu_ms"]["heartbeat"]["calls"]
+        )
+
+    def test_seed_changes_digest(self, tmp_path):
+        first = run_sim("fleet_mass_preemption", str(tmp_path / "a"))
+        second = run_sim(
+            "fleet_mass_preemption", str(tmp_path / "b"), seed=999
+        )
+        assert first["event_log_digest"] != second["event_log_digest"]
+
+    def test_rolling_slice_loss(self, tmp_path):
+        result = run_sim("fleet_rolling_slice_loss", str(tmp_path))
+        assert result["invariants_ok"], result["invariants"]
+        # three of eight slices died
+        assert result["scale"]["dead_detected"] == 3 * (120 // 8)
+
+    def test_master_kill_rehomes_and_journals(self, tmp_path):
+        result = run_sim("fleet_master_kill_fanin", str(tmp_path))
+        assert result["invariants_ok"], result["invariants"]
+        assert result["scale"]["rehomes"] == 120
+        assert result["budgets"]["journal_bytes_per_event"]["ok"]
+        assert os.path.exists(tmp_path / "journal" / "journal.jsonl")
+
+    def test_lost_task_corruption_trips_exactly_once(self, tmp_path):
+        result = run_sim(
+            "fleet_mass_preemption", str(tmp_path), corrupt="lost_task"
+        )
+        assert result["rc"] == 1
+        failed = {
+            i["name"]
+            for i in result["invariants"]
+            if i["status"] == "FAIL"
+        }
+        assert "exactly_once" in failed
+        assert "records_accounted" in failed
+
+    def test_series_flood_corruption_trips_cardinality_budget(
+        self, tmp_path
+    ):
+        """The /metrics cardinality gate is falsifiable: lifting the
+        per-worker series cap at a fleet past the budget must render
+        one series per worker and fail scrape_worker_series."""
+        result = run_sim(
+            "fleet_mass_preemption", str(tmp_path), corrupt="series_flood"
+        )
+        assert result["rc"] == 1
+        budget = result["budgets"]["scrape_worker_series"]
+        assert not budget["ok"]
+        assert budget["value"] > budget["budget"]
+
+    def test_budget_override_trips_compliance(self, tmp_path):
+        result = run_sim(
+            "fleet_mass_preemption",
+            str(tmp_path),
+            budgets={"heartbeat_cpu_ms": 1e-9},
+        )
+        assert result["rc"] == 1
+        failed = {
+            i["name"]
+            for i in result["invariants"]
+            if i["status"] == "FAIL"
+        }
+        assert failed == {"budget_compliance"}
+
+    def test_result_schema_matches_chaos_result_core(self, tmp_path):
+        """Satellite contract: one verdict schema across chaos and
+        fleetsim artifacts — CI reads both with the same code."""
+        result = run_sim("fleet_mass_preemption", str(tmp_path))
+        path = tmp_path / "fleetsim_result.json"
+        assert path.exists()
+        artifact = json.loads(path.read_text())
+        for key in ("plan", "seed", "corrupt", "invariants",
+                    "invariants_ok", "rc"):
+            assert key in artifact, key
+        for invariant in artifact["invariants"]:
+            assert set(invariant) >= {"name", "status"}
+        assert artifact["event_log_digest"] == result["event_log_digest"]
+
+    def test_report_control_plane_section(self, tmp_path):
+        from elasticdl_tpu.telemetry.report import control_plane_section
+
+        run_sim("fleet_mass_preemption", str(tmp_path))
+        section = control_plane_section(str(tmp_path))
+        assert section is not None
+        run = section["runs"][0]
+        assert run["plan"] == "fleet_mass_preemption"
+        assert run["scale"]["heartbeats"]["total"] > 0
+        assert "sweep_ms" in run["scale"]
+
+    def test_runner_cli_list(self, capsys):
+        from elasticdl_tpu.fleetsim.runner import main
+
+        assert main(["--list"]) == 0
+        out = capsys.readouterr().out
+        assert "fleet_mass_preemption" in out
+        assert "fleet_master_kill_fanin" in out
+        assert "budget_compliance" in out
+
+    def test_uses_unmodified_production_servicer(self, tmp_path):
+        """The no-forked-control-plane contract: the simulator's master
+        objects ARE the production classes, not subclasses."""
+        from elasticdl_tpu.fleetsim.plans import named_fleet_plan
+        from elasticdl_tpu.fleetsim.sim import FleetConfig, FleetSimulator
+        from elasticdl_tpu.master.autoscaler import Autoscaler
+
+        sim = FleetSimulator(
+            named_fleet_plan("fleet_mass_preemption"),
+            FleetConfig(num_workers=10, num_tasks=10),
+        )
+        assert type(sim.servicer) is MasterServicer
+        assert type(sim.task_d) is TaskDispatcher
+        assert type(sim.autoscaler) is Autoscaler
+
+    def test_autoscaler_in_loop_fires_on_backlog(self, tmp_path):
+        """The REAL autoscaler rides the simulated tick: a mass
+        preemption's requeue spike crosses the backlog SLO and the
+        decision lands in the scale section — deterministically (the
+        p95 tracker is deliberately unwired)."""
+        from elasticdl_tpu.fleetsim.plans import named_fleet_plan
+        from elasticdl_tpu.fleetsim.sim import FleetConfig, FleetSimulator
+
+        plan = named_fleet_plan("fleet_mass_preemption")
+        plan.seed = 77
+        sim = FleetSimulator(
+            plan,
+            FleetConfig(
+                num_workers=60,
+                num_tasks=200,
+                seed=77,
+                autoscale_backlog_tasks=20,
+            ),
+        )
+        result = sim.run()
+        decisions = result["scale"]["autoscale_decisions"]
+        assert decisions, "backlog spike never crossed the SLO"
+        assert decisions[0]["action"] == "grow"
+        assert result["invariants_ok"], result["invariants"]
+
+    def test_no_nondaemon_threads_leak(self, tmp_path):
+        before = {
+            t
+            for t in threading.enumerate()
+            if not t.daemon
+        }
+        run_sim("fleet_master_kill_fanin", str(tmp_path), workers=40,
+                num_tasks=60)
+        after = {
+            t
+            for t in threading.enumerate()
+            if not t.daemon
+        }
+        assert after <= before
+
+
+class TestFleetPlans:
+    def test_plans_serialize_roundtrip(self, tmp_path):
+        from elasticdl_tpu.chaos.plan import FaultPlan
+        from elasticdl_tpu.fleetsim.plans import builtin_fleet_plans
+
+        for name, plan in builtin_fleet_plans().items():
+            restored = FaultPlan.from_json(plan.to_json())
+            assert restored.name == name
+            assert [f.fault_id for f in restored.faults] == [
+                f.fault_id for f in plan.faults
+            ]
+            # the mass-fault fraction survives the JSON round trip
+            assert [f.fraction for f in restored.faults] == [
+                f.fraction for f in plan.faults
+            ]
+
+    def test_old_plan_json_still_loads(self):
+        """The new Fault.fraction field must default for pre-existing
+        plan JSONs (wire compatibility, the PR-4 discipline)."""
+        from elasticdl_tpu.chaos.plan import FaultPlan
+
+        raw = json.dumps(
+            {
+                "name": "legacy",
+                "faults": [
+                    {
+                        "kind": "preempt_worker",
+                        "fault_id": "old",
+                        "at_step": 3,
+                    }
+                ],
+            }
+        )
+        plan = FaultPlan.from_json(raw)
+        assert plan.faults[0].fraction == 0.0
+
+    def test_chaos_runner_list_includes_fleet_plans(self, capsys):
+        from elasticdl_tpu.chaos.runner import main
+
+        assert main(["--list"]) == 0
+        out = capsys.readouterr().out
+        assert "fleet_mass_preemption" in out
+        assert "fleet_rolling_slice_loss" in out
+        assert "heartbeat_merge_monotone" in out
+        assert "fleet_recovery" in out
