@@ -758,22 +758,51 @@ def _measure_anatomy_window(
         )
         LocalExecutor(args).run()
         from elasticdl_tpu.telemetry.events import read_events
-        from elasticdl_tpu.telemetry.report import goodput_section
-
-        section = goodput_section(
-            read_events(_os.path.join(telemetry_dir, "events.jsonl"))
+        from elasticdl_tpu.telemetry.report import (
+            goodput_section,
+            memory_section,
         )
+
+        events = read_events(
+            _os.path.join(telemetry_dir, "events.jsonl")
+        )
+        section = goodput_section(events)
         if not section:
             return {"error": "no step_anatomy events recorded"}
-        return section["overall"]
+        overall = dict(section["overall"])
+        memory = memory_section(events)
+        if memory:
+            # the falsifiable headroom numbers the sharded-embedding
+            # work inherits: per-component peaks + the unaccounted
+            # residual vs its budget, measured on the SAME run the
+            # roofline ratio comes from
+            overall["memory"] = {
+                "components": {
+                    name: slot["peak_bytes"]
+                    for name, slot in memory["components"].items()
+                },
+                "host_rss_peak_bytes": memory["host_rss_peak_bytes"],
+                "unaccounted_bytes": memory["unaccounted_bytes"],
+                "unaccounted_over_budget": memory[
+                    "unaccounted_over_budget"
+                ],
+            }
+        return overall
     except Exception as ex:  # noqa: BLE001 — anatomy must not fail bench
         return {"error": f"{type(ex).__name__}: {ex}"}
     finally:
         # the instrumented run installed process-global recorders bound
-        # to this tempdir; later configs must not inherit them
+        # to this tempdir; later configs must not inherit them — and the
+        # model_state ledger callback closes over the whole trainer, so
+        # unregistering it here releases the previous config's
+        # params/opt-state pytree
+        from elasticdl_tpu.telemetry import memory as memory_mod
+
         anatomy_mod.uninstall()
         worker_hooks.uninstall()
         tracing.uninstall()
+        memory_mod.unregister_component(memory_mod.COMPONENT_MODEL_STATE)
+        memory_mod.uninstall()
 
 
 E2E_CONFIGS = {

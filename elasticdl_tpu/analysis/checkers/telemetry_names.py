@@ -60,6 +60,12 @@ REQUIRED_EVENT_NAMES = frozenset(
         "serving_request",
         "model_swap",
         "fleet_fault",
+        # memory observability plane (telemetry/memory.py) + the
+        # on-demand profiler round trip (utils/profiling.py)
+        "memory_sample",
+        "memory_pressure",
+        "profile_window_open",
+        "profile_window_close",
     }
 )
 REQUIRED_SPAN_NAMES = frozenset(
@@ -79,6 +85,8 @@ REQUIRED_SPAN_NAMES = frozenset(
         "serving_request",
         "model_swap",
         "fleet_fault",
+        # the XLA profiler capture window (flag-armed or on-demand)
+        "profile_window",
     }
 )
 REQUIRED_PHASE_NAMES = frozenset(
@@ -113,6 +121,9 @@ REQUIRED_METRIC_NAMES = frozenset(
         "elasticdl_heartbeat_batches_total",
         "elasticdl_dead_worker_sweep_ms_total",
         "elasticdl_worker_heartbeat_age_secs",
+        # memory observability plane: the component-level byte ledger
+        # (component= / kind=current|peak gauge family)
+        "elasticdl_memory_bytes",
     }
 )
 

@@ -108,10 +108,24 @@ class MasterJournal:
         self._last_version = -1
         self._callbacks_invoked = 0
         self._snapshot_provider = None
+        # memory-ledger accounting: the unflushed append buffer (small
+        # by design — the fsync batcher bounds it — but a wedged disk
+        # would grow it silently, which is exactly what a ledger is for)
+        from elasticdl_tpu.telemetry import memory as memory_mod
+
+        self._ledger_cb = self.buffer_bytes
+        memory_mod.register_component(
+            memory_mod.COMPONENT_MASTER_JOURNAL, self._ledger_cb
+        )
         self._flusher = threading.Thread(
             target=self._flush_loop, name="master-journal", daemon=True
         )
         self._flusher.start()
+
+    def buffer_bytes(self) -> int:
+        """Bytes buffered and not yet flushed to disk."""
+        with self._lock:
+            return sum(len(line) for line in self._buffer)
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -159,6 +173,7 @@ class MasterJournal:
     def close(self):
         self.flush()
         self._closed = True
+        self._unregister_ledger()
 
     def abort(self):
         """SIGKILL semantics for the in-process chaos harness: drop the
@@ -168,6 +183,17 @@ class MasterJournal:
         with self._lock:
             self._buffer.clear()
             self._closed = True
+        self._unregister_ledger()
+
+    def _unregister_ledger(self):
+        # identity-guarded: a relaunched master's journal (HA harness,
+        # fleetsim replays) may already have re-registered the name —
+        # this journal's teardown must not drop the live one's callback
+        from elasticdl_tpu.telemetry import memory as memory_mod
+
+        memory_mod.unregister_component(
+            memory_mod.COMPONENT_MASTER_JOURNAL, self._ledger_cb
+        )
 
     # ---- append machinery --------------------------------------------------
 

@@ -27,6 +27,7 @@ from elasticdl_tpu.rpc import messages as msg
 from elasticdl_tpu.utils.constants import TaskType
 from elasticdl_tpu.utils.log_utils import default_logger as logger
 from elasticdl_tpu.utils.merge import (
+    last_merge_counters,
     max_merge_counters,
     max_merge_phase_stats,
 )
@@ -110,12 +111,28 @@ class MasterServicer:
         # discipline, mirrored onto the elasticdl_step_phase_* families
         self._worker_phase_stats: dict[int, dict] = {}  # guarded-by: _lock
         self._worker_prefetch_stats: dict[int, dict] = {}  # guarded-by: _lock
+        # worker-shipped memory-ledger snapshots (heartbeat `memory`
+        # field, telemetry/memory.py).  Memory goes DOWN as well as up,
+        # so "current" merges timestamped last-writer-wins (per-key
+        # stamps alongside the values) while the peak watermarks keep
+        # the monotone max rule
+        self._worker_memory: dict[int, dict[str, int]] = {}  # guarded-by: _lock
+        self._worker_memory_stamps: dict[int, dict[str, float]] = {}  # guarded-by: _lock
+        self._worker_memory_peaks: dict[int, dict[str, int]] = {}  # guarded-by: _lock
         # fleet-wide aggregates maintained INCREMENTALLY by the merge
         # rule (utils/merge.py ``totals=``): scrape-time reads are
         # O(keys), not an O(world_size) walk under the lock
         self._rpc_totals: dict[str, int] = {}  # guarded-by: _lock
         self._phase_totals: dict[str, dict] = {}  # guarded-by: _lock
         self._prefetch_totals: dict[str, int] = {}  # guarded-by: _lock
+        self._memory_totals: dict[str, int] = {}  # guarded-by: _lock
+        self._memory_peak_totals: dict[str, int] = {}  # guarded-by: _lock
+        # on-demand profiler command (request_profile): the latest armed
+        # window, redistributed on every heartbeat response until its
+        # TTL lapses.  Published as an immutable dict so responses can
+        # read it GIL-atomically without the lock
+        self._profile_command: dict | None = None  # guarded-by: _lock (writes)
+        self._profile_window_seq = 0  # guarded-by: _lock
         # liveness-vs-progress split (/healthz): when any worker last
         # ADVANCED its step sample (heartbeat `step` / version report) —
         # a hung-but-alive job heartbeats forever but this stops moving
@@ -540,6 +557,7 @@ class MasterServicer:
             cluster_version=generation,
             replica_peers=replica_peers,
             boot_id=self._boot_id,
+            profile=self._live_profile_command(),
         )
 
     def _drain_heartbeats(self, block: bool = False):
@@ -636,6 +654,42 @@ class MasterServicer:
                 request.prefetch,
                 totals=self._prefetch_totals,
             )
+        if request.memory and isinstance(request.memory, dict):
+            # memory-ledger snapshot: current values are NON-monotone
+            # (a swap releases, a queue drains) so they merge by the
+            # sender's sample stamp — newest wins, reordered/duplicate
+            # beats absorbed — while peaks keep the max rule.  Both
+            # aggregates are incremental: the current total carries
+            # signed deltas (it goes down on release)
+            try:
+                at = float(request.memory.get("at", 0.0))
+            except (TypeError, ValueError):
+                at = None
+            if at is not None:
+                wid = request.worker_id
+                current = request.memory.get("current")
+                if isinstance(current, dict):
+                    # complete=True: the ledger ships its WHOLE current
+                    # map each beat, so a component the snapshot no
+                    # longer carries (its owner unregistered — a closed
+                    # stager, a drained queue) is deleted from the
+                    # merged view instead of ratcheting at its last
+                    # nonzero reading
+                    last_merge_counters(
+                        self._worker_memory.setdefault(wid, {}),
+                        current,
+                        at,
+                        self._worker_memory_stamps.setdefault(wid, {}),
+                        totals=self._memory_totals,
+                        complete=True,
+                    )
+                peaks = request.memory.get("peak")
+                if isinstance(peaks, dict):
+                    max_merge_counters(
+                        self._worker_memory_peaks.setdefault(wid, {}),
+                        peaks,
+                        totals=self._memory_peak_totals,
+                    )
 
     # lock-holding: _lock
     def _note_beat_locked(self, worker_id: int, now: float):
@@ -662,6 +716,71 @@ class MasterServicer:
                 (at, wid) for wid, at in self._heartbeats.items()
             ]
             heapq.heapify(self._hb_heap)
+
+    # ---- on-demand profiler windows -----------------------------------------
+
+    # how long a request_profile command keeps riding heartbeat
+    # responses.  Sized to cover a few beats from every worker; while
+    # unexpired, a second request_profile is ABSORBED (returns the same
+    # window id) — that plus the workers' window_id dedup is what makes
+    # the method safe under RPC re-delivery
+    PROFILE_COMMAND_TTL_SECS = 30.0
+
+    def _live_profile_command(self) -> dict:
+        """The unexpired profile command for heartbeat responses ({}
+        otherwise).  Lock-free: the command dict is published immutably
+        (writes-guarded), so this is a GIL-atomic reference read plus a
+        clock compare — the heartbeat response path never waits."""
+        cmd = self._profile_command
+        if cmd is None:
+            return {}
+        if self._clock() - cmd["issued_at"] >= self.PROFILE_COMMAND_TTL_SECS:
+            return {}
+        return {
+            "window_id": cmd["window_id"],
+            "num_steps": cmd["num_steps"],
+            "out_dir": cmd["out_dir"],
+        }
+
+    def request_profile(
+        self, request: msg.RequestProfileRequest
+    ) -> msg.RequestProfileResponse:
+        """Arm an on-demand XLA profiler window: the command rides down
+        on every heartbeat response until the TTL lapses, and each
+        worker opens one capture into its telemetry dir at runtime — a
+        live degraded job gets profiled without a relaunch.  Arming
+        while a command is still being distributed returns the EXISTING
+        window id (the absorbed-replay contract the idempotency
+        registry claims)."""
+        with self._lock:
+            now = self._clock()
+            cmd = self._profile_command
+            if cmd is not None and (
+                now - cmd["issued_at"] < self.PROFILE_COMMAND_TTL_SECS
+            ):
+                return msg.RequestProfileResponse(
+                    accepted=True,
+                    window_id=cmd["window_id"],
+                    reason="window already being distributed (absorbed)",
+                )
+            self._profile_window_seq += 1
+            try:
+                num_steps = max(1, int(request.num_steps))
+            except (TypeError, ValueError):
+                num_steps = 5
+            self._profile_command = {
+                "window_id": self._profile_window_seq,
+                "num_steps": num_steps,
+                "out_dir": str(request.out_dir or ""),
+                "issued_at": now,
+            }
+            window_id = self._profile_window_seq
+        logger.info(
+            "On-demand profile window %d armed (%d steps)",
+            window_id,
+            num_steps,
+        )
+        return msg.RequestProfileResponse(accepted=True, window_id=window_id)
 
     # ---- master high availability: the re-homing handshake -----------------
 
@@ -866,6 +985,23 @@ class MasterServicer:
             # it, sees no _heartbeats entry, and discards it
             self._heartbeats.pop(worker_id, None)
             self._marked_dead.discard(worker_id)
+            # retire the worker's memory CURRENT contribution: unlike
+            # the lifetime RPC counters (monotone, deliberately kept),
+            # the memory gauge is "sum of live workers' newest-stamped
+            # bytes" — a dead worker's RAM is freed with its process,
+            # and leaving it would ratchet the fleet gauge upward
+            # across preemptions.  Peaks stay: the watermark happened,
+            # and the per-worker peak map is kept so a REUSED worker id
+            # max-merges against it instead of double-counting totals.
+            current = self._worker_memory.pop(worker_id, None)
+            self._worker_memory_stamps.pop(worker_id, None)
+            if current:
+                for key, value in current.items():
+                    remaining = self._memory_totals.get(key, 0) - value
+                    if remaining:
+                        self._memory_totals[key] = remaining
+                    else:
+                        self._memory_totals.pop(key, None)
         if self._replica_directory is not None:
             self._replica_directory.forget_worker(worker_id)
 
@@ -918,6 +1054,20 @@ class MasterServicer:
         self._drain_heartbeats(block=True)
         with self._lock:
             return dict(self._prefetch_totals)
+
+    def memory_stats_totals(self) -> dict[str, dict]:
+        """Fleet-wide memory-ledger aggregates — ``{"current": {key:
+        bytes}, "peak": {key: bytes}}``.  ``current`` is the sum over
+        workers of each worker's NEWEST-stamped sample (it goes down on
+        release — last-writer-wins, not a ratchet); ``peak`` is the sum
+        of per-worker watermark maxima.  Both maintained incrementally;
+        O(keys) under the lock."""
+        self._drain_heartbeats(block=True)
+        with self._lock:
+            return {
+                "current": dict(self._memory_totals),
+                "peak": dict(self._memory_peak_totals),
+            }
 
     def phase_stats_totals(self) -> dict[str, dict]:
         """Fleet-wide step-anatomy phase totals — ``{phase: {"ms":

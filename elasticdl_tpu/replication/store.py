@@ -50,6 +50,38 @@ class ReplicaStore:
         # report/invariant code are fine, increments take the lock —
         # += on a shared int is load/add/store, not atomic
         self.rejected = 0  # guarded-by: _lock (writes)
+        # memory-ledger accounting: the two-versions-per-source
+        # retention is exactly the kind of silent resident set that
+        # walks a host into OOM under elasticity
+        from elasticdl_tpu.telemetry import memory as memory_mod
+
+        self._ledger_cb = self.nbytes
+        memory_mod.register_component(
+            memory_mod.COMPONENT_REPLICA_STORE, self._ledger_cb
+        )
+
+    def nbytes(self) -> int:
+        """Total retained shard payload bytes (all sources, all
+        versions) — the memory ledger's accounting callback."""
+        with self._lock:
+            return sum(
+                len(shard.payload)
+                for held in self._shards.values()
+                for shard in held.values()
+            )
+
+    def close(self):
+        """Drop the ledger callback so a discarded store's retained
+        payloads (two versions per source of model-sized blobs) are not
+        pinned by the component registry.  Identity-guarded: a newer
+        store registered under the same name stays live.  Worker
+        processes die with their store (SIGKILL), but the in-process
+        harnesses and tests build several stores per process."""
+        from elasticdl_tpu.telemetry import memory as memory_mod
+
+        memory_mod.unregister_component(
+            memory_mod.COMPONENT_REPLICA_STORE, self._ledger_cb
+        )
 
     @property
     def generation(self) -> int:

@@ -98,6 +98,14 @@ IDEMPOTENCY: dict[str, tuple[str, str]] = {
         "monotone-merge",
         "server takes max(version); replays are absorbed",
     ),
+    "request_profile": (
+        "deduped",
+        "arming while a window is still being distributed returns the "
+        "existing window id (absorbed), and workers dedupe the "
+        "heartbeat-borne command by window_id — so neither a "
+        "re-delivered arm nor a duplicated response can open a second "
+        "capture",
+    ),
     "serving_status": (
         "read-only",
         "pure snapshot of replica counters/version; doubles as the "

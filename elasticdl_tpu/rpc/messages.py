@@ -141,6 +141,14 @@ class HeartbeatRequest:
     # --device_prefetch is off; old payloads decode to {} so the field
     # is wire-compatible
     prefetch: dict = field(default_factory=dict)
+    # memory-ledger snapshot (telemetry/memory.py): {"at": <sender wall
+    # clock>, "current": {component: bytes}, "peak": {component:
+    # bytes}}.  NON-monotone by nature (a swap releases, a queue
+    # drains), so the master merges "current" with timestamped
+    # last-writer-wins (utils/merge.last_merge_counters) and "peak"
+    # with the usual max rule.  Empty when the ledger is off; old
+    # payloads decode to {} so the field is wire-compatible
+    memory: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -160,6 +168,12 @@ class HeartbeatResponse:
     # master reconciles accounting (master/journal.py).  Old payloads
     # decode to "" — wire-compatible
     boot_id: str = ""
+    # on-demand profiler command (utils/profiling.py): {"window_id",
+    # "num_steps", "out_dir"} when a request_profile window is being
+    # distributed; workers dedupe by window_id, so the master can keep
+    # re-sending the latest command and every replay is absorbed.
+    # Empty otherwise; old payloads decode to {} — wire-compatible
+    profile: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -381,6 +395,27 @@ class SwapModelResponse:
 
 
 @dataclass
+class RequestProfileRequest:
+    """Arm an on-demand XLA profiler window on the running job: the
+    master rides the command down on every heartbeat response until the
+    distribution TTL lapses, and each worker opens one
+    ``num_steps``-step capture into its telemetry dir (or ``out_dir``
+    when given).  Arming while a window is already being distributed is
+    ABSORBED (the response carries the existing window id) — that is
+    what makes a re-delivered arm safe to retry."""
+
+    num_steps: int = 5
+    out_dir: str = ""
+
+
+@dataclass
+class RequestProfileResponse:
+    accepted: bool = False
+    window_id: int = 0
+    reason: str = ""
+
+
+@dataclass
 class GetRestoreStateRequest:
     """A re-formed world asks the master for the harvested in-memory
     replica set.  ``cluster_version`` fences the stage: only the
@@ -416,6 +451,8 @@ _SIMPLE_TYPES = {
     "FetchReplicaResponse": FetchReplicaResponse,
     "GetRestoreStateRequest": GetRestoreStateRequest,
     "RestoreStateResponse": RestoreStateResponse,
+    "RequestProfileRequest": RequestProfileRequest,
+    "RequestProfileResponse": RequestProfileResponse,
     "PredictRequest": PredictRequest,
     "PredictResponse": PredictResponse,
     "ServingStatusRequest": ServingStatusRequest,

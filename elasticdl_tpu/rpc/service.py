@@ -39,6 +39,7 @@ _METHODS = (
     "get_world_assignment",
     "get_restore_state",
     "rehome_worker",
+    "request_profile",
 )
 
 # every master control-plane method is retry-safe (classified in
@@ -370,3 +371,8 @@ class MasterClient(RpcClient):
         self, request: msg.RehomeRequest
     ) -> msg.RehomeResponse:
         return self._call("rehome_worker", request)
+
+    def request_profile(
+        self, request: msg.RequestProfileRequest
+    ) -> msg.RequestProfileResponse:
+        return self._call("request_profile", request)

@@ -109,6 +109,7 @@ class Ticket:
         "request_id",
         "features",
         "rows",
+        "nbytes",
         "submitted_at",
         "first_dispatch_at",
         "finished_at",
@@ -125,6 +126,11 @@ class Ticket:
         self.request_id = request_id
         self.features = features
         self.rows = rows
+        # queued feature bytes (memory-ledger accounting) — THE shared
+        # leaf-byte rule, so nested feature trees count correctly
+        from elasticdl_tpu.telemetry.memory import pytree_bytes
+
+        self.nbytes = pytree_bytes(features)
         self.submitted_at = time.monotonic()
         self.first_dispatch_at: float | None = None
         self.finished_at: float | None = None
@@ -249,7 +255,16 @@ class MicroBatcher:
         # (ticket, next_row) cursors, FIFO  # guarded-by: _lock
         self._cursors: deque = deque()
         self._pending_rows = 0  # guarded-by: _lock
+        self._pending_bytes = 0  # guarded-by: _lock
         self._closed = False  # guarded-by: _lock
+        # memory-ledger accounting: a traffic spike's queued request
+        # rows are host-resident until their groups dispatch
+        from elasticdl_tpu.telemetry import memory as memory_mod
+
+        self._ledger_cb = self.queue_bytes
+        memory_mod.register_component(
+            memory_mod.COMPONENT_SERVING_QUEUE, self._ledger_cb
+        )
 
     # ---- submitter threads -------------------------------------------------
 
@@ -273,12 +288,19 @@ class MicroBatcher:
                 )
             self._cursors.append([ticket, 0])
             self._pending_rows += rows
+            self._pending_bytes += ticket.nbytes
             self._nonempty.notify()
         return ticket
 
     def queue_rows(self) -> int:
         with self._lock:
             return self._pending_rows
+
+    def queue_bytes(self) -> int:
+        """Host bytes of the queued (not yet fully dispatched) request
+        features — the memory ledger's accounting callback."""
+        with self._lock:
+            return self._pending_bytes
 
     def close(self):
         """Refuse new submits and wake the dispatch thread; queued
@@ -287,9 +309,17 @@ class MicroBatcher:
             self._closed = True
             cursors, self._cursors = list(self._cursors), deque()
             self._pending_rows = 0
+            self._pending_bytes = 0
             self._nonempty.notify_all()
         for ticket, _pos in cursors:
             ticket.fail(ServingShutdownError("server shutting down"))
+        # drop the ledger callback so the closed batcher is not kept
+        # alive by the component registry
+        from elasticdl_tpu.telemetry import memory as memory_mod
+
+        memory_mod.unregister_component(
+            memory_mod.COMPONENT_SERVING_QUEUE, self._ledger_cb
+        )
 
     # ---- the dispatch thread -----------------------------------------------
 
@@ -326,6 +356,10 @@ class MicroBatcher:
                 taken += take
                 if pos + take >= ticket.rows:
                     self._cursors.popleft()
+                    # the ticket's last row left the queue: its feature
+                    # bytes are no longer queue-resident (the dispatch
+                    # group holds its own slices)
+                    self._pending_bytes -= ticket.nbytes
                 else:
                     cursor[1] = pos + take
             self._pending_rows -= taken

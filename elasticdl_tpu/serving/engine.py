@@ -147,6 +147,26 @@ class ServingEngine:
         self.requests_served = 0
         self.rows_served = 0
         self.swaps_applied = 0
+        # memory-ledger accounting: the served leaves, the pre-build
+        # flats, and — during a hot swap — the incoming leaves while
+        # the outgoing ones are still resident (the transient double
+        # residency the ledger exists to make visible)
+        from elasticdl_tpu.telemetry import memory as memory_mod
+
+        self._memory_mod = memory_mod
+        self._model_bytes = 0
+        self._swap_extra_bytes = 0
+        self._pending_flat_bytes = memory_mod.pytree_bytes(
+            self._pending_flats
+        )
+        self._ledger_cb = lambda: (
+            self._model_bytes
+            + self._swap_extra_bytes
+            + self._pending_flat_bytes
+        )
+        memory_mod.register_component(
+            memory_mod.COMPONENT_SERVING_MODEL, self._ledger_cb
+        )
         self.metrics.model_version.set(self._version)
 
     # ---- build -------------------------------------------------------------
@@ -214,7 +234,12 @@ class ServingEngine:
                     self._model.apply, params, optax.identity(), model_state
                 )
                 self._pending_flats = None
+                self._pending_flat_bytes = 0
+                self._model_bytes = self._memory_mod.pytree_bytes(
+                    (params, model_state)
+                )
                 self._feature_spec = self._spec_of(sample_features)
+            self._memory_mod.sample("engine_build")
             logger.info(
                 "Serving engine built: %s version %d, canonical rows %d",
                 self._manifest.get("model_def", "?"),
@@ -332,6 +357,10 @@ class ServingEngine:
         }
         self.metrics.dispatches.inc()
         self.metrics.batch_fill.observe(group.n_real / self.canonical_rows)
+        if self.metrics.dispatches.value % 64 == 0:
+            # serving has no heartbeat thread: every 64th dispatch is
+            # the periodic memory cadence (no-op without a ledger)
+            self._memory_mod.sample()
         offset = 0
         for ticket, lo, hi in group.segments:
             n = hi - lo
@@ -442,6 +471,9 @@ class ServingEngine:
             if self._state is None:
                 # not built yet: the pending flats ARE the model
                 self._pending_flats = (dict(flat_params), dict(flat_state))
+                self._pending_flat_bytes = self._memory_mod.pytree_bytes(
+                    self._pending_flats
+                )
                 old = self._version
                 self._version = version
             else:
@@ -468,6 +500,14 @@ class ServingEngine:
                 model_state = _place_like(
                     model_state, self._state.model_state
                 )
+                # double-residency window: the incoming leaves are
+                # placed, the outgoing ones still served — the ledger
+                # sample HERE is what records the swap's true peak
+                new_bytes = self._memory_mod.pytree_bytes(
+                    (params, model_state)
+                )
+                self._swap_extra_bytes = new_bytes
+                self._memory_mod.sample("model_swap")
                 old = self._version
                 # same treedef, same shapes -> the jitted program is
                 # reused; in-flight groups keep the state they snapshot
@@ -475,7 +515,19 @@ class ServingEngine:
                     params=params, model_state=model_state
                 )
                 self._version = version
+                # the ledger callback reads these two fields without a
+                # lock from the dispatch thread: zero the extra BEFORE
+                # moving _model_bytes, so a concurrent sample can only
+                # momentarily UNDER-count (old bytes + 0) — the reverse
+                # order could record a false new+new peak watermark
+                # that max-merge would keep forever
+                self._swap_extra_bytes = 0
+                self._model_bytes = new_bytes
         secs = time.monotonic() - t0
+        # post-swap sample: the old leaves are released (in-flight
+        # groups may pin them briefly) — current drops back, peak keeps
+        # the double-residency watermark
+        self._memory_mod.sample("model_swap")
         self.swaps_applied += 1
         self.metrics.swaps.inc()
         self.metrics.model_version.set(version)

@@ -135,7 +135,12 @@ def _canonical_rows(minibatch_size: int) -> int:
 
 
 def _install_telemetry(args):
-    from elasticdl_tpu.telemetry import compile_tracker, tracing, worker_hooks
+    from elasticdl_tpu.telemetry import (
+        compile_tracker,
+        memory,
+        tracing,
+        worker_hooks,
+    )
 
     telemetry_dir = args.telemetry_dir or os.environ.get(
         worker_hooks.TELEMETRY_DIR_ENV, ""
@@ -143,6 +148,10 @@ def _install_telemetry(args):
     worker_hooks.install(telemetry_dir)
     tracing.install(telemetry_dir)
     compile_tracker.install()
+    # the serving plane's byte owners (batcher queue, served leaves incl.
+    # the swap's double residency) register against THIS process's
+    # ledger; without it every engine/batcher sample site is a no-op
+    memory.install_if_enabled(telemetry_dir)
     return telemetry_dir
 
 

@@ -58,6 +58,24 @@ class ServingReplicaServicer:
         self.batcher = batcher
         self.replica_id = int(replica_id)
 
+    def _note_failed(self, request, kind: str, shed: bool = False):
+        """Failed/shed requests ride the same ``serving_request`` event
+        stream the engine emits for completions (``error`` set, phases
+        absent), so the report's serving section can count sheds and
+        errors without a second artifact.  Already on an exceptional
+        path — never the per-request hot path."""
+        from elasticdl_tpu.telemetry import worker_hooks
+        from elasticdl_tpu.telemetry.events import EVENT_SERVING_REQUEST
+
+        worker_hooks.emit_event(
+            EVENT_SERVING_REQUEST,
+            request_id=request.request_id,
+            rows=int(request.rows),
+            replica_id=self.replica_id,
+            error=kind,
+            shed=bool(shed),
+        )
+
     def predict(self, request: msg.PredictRequest) -> msg.PredictResponse:
         try:
             features = msg.unpack_array_tree(request.features)
@@ -75,24 +93,30 @@ class ServingReplicaServicer:
             # consumers size capacity off this counter, so a malformed
             # request must not inflate it (those land in errors below)
             self.engine.metrics.rejected.inc()
+            self._note_failed(request, "overload", shed=True)
             return msg.PredictResponse(error=str(ex), retryable=True)
         except ServingError as ex:
             self.engine.metrics.errors.inc()
+            self._note_failed(request, type(ex).__name__)
             return msg.PredictResponse(
                 error=str(ex), retryable=bool(getattr(ex, "retryable", False))
             )
         except Exception as ex:  # noqa: BLE001 — malformed payloads must
             # answer, not kill the handler thread
+            self._note_failed(request, "bad_request")
             return msg.PredictResponse(error=f"bad request: {ex}")
         try:
             outputs = ticket.result(TICKET_WAIT_SECS)
         except ServingError as ex:
+            self._note_failed(request, type(ex).__name__)
             return msg.PredictResponse(
                 error=str(ex), retryable=bool(getattr(ex, "retryable", False))
             )
         except TimeoutError as ex:
+            self._note_failed(request, "timeout")
             return msg.PredictResponse(error=str(ex), retryable=True)
         except Exception as ex:  # noqa: BLE001 — dispatch errors carry over
+            self._note_failed(request, "dispatch_failed")
             return msg.PredictResponse(error=f"dispatch failed: {ex}")
         phases_ms = {
             name: secs * 1000.0 for name, secs in ticket.phases_secs.items()
@@ -219,6 +243,15 @@ class ServingReplica:
             self._thread.join(timeout=5)
         if self._server is not None:
             self._server.stop(grace).wait(grace)
+        # drop the engine's ledger callback: a closed replica's served
+        # leaves must not be pinned by the component registry
+        # (identity-guarded — a newer engine's registration stays)
+        from elasticdl_tpu.telemetry import memory as memory_mod
+
+        memory_mod.unregister_component(
+            memory_mod.COMPONENT_SERVING_MODEL,
+            getattr(self.engine, "_ledger_cb", None),
+        )
 
 
 class ServingClient(RpcClient):

@@ -85,6 +85,13 @@ EVENT_MODEL_SWAP = "model_swap"
 # loss, master kill) with its virtual firing time — the source of the
 # report's control-plane scale section fault timeline
 EVENT_FLEET_FAULT = "fleet_fault"
+# memory observability plane (telemetry/memory.py): one event per
+# ledger sample (periodic + phase edges: reform, model swap,
+# checkpoint) carrying per-component bytes, peaks, host RSS and the
+# explicit unaccounted residual / host MemAvailable crossed below the
+# pressure fraction (entered=True) or recovered above it
+EVENT_MEMORY_SAMPLE = "memory_sample"
+EVENT_MEMORY_PRESSURE = "memory_pressure"
 
 EVENTS_FILENAME = "events.jsonl"
 

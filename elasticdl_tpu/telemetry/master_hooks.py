@@ -46,6 +46,7 @@ _TASKS_DISPATCHED = "elasticdl_tasks_dispatched_total"
 _TASKS_COMPLETED = "elasticdl_tasks_completed_total"
 _WORKER_TIME_MS = "elasticdl_worker_time_ms_total"
 _WORKER_HB_AGE = "elasticdl_worker_heartbeat_age_secs"
+_MEMORY_BYTES = "elasticdl_memory_bytes"
 
 # per-worker label-cardinality budget for /metrics: a fleet at or under
 # this size exposes one heartbeat-age series per worker; above it the
@@ -185,6 +186,14 @@ class MasterTelemetry:
 
         compile_tracker.install()
         self._compile_tracker = compile_tracker
+        # master-side memory ledger: samples at reform edges + scrape
+        # time; its components (master journal buffers) fold into the
+        # same elasticdl_memory_bytes family the heartbeat-fed worker
+        # components land in.  Enabled exactly when telemetry is
+        from elasticdl_tpu.telemetry import memory as memory_mod
+
+        self._memory_mod = memory_mod
+        memory_mod.install_if_enabled(telemetry_dir, emit=self.events.emit)
 
         self._task_d = None
         self._servicer = None
@@ -336,6 +345,7 @@ class MasterTelemetry:
                     "Slowest single dead-worker sweep",
                 ).set(sweep.get("max_ms", 0.0))
             self._collect_worker_ages()
+            self._collect_memory()
             prefetch_totals = getattr(
                 self._servicer, "prefetch_stats_totals", lambda: {}
             )()
@@ -386,6 +396,71 @@ class MasterTelemetry:
                 labels={"worker": key},
             ).set(value)
 
+    def _collect_memory(self):
+        """Mirror the memory ledger onto ``elasticdl_memory_bytes
+        {component=, kind=current|peak}``: the heartbeat-fed fleet
+        aggregates (last-writer-wins currents, max-merged peaks) plus
+        this process's own ledger components (master journal buffers).
+
+        Cardinality-bounded like the per-worker age series: component
+        names arrive over the wire (untrusted), so above the series
+        budget the smallest components collapse into ``component=
+        "other"`` and stale children are pruned."""
+        totals = getattr(
+            self._servicer, "memory_stats_totals", lambda: {}
+        )()
+        current = dict((totals or {}).get("current") or {})
+        peak = dict((totals or {}).get("peak") or {})
+        ledger = self._memory_mod.get_ledger()
+        if ledger is not None:
+            # sample at scrape time so the journal-buffer reading (and
+            # master RSS) is fresh without any run-loop bookkeeping
+            ledger.sample("scrape")
+            own = ledger.snapshot()
+            for key, value in own["current"].items():
+                current[key] = current.get(key, 0) + value
+            for key, value in own["peak"].items():
+                peak[key] = peak.get(key, 0) + value
+        if not current and not peak:
+            return
+        budget = worker_series_budget()
+
+        def bounded(values: dict) -> dict:
+            if len(values) <= budget:
+                return dict(values)
+            ordered = sorted(
+                values.items(), key=lambda kv: (-kv[1], kv[0])
+            )
+            kept = dict(ordered[: budget - 1])
+            # ADD into the collapse bucket (never assign): component
+            # names arrive over the wire, so a real component that is
+            # literally named "other" and ranked in the kept set must
+            # not have its value overwritten by the tail aggregate
+            kept["other"] = kept.get("other", 0) + sum(
+                v for _k, v in ordered[budget - 1 :]
+            )
+            return kept
+
+        current = bounded(current)
+        peak = bounded(peak)
+        keep = [
+            {"component": name, "kind": "current"} for name in current
+        ] + [{"component": name, "kind": "peak"} for name in peak]
+        self.registry.prune_children(_MEMORY_BYTES, keep)
+        for kind, values in (("current", current), ("peak", peak)):
+            for name, value in values.items():
+                # the literal (not _MEMORY_BYTES) is the telemetry-names
+                # checker's registration site; it must match the
+                # constant the prune call above targets
+                self.registry.gauge(
+                    "elasticdl_memory_bytes",
+                    "Component-level memory ledger (host/HBM bytes by "
+                    "registered owner; kind=current is last-writer-"
+                    "wins across beats, kind=peak is the monotone "
+                    "watermark)",
+                    labels={"component": name, "kind": kind},
+                ).set(value)
+
     def build_health_fn(self, job_type: str, instance_manager_fn=lambda: None):
         """The ``/healthz`` payload closure (also used directly by
         tests): generation, live workers, model version, quiesce."""
@@ -411,6 +486,32 @@ class MasterTelemetry:
                 and hasattr(servicer, "last_step_age_secs")
                 else None
             )
+            # memory headroom: the master host's point-in-time RSS and
+            # availability (telemetry/memory.py; None-safe off-Linux),
+            # plus the fleet's tracked byte total when the servicer
+            # carries heartbeat-fed ledger aggregates
+            from elasticdl_tpu.telemetry.memory import (
+                KEY_DEVICE_IN_USE,
+                KEY_HOST_RSS,
+                host_memory_health,
+            )
+
+            memory = host_memory_health()
+            if servicer is not None and hasattr(
+                servicer, "memory_stats_totals"
+            ):
+                totals = servicer.memory_stats_totals()
+                # tracked COMPONENTS only: the wire map also carries the
+                # host_rss/device pseudo-keys, and summing those in
+                # would double-count each worker's entire RSS on top of
+                # the components it contains
+                memory["fleet_tracked_bytes"] = sum(
+                    value
+                    for key, value in (
+                        totals.get("current") or {}
+                    ).items()
+                    if key not in (KEY_HOST_RSS, KEY_DEVICE_IN_USE)
+                )
             return {
                 "status": "quiescing" if quiescing else "ok",
                 "job_type": job_type,
@@ -429,6 +530,7 @@ class MasterTelemetry:
                     and hasattr(servicer, "network_degraded")
                     and servicer.network_degraded()
                 ),
+                "memory": memory,
             }
 
         return health
@@ -569,6 +671,9 @@ class MasterTelemetry:
 
     def reform_start(self, generation, dead, reason, old_world_size):
         self._generation.set(generation)
+        # phase-edge memory sample: a re-formation is where harvested
+        # replica payloads and restore stages spike master RSS
+        self._memory_mod.sample("reform")
         # every re-formation is one trace: the root span opens here, the
         # fence/relaunch child spans bracket the phases in
         # Master._reform_lockstep, and the relaunched workers' world_join
@@ -596,6 +701,7 @@ class MasterTelemetry:
 
     def reform_complete(self, generation, old_world_size, new_world_size):
         self._reforms.inc()
+        self._memory_mod.sample("reform")
         span, self._reform_span = self._reform_span, None
         if span is not None:
             span.end(new_world_size=new_world_size)

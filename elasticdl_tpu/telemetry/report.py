@@ -226,6 +226,12 @@ def analyze_events(events: list[dict], faults: list[dict]) -> dict:
     master_ha = master_ha_section(events)
     if master_ha is not None:
         out["master_ha"] = master_ha
+    serving = serving_section(events)
+    if serving is not None:
+        out["serving"] = serving
+    memory = memory_section(events)
+    if memory is not None:
+        out["memory"] = memory
     return out
 
 
@@ -611,6 +617,188 @@ def replication_section(events: list[dict]) -> dict | None:
     }
 
 
+def serving_section(events: list[dict]) -> dict | None:
+    """Serving-plane aggregate from ``serving_request`` events — the
+    way goodput aggregates ``step_anatomy``: per-phase p50/p95/p99 over
+    completed requests, shed/error counts (the batcher's overload
+    rejections ride the same event stream with ``error`` set), and the
+    ``model_swap`` timeline.  None (key absent) when the run never
+    served, so training-only reports are unchanged."""
+    requests = [e for e in events if e.get("event") == "serving_request"]
+    swaps = sorted(
+        (e for e in events if e.get("event") == "model_swap"),
+        key=lambda e: e.get("monotonic", 0.0),
+    )
+    if not requests and not swaps:
+        return None
+    ok = [e for e in requests if not e.get("error")]
+    failed = [e for e in requests if e.get("error")]
+    sheds = sum(1 for e in failed if e.get("shed"))
+    errors_by_kind: dict[str, int] = defaultdict(int)
+    for event in failed:
+        errors_by_kind[str(event.get("error"))] += 1
+    from elasticdl_tpu.telemetry.anatomy import SERVING_REQUEST_PHASES
+
+    phases = {}
+    for phase in SERVING_REQUEST_PHASES + ("untracked",):
+        values = [
+            float(e[f"{phase}_ms"]) for e in ok if f"{phase}_ms" in e
+        ]
+        if values:
+            phases[phase] = {
+                "total_ms": round(sum(values), 3),
+                "p50_ms": round(percentile(values, 50), 3),
+                "p95_ms": round(percentile(values, 95), 3),
+                "p99_ms": round(percentile(values, 99), 3),
+            }
+    totals = [float(e["total_ms"]) for e in ok if "total_ms" in e]
+    out = {
+        "requests": len(ok),
+        "rows": sum(int(e.get("rows", 0)) for e in ok),
+        "dispatches": sum(int(e.get("dispatches", 0)) for e in ok),
+        "sheds": sheds,
+        "errors": len(failed) - sheds,
+        "errors_by_kind": dict(errors_by_kind),
+        "phases": phases,
+        "swaps": [
+            {
+                "old_version": s.get("old_version"),
+                "model_version": s.get("model_version"),
+                "replica_id": s.get("replica_id"),
+                "source": s.get("source"),
+                "swap_ms": s.get("swap_ms"),
+                "monotonic": s.get("monotonic"),
+            }
+            for s in swaps
+        ],
+    }
+    if totals:
+        out["latency_p50_ms"] = round(percentile(totals, 50), 3)
+        out["latency_p95_ms"] = round(percentile(totals, 95), 3)
+        out["latency_p99_ms"] = round(percentile(totals, 99), 3)
+    return out
+
+
+def memory_section(events: list[dict]) -> dict | None:
+    """Component-level memory ledger aggregate from ``memory_sample``
+    events (telemetry/memory.py): per-component last/current and peak
+    bytes with shares of the tracked total, the host-RSS residual as an
+    explicit ``unaccounted`` line gated against its absolute-bytes
+    budget (allocators lie, so the residual is surfaced, never forced
+    to zero), and the ``memory_pressure`` crossing timeline.  None
+    (key absent) when the run never sampled, so ledger-less reports
+    are unchanged.
+
+    Samples are grouped by EMITTING PROCESS (``worker_id`` /
+    ``process_id``, riding every worker-hooks emit; the master's own
+    ledger forms its own group) and only ordered WITHIN a group —
+    ``monotonic`` restarts per process, so a cross-process sort would
+    interleave incomparable clocks and make "last sample" one
+    arbitrary worker's reading.  Per-process lasts and peaks then SUM
+    across groups: currents are the fleet's newest per-process bytes
+    (the wire's last-writer-wins, re-derived from the log), peaks the
+    sum of per-process watermarks, RSS and the unaccounted residual
+    the sums of per-process values."""
+    by_process: dict[tuple, list[dict]] = {}
+    for event in events:
+        if event.get("event") == "memory_sample":
+            key = (event.get("worker_id"), event.get("process_id"))
+            by_process.setdefault(key, []).append(event)
+    pressures = [
+        e for e in events if e.get("event") == "memory_pressure"
+    ]
+    if not by_process and not pressures:
+        return None
+    components: dict[str, dict] = {}
+    n_samples = 0
+    last_rss = None
+    peak_rss = 0
+    device_peak = 0
+    for group in by_process.values():
+        group.sort(key=lambda e: e.get("monotonic", 0.0))
+        n_samples += len(group)
+        group_current: dict[str, int] = {}
+        group_peak: dict[str, int] = {}
+        group_rss = None
+        group_rss_peak = 0
+        group_device_peak = 0
+        for event in group:
+            comp = event.get("components")
+            if isinstance(comp, dict):
+                group_current = {}
+                for name, value in comp.items():
+                    try:
+                        value = int(value)
+                    except (TypeError, ValueError):
+                        continue
+                    group_current[name] = value  # last sample wins
+                    if value > group_peak.get(name, 0):
+                        group_peak[name] = value
+            rss = event.get("host_rss_bytes")
+            if isinstance(rss, (int, float)):
+                group_rss = int(rss)
+                if rss > group_rss_peak:
+                    group_rss_peak = int(rss)
+            dev = event.get("device_peak_bytes_in_use")
+            if isinstance(dev, (int, float)) and dev > group_device_peak:
+                group_device_peak = int(dev)
+        for name, value in group_current.items():
+            slot = components.setdefault(
+                name, {"current_bytes": 0, "peak_bytes": 0}
+            )
+            slot["current_bytes"] += value
+        for name, value in group_peak.items():
+            slot = components.setdefault(
+                name, {"current_bytes": 0, "peak_bytes": 0}
+            )
+            slot["peak_bytes"] += value
+        if group_rss is not None:
+            last_rss = (last_rss or 0) + group_rss
+            peak_rss += group_rss_peak
+        device_peak += group_device_peak
+    tracked = sum(c["current_bytes"] for c in components.values())
+    for slot in components.values():
+        slot["share_of_tracked"] = (
+            round(slot["current_bytes"] / tracked, 4) if tracked else None
+        )
+    from elasticdl_tpu.telemetry.memory import untracked_budget_bytes
+
+    budget = untracked_budget_bytes()
+    unaccounted = (
+        max(0, last_rss - tracked) if last_rss is not None else None
+    )
+    out = {
+        "samples": n_samples,
+        "components": components,
+        "tracked_bytes": tracked,
+        "host_rss_bytes": last_rss,
+        "host_rss_peak_bytes": peak_rss or None,
+        "unaccounted_bytes": unaccounted,
+        "unaccounted_share_of_rss": round(unaccounted / last_rss, 4)
+        if unaccounted is not None and last_rss
+        else None,
+        "unaccounted_budget_bytes": budget,
+        "unaccounted_over_budget": bool(
+            unaccounted is not None and unaccounted > budget
+        ),
+        "pressure_events": [
+            {
+                "entered": e.get("entered"),
+                "host_available_bytes": e.get("host_available_bytes"),
+                "monotonic": e.get("monotonic"),
+            }
+            for e in pressures
+        ],
+    }
+    if device_peak:
+        out["device_peak_bytes_in_use"] = device_peak
+    if not n_samples:
+        # pressure events without samples (a partial log): still a
+        # valid report, flagged explicitly — the no_data discipline
+        out["no_data"] = "memory_pressure events but no memory samples"
+    return out
+
+
 def control_plane_section(run_dir: str) -> dict | None:
     """Control-plane scale: heartbeat fan-in shape, per-event master
     CPU, sweep/fence latency and scrape cost vs world size — read from
@@ -922,6 +1110,85 @@ def _format_text(report: dict) -> str:
                     f"slice{s}={n}" for s, n in sorted(pushes.items())
                 )
                 lines.append(f"cross-slice replica pushes: {per_slice}")
+        serving = run.get("serving")
+        if serving:
+            lines.append(
+                "serving: {} requests / {} rows in {} dispatches  "
+                "sheds={} errors={}{}".format(
+                    serving["requests"],
+                    serving["rows"],
+                    serving["dispatches"],
+                    serving["sheds"],
+                    serving["errors"],
+                    "  p50={}ms p95={}ms p99={}ms".format(
+                        serving["latency_p50_ms"],
+                        serving["latency_p95_ms"],
+                        serving["latency_p99_ms"],
+                    )
+                    if "latency_p50_ms" in serving
+                    else "",
+                )
+            )
+            for phase, stats in sorted(serving["phases"].items()):
+                lines.append(
+                    "  phase {:<15s} p50={:.3f}ms p95={:.3f}ms "
+                    "p99={:.3f}ms".format(
+                        phase,
+                        stats["p50_ms"],
+                        stats["p95_ms"],
+                        stats["p99_ms"],
+                    )
+                )
+            for swap in serving["swaps"]:
+                lines.append(
+                    "  swap: v{} -> v{} ({}, {:.1f}ms)".format(
+                        swap.get("old_version"),
+                        swap.get("model_version"),
+                        swap.get("source"),
+                        float(swap.get("swap_ms") or 0.0),
+                    )
+                )
+        memory = run.get("memory")
+        if memory:
+            if memory.get("no_data"):
+                lines.append(f"memory: no data: {memory['no_data']}")
+            rss = memory.get("host_rss_bytes")
+            unaccounted = memory.get("unaccounted_bytes")
+            lines.append(
+                "memory: tracked {:.1f} MB over {} components  "
+                "rss {}  unaccounted {}{}".format(
+                    memory["tracked_bytes"] / 1e6,
+                    len(memory["components"]),
+                    f"{rss / 1e6:.1f} MB" if rss is not None else "n/a",
+                    f"{unaccounted / 1e6:.1f} MB"
+                    if unaccounted is not None
+                    else "n/a",
+                    "  [OVER BUDGET]"
+                    if memory.get("unaccounted_over_budget")
+                    else "",
+                )
+            )
+            for name, slot in sorted(memory["components"].items()):
+                lines.append(
+                    "  component {:<16s} current {:>12.0f} B  "
+                    "peak {:>12.0f} B{}".format(
+                        name,
+                        slot["current_bytes"],
+                        slot["peak_bytes"],
+                        "  ({:.1f}% of tracked)".format(
+                            slot["share_of_tracked"] * 100.0
+                        )
+                        if slot.get("share_of_tracked") is not None
+                        else "",
+                    )
+                )
+            for pressure in memory["pressure_events"]:
+                lines.append(
+                    "  pressure {}: MemAvailable {}".format(
+                        "ENTERED" if pressure.get("entered") else "cleared",
+                        pressure.get("host_available_bytes"),
+                    )
+                )
         for worker, rate in run["records_per_sec_by_worker"].items():
             lines.append(f"throughput: worker {worker}: {rate:.1f} records/s")
         if run["worker_time_ms"]:

@@ -107,6 +107,11 @@ class PeriodicCheckpointer:
         )
 
         telemetry_hooks.emit_event(EVENT_CHECKPOINT_SAVE, step=int(version))
+        # phase-edge memory sample: a checkpoint materializes a host
+        # copy of the state — exactly when the footprint spikes
+        from elasticdl_tpu.telemetry import memory as memory_mod
+
+        memory_mod.sample("checkpoint")
         # non-chiefs only write their table parts: don't pay device->host
         # copies for replicated leaves they would discard.  The span
         # covers the SYNCHRONOUS cost the training thread actually pays

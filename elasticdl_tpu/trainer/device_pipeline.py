@@ -230,12 +230,14 @@ class StagedGroup:
         "hook_features",
         "host",
         "error",
+        "nbytes",
         "_placed",
+        "_release",
     )
 
     def __init__(
         self, kind, placed, steps, records, hook_features, host=None,
-        error=None,
+        error=None, nbytes=0, release=None,
     ):
         self.kind = kind
         self.steps = int(steps)
@@ -243,7 +245,11 @@ class StagedGroup:
         self.hook_features = hook_features
         self.host = host
         self.error = error
+        # staged device bytes this group holds until taken (memory
+        # ledger accounting); `release` hands them back to the stager
+        self.nbytes = int(nbytes)
         self._placed = placed
+        self._release = release
 
     def take(self):
         """Transfer ownership of the placed buffers to the caller —
@@ -255,6 +261,9 @@ class StagedGroup:
                 "were donated to the dispatch and no longer exist"
             )
         placed, self._placed = self._placed, None
+        if self._release is not None:
+            release, self._release = self._release, None
+            release(self.nbytes)
         return placed
 
 
@@ -328,6 +337,16 @@ class DeviceStager:
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
         self._done = False
+        # staged-but-untaken device bytes (memory ledger): incremented
+        # when a group lands in the queue, released at take()
+        self._bytes_lock = threading.Lock()
+        self._staged_bytes = 0  # guarded-by: _bytes_lock
+        from elasticdl_tpu.telemetry import memory as memory_mod
+
+        self._ledger_cb = lambda: self._staged_bytes
+        memory_mod.register_component(
+            memory_mod.COMPONENT_DEVICE_STAGER, self._ledger_cb
+        )
         self._thread = threading.Thread(
             target=self._produce, name="device-stage", daemon=True
         )
@@ -368,6 +387,9 @@ class DeviceStager:
                 error=e,
             )
             return self._put((_STAGE_KIND_GROUP, staged))
+        from elasticdl_tpu.telemetry.memory import pytree_bytes
+
+        nbytes = pytree_bytes(placed)
         staged = StagedGroup(
             kind,
             placed,
@@ -375,9 +397,17 @@ class DeviceStager:
             records=records,
             hook_features=hooks,
             host=host,
+            nbytes=nbytes,
+            release=self._release_bytes,
         )
+        with self._bytes_lock:
+            self._staged_bytes += nbytes
         _note_staged(time.monotonic() - t0)
         return self._put((_STAGE_KIND_GROUP, staged))
+
+    def _release_bytes(self, nbytes: int):
+        with self._bytes_lock:
+            self._staged_bytes -= nbytes
 
     def _stage_plain(self, trainer, group) -> bool:
         return self._stage(
@@ -490,6 +520,13 @@ class DeviceStager:
         except queue.Empty:
             pass
         self._thread.join(timeout=5)
+        # drop the ledger callback: a closed stager (and any untaken
+        # staged buffers) must not be pinned by the component registry
+        from elasticdl_tpu.telemetry import memory as memory_mod
+
+        memory_mod.unregister_component(
+            memory_mod.COMPONENT_DEVICE_STAGER, self._ledger_cb
+        )
 
 
 # ---- the pipelined dispatch loop --------------------------------------------

@@ -82,6 +82,14 @@ class TaskPrefetcher:
             target=self._produce, name="task-prefetch", daemon=True
         )
         self._started = False
+        # memory-ledger accounting: the decode-ahead buffer is exactly
+        # the bytes budget this class already tracks (GIL-atomic read)
+        from elasticdl_tpu.telemetry import memory as memory_mod
+
+        self._ledger_cb = lambda: self._buffered_bytes
+        memory_mod.register_component(
+            memory_mod.COMPONENT_TASK_PREFETCHER, self._ledger_cb
+        )
 
     # ---- producer ---------------------------------------------------------
 
@@ -203,3 +211,10 @@ class TaskPrefetcher:
             pass
         if self._started:
             self._thread.join(timeout=5)
+        # drop the ledger callback so a closed prefetcher (and the
+        # batches it pins) is not kept alive by the component registry
+        from elasticdl_tpu.telemetry import memory as memory_mod
+
+        memory_mod.unregister_component(
+            memory_mod.COMPONENT_TASK_PREFETCHER, self._ledger_cb
+        )

@@ -172,6 +172,16 @@ class LocalExecutor:
             getattr(args, "step_anatomy", None),
             model_def=getattr(args, "model_def", "") or "",
         )
+        # memory ledger (telemetry/memory.py): component byte accounting
+        # sampled at task boundaries + phase edges; enabled exactly when
+        # telemetry is (its surfaces all hang off the telemetry dir)
+        from elasticdl_tpu.telemetry import memory as memory_mod
+
+        self._memory_mod = memory_mod
+        memory_mod.install_if_enabled(telemetry_dir)
+        memory_mod.register_trainer_state(
+            lambda: self._trainer.state if self._trainer is not None else None
+        )
         self._last_eval_milestone = 0
         from elasticdl_tpu.utils.profiling import StepProfiler
 
@@ -428,6 +438,9 @@ class LocalExecutor:
                 with self._timing.record("task_process"):
                     total += self._train_task(task, batches)
                 dispatcher.report(tid, True)
+                # task boundaries are the single-process run's periodic
+                # memory cadence (no heartbeat thread to ride)
+                self._memory_mod.sample()
             ok = True
         finally:
             prefetcher.close()
@@ -444,6 +457,7 @@ class LocalExecutor:
         logger.info(
             "Training complete: %d records, %d steps", total, self._version
         )
+        self._memory_mod.sample("job_end")
         from elasticdl_tpu.telemetry.worker_hooks import publish_timing
 
         publish_timing(self._timing)

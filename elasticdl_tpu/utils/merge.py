@@ -1,4 +1,4 @@
-"""Monotone max-merge — THE merge rule for worker-shipped totals.
+"""Heartbeat merge rules: monotone max-merge and timestamped last-writer-wins.
 
 Workers ship process-lifetime monotone counters on the heartbeat (PR-8
 RPC outcome totals, PR-9 step-anatomy phase totals).  Beats can be
@@ -12,14 +12,28 @@ should have.  This module is the single definition site; the unit test
 pins the monotonicity and malformed-input tolerance both call sites
 rely on.
 
-Both functions optionally maintain a fleet-wide AGGREGATE alongside the
-per-worker maxima: pass ``totals`` and every rise of a per-worker
+The max rule assumes the shipped value only goes UP.  Memory gauges
+(telemetry/memory.py) break that assumption: a model swap releases its
+old leaves, a drained queue empties, RSS shrinks — so a max-merged
+"current bytes" would be a ratchet that can only report the high-water
+mark, never the release.  :func:`last_merge_counters` is the
+non-monotone counterpart: every sample carries the SENDER's timestamp,
+and the newest-stamped sample wins per key.  Reordering, duplication
+and batch-then-replay all converge to the same merged state because
+"newest stamp" is order-independent (ties break toward the larger
+value, so even same-stamp duplicates are deterministic).  Peak
+watermarks stay on :func:`max_merge_counters` — a peak IS monotone.
+
+Both max functions optionally maintain a fleet-wide AGGREGATE alongside
+the per-worker maxima: pass ``totals`` and every rise of a per-worker
 counter adds its delta there.  That is what lets the servicer answer
 "sum of per-worker maxima across the fleet" in O(keys) at scrape time
 instead of an O(world_size) walk under its lock — the 1000-worker
 scrape path.  The aggregate is exactly ``sum over workers of max over
 beats``; the order or batching of beats cannot change it (pinned by
-tests/test_fleetsim.py).
+tests/test_fleetsim.py).  ``last_merge_counters`` maintains the same
+aggregate shape with signed deltas (values go down too), so the
+fleet-wide sum tracks the newest-stamped per-worker values exactly.
 """
 
 from __future__ import annotations
@@ -53,6 +67,91 @@ def max_merge_counters(
                 totals[key] = totals.get(key, 0) + (value - old)
             merged[key] = value
     return rose
+
+
+# reserved key in a last-merge ``stamps`` dict holding the newest
+# COMPLETE-snapshot stamp for that worker (no component may be named
+# this; component names are snake_case identifiers)
+SNAPSHOT_STAMP_KEY = "\x00snapshot"
+
+
+def last_merge_counters(
+    merged: dict[str, int],
+    update: dict,
+    at: float,
+    stamps: dict[str, float],
+    totals: dict[str, int] | None = None,
+    complete: bool = False,
+) -> bool:
+    """Timestamped last-writer-wins merge for NON-MONOTONE gauges.
+
+    ``merged[key]`` becomes the value of the newest-stamped sample seen
+    for that key; ``stamps[key]`` records that stamp (the caller keeps
+    both dicts together, per worker).  A sample older than the stored
+    stamp is dropped — a reordered or duplicated beat can never roll a
+    gauge back to a stale reading — and equal stamps break toward the
+    larger value so any delivery order converges to the same state.
+    Non-numeric values are skipped (wire payloads are untrusted).
+
+    ``complete=True`` declares ``update`` a WHOLE snapshot, not a
+    per-key patch: a key the snapshot no longer carries was released at
+    the source (its owner unregistered — a drained queue, a closed
+    stager), so the newest snapshot's key SET wins too.  The newest
+    complete stamp seen is kept in ``stamps`` under
+    :data:`SNAPSHOT_STAMP_KEY`: a snapshot older than that floor is
+    dropped WHOLESALE (its keys are known-superseded — without the
+    floor, a reordered stale beat could re-add a key a newer snapshot
+    deleted), a newer one applies its keys then deletes older-stamped
+    keys it no longer carries, and an equal-stamped duplicate keeps the
+    per-key larger-value tie rule (absence keeps the key), so any
+    delivery order converges to one state.  The heartbeat's memory
+    field is a complete snapshot; without deletion, the last nonzero
+    reading of a retired component would ratchet in the fleet gauge
+    forever — exactly the failure last-writer-wins exists to prevent.
+
+    ``totals``, when given, is adjusted by each applied change's SIGNED
+    delta: the aggregate is exactly "sum over workers of the
+    newest-stamped value", and unlike the max rule it goes down when
+    memory is released.  Returns True when anything changed.
+    """
+    floor = stamps.get(SNAPSHOT_STAMP_KEY)
+    if complete:
+        if floor is not None and at < floor:
+            return False  # superseded snapshot: every key is stale
+        stamps[SNAPSHOT_STAMP_KEY] = at
+    changed = False
+    for key, value in update.items():
+        try:
+            value = int(value)
+        except (TypeError, ValueError):
+            continue
+        stamp = stamps.get(key)
+        if stamp is not None and (
+            at < stamp or (at == stamp and value <= merged.get(key, 0))
+        ):
+            continue
+        old = merged.get(key, 0)
+        if totals is not None:
+            totals[key] = totals.get(key, 0) + (value - old)
+        merged[key] = value
+        stamps[key] = at
+        changed = changed or value != old or stamp is None
+    if complete and (floor is None or at > floor):
+        for key in [
+            k
+            for k, stamp in stamps.items()
+            if k != SNAPSHOT_STAMP_KEY and stamp < at and k not in update
+        ]:
+            old = merged.pop(key, 0)
+            del stamps[key]
+            if totals is not None and old:
+                remaining = totals.get(key, 0) - old
+                if remaining:
+                    totals[key] = remaining
+                else:
+                    totals.pop(key, None)
+            changed = True
+    return changed
 
 
 def max_merge_phase_stats(

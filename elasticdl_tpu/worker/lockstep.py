@@ -194,6 +194,15 @@ class LockstepWorker:
         anatomy_mod.install_from_env(
             model_def=getattr(args, "model_def", "") or ""
         )
+        # memory ledger (telemetry/memory.py): sampled on the heartbeat
+        # cadence, shipped as HeartbeatRequest.memory; no-op without the
+        # master-exported telemetry dir
+        from elasticdl_tpu.telemetry import memory as memory_mod
+
+        memory_mod.install_from_env()
+        memory_mod.register_trainer_state(
+            lambda: self._trainer.state if self._trainer is not None else None
+        )
         # process-wide compile counter; the chief ships deltas to the
         # master as a `compile_count` exec counter with task reports
         from elasticdl_tpu.telemetry import compile_tracker
@@ -222,6 +231,7 @@ class LockstepWorker:
         # single process has no surviving peer to restore from
         self._replicator = None
         self._replica_server = None
+        self._replica_store = None
         # replication ON (the flag, not the ring): even a single-process
         # world — e.g. one shrunk to a lone surviving slice — must still
         # ASK the master for a staged replica harvest at restore time
@@ -237,6 +247,7 @@ class LockstepWorker:
             from elasticdl_tpu.replication.store import ReplicaStore
 
             store = ReplicaStore(generation=self._cluster_version)
+            self._replica_store = store
             self._replica_server, replica_port = start_replica_server(store)
             self._replicator = PeerReplicator(
                 store,
@@ -671,12 +682,17 @@ class LockstepWorker:
         import threading
 
         from elasticdl_tpu.rpc import stats as rpc_stats
+        from elasticdl_tpu.telemetry import memory as memory_mod
         from elasticdl_tpu.telemetry.anatomy import (
             heartbeat_snapshot as anatomy_snapshot,
         )
+        from elasticdl_tpu.telemetry.worker_hooks import TELEMETRY_DIR_ENV
         from elasticdl_tpu.trainer.device_pipeline import (
             heartbeat_snapshot as prefetch_snapshot,
         )
+        from elasticdl_tpu.utils.profiling import apply_profile_command
+
+        telemetry_dir = os.environ.get(TELEMETRY_DIR_ENV, "")
 
         def beat():
             while not self._stopped:
@@ -689,6 +705,9 @@ class LockstepWorker:
                     time.sleep(interval_secs)
                     continue
                 t0 = time.monotonic()
+                # the beat IS the periodic memory sample cadence (no-op
+                # without an installed ledger)
+                memory_mod.sample()
                 try:
                     # the heartbeat doubles as the replica directory's
                     # advertisement channel (up: addr + holdings; down:
@@ -711,6 +730,9 @@ class LockstepWorker:
                             # device-prefetch staging totals ({} when
                             # off), mirrored the same way
                             prefetch=prefetch_snapshot(),
+                            # memory-ledger snapshot ({} when off):
+                            # non-monotone, merged last-writer-wins
+                            memory=memory_mod.heartbeat_snapshot(),
                         )
                     )
                     if self._replicator is not None and resp is not None:
@@ -719,6 +741,16 @@ class LockstepWorker:
                         self._note_master_boot(
                             getattr(resp, "boot_id", "")
                         )
+                        profile_cmd = getattr(resp, "profile", None)
+                        if profile_cmd:
+                            # on-demand capture window (request_profile):
+                            # replayed window ids are absorbed in arm()
+                            apply_profile_command(
+                                self._profiler,
+                                profile_cmd,
+                                telemetry_dir=telemetry_dir,
+                                tag=f"p{self._process_id}",
+                            )
                 except Exception:  # noqa: BLE001 — master may be gone
                     pass
                 tracer = self._tracing.get_tracer()
@@ -864,6 +896,12 @@ class LockstepWorker:
                 if ok:
                     if self._replica_server is not None:
                         self._replica_server.stop(grace=0)
+                    if self._replica_store is not None:
+                        # clean exit: release the retained shard
+                        # payloads from the ledger registry (the crash
+                        # path keeps them — the linger exists so the
+                        # master can still harvest this RAM)
+                        self._replica_store.close()
                 elif self._replica_server is not None or self._ha_mode():
                     # a lockstep crash means the world is about to
                     # re-form — LINGER rather than exit.  With

@@ -104,6 +104,15 @@ class Worker:
         anatomy_mod.install_from_env(
             model_def=getattr(args, "model_def", "") or ""
         )
+        # memory ledger (telemetry/memory.py): sampled on the heartbeat
+        # cadence, shipped as HeartbeatRequest.memory; no-op without the
+        # master-exported telemetry dir
+        from elasticdl_tpu.telemetry import memory as memory_mod
+
+        memory_mod.install_from_env()
+        memory_mod.register_trainer_state(
+            lambda: self._trainer.state if self._trainer is not None else None
+        )
         self._task_traces: dict[int, dict] = {}
         # the lease ledger the re-home handshake presents: every lease
         # this worker holds an unreported task for.  Tracked
@@ -975,19 +984,28 @@ class Worker:
         """Background liveness pings so the master's failure detector works
         across long compute gaps (the TPU-build replacement for the k8s
         watch stream; every get_task also counts implicitly)."""
+        import os
         import threading
 
         from elasticdl_tpu.rpc import stats as rpc_stats
+        from elasticdl_tpu.telemetry import memory as memory_mod
         from elasticdl_tpu.telemetry.anatomy import (
             heartbeat_snapshot as anatomy_snapshot,
         )
+        from elasticdl_tpu.telemetry.worker_hooks import TELEMETRY_DIR_ENV
         from elasticdl_tpu.trainer.device_pipeline import (
             heartbeat_snapshot as prefetch_snapshot,
         )
+        from elasticdl_tpu.utils.profiling import apply_profile_command
+
+        telemetry_dir = os.environ.get(TELEMETRY_DIR_ENV, "")
 
         def beat():
             while not self._stopped:
                 t0 = time.monotonic()
+                # the beat IS the periodic memory sample cadence (no-op
+                # without an installed ledger)
+                memory_mod.sample()
                 try:
                     resp = self._master.heartbeat(
                         msg.HeartbeatRequest(
@@ -1003,6 +1021,9 @@ class Worker:
                             # device-prefetch staging totals ({} when
                             # off), mirrored the same way
                             prefetch=prefetch_snapshot(),
+                            # memory-ledger snapshot ({} when off):
+                            # non-monotone, merged last-writer-wins
+                            memory=memory_mod.heartbeat_snapshot(),
                         )
                     )
                     if resp is not None:
@@ -1015,6 +1036,16 @@ class Worker:
                         ):
                             self._master_cluster_version = int(
                                 getattr(resp, "cluster_version", 0)
+                            )
+                        profile_cmd = getattr(resp, "profile", None)
+                        if profile_cmd:
+                            # on-demand capture window (request_profile):
+                            # replayed window ids are absorbed in arm()
+                            apply_profile_command(
+                                self._profiler,
+                                profile_cmd,
+                                telemetry_dir=telemetry_dir,
+                                tag=f"w{self._worker_id}",
                             )
                 except Exception:  # noqa: BLE001 — master may be gone
                     pass

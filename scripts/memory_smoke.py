@@ -1,0 +1,436 @@
+#!/usr/bin/env python3
+"""Tier-1 memory-observability smoke (wired into scripts/run_tier1.sh).
+
+Four gates over the memory ledger (telemetry/memory.py), end to end on
+real runs:
+
+1. **Training ledger** — a tiny LocalExecutor mnist job with telemetry
+   must produce a ``memory`` section in ``telemetry.report``: the
+   ``model_state`` component carries real bytes (> 0), every
+   component's peak >= its current, and the host-RSS residual is under
+   the documented absolute-bytes budget (the explicit ``unaccounted``
+   line — allocators lie, so the ledger surfaces the residual instead
+   of pretending sum-exactness).
+2. **Serving hot swap under traffic** — an in-process replica serving
+   the trained export is hammered by concurrent predict threads while
+   the model hot-swaps: zero failed requests, and the ledger's
+   ``serving_model`` PEAK shows the transient double residency (old +
+   new leaves resident at once) that then releases (current settles
+   back under the peak).
+3. **/metrics** — heartbeat-shipped ledger snapshots render as
+   ``elasticdl_memory_bytes{component=,kind=current|peak}`` gauges, a
+   newer-stamped LOWER sample lowers the current series (last-writer-
+   wins, not a ratchet) while the peak holds, and the family stays
+   under the fleetsim cardinality cap even when a payload floods
+   component names.
+4. **On-demand profiler round trip** — ``request_profile`` on the real
+   servicer rides a heartbeat response down, arms the worker-side
+   ``StepProfiler`` through the same ``apply_profile_command`` path the
+   workers run, and a short jitted loop produces a LOADABLE capture
+   (trace artifacts on disk) plus ``profile_window_open``/
+   ``profile_window_close`` events; a replayed command is absorbed.
+
+The disabled-path cost (one global load + None check per sample site)
+is machine-checked by elastic-lint's hot-path gate, which runs first in
+run_tier1.sh.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+import tempfile
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "")
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# the double-residency gate: the swap peak must cover most of two
+# resident copies (1.8x leaves slack for accounting noise)
+DOUBLE_RESIDENCY_FACTOR = 1.8
+# cardinality budget used for the /metrics gate
+SERIES_BUDGET = 8
+
+
+def _fail(message: str) -> int:
+    print(f"memory_smoke: {message}", file=sys.stderr)
+    return 1
+
+
+def _train_window(workdir: str) -> tuple[dict, str] | int:
+    """Gate 1: instrumented LocalExecutor run -> report memory section."""
+    from elasticdl_tpu.data.recordio_gen import synthetic
+    from elasticdl_tpu.telemetry import memory as memory_mod
+    from elasticdl_tpu.telemetry import tracing, worker_hooks
+    from elasticdl_tpu.telemetry.events import read_events
+    from elasticdl_tpu.telemetry.report import memory_section
+    from elasticdl_tpu.trainer.local_executor import LocalExecutor
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    train = synthetic.gen_mnist(
+        os.path.join(workdir, "train"),
+        num_records=512,
+        num_shards=1,
+        seed=11,
+    )
+    telemetry_dir = os.path.join(workdir, "telemetry")
+    export_dir = os.path.join(workdir, "export")
+    args = parse_master_args(
+        [
+            "--model_def",
+            "mnist_functional_api.mnist_functional_api.custom_model",
+            "--training_data",
+            train,
+            "--minibatch_size",
+            "64",
+            "--records_per_task",
+            "128",
+            "--num_epochs",
+            "1",
+            "--telemetry_dir",
+            telemetry_dir,
+            "--output",
+            export_dir,
+        ]
+    )
+    try:
+        LocalExecutor(args).run()
+    finally:
+        worker_hooks.uninstall()
+        tracing.uninstall()
+        memory_mod.uninstall()
+
+    events = read_events(os.path.join(telemetry_dir, "events.jsonl"))
+    section = memory_section(events)
+    if not section:
+        return _fail("telemetry.report emitted no memory section")
+    components = section["components"]
+    model = components.get("model_state")
+    if not model or model["current_bytes"] <= 0:
+        return _fail(
+            f"model_state bytes not measured: {model!r} "
+            f"(components: {sorted(components)})"
+        )
+    for name, slot in components.items():
+        if slot["peak_bytes"] < slot["current_bytes"]:
+            return _fail(
+                f"component {name}: peak {slot['peak_bytes']} < "
+                f"current {slot['current_bytes']}"
+            )
+    if section.get("host_rss_bytes") is None:
+        return _fail("host RSS not read (/proc/self/status)")
+    if section.get("unaccounted_over_budget"):
+        return _fail(
+            "unaccounted bytes over budget: "
+            f"{section['unaccounted_bytes']} > "
+            f"{section['unaccounted_budget_bytes']}"
+        )
+    share = section.get("unaccounted_share_of_rss")
+    if share is None or not (0.0 <= share <= 1.0):
+        return _fail(f"unaccounted share not computed: {share!r}")
+    return section, export_dir
+
+
+def _serving_window(workdir: str, export_dir: str) -> int | dict:
+    """Gate 2: hot swap under a request hammer — double-residency peak
+    observed, then released; zero failed requests."""
+    import numpy as np
+
+    from elasticdl_tpu.rpc import messages as msg
+    from elasticdl_tpu.serving.replica import ServingReplica
+    from elasticdl_tpu.telemetry import memory as memory_mod
+    from elasticdl_tpu.telemetry import worker_hooks
+    from elasticdl_tpu.telemetry.events import read_events
+    from elasticdl_tpu.telemetry.report import serving_section
+    from elasticdl_tpu.utils.export_utils import read_manifest
+
+    telemetry_dir = os.path.join(workdir, "serving_telemetry")
+    worker_hooks.install(telemetry_dir)
+    # install AFTER worker_hooks so ledger samples emit memory_sample
+    # events into this window's event log
+    ledger = memory_mod.install_if_enabled(telemetry_dir)
+    replica = ServingReplica(export_dir, canonical_rows=64)
+    replica.start()
+    try:
+        rng = np.random.RandomState(5)
+
+        def one_request(i: int):
+            # the mnist zoo's wire schema: uint8 images under "image"
+            feats = {
+                "image": rng.randint(
+                    0, 255, size=(1 + (i % 7), 28, 28), dtype=np.uint8
+                )
+            }
+            return replica.servicer.predict(
+                msg.PredictRequest(
+                    request_id=f"r{i}",
+                    features=msg.pack_array_tree(feats),
+                    rows=feats["image"].shape[0],
+                )
+            )
+
+        warm = one_request(0)
+        if warm.error:
+            return _fail(f"warmup request failed: {warm.error}")
+        built = ledger.snapshot()["current"].get("serving_model", 0)
+        if built <= 0:
+            return _fail("serving_model bytes not measured after build")
+
+        failures: list[str] = []
+        stop = threading.Event()
+
+        def hammer(tid: int):
+            i = 0
+            while not stop.is_set():
+                response = one_request(tid * 10_000 + i)
+                if response.error:
+                    failures.append(response.error)
+                i += 1
+
+        threads = [
+            threading.Thread(target=hammer, args=(t,), daemon=True)
+            for t in range(4)
+        ]
+        for thread in threads:
+            thread.start()
+        # hot swap mid-traffic: same flats re-keyed to a newer version
+        manifest = read_manifest(export_dir)
+        flat_params = {}
+        with np.load(os.path.join(export_dir, "params.npz")) as z:
+            flat_params = {k: z[k] for k in z.files}
+        accepted, version, reason = replica.engine.swap_state_dicts(
+            flat_params, {}, version=int(manifest["model_version"]) + 1
+        )
+        if not accepted:
+            return _fail(f"hot swap refused: {reason}")
+        stop.set()
+        for thread in threads:
+            thread.join(timeout=10)
+        if failures:
+            return _fail(
+                f"{len(failures)} requests failed under swap "
+                f"(first: {failures[0]})"
+            )
+        snap = ledger.snapshot()
+        peak = snap["peak"].get("serving_model", 0)
+        current = snap["current"].get("serving_model", 0)
+        if peak < int(DOUBLE_RESIDENCY_FACTOR * built):
+            return _fail(
+                f"swap double residency not observed: peak {peak} < "
+                f"{DOUBLE_RESIDENCY_FACTOR} x built {built}"
+            )
+        if current >= peak:
+            return _fail(
+                f"swap residency never released: current {current} >= "
+                f"peak {peak}"
+            )
+        events = read_events(os.path.join(telemetry_dir, "events.jsonl"))
+        swaps = [e for e in events if e.get("event") == "memory_sample"
+                 and e.get("phase") == "model_swap"]
+        if not swaps:
+            return _fail("no model_swap phase-edge memory samples")
+        section = serving_section(events)
+        if not section or section["requests"] <= 0:
+            return _fail("report serving section missing/empty")
+        if not section["swaps"]:
+            return _fail("report serving section lost the swap timeline")
+        return {
+            "built": built,
+            "peak": peak,
+            "current": current,
+            "requests": section["requests"],
+        }
+    finally:
+        replica.close()
+        worker_hooks.uninstall()
+        memory_mod.uninstall()
+
+
+def _metrics_window() -> int | dict:
+    """Gate 3: heartbeat -> /metrics mirror, release visible, series
+    capped."""
+    os.environ["ELASTICDL_TPU_WORKER_SERIES_MAX"] = str(SERIES_BUDGET)
+    try:
+        from elasticdl_tpu.master.servicer import MasterServicer
+        from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+        from elasticdl_tpu.rpc import messages as msg
+        from elasticdl_tpu.telemetry.master_hooks import MasterTelemetry
+
+        dispatcher = TaskDispatcher(
+            {"s": (0, 64)}, records_per_task=64, num_epochs=1
+        )
+        servicer = MasterServicer(64, dispatcher)
+        telemetry = MasterTelemetry()
+        telemetry.attach(dispatcher, servicer)
+
+        def beat(at, current, peak):
+            servicer.heartbeat(
+                msg.HeartbeatRequest(
+                    worker_id=1,
+                    memory={"at": at, "current": current, "peak": peak},
+                )
+            )
+
+        beat(1.0, {"model_state": 1000}, {"model_state": 1000})
+        text = telemetry.registry.exposition()
+        needle = (
+            'elasticdl_memory_bytes{component="model_state",'
+            'kind="current"} 1000'
+        )
+        if needle not in text:
+            return _fail(f"/metrics missing {needle!r}")
+        # a newer, LOWER sample must lower current and hold the peak
+        beat(2.0, {"model_state": 250}, {"model_state": 1000})
+        text = telemetry.registry.exposition()
+        if (
+            'component="model_state",kind="current"} 250' not in text
+            or 'component="model_state",kind="peak"} 1000' not in text
+        ):
+            return _fail("release not visible on /metrics (or peak lost)")
+        # cardinality: a flood of component names collapses into the cap
+        flood = {f"c{i:03d}": i + 1 for i in range(64)}
+        beat(3.0, flood, flood)
+        text = telemetry.registry.exposition()
+        series = [
+            line
+            for line in text.splitlines()
+            if line.startswith("elasticdl_memory_bytes{")
+        ]
+        if len(series) > 2 * SERIES_BUDGET:
+            return _fail(
+                f"memory series cardinality {len(series)} exceeds "
+                f"2 x budget {SERIES_BUDGET}"
+            )
+        if 'component="other"' not in text:
+            return _fail("flooded components did not collapse to 'other'")
+        return {"series": len(series), "servicer": servicer,
+                "telemetry": telemetry}
+    finally:
+        os.environ.pop("ELASTICDL_TPU_WORKER_SERIES_MAX", None)
+
+
+def _profile_window(workdir: str, servicer) -> int | dict:
+    """Gate 4: request_profile -> heartbeat -> arm -> loadable capture +
+    window events, replays absorbed."""
+    import jax
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.rpc import messages as msg
+    from elasticdl_tpu.telemetry import worker_hooks
+    from elasticdl_tpu.telemetry.events import read_events
+    from elasticdl_tpu.utils.profiling import (
+        StepProfiler,
+        apply_profile_command,
+    )
+
+    telemetry_dir = os.path.join(workdir, "profile_telemetry")
+    worker_hooks.install(telemetry_dir)
+    try:
+        first = servicer.request_profile(
+            msg.RequestProfileRequest(num_steps=3)
+        )
+        if not first.accepted or first.window_id <= 0:
+            return _fail(f"request_profile refused: {first!r}")
+        duplicate = servicer.request_profile(
+            msg.RequestProfileRequest(num_steps=3)
+        )
+        if duplicate.window_id != first.window_id:
+            return _fail(
+                "duplicate request_profile opened a second window "
+                f"({first.window_id} -> {duplicate.window_id})"
+            )
+        response = servicer.heartbeat(msg.HeartbeatRequest(worker_id=0))
+        if not response.profile:
+            return _fail("heartbeat response did not carry the command")
+        profiler = StepProfiler("")
+        if not apply_profile_command(
+            profiler, response.profile, telemetry_dir=telemetry_dir,
+            tag="w0",
+        ):
+            return _fail("apply_profile_command did not arm")
+        # the replayed command on the NEXT beat is absorbed
+        replay = servicer.heartbeat(msg.HeartbeatRequest(worker_id=0))
+        if apply_profile_command(
+            profiler, replay.profile, telemetry_dir=telemetry_dir, tag="w0"
+        ):
+            return _fail("replayed profile command re-armed the window")
+
+        step = jax.jit(lambda x: (x @ x.T).sum())
+        value = jnp.ones((64, 64))
+        for _ in range(6):
+            profiler.on_step()
+            step(value).block_until_ready()
+        profiler.stop()
+
+        events = read_events(os.path.join(telemetry_dir, "events.jsonl"))
+        names = [e.get("event") for e in events]
+        if "profile_window_open" not in names:
+            return _fail("no profile_window_open event")
+        if "profile_window_close" not in names:
+            return _fail("no profile_window_close event")
+        closed = next(
+            e for e in events if e.get("event") == "profile_window_close"
+        )
+        if closed.get("window_id") != first.window_id:
+            return _fail(
+                f"close event window_id {closed.get('window_id')} != "
+                f"{first.window_id}"
+            )
+        capture_root = os.path.join(
+            telemetry_dir, "profile", f"window_{first.window_id}_w0"
+        )
+        artifacts = glob.glob(
+            os.path.join(capture_root, "**", "*"), recursive=True
+        )
+        artifacts = [p for p in artifacts if os.path.isfile(p)]
+        if not artifacts:
+            return _fail(f"no capture artifacts under {capture_root}")
+        return {"window_id": first.window_id, "artifacts": len(artifacts)}
+    finally:
+        worker_hooks.uninstall()
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory() as workdir:
+        trained = _train_window(workdir)
+        if isinstance(trained, int):
+            return trained
+        section, export_dir = trained
+        served = _serving_window(workdir, export_dir)
+        if isinstance(served, int):
+            return served
+        metrics = _metrics_window()
+        if isinstance(metrics, int):
+            return metrics
+        profiled = _profile_window(workdir, metrics["servicer"])
+        if isinstance(profiled, int):
+            return profiled
+
+    model_mb = section["components"]["model_state"]["current_bytes"] / 1e6
+    print(
+        "memory_smoke: OK (model_state {:.2f} MB over {} components, "
+        "unaccounted {:.0f} MB under budget | swap: built {:.2f} MB "
+        "peak {:.2f} MB released to {:.2f} MB over {} requests | "
+        "/metrics {} series | profile window {} with {} artifacts)".format(
+            model_mb,
+            len(section["components"]),
+            (section["unaccounted_bytes"] or 0) / 1e6,
+            served["built"] / 1e6,
+            served["peak"] / 1e6,
+            served["current"] / 1e6,
+            served["requests"],
+            metrics["series"],
+            profiled["window_id"],
+            profiled["artifacts"],
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

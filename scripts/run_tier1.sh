@@ -37,6 +37,15 @@
 # per heartbeat, sweep/fence latency, journal bytes/event, /metrics
 # scrape + series cardinality], the event log must be seed-deterministic,
 # and seeded corruptions must exit 1)
+# + memory smoke (component-level byte ledger end to end: a real
+# LocalExecutor run must report per-component bytes with peak >=
+# current and the unaccounted-vs-RSS residual under budget, a serving
+# hot swap under concurrent traffic must show the transient
+# double-residency peak then release it, heartbeat-shipped snapshots
+# must render as elasticdl_memory_bytes gauges with releases visible
+# [last-writer-wins, not a ratchet] under the series cardinality cap,
+# and an on-demand request_profile round trip must produce a loadable
+# capture + profile_window_* events with replays absorbed)
 # + the ROADMAP.md test command, verbatim.
 # Run from the repo root: scripts/run_tier1.sh
 cd "$(dirname "$0")/.." || exit 2
@@ -61,4 +70,5 @@ timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/master_ha_smoke.py || exi
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/multislice_smoke.py || exit 1
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/serving_smoke.py || exit 1
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/fleetsim_smoke.py || exit 1
+timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/memory_smoke.py || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
