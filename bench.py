@@ -171,7 +171,7 @@ def _configs(n_chips: int = 1):
     # sequences per step: a multiple of the dp size (plain device_put has
     # no padding fallback), at least 8 per chip
     seq_batch = 8 * n_chips
-    return {
+    cfgs = {
         "mnist": dict(
             model_def="mnist_functional_api.mnist_functional_api.custom_model",
             features={"image": rng.rand(256, 28, 28).astype(np.float32)},
@@ -194,6 +194,27 @@ def _configs(n_chips: int = 1):
             model_def="deepfm_edl_embedding.deepfm_edl_embedding.custom_model",
             features={
                 "feature": rng.randint(0, 5383, (4096, 10)).astype(np.int64)
+            },
+            labels=rng.randint(0, 2, 4096).astype(np.int32),
+            batch=4096,
+        ),
+        # the sharded-embedding TPU shape (docs/designs/
+        # sharded_embeddings.md): a 100M-row x 64-dim table (25.6 GB
+        # f32 — larger than any single HBM) row-sharded P(dp, None)
+        # over the pod by the model's declared sharding_rules, batch
+        # ids spanning the full vocab so every step exercises the
+        # gather -> all-to-all; plain SGD (slot-free) keeps optimizer
+        # state off the table
+        "deepfm_100m": dict(
+            model_def=(
+                "deepfm_sharded_embedding"
+                ".deepfm_sharded_embedding.custom_model"
+            ),
+            model_params=dict(input_dim=100_000_000),
+            features={
+                "feature": rng.randint(
+                    0, 100_000_000, (4096, 10)
+                ).astype(np.int64)
             },
             labels=rng.randint(0, 2, 4096).astype(np.int32),
             batch=4096,
@@ -264,6 +285,14 @@ def _configs(n_chips: int = 1):
             ),
         ),
     }
+    # the 100M-row shape needs ~3.2 GB of table per chip at 8 chips
+    # (plus transient gradient residency); on smaller pods the shard
+    # cannot fit next to the other configs' programs, so the config is
+    # declared only where it can run rather than recorded as a
+    # guaranteed error
+    if n_chips < 8:
+        cfgs.pop("deepfm_100m")
+    return cfgs
 
 
 # loop-body-counted-once cross-check, done once PER CONFIG: compile the

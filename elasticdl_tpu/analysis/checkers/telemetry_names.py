@@ -66,6 +66,11 @@ REQUIRED_EVENT_NAMES = frozenset(
         "memory_pressure",
         "profile_window_open",
         "profile_window_close",
+        # sharded embedding subsystem (elasticdl_tpu/embeddings): the
+        # host-tier pull into the device minitable and the admission
+        # fault when neither tier has headroom
+        "embedding_gather",
+        "embedding_spill_fault",
     }
 )
 REQUIRED_SPAN_NAMES = frozenset(
@@ -124,6 +129,9 @@ REQUIRED_METRIC_NAMES = frozenset(
         # memory observability plane: the component-level byte ledger
         # (component= / kind=current|peak gauge family)
         "elasticdl_memory_bytes",
+        # sharded embedding subsystem: per-table resident bytes by tier
+        # (table= / tier=device|spill)
+        "elasticdl_embedding_bytes",
     }
 )
 

@@ -70,6 +70,11 @@ _REPLICA_RECOVERABLE_KINDS = frozenset(
 # duplicated report counts TWICE — exactly_once and
 # duplicate_delivery_exactly_once must both trip (requires a plan with
 # net_duplicate faults, e.g. dup_report_storm).
+# ``drop_shard_parts`` strips the sharded table rows from every replica
+# push blob (worker-side, via env) while the push event still reports
+# the state HAS sharded rows — the shape of "a shard's only replica
+# died" — so the sharded extension of cross_slice_replica_coverage must
+# trip (requires replication and a model with row-sharded tables).
 CORRUPTIONS = (
     "",
     "double_report",
@@ -78,13 +83,24 @@ CORRUPTIONS = (
     "journal_rollback",
     "same_slice_ring",
     "drop_dedup",
+    "drop_shard_parts",
 )
+
+# model-zoo presets the harness can run: model_def + the synthetic
+# dataset generator that feeds it (the chaos jobs are real model-zoo
+# jobs, and the sharded-embedding smoke needs the recommender model,
+# not mnist)
+DATASETS = ("mnist", "frappe")
 
 
 @dataclass
 class ChaosJobConfig:
     plan: FaultPlan
     workdir: str
+    # which model-zoo job the faults hit: any model_def the master can
+    # resolve, paired with the synthetic dataset that feeds it
+    model_def: str = "mnist_functional_api.mnist_functional_api.custom_model"
+    dataset: str = "mnist"  # one of DATASETS
     num_records: int = 512
     num_epochs: int = 2
     num_workers: int = 2
@@ -142,10 +158,14 @@ def _master_args(config: ChaosJobConfig, train_dir: str, ckpt_dir: str):
         from elasticdl_tpu.replication.replicator import SAME_SLICE_RING_ENV
 
         envs.append(f"{SAME_SLICE_RING_ENV}=1")
+    if config.corrupt == "drop_shard_parts":
+        from elasticdl_tpu.replication.replicator import DROP_SHARD_PARTS_ENV
+
+        envs.append(f"{DROP_SHARD_PARTS_ENV}=1")
     return parse_master_args(
         [
             "--model_def",
-            "mnist_functional_api.mnist_functional_api.custom_model",
+            config.model_def,
             "--training_data",
             train_dir,
             "--minibatch_size",
@@ -622,17 +642,17 @@ def _check_no_lost_steps(
     if not recoverable:
         return None
     kill_at = min(e["monotonic"] for e in recoverable)
-    pushed = [
-        int(e.get("step", -1))
+    push_events = [
+        e
         for e in events
         if e.get("event") == "replica_push"
         and e.get("monotonic", 0.0) <= kill_at
     ]
-    restored = [
-        int(e.get("step", -1))
-        for e in events
-        if e.get("event") == "replica_restore"
+    restore_events = [
+        e for e in events if e.get("event") == "replica_restore"
     ]
+    pushed = [int(e.get("step", -1)) for e in push_events]
+    restored = [int(e.get("step", -1)) for e in restore_events]
     violations = []
     if not pushed:
         violations.append("no replica_push before the kill")
@@ -647,6 +667,31 @@ def _check_no_lost_steps(
             "was replicated before the kill — steps lost despite a "
             "complete replica set"
         )
+    # sharded-table extension: when the replicated state carries
+    # row-sharded tables, "no lost steps" includes the ROWS — the
+    # pushes before the kill must have carried them and the restore
+    # must have applied them (a restore event alone proves only the
+    # dense leaves came back)
+    sharded_state = any(e.get("has_sharded") for e in push_events)
+    if sharded_state:
+        rows_pushed = sum(
+            int(e.get("sharded_rows", 0) or 0) for e in push_events
+        )
+        rows_restored = sum(
+            int(e.get("sharded_rows", 0) or 0) for e in restore_events
+        )
+        if not rows_pushed:
+            violations.append(
+                "pushes report row-sharded state but carried zero "
+                "sharded table rows before the kill — the tables had "
+                "no replica to survive it"
+            )
+        if restored and not rows_restored:
+            violations.append(
+                "replica restore applied zero sharded table rows "
+                "though the replicated state is row-sharded — the "
+                "tables were lost across the reform"
+            )
     return {
         "name": "replication_no_lost_steps",
         "status": "FAIL" if violations else "PASS",
@@ -690,6 +735,22 @@ def check_cross_slice_coverage(
                 f"{e.get('source')} pushed to process {e.get('target')} "
                 f"on its OWN slice {src} — a slice loss takes shard and "
                 "replica together"
+            )
+    # sharded-table extension (audited over EVERY push, not just the
+    # multi-slice ones): a push whose source state HAS row-sharded
+    # tables must carry its shard's rows — has_sharded with zero
+    # sharded_rows is a replica that would restore the dense leaves but
+    # lose the table (exactly what --corrupt drop_shard_parts forges)
+    for e in events:
+        if e.get("event") != "replica_push" or not e.get("has_sharded"):
+            continue
+        if not int(e.get("sharded_rows", 0) or 0):
+            violations.append(
+                f"replica_push at step {e.get('step')} from process "
+                f"{e.get('source')}: state has "
+                f"{e.get('sharded_tables')} row-sharded table(s) but "
+                "the push carried zero rows — the shard's only replica "
+                "holds no table coverage"
             )
     return violations
 
@@ -819,7 +880,16 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
         os.path.join(config.workdir, "journal"), ignore_errors=True
     )
 
-    train = synthetic.gen_mnist(
+    if config.dataset not in DATASETS:
+        raise ValueError(
+            f"unknown dataset {config.dataset!r}; valid: {DATASETS}"
+        )
+    gen = (
+        synthetic.gen_frappe
+        if config.dataset == "frappe"
+        else synthetic.gen_mnist
+    )
+    train = gen(
         os.path.join(config.workdir, "train"),
         num_records=config.num_records,
         num_shards=2,
@@ -862,6 +932,18 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
             "--corrupt drop_dedup requires a plan with net_duplicate "
             "faults (dup_report_storm) — without duplicate delivery "
             "the disabled dedup corrupts nothing"
+        )
+    if config.corrupt == "drop_shard_parts" and not config.replication:
+        # the corruption strips sharded rows from replica push BLOBS;
+        # without replication no push ever happens and the "corrupted
+        # runs must exit non-zero" contract would pass green (a model
+        # without row-sharded tables is caught at run time: pushes then
+        # carry has_sharded=False and the sharded-coverage extension
+        # reports the vacuity)
+        raise ValueError(
+            "--corrupt drop_shard_parts requires replication on and a "
+            "model whose tables are row-sharded (it strips sharded rows "
+            "from the replica push payloads)"
         )
     if config.corrupt == "same_slice_ring" and not (
         config.replication and config.num_slices > 1
@@ -1230,7 +1312,12 @@ def _evaluate_checkpoint(config: ChaosJobConfig, ckpt: str) -> float:
     from elasticdl_tpu.trainer.local_executor import LocalExecutor
     from elasticdl_tpu.utils.args import parse_master_args
 
-    eval_dir = synthetic.gen_mnist(
+    gen = (
+        synthetic.gen_frappe
+        if config.dataset == "frappe"
+        else synthetic.gen_mnist
+    )
+    eval_dir = gen(
         os.path.join(config.workdir, "eval"),
         num_records=config.eval_records,
         num_shards=1,
@@ -1239,7 +1326,7 @@ def _evaluate_checkpoint(config: ChaosJobConfig, ckpt: str) -> float:
     args = parse_master_args(
         [
             "--model_def",
-            "mnist_functional_api.mnist_functional_api.custom_model",
+            config.model_def,
             "--validation_data",
             eval_dir,
             "--minibatch_size",

@@ -1,0 +1,72 @@
+"""Sharded embedding subsystem — the XLA-native sparse data plane.
+
+The reference hosted recommender-scale tables on a gRPC parameter
+server (``ps/embedding_table.py``: id-hash dict shards, pulled
+mid-forward by ``pull_embedding_vector``, gradients pushed back by
+id-hash scatter).  This package completes the repo's founding "gRPC PS
+-> XLA collectives" translation for that signature workload:
+
+- :func:`sharded_table_rules` row-partitions declared
+  ``SparseEmbedding`` tables over the mesh (ep > tp > fsdp, falling
+  back to dp so pure-data-parallel ELASTIC worlds shard too — the axis
+  is re-inferred every reform, so tables re-shard across slice loss);
+  lookup lowers to gather -> all-to-all INSIDE the jitted step and the
+  gradient scatter-add lands on the owning shard, both emitted by
+  GSPMD from the ``P(axis, None)`` spec;
+- :func:`plan_placement` admits each table onto a tier — device HBM
+  when the shard fits the measured budget, else the host-RAM spill
+  tier gated on the memory ledger's measured headroom
+  (``host_memory_health``), raising :class:`EmbeddingAdmissionError`
+  rather than walking the host into OOM;
+- :class:`ShardedHostTable` + :class:`SpillEmbeddingRuntime` implement
+  the spill tier: unique-row pull into a fixed-capacity minitable
+  around an unchanged jitted step (one compile), scatter-back after;
+- elasticity and serving ride the EXISTING owned-rows machinery:
+  dim-0-sharded leaves checkpoint/replicate as per-host ``(ids,
+  rows)`` parts (``parallel/elastic.state_checkpoint_parts``), slice
+  loss re-forms them through harvest/restore by global row id, and the
+  serving engine places tables by the same rules so hot swaps stay
+  treedef-preserving with zero recompiles.
+
+See docs/designs/sharded_embeddings.md for the full design.
+"""
+
+from elasticdl_tpu.embeddings.planner import (
+    DEVICE_BUDGET_ENV,
+    HOST_SHARE_ENV,
+    EmbeddingAdmissionError,
+    Placement,
+    device_budget_bytes,
+    embedding_axis,
+    owning_shard,
+    plan_placement,
+    shard_row_ranges,
+    sharded_table_rules,
+)
+from elasticdl_tpu.embeddings.spill import (
+    ShardedHostTable,
+    SpillEmbeddingRuntime,
+    metrics_registry,
+    set_table_bytes,
+    track_device_table,
+    untrack_device_table,
+)
+
+__all__ = [
+    "DEVICE_BUDGET_ENV",
+    "HOST_SHARE_ENV",
+    "EmbeddingAdmissionError",
+    "Placement",
+    "ShardedHostTable",
+    "SpillEmbeddingRuntime",
+    "device_budget_bytes",
+    "embedding_axis",
+    "metrics_registry",
+    "owning_shard",
+    "plan_placement",
+    "set_table_bytes",
+    "shard_row_ranges",
+    "sharded_table_rules",
+    "track_device_table",
+    "untrack_device_table",
+]

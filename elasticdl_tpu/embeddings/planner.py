@@ -1,0 +1,263 @@
+"""Placement planning for sharded embedding tables.
+
+Answers the two questions the reference answered with its PS topology
+(``ps/embedding_table.py`` hash-sharding ids over PS pods, and "the PS
+is host RAM, full stop"):
+
+1. WHICH mesh axis row-shards a declared table (:func:`embedding_axis`,
+   :func:`sharded_table_rules`).  Preference order is ep (dedicated
+   embedding axis) > tp > fsdp, same as the size-triggered policy in
+   ``layers/embedding.py``; unlike that policy this one FALLS BACK TO
+   ``dp``.  Rationale: the auto rules refuse dp because batch sharding
+   lives there and replicated small tables are cheaper than an
+   all-to-all — but a DECLARED sharded table is by definition too big to
+   replicate, and dp is the one axis every elastic world has (it is
+   re-inferred from the surviving process set on every reform, so a
+   dp-sharded table re-shards across a slice loss instead of dying with
+   a fixed ``ep=2`` mesh shape).  Batch ``P(dp)`` + table ``P(dp,
+   None)`` makes GSPMD emit exactly the gather -> all-to-all exchange
+   the reference hand-rolled over gRPC.
+
+2. WHICH TIER holds the rows (:func:`plan_placement`): device HBM when
+   the per-host shard fits the measured device budget, else the
+   host-RAM spill tier — gated on the memory ledger's measured
+   ``host_memory_health`` headroom rather than optimism.  A table
+   neither tier admits raises :class:`EmbeddingAdmissionError` and
+   emits ``embedding_spill_fault``: walking the host into OOM is the
+   exact failure the ledger exists to prevent.
+
+3. WHO owns which rows (:func:`shard_row_ranges`): contiguous
+   ``np.array_split`` ranges, the same lowest-index-gets-the-remainder
+   convention as ``parallel/elastic._owned_row_ranges`` so host-tier
+   shard ownership and checkpoint-part ownership never disagree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+
+from elasticdl_tpu.telemetry import memory as memory_ledger
+from elasticdl_tpu.utils.constants import MeshAxis
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+# Device-tier byte budget override.  On CPU backends ``memory_stats()``
+# is absent (the ledger's graceful-None contract), so the measured
+# budget is unknowable and the device tier admits everything; smokes
+# and tests set this to a small value to force tables onto the spill
+# tier deterministically.
+DEVICE_BUDGET_ENV = "ELASTICDL_TPU_EMBEDDING_DEVICE_BUDGET_BYTES"
+
+# Fraction of host MemAvailable a spill table may claim (admission is
+# against MEASURED availability, not MemTotal — other tenants count).
+HOST_SHARE_ENV = "ELASTICDL_TPU_EMBEDDING_HOST_SHARE"
+DEFAULT_HOST_SHARE = 0.5
+
+
+class EmbeddingAdmissionError(RuntimeError):
+    """Neither the device budget nor host-RAM headroom admits the table."""
+
+
+def shard_row_ranges(num_rows: int, num_hosts: int) -> list[tuple[int, int]]:
+    """Contiguous per-host row ranges ``[(lo, hi), ...]`` covering
+    ``[0, num_rows)`` — ``np.array_split`` semantics: the first
+    ``num_rows % num_hosts`` hosts carry one extra row, so uneven
+    vocabs split without padding and without gaps."""
+    if num_hosts < 1:
+        raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+    if num_rows < 0:
+        raise ValueError(f"num_rows must be >= 0, got {num_rows}")
+    base, extra = divmod(num_rows, num_hosts)
+    ranges = []
+    lo = 0
+    for host in range(num_hosts):
+        hi = lo + base + (1 if host < extra else 0)
+        ranges.append((lo, hi))
+        lo = hi
+    return ranges
+
+
+def owning_shard(row: int, ranges) -> int:
+    """Index of the shard whose range contains ``row``."""
+    for i, (lo, hi) in enumerate(ranges):
+        if lo <= row < hi:
+            return i
+    raise ValueError(f"row {row} outside all shard ranges {ranges}")
+
+
+def embedding_axis(mesh, rows: int | None = None, allow_dp: bool = True):
+    """The mesh axis that row-shards declared tables: first of
+    ep > tp > fsdp > dp with size > 1 that divides ``rows`` (when
+    given); None when no axis fits (single-device world — the table
+    stays replicated and lookup is a local gather)."""
+    axes = [MeshAxis.EP, MeshAxis.TP, MeshAxis.FSDP]
+    if allow_dp:
+        axes.append(MeshAxis.DP)
+    for axis in axes:
+        if axis not in mesh.axis_names or mesh.shape[axis] <= 1:
+            continue
+        if rows is not None and rows % mesh.shape[axis] != 0:
+            continue
+        return axis
+    return None
+
+
+def sharded_table_rules(mesh, tables: dict, allow_dp: bool = True) -> list:
+    """First-match-wins sharding rules row-partitioning each declared
+    table: ``tables`` maps the table's parameter path (e.g.
+    ``"embedding/embedding"``) to its (padded) row count.  Each entry
+    becomes ``Rule(r"(^|/)<path>$", P(axis, None))`` over
+    :func:`embedding_axis`; tables with no fitting axis are skipped
+    (``infer_param_specs`` then replicates them)."""
+    from jax.sharding import PartitionSpec as P
+
+    from elasticdl_tpu.parallel.sharding import Rule
+
+    rules = []
+    for path, rows in tables.items():
+        axis = embedding_axis(mesh, rows=rows, allow_dp=allow_dp)
+        if axis is None:
+            logger.warning(
+                "sharded_table_rules: no mesh axis divides %s rows of %r; "
+                "leaving it replicated",
+                rows,
+                path,
+            )
+            continue
+        rules.append(Rule(r"(^|/)" + re.escape(path) + "$", P(axis, None)))
+    return rules
+
+
+# ---- tier admission ----------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Placement:
+    """One table's admission decision: which tier holds the rows and the
+    measured budgets the decision was made against."""
+
+    tier: str  # "device" | "spill"
+    table_bytes: int
+    device_budget_bytes: int | None
+    host_available_bytes: int | None
+    reason: str
+
+
+def _host_share() -> float:
+    raw = os.environ.get(HOST_SHARE_ENV, "")
+    try:
+        return float(raw) if raw else DEFAULT_HOST_SHARE
+    except ValueError:
+        return DEFAULT_HOST_SHARE
+
+
+def device_budget_bytes() -> int | None:
+    """Free HBM across this process's local devices (``bytes_limit -
+    bytes_in_use``), or the env override; None where allocator stats
+    are absent (CPU) AND no override is set — an unknowable budget
+    admits (the graceful-None contract; CPU "HBM" is just host RAM)."""
+    raw = os.environ.get(DEVICE_BUDGET_ENV, "")
+    if raw:
+        try:
+            return int(float(raw))
+        except ValueError:
+            pass
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — no backend is a valid state
+        return None
+    total = 0
+    found = False
+    for device in devices:
+        try:
+            stats = device.memory_stats()
+        except Exception:  # noqa: BLE001 — per-device stats are optional
+            stats = None
+        if not stats or "bytes_limit" not in stats:
+            continue
+        found = True
+        total += max(
+            0,
+            int(stats.get("bytes_limit", 0) or 0)
+            - int(stats.get("bytes_in_use", 0) or 0),
+        )
+    return total if found else None
+
+
+def plan_placement(
+    table_bytes: int,
+    name: str = "",
+    prefer: str = "device",
+    emit=None,
+) -> Placement:
+    """Admit a table onto a tier or refuse loudly.
+
+    Device tier first (unless ``prefer="spill"``): admits when the
+    per-host bytes fit the measured free-HBM budget (or the budget is
+    unknowable).  Spill tier next: admits when the bytes fit within
+    ``HOST_SHARE`` of the ledger's measured ``MemAvailable``.  Neither
+    fitting emits ``embedding_spill_fault`` and raises — the caller
+    must shard wider or shrink, not gamble on the OOM killer."""
+    budget = device_budget_bytes()
+    if prefer != "spill" and (budget is None or table_bytes <= budget):
+        return Placement(
+            tier="device",
+            table_bytes=table_bytes,
+            device_budget_bytes=budget,
+            host_available_bytes=None,
+            reason="fits device budget"
+            if budget is not None
+            else "device budget unknowable; admitted",
+        )
+    health = memory_ledger.host_memory_health()
+    available = health.get("host_available_bytes")
+    share = _host_share()
+    if available is None or table_bytes <= available * share:
+        return Placement(
+            tier="spill",
+            table_bytes=table_bytes,
+            device_budget_bytes=budget,
+            host_available_bytes=available,
+            reason=f"fits {share:.2f} of host MemAvailable"
+            if available is not None
+            else "host availability unknowable; admitted",
+        )
+    if emit is None:
+        from elasticdl_tpu.telemetry.worker_hooks import emit_event
+
+        emit = emit_event
+    try:
+        from elasticdl_tpu.telemetry.events import EVENT_EMBEDDING_SPILL_FAULT
+
+        emit(
+            EVENT_EMBEDDING_SPILL_FAULT,
+            table=name,
+            table_bytes=int(table_bytes),
+            device_budget_bytes=budget,
+            host_available_bytes=available,
+            host_share=share,
+        )
+    except Exception:  # noqa: BLE001 — telemetry never raises into admission
+        logger.exception("embedding_spill_fault emit failed")
+    raise EmbeddingAdmissionError(
+        f"table {name or '<unnamed>'} ({table_bytes} bytes) fits neither "
+        f"the device budget ({budget}) nor {share:.2f} of host "
+        f"MemAvailable ({available}); shard wider or shrink the table"
+    )
+
+
+__all__ = [
+    "DEVICE_BUDGET_ENV",
+    "HOST_SHARE_ENV",
+    "EmbeddingAdmissionError",
+    "Placement",
+    "device_budget_bytes",
+    "embedding_axis",
+    "owning_shard",
+    "plan_placement",
+    "shard_row_ranges",
+    "sharded_table_rules",
+]

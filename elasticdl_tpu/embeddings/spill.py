@@ -1,0 +1,378 @@
+"""Host-RAM spill tier: tables bigger than HBM, staged per step.
+
+The reference's PS held EVERY sparse table in host RAM and served rows
+over gRPC mid-forward (``embedding_delegate.py:64-96``) — which is why
+it could host 100M-row tables on CPU pods, and why every lookup paid an
+RPC.  The XLA translation keeps the host tier but moves it OUT of the
+traced step: before dispatch, the runtime pulls exactly the UNIQUE rows
+this batch touches from :class:`ShardedHostTable` (numpy row shards,
+``shard_row_ranges`` ownership) into a fixed-capacity device minitable
+written into ``state.params`` at the table's leaf; ids are remapped
+onto minitable slots with ``np.searchsorted``; the UNCHANGED jitted
+step runs (fixed shapes — one compile, ever); updated rows are read
+back and scattered to the owning host shard (:meth:`commit`).
+
+This is the honest analogue of ``pull_embedding_vector`` /
+``push_gradient``: the pull/push still exists, but it is host-side
+numpy indexing at batch cadence, not per-id RPC inside the forward.
+The minitable trick constrains the optimizer to slot-free updates
+(plain SGD — the rows outside this batch receive exactly zero gradient
+and must not decay), which the runtime asserts rather than silently
+mis-training momentum.
+
+Byte accounting: every live table registers under the memory ledger's
+``embedding_spill`` component (device-tier shards register under
+``embedding_table`` via :func:`track_device_table`); teardown is
+identity-guarded so a replacement owner registered under the same name
+survives a stale owner's close.  Resident bytes are also exposed as
+the ``elasticdl_embedding_bytes{table=,tier=}`` gauge family — the one
+registration site for that required metric.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from elasticdl_tpu.embeddings import planner
+from elasticdl_tpu.telemetry import memory as memory_ledger
+from elasticdl_tpu.telemetry.registry import MetricsRegistry
+
+# ---- metrics (the single elasticdl_embedding_bytes registration site) --------
+
+_registry = MetricsRegistry()
+_gauge_lock = threading.Lock()
+
+
+def metrics_registry() -> MetricsRegistry:
+    """The subsystem's registry — mounted by whichever /metrics endpoint
+    the hosting process exposes (master hooks, serving replica, tests)."""
+    return _registry
+
+
+def set_table_bytes(table: str, tier: str, value: int):
+    """Point the ``elasticdl_embedding_bytes`` gauge for one table/tier
+    at its current resident bytes."""
+    with _gauge_lock:
+        gauge = _registry.gauge(
+            "elasticdl_embedding_bytes",
+            "Resident embedding bytes by table and tier",
+            labels={"table": table, "tier": tier},
+        )
+    gauge.set(int(value))
+
+
+# ---- ledger aggregation ------------------------------------------------------
+#
+# The ledger holds ONE callback per component, so per-table owners
+# aggregate through module registries; the component callback identity
+# is stable, which is exactly what makes unregister_component's
+# identity guard meaningful (a foreign registration under the same
+# name is left alone on teardown).
+
+_spill_tables: dict[str, "ShardedHostTable"] = {}
+_device_tables: dict[str, object] = {}
+_tables_lock = threading.Lock()
+
+
+def _spill_bytes() -> int:
+    with _tables_lock:
+        tables = list(_spill_tables.values())
+    return sum(t.nbytes for t in tables)
+
+
+def _device_bytes() -> int:
+    with _tables_lock:
+        fns = list(_device_tables.values())
+    total = 0
+    for fn in fns:
+        try:
+            total += int(fn())
+        except Exception:  # noqa: BLE001 — accounting must never raise
+            continue
+    return total
+
+
+def track_device_table(name: str, bytes_fn):
+    """Account a device-tier table's local shard bytes under the
+    ledger's ``embedding_table`` component (``bytes_fn`` -> current
+    bytes of the rows THIS process holds)."""
+    with _tables_lock:
+        _device_tables[name] = bytes_fn
+    memory_ledger.register_component(
+        memory_ledger.COMPONENT_EMBEDDING_TABLE, _device_bytes
+    )
+    try:
+        set_table_bytes(name, "device", int(bytes_fn()))
+    except Exception:  # noqa: BLE001 — accounting must never raise
+        pass
+
+
+def untrack_device_table(name: str):
+    with _tables_lock:
+        _device_tables.pop(name, None)
+        empty = not _device_tables
+    set_table_bytes(name, "device", 0)
+    if empty:
+        memory_ledger.unregister_component(
+            memory_ledger.COMPONENT_EMBEDDING_TABLE, _device_bytes
+        )
+
+
+# ---- the host tier -----------------------------------------------------------
+
+
+class ShardedHostTable:
+    """A ``(num_rows, dim)`` table held in host RAM as contiguous
+    per-host row shards (``planner.shard_row_ranges`` ownership — the
+    same convention as checkpoint parts, so harvest/restore and the
+    spill tier agree about who owns row r).
+
+    ``num_hosts`` simulates the multi-host layout on one machine the
+    same way the CPU smokes simulate multi-process meshes; on a real
+    fleet each process constructs only its own shard.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        num_rows: int,
+        dim: int,
+        num_hosts: int = 1,
+        dtype=np.float32,
+        seed: int = 0,
+        init_scale: float = 0.05,
+        rows: np.ndarray | None = None,
+    ):
+        self.name = name
+        self.num_rows = int(num_rows)
+        self.dim = int(dim)
+        self.ranges = tuple(planner.shard_row_ranges(num_rows, num_hosts))
+        if rows is not None:
+            rows = np.asarray(rows)
+            if rows.shape != (num_rows, dim):
+                raise ValueError(
+                    f"rows shape {rows.shape} != ({num_rows}, {dim})"
+                )
+            self._shards = [
+                np.array(rows[lo:hi], dtype=dtype) for lo, hi in self.ranges
+            ]
+        else:
+            rng = np.random.default_rng(seed)
+            self._shards = [
+                rng.uniform(-init_scale, init_scale, size=(hi - lo, dim)).astype(
+                    dtype
+                )
+                for lo, hi in self.ranges
+            ]
+        self._closed = False
+        with _tables_lock:
+            _spill_tables[name] = self
+        memory_ledger.register_component(
+            memory_ledger.COMPONENT_EMBEDDING_SPILL, _spill_bytes
+        )
+        set_table_bytes(name, "spill", self.nbytes)
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.ranges)
+
+    @property
+    def dtype(self):
+        return self._shards[0].dtype if self._shards else np.dtype(np.float32)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(s.nbytes) for s in self._shards)
+
+    def shard(self, host: int) -> np.ndarray:
+        return self._shards[host]
+
+    def _check_ids(self, ids: np.ndarray):
+        if ids.size and (ids.min() < 0 or ids.max() >= self.num_rows):
+            raise ValueError(
+                f"table {self.name!r}: ids outside [0, {self.num_rows}) — "
+                "the host tier refuses out-of-vocab ids instead of clipping"
+            )
+
+    def gather(self, ids) -> np.ndarray:
+        """Rows for ``ids`` (1-D), assembled across owning shards."""
+        ids = np.asarray(ids).ravel()
+        self._check_ids(ids)
+        out = np.empty((ids.size, self.dim), dtype=self.dtype)
+        for (lo, hi), shard in zip(self.ranges, self._shards):
+            mask = (ids >= lo) & (ids < hi)
+            if mask.any():
+                out[mask] = shard[ids[mask] - lo]
+        return out
+
+    def scatter(self, ids, rows):
+        """Write ``rows`` back to the owning shards (last write wins on
+        duplicate ids, matching numpy fancy-assignment)."""
+        ids = np.asarray(ids).ravel()
+        self._check_ids(ids)
+        rows = np.asarray(rows)
+        if rows.shape != (ids.size, self.dim):
+            raise ValueError(
+                f"rows shape {rows.shape} != ({ids.size}, {self.dim})"
+            )
+        for (lo, hi), shard in zip(self.ranges, self._shards):
+            mask = (ids >= lo) & (ids < hi)
+            if mask.any():
+                shard[ids[mask] - lo] = rows[mask]
+
+    def close(self):
+        """Tear down: drop from the ledger aggregate (identity-guarded —
+        a replacement component callback registered after this table's
+        construction is left alone) and zero the gauge."""
+        if self._closed:
+            return
+        self._closed = True
+        with _tables_lock:
+            if _spill_tables.get(self.name) is self:
+                _spill_tables.pop(self.name, None)
+            empty = not _spill_tables
+        set_table_bytes(self.name, "spill", 0)
+        if empty:
+            memory_ledger.unregister_component(
+                memory_ledger.COMPONENT_EMBEDDING_SPILL, _spill_bytes
+            )
+
+
+# ---- the per-step staging runtime --------------------------------------------
+
+
+class SpillEmbeddingRuntime:
+    """Stage/commit loop around an UNCHANGED jitted step.
+
+    ``tables`` maps parameter paths inside ``params`` (e.g.
+    ``"embedding/embedding"``) to their host tables; every table shares
+    one id space (DeepFM's feature table and id-bias table are looked
+    up with the same ids).  The model is built with ``input_dim =
+    capacity`` so the staged minitables ARE the table leaves — fixed
+    shapes, one compile.
+
+    Id 0 always occupies slot 0: the staged unique-id set is
+    ``np.unique([pad_id] + batch_ids)`` and ``np.unique`` sorts, so a
+    model's mask-zero/pad conventions survive the remap verbatim.
+    """
+
+    def __init__(self, tables: dict, capacity: int, pad_id: int = 0, emit=None):
+        if not tables:
+            raise ValueError("SpillEmbeddingRuntime needs at least one table")
+        sizes = {t.num_rows for t in tables.values()}
+        if len(sizes) != 1:
+            raise ValueError(
+                f"tables must share one id space, got row counts {sizes}"
+            )
+        self._tables = dict(tables)
+        self.capacity = int(capacity)
+        self.pad_id = int(pad_id)
+        self._emit = emit
+        self.gathers = 0
+        self.rows_gathered = 0
+
+    @property
+    def num_rows(self) -> int:
+        return next(iter(self._tables.values())).num_rows
+
+    def minitable_params(self, params):
+        """``params`` with every table leaf replaced by a zero
+        ``(capacity, dim)`` minitable — the shape the step compiles
+        against (call once at state build)."""
+        for path, table in self._tables.items():
+            mini = np.zeros((self.capacity, table.dim), dtype=table.dtype)
+            params = _with_leaf(params, path, mini)
+        return params
+
+    def stage(self, params, ids):
+        """Pull the unique rows ``ids`` touches into the minitable
+        leaves.  Returns ``(staged_params, remapped_ids, handle)``;
+        pass ``handle`` to :meth:`commit` after the step."""
+        ids_arr = np.asarray(ids)
+        # negative ids are the sparse layer's missing-value sentinel —
+        # never fetched, passed through remapping unchanged
+        flat = ids_arr.ravel()
+        flat = flat[flat >= 0]
+        unique = np.unique(np.concatenate(([self.pad_id], flat)))
+        if unique.size > self.capacity:
+            raise ValueError(
+                f"batch touches {unique.size} unique rows > minitable "
+                f"capacity {self.capacity}; raise the capacity or shrink "
+                "the batch"
+            )
+        remapped = np.searchsorted(unique, np.clip(ids_arr, 0, None))
+        remapped = np.where(ids_arr < 0, ids_arr, remapped).astype(
+            ids_arr.dtype
+        )
+        staged_bytes = 0
+        for path, table in self._tables.items():
+            mini = np.zeros((self.capacity, table.dim), dtype=table.dtype)
+            mini[: unique.size] = table.gather(unique)
+            staged_bytes += int(mini.nbytes)
+            params = _with_leaf(params, path, mini)
+        self.gathers += 1
+        self.rows_gathered += int(unique.size)
+        if self._emit is None:
+            from elasticdl_tpu.telemetry.worker_hooks import emit_event
+
+            emit = emit_event
+        else:
+            emit = self._emit
+        try:
+            from elasticdl_tpu.telemetry.events import EVENT_EMBEDDING_GATHER
+
+            emit(
+                EVENT_EMBEDDING_GATHER,
+                rows=int(unique.size),
+                tables=len(self._tables),
+                staged_bytes=staged_bytes,
+            )
+        except Exception:  # noqa: BLE001 — telemetry never raises here
+            pass
+        return params, remapped, unique
+
+    def commit(self, params, handle):
+        """Scatter the (updated) staged rows back to their owning host
+        shards; ``params`` is the post-step params, ``handle`` the
+        unique-id array :meth:`stage` returned."""
+        unique = np.asarray(handle)
+        for path, table in self._tables.items():
+            leaf = np.asarray(_get_leaf(params, path))
+            table.scatter(unique, leaf[: unique.size])
+
+    def close(self):
+        for table in self._tables.values():
+            table.close()
+
+
+# ---- pytree path helpers (plain nested dicts, shallow-copied) ----------------
+
+
+def _get_leaf(params, path: str):
+    node = params
+    for key in path.split("/"):
+        node = node[key]
+    return node
+
+
+def _with_leaf(params, path: str, value):
+    keys = path.split("/")
+    out = dict(params)
+    node = out
+    for key in keys[:-1]:
+        node[key] = dict(node[key])
+        node = node[key]
+    node[keys[-1]] = value
+    return out
+
+
+__all__ = [
+    "ShardedHostTable",
+    "SpillEmbeddingRuntime",
+    "metrics_registry",
+    "set_table_bytes",
+    "track_device_table",
+    "untrack_device_table",
+]

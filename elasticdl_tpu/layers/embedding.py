@@ -73,11 +73,15 @@ def resolve_initializer(name_or_fn) -> Callable:
 def embedding_lookup(table: jax.Array, ids: jax.Array) -> jax.Array:
     """Dense lookup: ``ids`` of any shape -> ``ids.shape + (dim,)``.
 
-    Padded ids (< 0) return zero vectors (the reference's delegate returns
-    rows for every id; we additionally zero pads so fixed-width batches are
-    safe).
+    Out-of-range ids — padded (< 0) OR past the table (>= rows) — return
+    zero vectors and contribute exactly zero gradient.  The upper bound
+    matters: under jit ``jnp.take`` CLIPS out-of-bounds indices, so an
+    out-of-vocab id would silently read (and backprop into) the LAST
+    table row — a corrupt-data bug that trains the wrong embedding
+    instead of failing or masking.  Same mask contract as the
+    shape-canonical batching weights (zero weight => zero gradient).
     """
-    mask = ids >= 0
+    mask = (ids >= 0) & (ids < table.shape[0])
     safe = jnp.where(mask, ids, 0)
     out = jnp.take(table, safe, axis=0)
     return out * mask[..., None].astype(out.dtype)
@@ -97,11 +101,19 @@ def safe_embedding_lookup_sparse(
     ids: ``(batch, max_ids)`` int, padded with ``PAD_ID``.
     weights: optional ``(batch, max_ids)`` float; pads are ignored either way.
     Returns ``(batch, dim)``.
+
+    Out-of-range handling is deterministic in BOTH directions: ids < 0
+    (the pad) and ids >= the table's rows are masked out of the combine
+    and contribute exactly zero gradient.  Without the upper bound,
+    jit-mode ``jnp.take`` clips an out-of-vocab id onto the last row —
+    it would join the combine AND receive gradient, silently corrupting
+    that row (pinned by test_out_of_vocab_id_zero_gradient).
     """
     if combiner not in Combiner:
         raise ValueError(f"combiner must be one of {Combiner}, got {combiner}")
-    mask = (ids >= 0).astype(table.dtype)
-    safe = jnp.where(ids >= 0, ids, 0)
+    in_range = (ids >= 0) & (ids < table.shape[0])
+    mask = in_range.astype(table.dtype)
+    safe = jnp.where(in_range, ids, 0)
     emb = jnp.take(table, safe, axis=0)  # (b, k, d)
     w = mask if weights is None else weights.astype(table.dtype) * mask
     summed = jnp.einsum("bk,bkd->bd", w, emb)
@@ -163,13 +175,22 @@ class Embedding(nn.Module):
 
 
 class SparseEmbedding(nn.Module):
-    """Combiner embedding over a local (never-distributed) table — the
-    export-time counterpart (reference keras/layers/sparse_embedding.py:7).
+    """Combiner embedding whose table is DECLARED shard-eligible — the
+    recommender-scale counterpart (reference
+    keras/layers/sparse_embedding.py:7, the layer that always lived on
+    the parameter servers regardless of size).
 
     Same math as :class:`Embedding` with a combiner; kept as a distinct
-    class so the model handler can tell "always local" from "distribute
-    when large" the way the reference distinguishes SparseEmbedding from
-    rewritten Keras Embedding (model_handler.py:199-241).
+    class so policy can tell "always distribute" from "distribute when
+    large" the way the reference distinguishes SparseEmbedding from
+    rewritten Keras Embedding (model_handler.py:199-241).  The sharded
+    embedding subsystem (:mod:`elasticdl_tpu.embeddings`) treats every
+    ``SparseEmbedding`` table as row-partitionable: models export
+    ``sharding_rules(mesh)`` built from
+    :func:`elasticdl_tpu.embeddings.sharded_table_rules`, which
+    range-shards the ``embedding`` param over the mesh's embedding axis
+    (ep > tp > fsdp, falling back to dp on pure-data-parallel worlds).
+    ``vocab_pad_multiple`` keeps odd vocabs divisible over any such axis.
     """
 
     input_dim: int
@@ -177,13 +198,19 @@ class SparseEmbedding(nn.Module):
     combiner: str = "sum"
     embeddings_initializer: Any = Initializer.UNIFORM
     dtype: Any = jnp.float32
+    vocab_pad_multiple: int = 1
+
+    @property
+    def padded_input_dim(self) -> int:
+        m = max(1, self.vocab_pad_multiple)
+        return ((self.input_dim + m - 1) // m) * m
 
     @nn.compact
     def __call__(self, ids, weights=None):
         table = self.param(
             "embedding",
             resolve_initializer(self.embeddings_initializer),
-            (self.input_dim, self.output_dim),
+            (self.padded_input_dim, self.output_dim),
             self.dtype,
         )
         ids = jnp.asarray(ids)

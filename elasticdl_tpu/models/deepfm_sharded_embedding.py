@@ -1,0 +1,56 @@
+"""DeepFM over the sharded embedding subsystem — elastic table layout.
+
+Same DeepFM math as :mod:`deepfm_functional_api`; the difference from
+:mod:`deepfm_edl_embedding` is WHERE the tables may land.  That variant
+pins tables to a dedicated mesh axis (ep/tp/fsdp) and replicates when
+none exists — faithful to "always on the PS", but a fixed ``ep=2`` mesh
+shape cannot survive an elastic shrink.  This variant routes through
+:func:`elasticdl_tpu.embeddings.sharded_table_rules`, which FALLS BACK
+TO ``dp``: dp is the one axis every elastic world has, re-inferred from
+the surviving processes on each reform, so the tables are row-sharded
+on the default mesh and RE-shard across slice loss (restore places
+checkpoint parts by global row id under whatever the new mesh says).
+Batch ``P(dp)`` + table ``P(dp, None)`` is exactly the layout GSPMD
+lowers to the gather -> all-to-all the reference did over gRPC.
+"""
+
+from __future__ import annotations
+
+from elasticdl_tpu.models import deepfm_functional_api as _base
+from elasticdl_tpu.models.deepfm_functional_api import (  # noqa: F401
+    DeepFM,
+    batch_parse,
+    custom_data_reader,
+    dataset_fn,
+    eval_metrics_fn,
+    loss,
+    optimizer,
+)
+
+# the /128-padded table height the layers actually allocate; tracks the
+# most recent custom_model() so input_dim overrides (bench/smoke) keep
+# the rules honest — the same module-global pattern the base model uses
+# for its wire dtype
+_padded_vocab = -(-DeepFM().input_dim // 128) * 128
+
+
+def custom_model(**kwargs):
+    global _padded_vocab
+    model = _base.custom_model(**kwargs)
+    _padded_vocab = -(-model.input_dim // 128) * 128
+    return model
+
+
+def sharding_rules(mesh):
+    """Row-shard both tables over the elastic embedding axis (ep > tp >
+    fsdp > dp); [] (replicated) only on a genuinely single-device
+    world."""
+    from elasticdl_tpu.embeddings import sharded_table_rules
+
+    return sharded_table_rules(
+        mesh,
+        {
+            "embedding/embedding": _padded_vocab,
+            "id_bias/embedding": _padded_vocab,
+        },
+    )

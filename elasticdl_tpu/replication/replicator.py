@@ -42,6 +42,23 @@ REPLICA_HOST_ENV = "MY_POD_IP"  # k8s pods advertise their pod IP
 # slice loss then takes a shard and its only replica together
 SAME_SLICE_RING_ENV = "ELASTICDL_TPU_CHAOS_SAME_SLICE_RING"
 
+# chaos corruption (--corrupt drop_shard_parts): strip the sharded
+# table rows from the pushed blob AFTER the event's has_sharded field
+# is computed from the real state, so the sharded replica-coverage
+# extension of cross_slice_replica_coverage can be proven falsifiable —
+# the push honestly reports "this state HAS sharded rows" while
+# carrying none, which is exactly the shape of "a shard's only replica
+# died"
+DROP_SHARD_PARTS_ENV = "ELASTICDL_TPU_CHAOS_DROP_SHARD_PARTS"
+
+
+def _parts_row_count(parts) -> int:
+    """Total table rows across a snapshot's sharded parts (each part is
+    ``name -> (ids, rows)``)."""
+    if not parts:
+        return 0
+    return sum(len(ids) for ids, _ in parts.values())
+
 
 def replica_host() -> str:
     return os.environ.get(REPLICA_HOST_ENV, "") or "127.0.0.1"
@@ -214,6 +231,16 @@ class PeerReplicator:
             dense, parts = elastic.state_checkpoint_parts(
                 trainer.state, mesh, materialize_dense=self._process_id == 0
             )
+            # sharded-coverage bookkeeping BEFORE any corruption: the
+            # event must report what the STATE has, the blob what the
+            # push actually carried — the gap is what the chaos
+            # invariant audits
+            has_sharded = bool(parts)
+            sharded_tables = len(parts)
+            sharded_rows = _parts_row_count(parts)
+            if has_sharded and os.environ.get(DROP_SHARD_PARTS_ENV, ""):
+                parts = {}
+                sharded_rows = 0
             blob = encode_snapshot(dense, parts)
             shard = ReplicaShard(
                 source=self._process_id,
@@ -246,6 +273,13 @@ class PeerReplicator:
             target_slice=self._slice_of(self.neighbor),
             num_slices=len(set(self._slice_map)) if self._slice_map else 1,
             ok=bool(ok),
+            # sharded-table coverage: has_sharded reflects the live
+            # state, sharded_rows what the push carried — a push with
+            # has_sharded and zero rows is a shard whose replica
+            # carries no table coverage (the corrupt-mode signature)
+            has_sharded=has_sharded,
+            sharded_tables=sharded_tables,
+            sharded_rows=sharded_rows,
         )
 
     def _push(self, shard: ReplicaShard) -> bool:
@@ -370,7 +404,15 @@ def restore_from_replica(
     from elasticdl_tpu.chaos import hooks as chaos_hooks
 
     chaos_hooks.notify_replica_restore(version)
-    telemetry_hooks.emit_event(EVENT_REPLICA_RESTORE, step=version)
+    telemetry_hooks.emit_event(
+        EVENT_REPLICA_RESTORE,
+        step=version,
+        # sharded coverage actually APPLIED: replication_no_lost_steps
+        # requires pushed sharded rows to come back as restored sharded
+        # rows, not merely as a restore event
+        sharded_rows=_parts_row_count(parts),
+        sharded_tables=len(parts) if parts else 0,
+    )
     logger.info(
         "Process %d restored state at version %d from peer replica "
         "(generation %d)",

@@ -96,6 +96,19 @@ def _place_with(tree, sharding):
     )
 
 
+def _place_tree_with_rules(tree, mesh, rules, infer_param_specs,
+                           specs_to_shardings):
+    """Commit a variable tree under the model's sharding rules: leaves a
+    rule matches land sharded (row-partitioned embedding tables), the
+    rest replicated — the serving-side mirror of the trainer's
+    rule-driven ``out_shardings``."""
+    specs = infer_param_specs(tree, mesh, rules)
+    shardings = specs_to_shardings(specs, mesh)
+    return jax.tree_util.tree_map(
+        lambda x, sh: jax.device_put(np.asarray(x), sh), tree, shardings
+    )
+
+
 class ServingEngine:
     """Loads an export (``utils/export_utils.py`` manifest + npz), lazily
     builds model variables on the first request (the ``_ensure_trainer``
@@ -218,16 +231,41 @@ class ServingEngine:
                 params, model_state = rebuild_variables(
                     self._model, sample_row, flat_params, flat_state
                 )
-                # COMMIT the variables to the mesh (replicated) at build:
+                # COMMIT the variables to the mesh at build:
                 # rebuild_variables returns host numpy leaves, and
                 # feeding those to the jitted step would both re-ship
                 # the whole model per dispatch AND leave the jit cache
                 # key unstable (uncommitted args let the compiler pick,
                 # and a later committed leaf is a recompile — the smoke
-                # caught exactly that under traffic)
-                replicated = self._replicated_sharding()
-                params = _place_with(params, replicated)
-                model_state = _place_with(model_state, replicated)
+                # caught exactly that under traffic).  Placement follows
+                # the model's OWN sharding rules (the sharded embedding
+                # subsystem's row-partitioned tables serve sharded, so a
+                # 100M-row table never materializes replicated per
+                # device); rule-less models keep the replicated layout.
+                # _place_like preserves these per-leaf shardings on hot
+                # swap, so the layout — and the compiled program — is
+                # stable across swaps.
+                rules = ()
+                if self._spec.sharding_rules is not None:
+                    rules = tuple(self._spec.sharding_rules(self._mesh))
+                if rules:
+                    from elasticdl_tpu.parallel.sharding import (
+                        infer_param_specs,
+                        specs_to_shardings,
+                    )
+
+                    params = _place_tree_with_rules(
+                        params, self._mesh, rules,
+                        infer_param_specs, specs_to_shardings,
+                    )
+                    model_state = _place_tree_with_rules(
+                        model_state, self._mesh, rules,
+                        infer_param_specs, specs_to_shardings,
+                    )
+                else:
+                    replicated = self._replicated_sharding()
+                    params = _place_with(params, replicated)
+                    model_state = _place_with(model_state, replicated)
                 import optax
 
                 self._state = TrainState.create(

@@ -92,6 +92,14 @@ EVENT_FLEET_FAULT = "fleet_fault"
 # pressure fraction (entered=True) or recovered above it
 EVENT_MEMORY_SAMPLE = "memory_sample"
 EVENT_MEMORY_PRESSURE = "memory_pressure"
+# sharded embedding subsystem (elasticdl_tpu.embeddings): one event per
+# host-tier pull of unique rows into the fixed-capacity device
+# minitable (the XLA-era pull_embedding_vector) with row/byte counts /
+# a table admission FAILED — neither the device budget nor the host-RAM
+# headroom (memory ledger) admits it, so the caller must shrink or
+# re-place the table rather than walk the host into OOM
+EVENT_EMBEDDING_GATHER = "embedding_gather"
+EVENT_EMBEDDING_SPILL_FAULT = "embedding_spill_fault"
 
 EVENTS_FILENAME = "events.jsonl"
 

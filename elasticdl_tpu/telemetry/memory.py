@@ -69,6 +69,11 @@ COMPONENT_TASK_PREFETCHER = "task_prefetcher"
 COMPONENT_SERVING_QUEUE = "serving_queue"
 COMPONENT_SERVING_MODEL = "serving_model"
 COMPONENT_MASTER_JOURNAL = "master_journal"
+# sharded embedding subsystem (elasticdl_tpu.embeddings): device-tier
+# row shards this process holds, and the host-RAM spill tier's row
+# stores + per-step minitable staging
+COMPONENT_EMBEDDING_TABLE = "embedding_table"
+COMPONENT_EMBEDDING_SPILL = "embedding_spill"
 
 # pseudo-components carried in the same current/peak maps (so /metrics
 # renders one elasticdl_memory_bytes family for everything byte-shaped)

@@ -1,0 +1,369 @@
+#!/usr/bin/env python3
+"""Tier-1 sharded-embedding smoke (wired into scripts/run_tier1.sh).
+
+Three gates over the sharded embedding subsystem
+(docs/designs/sharded_embeddings.md):
+
+1. SHARDED ELASTICITY — a 2-process lockstep deepfm job (frappe
+   synthetic data) whose tables are row-sharded over the world's dp
+   axis by the model's declared ``sharding_rules`` runs under the
+   ``slice_loss_mid_epoch`` plan with peer replication ON.  Requires:
+   every invariant PASS — including ``cross_slice_replica_coverage``
+   and ``replication_no_lost_steps``, both now extended to sharded
+   table rows; at least one ``replica_restore`` event that restored a
+   POSITIVE number of sharded rows (the shrunken world re-formed the
+   table from checkpoint parts, not luck); a SHRINKING ``mesh_resize``
+   span; and the post-resize generation compiling no more programs
+   than generation 0 (re-sharding rode the normal reform compile, no
+   compile storm).
+2. CORRUPT MODE — the same job with ``corrupt=drop_shard_parts``
+   (replica pushes silently drop every sharded part, simulating a
+   shard whose only replica died) must FAIL the coverage invariants:
+   a checker that cannot detect a lost shard is vacuous.
+3. SPILL TIER — a 2^20-row (>=1M) table split across 2 simulated hosts
+   is refused device admission by ``plan_placement`` under a forced
+   byte budget, lands on the host tier, and trains through the
+   stage -> unchanged jitted step -> commit loop with exactly ONE
+   compile, byte-for-byte parity with dense full-table SGD, ledger
+   ``embedding_spill`` accounting, the ``elasticdl_embedding_bytes``
+   gauge, and ``embedding_gather`` events at batch cadence.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the spill gate shards its host table across 2 simulated hosts; give
+# the in-process mesh 2 virtual devices to mirror that layout
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=2"
+)
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+DEEPFM_DEF = "deepfm_sharded_embedding.deepfm_sharded_embedding.custom_model"
+
+
+def _sharded_chaos_config(workdir: str, corrupt: str = ""):
+    from elasticdl_tpu.chaos.harness import ChaosJobConfig
+    from elasticdl_tpu.chaos.plan import named_plan
+
+    return ChaosJobConfig(
+        plan=named_plan("slice_loss_mid_epoch", 2),
+        workdir=workdir,
+        model_def=DEEPFM_DEF,
+        dataset="frappe",
+        num_records=256,
+        num_epochs=2,
+        num_workers=2,
+        num_slices=2,
+        # coarser than the replication cadence: a disk-only restore
+        # could not land at the step pushed before the slice died
+        checkpoint_steps=4,
+        replication=True,
+        corrupt=corrupt,
+        run_timeout_secs=300.0,
+    )
+
+
+def _check_sharded_elasticity() -> int:
+    import tempfile
+
+    from elasticdl_tpu.chaos.harness import run_chaos_job
+    from elasticdl_tpu.telemetry.events import (
+        EVENT_REPLICA_RESTORE,
+        EVENTS_FILENAME,
+        read_jsonl,
+    )
+    from elasticdl_tpu.telemetry.tracing import (
+        SPAN_COMPILE,
+        SPAN_MESH_RESIZE,
+        SPANS_FILENAME,
+        read_spans,
+    )
+
+    with tempfile.TemporaryDirectory() as workdir:
+        chaos_dir = os.path.join(workdir, "chaos")
+        report = run_chaos_job(_sharded_chaos_config(chaos_dir))
+        failed = [
+            i["name"] for i in report["invariants"] if i["status"] != "PASS"
+        ]
+        if not report["invariants_ok"] or failed:
+            print(
+                f"embedding_smoke: invariants failed on the sharded job: "
+                f"{failed} (rc={report.get('rc')}, "
+                f"timed_out={report.get('timed_out')})",
+                file=sys.stderr,
+            )
+            return 1
+        names = [i["name"] for i in report["invariants"]]
+        for required in (
+            "cross_slice_replica_coverage",
+            "replication_no_lost_steps",
+        ):
+            if required not in names:
+                print(
+                    f"embedding_smoke: {required} missing from the report",
+                    file=sys.stderr,
+                )
+                return 1
+        telemetry = os.path.join(chaos_dir, "telemetry")
+        events = read_jsonl(os.path.join(telemetry, EVENTS_FILENAME))
+        restores = [
+            e
+            for e in events
+            if e.get("event") == EVENT_REPLICA_RESTORE
+            and int(e.get("sharded_rows", 0) or 0) > 0
+        ]
+        if not restores:
+            print(
+                "embedding_smoke: no replica_restore event restored "
+                "sharded table rows — the table did not survive the "
+                "slice loss through checkpoint parts",
+                file=sys.stderr,
+            )
+            return 1
+        spans = read_spans(os.path.join(telemetry, SPANS_FILENAME))
+        shrunk = [
+            s
+            for s in spans
+            if s.get("span") == SPAN_MESH_RESIZE
+            and (s.get("new_slices") or 0) < (s.get("old_slices") or 0)
+        ]
+        if not shrunk:
+            print(
+                "embedding_smoke: no shrinking mesh_resize span — the "
+                "slice loss did not re-shard the table over a smaller "
+                "world",
+                file=sys.stderr,
+            )
+            return 1
+        # re-sharding must ride the normal reform compile: the reformed
+        # (smaller) generation may not compile MORE programs than the
+        # full-size generation 0 did
+        boundary = shrunk[0].get("start") or 0.0
+        compiles = [s for s in spans if s.get("span") == SPAN_COMPILE]
+        gen0 = [s for s in compiles if (s.get("start") or 0.0) < boundary]
+        gen1 = [s for s in compiles if (s.get("start") or 0.0) >= boundary]
+        if not gen0 or len(gen1) > len(gen0):
+            print(
+                f"embedding_smoke: compile storm across the resize — "
+                f"{len(gen0)} compiles before vs {len(gen1)} after",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            "embedding_smoke: sharded elasticity OK (restored "
+            f"{restores[0].get('sharded_rows')} sharded rows across "
+            f"{shrunk[0].get('old_slices')}s->{shrunk[0].get('new_slices')}s; "
+            f"compiles {len(gen0)} -> {len(gen1)})"
+        )
+    return 0
+
+
+def _check_corrupt_trips() -> int:
+    import tempfile
+
+    from elasticdl_tpu.chaos.harness import run_chaos_job
+
+    with tempfile.TemporaryDirectory() as workdir:
+        report = run_chaos_job(
+            _sharded_chaos_config(
+                os.path.join(workdir, "chaos"), corrupt="drop_shard_parts"
+            )
+        )
+        if report["invariants_ok"]:
+            print(
+                "embedding_smoke: drop_shard_parts corruption passed the "
+                "invariants — the sharded coverage checker is vacuous",
+                file=sys.stderr,
+            )
+            return 1
+        tripped = [
+            i
+            for i in report["invariants"]
+            if i["status"] == "FAIL"
+            and i["name"]
+            in (
+                "cross_slice_replica_coverage",
+                "replication_no_lost_steps",
+            )
+        ]
+        if not tripped:
+            failed = [
+                i["name"]
+                for i in report["invariants"]
+                if i["status"] != "PASS"
+            ]
+            print(
+                "embedding_smoke: corruption tripped the wrong "
+                f"invariant(s): {failed}",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            "embedding_smoke: drop_shard_parts correctly tripped "
+            f"{[i['name'] for i in tripped]}"
+        )
+    return 0
+
+
+def _check_spill_tier() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from elasticdl_tpu import embeddings as emb
+    from elasticdl_tpu.layers.embedding import safe_embedding_lookup_sparse
+    from elasticdl_tpu.telemetry import compile_tracker
+    from elasticdl_tpu.telemetry import memory as memory_ledger
+    from elasticdl_tpu.telemetry.events import EVENT_EMBEDDING_GATHER
+
+    rows, dim, capacity, hosts = 1 << 20, 8, 2048, 2
+    table_bytes = rows * dim * 4
+    # force the admission decision: a budget the table cannot fit
+    os.environ[emb.DEVICE_BUDGET_ENV] = str(table_bytes // 4)
+    try:
+        placement = emb.plan_placement(table_bytes, name="smoke_table")
+    finally:
+        os.environ.pop(emb.DEVICE_BUDGET_ENV, None)
+    if placement.tier != "spill":
+        print(
+            f"embedding_smoke: expected spill admission, got "
+            f"{placement.tier} ({placement.reason})",
+            file=sys.stderr,
+        )
+        return 1
+
+    table = emb.ShardedHostTable("smoke_table", rows, dim, num_hosts=hosts)
+    gathers = []
+    rt = emb.SpillEmbeddingRuntime(
+        {"emb/embedding": table},
+        capacity=capacity,
+        emit=lambda ev, **f: gathers.append((ev, f)),
+    )
+    try:
+        ledger = memory_ledger.MemoryLedger().sample()["components"]
+        if ledger.get(memory_ledger.COMPONENT_EMBEDDING_SPILL) != table_bytes:
+            print(
+                f"embedding_smoke: ledger embedding_spill = "
+                f"{ledger.get(memory_ledger.COMPONENT_EMBEDDING_SPILL)} "
+                f"!= {table_bytes}",
+                file=sys.stderr,
+            )
+            return 1
+        exposition = emb.metrics_registry().exposition()
+        if (
+            "elasticdl_embedding_bytes" not in exposition
+            or 'table="smoke_table"' not in exposition
+        ):
+            print(
+                "embedding_smoke: elasticdl_embedding_bytes gauge missing "
+                "for smoke_table",
+                file=sys.stderr,
+            )
+            return 1
+
+        tx = optax.sgd(0.3)
+
+        def loss_fn(p, ids):
+            out = safe_embedding_lookup_sparse(
+                p["emb"]["embedding"], ids, combiner="mean"
+            )
+            return (out * out).sum()
+
+        @jax.jit
+        def step(p, o, ids):
+            g = jax.grad(loss_fn)(p, ids)
+            updates, o = tx.update(g, o, p)
+            return optax.apply_updates(p, updates), o
+
+        rng = np.random.RandomState(11)
+        batches = [
+            rng.randint(0, rows, size=(8, 16)).astype(np.int32)
+            for _ in range(3)
+        ]
+        base = rt.minitable_params({"emb": {"embedding": None}})
+        opt = tx.init(base)
+        compile_tracker.install()
+        compiles0 = compile_tracker.compile_count()
+        for ids in batches:
+            staged, remapped, handle = rt.stage(base, ids)
+            new_p, opt = step(staged, opt, jnp.asarray(remapped))
+            rt.commit(new_p, handle)
+        spill_compiles = compile_tracker.compile_count() - compiles0
+        if spill_compiles != 1:
+            print(
+                f"embedding_smoke: spill loop compiled {spill_compiles} "
+                "programs, expected exactly 1 (fixed minitable shapes)",
+                file=sys.stderr,
+            )
+            return 1
+        gather_events = [g for g in gathers if g[0] == EVENT_EMBEDDING_GATHER]
+        if len(gather_events) != len(batches) or rt.gathers != len(batches):
+            print(
+                f"embedding_smoke: {len(gather_events)} embedding_gather "
+                f"events / {rt.gathers} gathers for {len(batches)} batches",
+                file=sys.stderr,
+            )
+            return 1
+
+        # dense full-table reference over the SAME 1M-row id space: the
+        # spill loop must land every touched row exactly where dense
+        # SGD lands it (a fresh jit — compiled after the flatness gate)
+        init_rows = emb.ShardedHostTable(
+            "smoke_ref", rows, dim, num_hosts=hosts
+        )
+        try:
+            dense_p = {
+                "emb": {
+                    "embedding": jnp.asarray(
+                        init_rows.gather(np.arange(rows))
+                    )
+                }
+            }
+            dense_o = tx.init(dense_p)
+            for ids in batches:
+                dense_p, dense_o = step(dense_p, dense_o, jnp.asarray(ids))
+            touched = np.unique(np.concatenate([b.ravel() for b in batches]))
+            got = table.gather(touched)
+            want = np.asarray(dense_p["emb"]["embedding"])[touched]
+            if not np.allclose(got, want, rtol=1e-5, atol=1e-6):
+                print(
+                    "embedding_smoke: spill-trained rows diverge from "
+                    "dense full-table SGD",
+                    file=sys.stderr,
+                )
+                return 1
+        finally:
+            init_rows.close()
+        print(
+            f"embedding_smoke: spill tier OK ({rows} rows x {hosts} hosts "
+            f"= {table_bytes >> 20}MiB host-resident, {len(batches)} "
+            f"steps, 1 compile, parity on {touched.size} touched rows)"
+        )
+    finally:
+        rt.close()
+    return 0
+
+
+def main() -> int:
+    for gate in (
+        _check_spill_tier,
+        _check_sharded_elasticity,
+        _check_corrupt_trips,
+    ):
+        rc = gate()
+        if rc:
+            return rc
+    print("embedding_smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

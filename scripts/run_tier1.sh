@@ -37,6 +37,13 @@
 # per heartbeat, sweep/fence latency, journal bytes/event, /metrics
 # scrape + series cardinality], the event log must be seed-deterministic,
 # and seeded corruptions must exit 1)
+# + embedding smoke (sharded embedding subsystem end to end: a >=1M-row
+# host-spill table trains through the stage->jitted-step->commit loop
+# with ONE compile and dense-SGD parity under ledger/gauge accounting, a
+# 2-process row-sharded deepfm job survives slice_loss_mid_epoch with
+# its table rows restored from checkpoint parts and no compile storm,
+# and the drop_shard_parts corruption must TRIP the sharded coverage
+# invariants)
 # + memory smoke (component-level byte ledger end to end: a real
 # LocalExecutor run must report per-component bytes with peak >=
 # current and the unaccounted-vs-RSS residual under budget, a serving
@@ -71,4 +78,5 @@ timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/multislice_smoke.py || ex
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/serving_smoke.py || exit 1
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/fleetsim_smoke.py || exit 1
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/memory_smoke.py || exit 1
+timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/embedding_smoke.py || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

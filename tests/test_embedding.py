@@ -174,3 +174,60 @@ def test_sharded_embedding_trains_on_mesh():
         trainer.place_batch(feats), trainer.place_batch(labels)
     )
     assert float(m2["loss"]) < float(m["loss"]) + 1.0
+
+
+def test_out_of_vocab_id_zero_gradient(table):
+    """Falsification of the clip bug: under jit ``jnp.take`` CLIPS an
+    out-of-vocab id onto the LAST table row — without the upper-bound
+    mask it would join the combine AND receive gradient, silently
+    corrupting that row.  An out-of-range id (either direction) must
+    contribute exactly zero output and exactly zero gradient, the PR-5
+    mask contract extended to the upper bound."""
+    rows = np.asarray(table).shape[0]
+    ids = jnp.array([[1, rows, rows + 83]])  # one-past and far out
+
+    def loss(t):
+        return safe_embedding_lookup_sparse(t, ids, combiner="sum").sum()
+
+    g = np.asarray(jax.jit(jax.grad(loss))(table))
+    assert np.all(g[1] == 1.0)
+    assert np.all(np.delete(g, [1], axis=0) == 0.0)  # esp. the last row
+    # the combine excluded the OOV ids from value AND denominator
+    for combiner in ("sum", "mean", "sqrtn"):
+        out = jax.jit(
+            lambda t: safe_embedding_lookup_sparse(t, ids, combiner=combiner)
+        )(table)
+        np.testing.assert_allclose(
+            out[0], np.asarray(table)[1], rtol=1e-6
+        )
+
+
+def test_dense_lookup_out_of_range_zeros_and_zero_gradient(table):
+    rows = np.asarray(table).shape[0]
+    ids = jnp.array([0, rows, rows + 7])
+    out = jax.jit(lambda t: embedding_lookup(t, ids))(table)
+    np.testing.assert_allclose(out[0], np.asarray(table)[0], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out[1:]), 0.0)
+    g = np.asarray(
+        jax.jit(jax.grad(lambda t: embedding_lookup(t, ids).sum()))(table)
+    )
+    assert np.all(g[0] == 1.0)
+    assert np.all(g[1:] == 0.0)  # the clip target (last row) included
+
+
+def test_vocab_pad_multiple_allocates_padded_table():
+    model = SparseEmbedding(
+        input_dim=5383, output_dim=4, vocab_pad_multiple=128
+    )
+    assert model.padded_input_dim == 5504
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 3), jnp.int32))
+    assert params["params"]["embedding"].shape == (5504, 4)
+    # padded rows are never looked up -> zero gradient on them
+    ids = jnp.array([[5382, -1, -1]])
+
+    def loss(p):
+        return model.apply(p, ids).sum()
+
+    g = np.asarray(jax.grad(loss)(params)["params"]["embedding"])
+    assert np.all(g[5383:] == 0.0)
+    assert np.any(g[5382] != 0.0)

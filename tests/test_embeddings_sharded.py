@@ -1,0 +1,450 @@
+"""Sharded embedding subsystem: row ranges, dp-fallback rules, spill
+tier, ledger accounting, sharded replica invariants, sharded serving.
+
+The shard-placement tests PIN the uneven-split layout (vocab not
+divisible by host count, n_hosts 1/2/3) and round-trip parity against
+the dense layer's outputs; the chaos-invariant tests drive the pure
+checkers with synthetic events — including the ``drop_shard_parts``
+signature (has_sharded with zero rows) they must trip on; the serving
+test proves a row-sharded table serves and hot-swaps with a flat
+compile counter on the virtual 8-device mesh."""
+
+import argparse
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu import embeddings as emb
+from elasticdl_tpu.layers.embedding import safe_embedding_lookup_sparse
+from elasticdl_tpu.parallel.mesh import MeshConfig
+from elasticdl_tpu.telemetry import memory as memory_ledger
+from elasticdl_tpu.utils.constants import MeshAxis
+
+DEEPFM_DEF = "deepfm_sharded_embedding.deepfm_sharded_embedding.custom_model"
+
+
+# ---- row partitioning --------------------------------------------------------
+
+
+def test_shard_row_ranges_uneven_pinned():
+    # np.array_split semantics: the first (rows % hosts) shards carry
+    # one extra row — pinned so host-tier ownership can never drift
+    # from checkpoint-part ownership
+    assert emb.shard_row_ranges(10, 1) == [(0, 10)]
+    assert emb.shard_row_ranges(10, 2) == [(0, 5), (5, 10)]
+    assert emb.shard_row_ranges(10, 3) == [(0, 4), (4, 7), (7, 10)]
+    assert emb.shard_row_ranges(5383, 2) == [(0, 2692), (2692, 5383)]
+    assert emb.shard_row_ranges(5383, 3) == [
+        (0, 1795),
+        (1795, 3589),
+        (3589, 5383),
+    ]
+    # contiguous cover, no gaps/overlap, for every tested layout
+    for rows in (1, 7, 5383):
+        for hosts in (1, 2, 3):
+            ranges = emb.shard_row_ranges(rows, hosts)
+            assert ranges[0][0] == 0 and ranges[-1][1] == rows
+            for (_, hi), (lo2, _) in zip(ranges, ranges[1:]):
+                assert hi == lo2
+    with pytest.raises(ValueError):
+        emb.shard_row_ranges(10, 0)
+
+
+def test_owning_shard():
+    ranges = emb.shard_row_ranges(10, 3)
+    assert [emb.owning_shard(r, ranges) for r in (0, 3, 4, 6, 7, 9)] == [
+        0, 0, 1, 1, 2, 2,
+    ]
+    with pytest.raises(ValueError):
+        emb.owning_shard(10, ranges)
+
+
+# ---- axis selection and rules ------------------------------------------------
+
+
+def test_embedding_axis_prefers_dedicated_then_falls_back_to_dp():
+    devs = jax.devices("cpu")[:8]
+    ep_mesh = MeshConfig.from_string("dp=2,ep=4").create(devs)
+    assert emb.embedding_axis(ep_mesh) == MeshAxis.EP
+    tp_mesh = MeshConfig.from_string("dp=2,tp=4").create(devs)
+    assert emb.embedding_axis(tp_mesh) == MeshAxis.TP
+    # pure-data-parallel world: the auto policy refuses dp, the
+    # DECLARED-sharded policy falls back to it (elasticity: dp is the
+    # one axis every re-formed world has)
+    dp_mesh = MeshConfig.from_string("dp=8").create(devs)
+    assert emb.embedding_axis(dp_mesh) == MeshAxis.DP
+    assert emb.embedding_axis(dp_mesh, allow_dp=False) is None
+    # divisibility gates the pick
+    assert emb.embedding_axis(dp_mesh, rows=1000) == MeshAxis.DP  # 1000%8!=0? no
+    assert emb.embedding_axis(dp_mesh, rows=1001) is None
+    single = MeshConfig.from_string("").create(devs[:1])
+    assert emb.embedding_axis(single) is None
+
+
+def test_sharded_table_rules_dp_fallback_and_skip():
+    devs = jax.devices("cpu")[:8]
+    mesh = MeshConfig.from_string("").create(devs)  # inferred dp=8
+    rules = emb.sharded_table_rules(
+        mesh, {"embedding/embedding": 5504, "id_bias/embedding": 5504}
+    )
+    assert len(rules) == 2
+    for rule in rules:
+        assert rule.spec == P(MeshAxis.DP, None)
+    assert rules[0].matches("embedding/embedding")
+    assert rules[0].matches("params/embedding/embedding")
+    assert not rules[0].matches("big_embedding/embedding")
+    # a vocab no axis divides is skipped (downstream replicates)
+    assert emb.sharded_table_rules(mesh, {"t/embedding": 5383}) == []
+
+
+# ---- host tier: parity, uneven splits, ledger --------------------------------
+
+
+@pytest.mark.parametrize("num_hosts", [1, 2, 3])
+def test_host_table_parity_vs_dense_layer(num_hosts):
+    """Uneven vocab (11 rows) split over 1/2/3 hosts: gather must equal
+    the dense table row-for-row, and a combiner lookup over rows staged
+    FROM the host tier must match the dense layer's output exactly."""
+    rng = np.random.RandomState(7)
+    vocab, dim = 11, 4
+    dense = rng.rand(vocab, dim).astype(np.float32)
+    table = emb.ShardedHostTable(
+        f"parity{num_hosts}", vocab, dim, num_hosts=num_hosts, rows=dense
+    )
+    try:
+        assert [s.shape[0] for s in table._shards] == [
+            hi - lo for lo, hi in emb.shard_row_ranges(vocab, num_hosts)
+        ]
+        ids = np.array([0, 10, 3, 7, 3])
+        np.testing.assert_array_equal(table.gather(ids), dense[ids])
+        # round-trip parity against the dense layer: stage the touched
+        # rows into a minitable and combine — same output as combining
+        # over the full dense table
+        batch = jnp.array([[1, 5, -1], [10, 0, 2]])
+        rt = emb.SpillEmbeddingRuntime(
+            {"t/embedding": table}, capacity=8, emit=lambda *a, **k: None
+        )
+        params = rt.minitable_params({"t": {"embedding": None}})
+        staged, remapped, handle = rt.stage(params, np.asarray(batch))
+        # negative sentinel ids pass through remapping untouched
+        np.testing.assert_array_equal(
+            np.asarray(remapped) < 0, np.asarray(batch) < 0
+        )
+        out_mini = safe_embedding_lookup_sparse(
+            jnp.asarray(staged["t"]["embedding"]),
+            jnp.asarray(remapped),
+            combiner="sum",
+        )
+        out_dense = safe_embedding_lookup_sparse(
+            jnp.asarray(dense), batch, combiner="sum"
+        )
+        np.testing.assert_allclose(out_mini, out_dense, rtol=1e-6)
+    finally:
+        table.close()
+
+
+def test_host_table_refuses_out_of_range_ids():
+    table = emb.ShardedHostTable("oob", 10, 2, num_hosts=2)
+    try:
+        with pytest.raises(ValueError):
+            table.gather(np.array([0, 10]))
+        with pytest.raises(ValueError):
+            table.scatter(np.array([-1]), np.zeros((1, 2), np.float32))
+    finally:
+        table.close()
+
+
+def test_ledger_components_and_identity_guarded_unregister():
+    table = emb.ShardedHostTable("ledgered", 100, 8, num_hosts=2)
+    sample = memory_ledger.MemoryLedger().sample()
+    assert sample["components"][
+        memory_ledger.COMPONENT_EMBEDDING_SPILL
+    ] == table.nbytes
+    # a replacement owner registers under the same component name;
+    # closing the STALE owner must leave the replacement alone (the
+    # identity guard)
+    replacement = lambda: 12345  # noqa: E731
+    memory_ledger.register_component(
+        memory_ledger.COMPONENT_EMBEDDING_SPILL, replacement
+    )
+    table.close()
+    sample2 = memory_ledger.MemoryLedger().sample()
+    assert sample2["components"][
+        memory_ledger.COMPONENT_EMBEDDING_SPILL
+    ] == 12345
+    memory_ledger.unregister_component(
+        memory_ledger.COMPONENT_EMBEDDING_SPILL, replacement
+    )
+    # device-tier tracking mirrors the same contract
+    emb.track_device_table("dev_t", lambda: 4096)
+    got = memory_ledger.MemoryLedger().sample()["components"]
+    assert got[memory_ledger.COMPONENT_EMBEDDING_TABLE] == 4096
+    emb.untrack_device_table("dev_t")
+    got2 = memory_ledger.MemoryLedger().sample()["components"]
+    assert memory_ledger.COMPONENT_EMBEDDING_TABLE not in got2
+
+
+# ---- tier admission ----------------------------------------------------------
+
+
+def test_plan_placement_tiers_and_admission_fault(monkeypatch):
+    monkeypatch.setenv(emb.DEVICE_BUDGET_ENV, str(1 << 20))
+    small = emb.plan_placement(1 << 10, name="small")
+    assert small.tier == "device"
+    big = emb.plan_placement(1 << 24, name="big")  # 16MB > 1MB budget
+    assert big.tier == "spill"
+    assert big.host_available_bytes is not None
+    events = []
+    with pytest.raises(emb.EmbeddingAdmissionError):
+        emb.plan_placement(
+            1 << 62,
+            name="monster",
+            emit=lambda ev, **fields: events.append((ev, fields)),
+        )
+    assert events and events[0][0] == "embedding_spill_fault"
+    assert events[0][1]["table"] == "monster"
+
+
+# ---- spill runtime: parity with dense training, compile-once -----------------
+
+
+def test_spill_runtime_trains_identically_to_dense_table():
+    """K SGD steps through the stage -> unchanged jitted step -> commit
+    loop must land the host table EXACTLY where dense full-table
+    training lands it, with ONE compile total (fixed minitable shapes).
+    Also pins id 0 -> slot 0 (np.unique sorts), the mask-zero seam."""
+    from elasticdl_tpu.telemetry import compile_tracker
+
+    vocab, dim, capacity = 50, 3, 32
+    rng = np.random.RandomState(3)
+    init = rng.rand(vocab, dim).astype(np.float32)
+    batches = [
+        rng.randint(0, vocab, size=(4, 5)).astype(np.int32) for _ in range(4)
+    ]
+    tx = optax.sgd(0.5)
+
+    def loss_fn(p, ids):
+        out = safe_embedding_lookup_sparse(
+            p["emb"]["embedding"], ids, combiner="mean"
+        )
+        return (out * out).sum()
+
+    @jax.jit
+    def step(p, o, ids):
+        g = jax.grad(loss_fn)(p, ids)
+        updates, o = tx.update(g, o, p)
+        return optax.apply_updates(p, updates), o
+
+    # dense reference
+    dense_p = {"emb": {"embedding": jnp.asarray(init)}}
+    dense_o = tx.init(dense_p)
+    for ids in batches:
+        dense_p, dense_o = step(dense_p, dense_o, jnp.asarray(ids))
+
+    # spill path: same batches through minitable staging
+    table = emb.ShardedHostTable(
+        "train", vocab, dim, num_hosts=3, rows=init
+    )
+    rt = emb.SpillEmbeddingRuntime(
+        {"emb/embedding": table}, capacity=capacity,
+        emit=lambda *a, **k: None,
+    )
+    try:
+        base = rt.minitable_params({"emb": {"embedding": None}})
+        opt = tx.init(base)
+        compile_tracker.install()
+        compiles0 = compile_tracker.compile_count()
+        for ids in batches:
+            staged, remapped, handle = rt.stage(base, ids)
+            assert handle[0] == 0  # id 0 always staged, slot 0
+            new_p, opt = step(staged, opt, jnp.asarray(remapped))
+            rt.commit(new_p, handle)
+        assert compile_tracker.compile_count() - compiles0 == 1
+        np.testing.assert_allclose(
+            table.gather(np.arange(vocab)),
+            np.asarray(dense_p["emb"]["embedding"]),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+        assert rt.gathers == len(batches)
+    finally:
+        rt.close()
+
+
+def test_spill_runtime_capacity_overflow_raises():
+    table = emb.ShardedHostTable("cap", 100, 2, num_hosts=2)
+    rt = emb.SpillEmbeddingRuntime(
+        {"t/embedding": table}, capacity=4, emit=lambda *a, **k: None
+    )
+    try:
+        with pytest.raises(ValueError):
+            rt.stage(
+                rt.minitable_params({"t": {"embedding": None}}),
+                np.arange(10).reshape(1, 10),
+            )
+    finally:
+        rt.close()
+
+
+# ---- sharded replica invariants (pure checkers, synthetic events) ------------
+
+
+def _push(step, src, dst, src_slice, dst_slice, num_slices=2, **extra):
+    return {
+        "event": "replica_push",
+        "step": step,
+        "source": src,
+        "target": dst,
+        "source_slice": src_slice,
+        "target_slice": dst_slice,
+        "num_slices": num_slices,
+        "ok": True,
+        "monotonic": float(step),
+        **extra,
+    }
+
+
+def test_cross_slice_coverage_sharded_extension():
+    from elasticdl_tpu.chaos.harness import check_cross_slice_coverage
+
+    healthy = [
+        _push(2, 0, 1, 0, 1, has_sharded=True, sharded_tables=2,
+              sharded_rows=2752),
+        _push(2, 1, 0, 1, 0, has_sharded=True, sharded_tables=2,
+              sharded_rows=2752),
+    ]
+    assert check_cross_slice_coverage(healthy, 2) == []
+    # the drop_shard_parts signature: state HAS sharded tables, push
+    # carried zero rows — the shard's only replica holds no coverage
+    dropped = [
+        _push(2, 0, 1, 0, 1, has_sharded=True, sharded_tables=2,
+              sharded_rows=0),
+        _push(2, 1, 0, 1, 0, has_sharded=True, sharded_tables=2,
+              sharded_rows=2752),
+    ]
+    violations = check_cross_slice_coverage(dropped, 2)
+    assert len(violations) == 1 and "zero rows" in violations[0]
+    # dense-only states (no sharded tables) stay out of contract
+    dense_only = [_push(2, 0, 1, 0, 1, has_sharded=False, sharded_rows=0)]
+    assert check_cross_slice_coverage(dense_only, 2) == []
+
+
+def test_no_lost_steps_sharded_extension(tmp_path):
+    from elasticdl_tpu.chaos.harness import ChaosJobConfig, _check_no_lost_steps
+    from elasticdl_tpu.chaos.plan import FaultKind, named_plan
+
+    config = ChaosJobConfig(
+        plan=named_plan("preempt_one_worker", 2),
+        workdir=str(tmp_path),
+        replication=True,
+    )
+    fault_events = [{"kind": FaultKind.PREEMPT, "monotonic": 10.0}]
+
+    def restore(step, rows):
+        return {
+            "event": "replica_restore",
+            "step": step,
+            "sharded_rows": rows,
+            "monotonic": 11.0,
+        }
+
+    healthy = [
+        _push(4, 0, 1, 0, 0, num_slices=1, has_sharded=True,
+              sharded_rows=2752),
+        restore(4, 5504),
+    ]
+    ok = _check_no_lost_steps(config, healthy, fault_events)
+    assert ok["status"] == "PASS"
+    # restore applied the dense leaves but zero table rows
+    lost_rows = [
+        _push(4, 0, 1, 0, 0, num_slices=1, has_sharded=True,
+              sharded_rows=2752),
+        restore(4, 0),
+    ]
+    bad = _check_no_lost_steps(config, lost_rows, fault_events)
+    assert bad["status"] == "FAIL"
+    assert any("zero sharded table rows" in v for v in bad["violations"])
+    # pushes that never carried the rows in the first place
+    never_pushed = [
+        _push(4, 0, 1, 0, 0, num_slices=1, has_sharded=True,
+              sharded_rows=0),
+        restore(4, 0),
+    ]
+    bad2 = _check_no_lost_steps(config, never_pushed, fault_events)
+    assert bad2["status"] == "FAIL"
+    assert any("no replica to survive" in v for v in bad2["violations"])
+
+
+# ---- sharded serving: rule-placed tables, zero-recompile hot swap ------------
+
+
+def _export_deepfm(out_dir: str, version: int, scale: float = 1.0) -> str:
+    from elasticdl_tpu.trainer.state import TrainState, init_model
+    from elasticdl_tpu.trainer.step import resolve_optimizer
+    from elasticdl_tpu.utils.export_utils import export_model
+    from elasticdl_tpu.utils.model_utils import get_model_spec
+
+    spec = get_model_spec("", DEEPFM_DEF)
+    model = spec.build_model()
+    sample = {"feature": np.zeros((1, 10), np.int32)}
+    params, model_state = init_model(model, sample)
+    params = jax.tree_util.tree_map(lambda x: x * scale + 0.01, params)
+    state = TrainState.create(
+        model.apply, params, resolve_optimizer(spec.optimizer), model_state
+    )
+    state = state.replace(step=jnp.asarray(version, jnp.int32))
+    args = argparse.Namespace(
+        model_zoo="", model_def=DEEPFM_DEF, model_params_dict={}
+    )
+    return export_model(out_dir, state, spec, args)
+
+
+def test_serving_sharded_table_zero_recompile_hot_swap(tmp_path):
+    """The serving engine must place the declared tables ROW-SHARDED
+    over its mesh (a 100M-row table cannot materialize replicated per
+    device), answer lookups against them, and hot-swap to a new version
+    with the layout — and therefore the compiled program — unchanged."""
+    from elasticdl_tpu.serving.engine import ServingEngine
+    from elasticdl_tpu.telemetry import compile_tracker
+
+    v1 = _export_deepfm(str(tmp_path / "v1"), version=3)
+    v2 = _export_deepfm(str(tmp_path / "v2"), version=9, scale=2.0)
+    engine = ServingEngine(v1, canonical_rows=8)
+    rng = np.random.RandomState(0)
+    feats = {"feature": rng.randint(1, 5383, size=(5, 10)).astype(np.int32)}
+    out1 = engine.predict_rows(feats)["logits"]
+    assert out1.shape[0] == 5
+    # the table leaves are committed row-sharded over dp (the 8 virtual
+    # devices), per the model's sharding_rules — not replicated
+    for path in ("embedding", "id_bias"):
+        leaf = engine._state.params[path]["embedding"]
+        assert leaf.sharding.spec == P(MeshAxis.DP, None)
+        assert (
+            leaf.addressable_shards[0].data.shape[0]
+            == leaf.shape[0] // len(jax.devices())
+        )
+    compile_tracker.install()
+    flat0 = compile_tracker.compile_count()
+    accepted, version, reason = engine.swap_from_export(v2)
+    assert accepted and version == 9, reason
+    out2 = engine.predict_rows(feats)["logits"]
+    assert compile_tracker.compile_count() == flat0  # zero recompiles
+    assert not np.allclose(out1, out2)  # genuinely the new version
+    # sharded layout survived the swap treedef-preserving
+    leaf = engine._state.params["embedding"]["embedding"]
+    assert leaf.sharding.spec == P(MeshAxis.DP, None)
+
+
+def test_spill_metrics_gauge_registered():
+    # the one elasticdl_embedding_bytes registration site renders from
+    # the subsystem registry
+    emb.set_table_bytes("gauge_t", "spill", 777)
+    text = emb.metrics_registry().exposition()
+    assert "elasticdl_embedding_bytes" in text
+    assert 'table="gauge_t"' in text
+    emb.set_table_bytes("gauge_t", "spill", 0)
