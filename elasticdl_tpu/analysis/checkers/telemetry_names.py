@@ -71,6 +71,12 @@ REQUIRED_EVENT_NAMES = frozenset(
         # fault when neither tier has headroom
         "embedding_gather",
         "embedding_spill_fault",
+        # SLO watchdog plane (telemetry/slo.py + telemetry/incident.py):
+        # detector fire/clear transitions and the incident lifecycle
+        "slo_violation",
+        "slo_recovered",
+        "incident_open",
+        "incident_close",
     }
 )
 REQUIRED_SPAN_NAMES = frozenset(
@@ -92,6 +98,8 @@ REQUIRED_SPAN_NAMES = frozenset(
         "fleet_fault",
         # the XLA profiler capture window (flag-armed or on-demand)
         "profile_window",
+        # the SLO watchdog burn window: first bad evaluation -> fire
+        "slo_watch",
     }
 )
 REQUIRED_PHASE_NAMES = frozenset(
@@ -132,6 +140,13 @@ REQUIRED_METRIC_NAMES = frozenset(
         # sharded embedding subsystem: per-table resident bytes by tier
         # (table= / tier=device|spill)
         "elasticdl_embedding_bytes",
+        # SLO watchdog plane: per-objective detector state (objective= /
+        # window=fast|slow) and the incident counter — registered at one
+        # site each inside SLOEngine.mirror_metrics
+        "elasticdl_slo_violations_total",
+        "elasticdl_slo_objective_ok",
+        "elasticdl_slo_burn_rate",
+        "elasticdl_slo_incidents_total",
     }
 )
 

@@ -37,6 +37,9 @@ FLEET_INVARIANT_DESCRIPTIONS = {
     "bytes per event, /metrics scrape time and series cardinality",
     "determinism": "the same (plan, seed, world size) reproduced the "
     "same virtual event log (digest equality across runs)",
+    "slo_detection": "the SLO watchdog judged the run on the virtual "
+    "clock (burn-rate detectors evaluated every poll tick; the "
+    "mute_slo corruption — detectors silenced — must trip this)",
 }
 
 

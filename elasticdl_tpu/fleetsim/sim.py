@@ -44,8 +44,10 @@ from elasticdl_tpu.utils.merge import max_merge_counters
 # with a lost shard — note that merely skipping a dead worker's
 # recovery is NOT a corruption: the lease-timeout backstop reclaims it
 # and the job legitimately self-heals); series_flood lifts the /metrics
-# per-worker series cap (the cardinality budget must FAIL at n=1000)
-CORRUPTIONS = ("", "slow_sweep", "lost_task", "series_flood")
+# per-worker series cap (the cardinality budget must FAIL at n=1000);
+# mute_slo silences the SLO watchdog's detectors (the slo_detection
+# invariant must FAIL — a watchdog that never judges is not a watchdog)
+CORRUPTIONS = ("", "slow_sweep", "lost_task", "series_flood", "mute_slo")
 
 # default scaling budgets — generous enough for shared CI hardware,
 # tight enough that an O(world_size)-per-event regression at n=1000
@@ -91,10 +93,10 @@ class FleetConfig:
     max_virtual_secs: float = 600.0
     num_slices: int = 8
     journal_dir: str = ""  # "" = no journal (MASTER_KILL plans need one)
-    # backlog SLO for the REAL in-loop autoscaler (None = off).  Only
-    # the backlog trigger is wired: p95 step time derives from REAL
-    # wall clocks inside StepTimeTracker, and a real-time input would
-    # leak into the decision stream and break the determinism digest.
+    # backlog SLO for the REAL in-loop autoscaler (None = off).  The
+    # step-time tracker it shares with the SLO engine runs on the
+    # VirtualClock, so its p95 is virtual-time-derived and the decision
+    # stream stays deterministic.
     autoscale_backlog_tasks: int | None = 200
     corrupt: str = ""
     budgets: dict = field(default_factory=dict)
@@ -146,6 +148,7 @@ class FleetSimulator:
         self._fence_samples_ms: list[float] = []
         self._dead_detected = 0
         self._rehomes = 0
+        self._model_version = 0  # fleet-global, survives master kills
         self._scrape: dict = {}
         self._current_slices = config.num_slices
         self._autoscale_decisions: list[dict] = []
@@ -156,6 +159,24 @@ class FleetSimulator:
         )
         self.task_d = self._build_dispatcher()
         self.servicer = self._build_servicer(self.task_d)
+        # the SLO watchdog engine on the VirtualClock: the SAME
+        # detectors the production master ticks, fed exclusively with
+        # virtual-time-derived signals (step-time p95 from a virtual-
+        # clock tracker on the version-report channel, last_step_age
+        # from the virtual-clock servicer, outage rise from the
+        # synthetic monotone rpc counters) — a /proc read or wall-clock
+        # sample here would poison the deterministic digest.  Built
+        # before _attach_observers so the tracker rides the first
+        # servicer's version-report channel too.
+        from elasticdl_tpu.telemetry import slo as slo_mod
+        from elasticdl_tpu.telemetry.incident import IncidentManager
+
+        self.slo_engine = slo_mod.SLOEngine(
+            slo_mod.parse_slo_config("default"),
+            clock=self.clock,
+            incidents=IncidentManager(clock=self.clock),
+            arm_profiler=self._arm_profiler,
+        )
         self.journal = None
         if config.journal_dir:
             self._attach_journal(restored_callbacks=0, start=True)
@@ -164,9 +185,10 @@ class FleetSimulator:
         # _autoscale_tick: backlog in, decision out.  Decisions are
         # RECORDED (event log + telemetry), and the slice ledger tracks
         # them; growing the simulated fleet on a grant is a follow-up.
-        # The version-report tracker is deliberately NOT attached — its
-        # p95 derives from real wall clocks and would leak real time
-        # into the deterministic decision stream.
+        # The step-time tracker is the SLO engine's virtual-clock
+        # instance (one percentile definition site, one instance — the
+        # ROADMAP-5 virtual-time p95), so no real time can leak into
+        # the deterministic decision stream.
         self.autoscaler = None
         if config.autoscale_backlog_tasks is not None:
             from elasticdl_tpu.master.autoscaler import Autoscaler
@@ -175,6 +197,7 @@ class FleetSimulator:
                 backlog_tasks=config.autoscale_backlog_tasks,
                 min_slices=1,
                 max_slices=config.num_slices + 2,
+                tracker=self.slo_engine.tracker,
             )
         from elasticdl_tpu.telemetry.master_hooks import MasterTelemetry
 
@@ -182,6 +205,7 @@ class FleetSimulator:
             telemetry if telemetry is not None else MasterTelemetry("")
         )
         self.telemetry.attach(self.task_d, self.servicer)
+        self.telemetry.set_slo_engine(self.slo_engine)
 
         # ---- the PR-8 netem seam (virtual clock/sleep injected) --------
         server_faults = plan.network_server_faults()
@@ -254,6 +278,22 @@ class FleetSimulator:
         self.task_d.add_observer(self.checker)
         self.task_d.add_observer(_DigestObserver(self))
         self.servicer.add_version_observer(self.checker.on_version_report)
+        # the virtual-clock step-time tracker rides the version-report
+        # channel exactly as on the real master (re-attached to every
+        # post-restart servicer; the engine is built lazily below
+        # because the first attach happens mid-__init__)
+        engine = getattr(self, "slo_engine", None)
+        if engine is not None:
+            self.servicer.add_version_observer(engine.tracker.note_version)
+
+    def _arm_profiler(self, num_steps: int):
+        """The violation auto-arm path at fleet scale: a real
+        request_profile against the virtual-clock servicer (workers see
+        the command ride their next HeartbeatResponse; re-arms within
+        the TTL are absorbed, all on virtual time)."""
+        self._invoke(
+            "request_profile", msg.RequestProfileRequest(num_steps=num_steps)
+        )
 
     def _attach_journal(self, restored_callbacks: int, start: bool):
         from elasticdl_tpu.master import journal as journal_mod
@@ -504,11 +544,19 @@ class FleetSimulator:
             "report_task_result",
             msg.ReportTaskResultRequest(task_id=task_id),
         )
-        worker.step += max(1, records // self.config.minibatch_size)
+        steps = max(1, records // self.config.minibatch_size)
+        worker.step += steps
+        # the version-report channel carries the GLOBAL model version
+        # (journal/telemetry/tracker all treat it as one monotone
+        # stream): every completed task advances the fleet-wide
+        # counter, exactly as optimizer steps advance the real model —
+        # a per-worker step here would interleave tiny incomparable
+        # versions and starve the step-time tracker of samples
+        self._model_version += steps
         self._invoke(
             "report_version",
             msg.ReportVersionRequest(
-                model_version=worker.step, worker_id=wid
+                model_version=self._model_version, worker_id=wid
             ),
         )
         self._schedule(self.clock.now() + 0.001, "pull", wid)
@@ -566,6 +614,28 @@ class FleetSimulator:
                         to_slices=decision["to_slices"],
                         reason=decision["reason"],
                         backlog=decision["backlog"],
+                    )
+            if self.config.corrupt != "mute_slo":
+                # the watchdog tick, on virtual time only (mute_slo
+                # skips it — the slo_detection invariant must notice)
+                from elasticdl_tpu.telemetry import slo as slo_mod
+
+                signals = {}
+                step_age = self.servicer.last_step_age_secs()
+                if step_age is not None:
+                    signals[slo_mod.SIGNAL_LAST_STEP_AGE_SECS] = step_age
+                signals[slo_mod.SIGNAL_RPC_OUTAGE_RISE] = (
+                    self.slo_engine.ingest_rpc_totals(
+                        self.servicer.rpc_stats_totals()
+                    )
+                )
+                for transition in self.slo_engine.evaluate(
+                    signals, now=self.clock.now()
+                ):
+                    self._log(
+                        "slo_" + transition["kind"],
+                        objective=transition["objective"],
+                        value=round(float(transition["value"]), 6),
                     )
             if self.journal is not None:
                 self.journal.maybe_snapshot()
@@ -839,6 +909,30 @@ class FleetSimulator:
             "rehomes": self._rehomes,
             "autoscale_decisions": list(self._autoscale_decisions),
             "scrape": dict(self._scrape),
+            "slo": self._slo_section(),
+        }
+
+    def _slo_section(self) -> dict:
+        """The watchdog's virtual-time verdict: evaluation count, the
+        measured virtual p95 (the ROADMAP-5 gate value), and the
+        transition/incident ledger."""
+        engine = self.slo_engine
+        incidents = engine.incidents
+        p95 = engine.tracker.p95_ms()
+        return {
+            "evaluations": engine.evaluations,
+            "p95_step_ms": round(p95, 3) if p95 is not None else None,
+            "p95_samples": engine.tracker.sample_count,
+            "violations": [
+                {
+                    "objective": t["objective"],
+                    "kind": t["kind"],
+                    "at": round(t["at"], 3),
+                }
+                for t in engine.transitions
+            ],
+            "incidents_total": incidents.total_count if incidents else 0,
+            "incidents_open": incidents.open_count if incidents else 0,
         }
 
     def build_result(self) -> dict:
@@ -912,6 +1006,23 @@ class FleetSimulator:
                 "name": "budget_compliance",
                 "status": "PASS" if not budget_violations else "FAIL",
                 "violations": budget_violations,
+            }
+        )
+
+        # the watchdog must have JUDGED the run: a detector plane that
+        # never evaluated (the mute_slo corruption, or a wiring
+        # regression that silently drops the tick) is a falsified gate
+        slo_violations = []
+        if self.slo_engine.evaluations == 0:
+            slo_violations.append(
+                "slo detectors never evaluated (muted or unwired): "
+                f"0 evaluations over {self.event_count} logged events"
+            )
+        invariants.append(
+            {
+                "name": "slo_detection",
+                "status": "PASS" if not slo_violations else "FAIL",
+                "violations": slo_violations,
             }
         )
 

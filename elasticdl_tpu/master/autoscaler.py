@@ -18,60 +18,22 @@ to an autoscaler-less build.
 
 from __future__ import annotations
 
-import threading
 import time
+
+from elasticdl_tpu.telemetry.slo import StepTimePercentileTracker
 
 DEFAULT_COOLDOWN_SECS = 30.0
 # shrink only when every configured SLO sits under this fraction of its
 # threshold (plus an empty backlog): hysteresis against flapping
 SHRINK_HEADROOM = 0.25
-# p95 window: enough samples to be a percentile, few enough to track a
-# regime change within a handful of tasks
-_WINDOW = 128
 
-
-class StepTimeTracker:
-    """Master-side step-time estimator riding the version-report channel.
-
-    The chief reports ``trainer.step`` after every task; consecutive
-    reports ``(t1, v1) -> (t2, v2)`` bound the mean per-step wall time of
-    the ``v2 - v1`` steps between them at ``(t2 - t1) / (v2 - v1)``.
-    Coarser than worker-side step spans, but master-local (no log reads
-    on the control path) and it tracks exactly the quantity the dp axis
-    changes: wall time per optimizer step."""
-
-    def __init__(self, window: int = _WINDOW):
-        self._lock = threading.Lock()
-        self._window = window
-        self._samples_ms: list[float] = []
-        self._last: tuple[float, int] | None = None
-
-    def note_version(self, worker_id: int, version: int):
-        now = time.monotonic()
-        with self._lock:
-            last = self._last
-            if last is not None and version > last[1]:
-                per_step_ms = (now - last[0]) * 1000.0 / (version - last[1])
-                self._samples_ms.append(per_step_ms)
-                if len(self._samples_ms) > self._window:
-                    del self._samples_ms[: -self._window]
-            if last is None or version >= last[1]:
-                self._last = (now, version)
-
-    def reset(self):
-        """A re-formation invalidates the baseline: the first report of
-        the new world would otherwise span the whole outage."""
-        with self._lock:
-            self._last = None
-            self._samples_ms.clear()
-
-    def p95_ms(self) -> float | None:
-        with self._lock:
-            samples = sorted(self._samples_ms)
-        if len(samples) < 4:
-            return None
-        idx = min(len(samples) - 1, int(round(0.95 * (len(samples) - 1))))
-        return samples[idx]
+# ONE percentile definition site: the tracker lives with the SLO engine
+# (telemetry/slo.py) so the autoscaler's grow/shrink evidence and the
+# watchdog's step-time objective can never disagree on what "p95 step
+# time" means.  The name stays exported here — the decision-stream pin
+# test holds the semantics byte-identical to the historical private
+# window.
+StepTimeTracker = StepTimePercentileTracker
 
 
 class Autoscaler:

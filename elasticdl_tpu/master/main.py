@@ -59,6 +59,13 @@ def build_master(args) -> Master:
             from elasticdl_tpu.telemetry.anatomy import STEP_ANATOMY_ENV
 
             envs.setdefault(STEP_ANATOMY_ENV, "1")
+        if getattr(args, "slo_config", None):
+            # the SLO watchdog evaluates in the master only, but the
+            # config follows the env-forwarding contract (never argv)
+            # so worker command lines stay byte-identical when off
+            from elasticdl_tpu.telemetry.slo import SLO_CONFIG_ENV
+
+            envs.setdefault(SLO_CONFIG_ENV, str(args.slo_config))
         if getattr(args, "device_prefetch", None):
             # device-path pipelining: same env-forwarding contract —
             # and because it changes the compiled step program (batch

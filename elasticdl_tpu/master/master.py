@@ -191,6 +191,37 @@ class Master:
         )
         self._telemetry_server = None
 
+        # ---- SLO watchdog plane (off by default: with --slo_config
+        # unset nothing below is constructed — no engine, no observer,
+        # no /healthz block — and behavior is byte-identical)
+        self.slo_engine = None
+        if getattr(args, "slo_config", None):
+            from elasticdl_tpu.telemetry import slo as slo_mod
+            from elasticdl_tpu.telemetry.incident import IncidentManager
+
+            incidents = IncidentManager(
+                telemetry_dir=getattr(args, "telemetry_dir", "") or "",
+                emit=self.telemetry.events.emit,
+                context_fn=self._slo_context,
+            )
+            self.slo_engine = slo_mod.install_if_enabled(
+                getattr(args, "slo_config", None),
+                emit=self.telemetry.events.emit,
+                tracer=self.telemetry.tracer,
+                arm_profiler=self._slo_arm_profiler,
+                incidents=incidents,
+            )
+            if self.autoscaler is not None:
+                # one percentile definition site AND one instance: the
+                # watchdog's step-time objective reads the tracker the
+                # autoscaler already feeds from version reports
+                self.slo_engine.tracker = self.autoscaler.tracker
+            else:
+                self.servicer.add_version_observer(
+                    self.slo_engine.tracker.note_version
+                )
+            self.telemetry.set_slo_engine(self.slo_engine)
+
         # ---- peer state replication (off by default: behavior and wire
         # payloads are then byte-identical to a replication-less build)
         self.replica_directory = None
@@ -646,6 +677,11 @@ class Master:
                     # REQUESTS a resize; the run loop (above, next tick)
                     # performs it through the same elective-reform path
                     self._autoscale_tick()
+                if self.slo_engine is not None and not dead:
+                    # SLO watchdog: judge the tick's signals through the
+                    # burn-rate detectors (violations emit, auto-arm the
+                    # profiler, and open incidents from inside evaluate)
+                    self._slo_tick()
                 if (
                     self.reform_events
                     and "latency_secs" not in self.reform_events[-1]
@@ -666,6 +702,12 @@ class Master:
                         self.telemetry.reform_latency(
                             event["cluster_version"], event["latency_secs"]
                         )
+                        if self.slo_engine is not None:
+                            # the downtime-budget objective sums these
+                            # over its slow window
+                            self.slo_engine.note_reform_downtime(
+                                event["latency_secs"]
+                            )
                 time.sleep(poll_secs)
         except KeyboardInterrupt:
             logger.warning("Interrupted; shutting down")
@@ -835,6 +877,10 @@ class Master:
             )
         if self.autoscaler is not None:
             self.autoscaler.note_reform()
+        if self.slo_engine is not None:
+            # same baseline-invalidation contract as the autoscaler
+            # (idempotent when they share the tracker)
+            self.slo_engine.note_reform()
         self.telemetry.reform_complete(
             new_version,
             old_world_size,
@@ -992,6 +1038,8 @@ class Master:
             im.stop_workers(grace_secs=0.0)
         if self.autoscaler is not None:
             self.autoscaler.note_reform()
+        if self.slo_engine is not None:
+            self.slo_engine.note_reform()
         self.telemetry.reform_complete(new_version, old_world_size, 0)
         self._record_world()
         logger.warning(
@@ -1031,6 +1079,57 @@ class Master:
             decision["reason"],
         )
         self.request_reform(f"autoscale:{decision['action']}")
+
+    # ---- SLO watchdog plumbing ----------------------------------------------
+
+    def _slo_context(self) -> dict:
+        """Correlatable state snapshotted at incident open/close: the
+        servicer's fleet-wide anatomy, memory, and rpc aggregates."""
+        return {
+            "anatomy": self.servicer.phase_stats_totals(),
+            "memory": self.servicer.memory_stats_totals(),
+            "rpc": self.servicer.rpc_stats_totals(),
+        }
+
+    def _slo_arm_profiler(self, num_steps: int):
+        """Violation hook: arm the PR-14 on-demand profiler for a
+        capture window (the servicer absorbs re-arms within the command
+        TTL, so repeated violations cannot storm the workers)."""
+        from elasticdl_tpu.rpc import messages as msg
+
+        response = self.servicer.request_profile(
+            msg.RequestProfileRequest(num_steps=num_steps)
+        )
+        if getattr(response, "accepted", False):
+            incidents = self.slo_engine.incidents
+            if incidents is not None:
+                incidents.note_profile_window(
+                    {"window_id": response.window_id}
+                )
+
+    def _slo_tick(self):
+        """Run-loop tick: derive this tick's signals from state the
+        master already holds and judge them through the detectors."""
+        from elasticdl_tpu.telemetry import slo as slo_mod
+        from elasticdl_tpu.telemetry.memory import host_memory_health
+
+        engine = self.slo_engine
+        signals: dict = {}
+        step_age = self.servicer.last_step_age_secs()
+        if step_age is not None:
+            signals[slo_mod.SIGNAL_LAST_STEP_AGE_SECS] = step_age
+        signals.update(
+            slo_mod.signals_from_phase_totals(
+                self.servicer.phase_stats_totals()
+            )
+        )
+        headroom = host_memory_health().get("headroom_share")
+        if headroom is not None:
+            signals[slo_mod.SIGNAL_MEMORY_HEADROOM_SHARE] = headroom
+        signals[slo_mod.SIGNAL_RPC_OUTAGE_RISE] = engine.ingest_rpc_totals(
+            self.servicer.rpc_stats_totals()
+        )
+        engine.evaluate(signals)
 
     def _stage_replica_restore(
         self, new_version: int, dead: list[int], old_world_size: int,

@@ -100,6 +100,15 @@ EVENT_MEMORY_PRESSURE = "memory_pressure"
 # re-place the table rather than walk the host into OOM
 EVENT_EMBEDDING_GATHER = "embedding_gather"
 EVENT_EMBEDDING_SPILL_FAULT = "embedding_spill_fault"
+# SLO watchdog plane (telemetry/slo.py + telemetry/incident.py): a
+# burn-rate detector fired (violation) / cleared through the
+# hysteresis band (recovered); an incident opened on the first
+# violation of an unhealthy episode / closed when every objective
+# recovered, pointing at the incidents/incident_<n>.json postmortem
+EVENT_SLO_VIOLATION = "slo_violation"
+EVENT_SLO_RECOVERED = "slo_recovered"
+EVENT_INCIDENT_OPEN = "incident_open"
+EVENT_INCIDENT_CLOSE = "incident_close"
 
 EVENTS_FILENAME = "events.jsonl"
 

@@ -1,0 +1,486 @@
+"""Incident postmortems for the SLO watchdog.
+
+When the SLO engine (:mod:`elasticdl_tpu.telemetry.slo`) fires, the
+interesting question is never "did we violate" — it is "what was
+happening around the violation".  This module owns that correlation:
+an :class:`IncidentManager` groups violations into incidents (one
+incident spans the whole unhealthy episode — a second objective firing
+while one is already open JOINS the open incident rather than opening
+another, which is how an injected regression produces exactly ONE
+incident), and at close time correlates events + spans + step anatomy
++ memory + rpc stats around the violation window into
+``incidents/incident_<n>.json``: a causal timeline plus a
+suspected-cause classification.
+
+The classification vocabulary is deliberately small — the five regimes
+an operator actually pages on:
+
+- ``input-bound``       the host fetch path grew; the device starved
+- ``compute-bound``     the device path itself slowed
+- ``network-degraded``  outage-class RPC counters rose
+- ``memory-pressure``   host/HBM headroom collapsed
+- ``control-plane``     reforms / master restarts / progress stalls
+
+Clocks are injectable like everywhere else in the watchdog: the master
+correlates against ``monotonic`` stamps in the on-disk event log; the
+fleet simulator runs the same manager with its ``VirtualClock`` and an
+empty telemetry dir (in-memory timeline only, no file I/O — nothing
+nondeterministic may ride the digest path)."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from elasticdl_tpu.telemetry import slo as slo_mod
+from elasticdl_tpu.telemetry.events import (
+    EVENT_INCIDENT_CLOSE,
+    EVENT_INCIDENT_OPEN,
+    EVENTS_FILENAME,
+    read_events,
+    read_jsonl,
+)
+
+INCIDENTS_DIRNAME = "incidents"
+
+CAUSE_INPUT_BOUND = "input-bound"
+CAUSE_COMPUTE_BOUND = "compute-bound"
+CAUSE_NETWORK_DEGRADED = "network-degraded"
+CAUSE_MEMORY_PRESSURE = "memory-pressure"
+CAUSE_CONTROL_PLANE = "control-plane"
+
+# events whose presence in the window marks control-plane churn
+_CONTROL_PLANE_EVENTS = frozenset(
+    {
+        "reform_start",
+        "reform_complete",
+        "reform_failed",
+        "master_restart",
+        "journal_replay",
+        "worker_rehome",
+        "slice_loss",
+        "mesh_resize",
+        "autoscale_decision",
+        "worker_dead",
+    }
+)
+
+# how far before the first bad evaluation the timeline reaches back —
+# the onset context (what changed just before the burn started)
+DEFAULT_LOOKBACK_SECS = 60.0
+# artifact bound: a pathological window must not produce a megabyte
+# timeline
+_TIMELINE_CAP = 400
+
+
+def _phase_ms(phase_totals: dict, phase: str) -> float:
+    try:
+        return float((phase_totals.get(phase) or {}).get("ms", 0.0))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def classify_cause(
+    violations: list[dict],
+    context_open: dict | None,
+    context_close: dict | None,
+    window_events: list[dict] | None = None,
+) -> tuple[str, str]:
+    """Pure classification: (suspected_cause, rationale).
+
+    Rule order encodes specificity — a memory or network signal is a
+    sharper diagnosis than "the step got slower", and control-plane
+    churn explains a stall better than anatomy shares do; only when
+    none of those hold do we split input- vs compute-bound on the
+    anatomy's phase growth across the incident window."""
+    signals = {v.get("signal") for v in violations}
+    if slo_mod.SIGNAL_MEMORY_HEADROOM_SHARE in signals:
+        return (
+            CAUSE_MEMORY_PRESSURE,
+            "memory headroom share violated its floor",
+        )
+    for event in window_events or []:
+        if event.get("event") == "memory_pressure":
+            return (
+                CAUSE_MEMORY_PRESSURE,
+                "memory_pressure events inside the violation window",
+            )
+    if slo_mod.SIGNAL_RPC_OUTAGE_RISE in signals:
+        return (
+            CAUSE_NETWORK_DEGRADED,
+            "outage-class rpc counters rose during the window",
+        )
+    open_rpc = (context_open or {}).get("rpc") or {}
+    close_rpc = (context_close or {}).get("rpc") or {}
+    if slo_mod.outage_total(close_rpc) > slo_mod.outage_total(open_rpc):
+        return (
+            CAUSE_NETWORK_DEGRADED,
+            "outage-class rpc counters rose across the incident",
+        )
+    control_events = sorted(
+        {
+            event.get("event")
+            for event in window_events or []
+            if event.get("event") in _CONTROL_PLANE_EVENTS
+        }
+    )
+    if control_events:
+        return (
+            CAUSE_CONTROL_PLANE,
+            "control-plane churn in the window: "
+            + ", ".join(str(e) for e in control_events),
+        )
+    if signals & {
+        slo_mod.SIGNAL_LAST_STEP_AGE_SECS,
+        slo_mod.SIGNAL_REFORM_DOWNTIME_SECS,
+    }:
+        return (
+            CAUSE_CONTROL_PLANE,
+            "progress stalled without matching anatomy/network/memory "
+            "signals",
+        )
+    # anatomy split: which side of the roofline grew across the
+    # incident?  Deltas when both snapshots carry phases; otherwise the
+    # close snapshot's absolute shares.
+    open_phases = (context_open or {}).get("anatomy") or {}
+    close_phases = (context_close or {}).get("anatomy") or {}
+    host = _phase_ms(close_phases, "host_fetch")
+    device = (
+        _phase_ms(close_phases, "assemble")
+        + _phase_ms(close_phases, "h2d_transfer")
+        + _phase_ms(close_phases, "device_compute")
+    )
+    if open_phases:
+        host -= _phase_ms(open_phases, "host_fetch")
+        device -= (
+            _phase_ms(open_phases, "assemble")
+            + _phase_ms(open_phases, "h2d_transfer")
+            + _phase_ms(open_phases, "device_compute")
+        )
+    if host >= device:
+        return (
+            CAUSE_INPUT_BOUND,
+            f"host_fetch grew {host:.1f}ms vs {device:.1f}ms on the "
+            "device path across the window",
+        )
+    return (
+        CAUSE_COMPUTE_BOUND,
+        f"device path grew {device:.1f}ms vs {host:.1f}ms host_fetch "
+        "across the window",
+    )
+
+
+class IncidentManager:
+    """Groups violations into incidents and writes the postmortems.
+
+    ``context_fn`` (optional) snapshots the master's correlatable state
+    — ``{"anatomy": phase_stats_totals, "memory": ..., "rpc": ...}`` —
+    at open and close; ``telemetry_dir`` locates the event/span logs
+    for the timeline (empty = in-memory only, the fleetsim mode)."""
+
+    def __init__(
+        self,
+        telemetry_dir: str = "",
+        emit=None,
+        clock=time.monotonic,
+        context_fn=None,
+        lookback_secs: float = DEFAULT_LOOKBACK_SECS,
+    ):
+        self._dir = telemetry_dir or ""
+        self._emit = emit
+        self._clock = clock
+        self._context_fn = context_fn
+        self._lookback_secs = float(lookback_secs)
+        self._seq = 0
+        self._open: dict | None = None
+        self.total_count = 0
+        self.closed: list[dict] = []
+
+    @property
+    def open_count(self) -> int:
+        return 1 if self._open is not None else 0
+
+    @property
+    def open_incident(self) -> dict | None:
+        return self._open
+
+    def _safe_emit(self, event: str, **fields):
+        if self._emit is None:
+            return
+        try:
+            self._emit(event, **fields)
+        except Exception:  # noqa: BLE001 — telemetry never raises
+            pass
+
+    def _snapshot_context(self) -> dict | None:
+        if self._context_fn is None:
+            return None
+        try:
+            return self._context_fn()
+        except Exception:  # noqa: BLE001 — a broken snapshot must not
+            # kill detection
+            return None
+
+    # ---- engine callbacks ---------------------------------------------------
+
+    def on_violation(self, transition: dict, now: float):
+        if self._open is not None:
+            # the episode is already open: this objective joins it
+            self._open["violations"].append(dict(transition))
+            return
+        self._seq += 1
+        self.total_count += 1
+        self._open = {
+            "incident": self._seq,
+            "opened_at": now,
+            "onset_at": transition.get("bad_since") or now,
+            "violations": [dict(transition)],
+            "recoveries": [],
+            "context_open": self._snapshot_context(),
+            "profile_windows": [],
+        }
+        self._safe_emit(
+            EVENT_INCIDENT_OPEN,
+            incident=self._seq,
+            objective=transition.get("objective"),
+            signal=transition.get("signal"),
+            value=transition.get("value"),
+        )
+
+    def on_recovery(self, transition: dict, now: float, all_clear: bool):
+        if self._open is None:
+            return
+        self._open["recoveries"].append(dict(transition))
+        if all_clear:
+            self._close(now)
+
+    def note_profile_window(self, window: dict | None):
+        """Attach an auto-armed profiler window ({"window_id", ...}) to
+        the open incident so the postmortem points at the capture."""
+        if self._open is not None and window:
+            self._open["profile_windows"].append(dict(window))
+
+    # ---- close + correlation ------------------------------------------------
+
+    def _window_records(
+        self, start: float, end: float
+    ) -> tuple[list[dict], list[dict]]:
+        """Events and spans whose monotonic stamps overlap the window.
+        File reads happen only here — at close, off every hot path —
+        and only when a telemetry dir exists."""
+        if not self._dir:
+            return [], []
+        events = []
+        try:
+            for record in read_events(
+                os.path.join(self._dir, EVENTS_FILENAME)
+            ):
+                t = record.get("monotonic")
+                if isinstance(t, (int, float)) and start <= t <= end:
+                    events.append(record)
+        except Exception:  # noqa: BLE001 — a torn log yields a thinner
+            # timeline, never a crash
+            pass
+        spans = []
+        try:
+            from elasticdl_tpu.telemetry.tracing import SPANS_FILENAME
+
+            for record in read_jsonl(
+                os.path.join(self._dir, SPANS_FILENAME)
+            ):
+                s, e = record.get("start"), record.get("end")
+                if (
+                    isinstance(s, (int, float))
+                    and isinstance(e, (int, float))
+                    and e >= start
+                    and s <= end
+                ):
+                    spans.append(record)
+        except Exception:  # noqa: BLE001
+            pass
+        return events, spans
+
+    def _build_timeline(
+        self,
+        incident: dict,
+        events: list[dict],
+        spans: list[dict],
+        closed_at: float,
+    ) -> list[dict]:
+        timeline: list[dict] = []
+        for violation in incident["violations"]:
+            timeline.append(
+                {
+                    "t": violation.get("at"),
+                    "kind": "slo",
+                    "name": "slo_violation",
+                    "detail": {
+                        "objective": violation.get("objective"),
+                        "signal": violation.get("signal"),
+                        "value": violation.get("value"),
+                        "threshold": violation.get("threshold"),
+                    },
+                }
+            )
+        for recovery in incident["recoveries"]:
+            timeline.append(
+                {
+                    "t": recovery.get("at"),
+                    "kind": "slo",
+                    "name": "slo_recovered",
+                    "detail": {"objective": recovery.get("objective")},
+                }
+            )
+        for window in incident["profile_windows"]:
+            timeline.append(
+                {
+                    "t": window.get("at", incident["opened_at"]),
+                    "kind": "profile",
+                    "name": "profile_window_armed",
+                    "detail": {"window_id": window.get("window_id")},
+                }
+            )
+        for record in events:
+            name = record.get("event")
+            if name in ("slo_violation", "slo_recovered"):
+                continue  # already represented from in-memory state
+            detail = {
+                k: v
+                for k, v in record.items()
+                if k not in ("time", "monotonic", "event")
+            }
+            timeline.append(
+                {
+                    "t": record.get("monotonic"),
+                    "kind": "event",
+                    "name": name,
+                    "detail": detail,
+                }
+            )
+        for record in spans:
+            timeline.append(
+                {
+                    "t": record.get("start"),
+                    "kind": "span",
+                    "name": record.get("name"),
+                    "detail": {
+                        "duration_secs": (
+                            record.get("end", 0) - record.get("start", 0)
+                        )
+                    },
+                }
+            )
+        timeline.sort(
+            key=lambda entry: (
+                entry["t"] if isinstance(entry["t"], (int, float)) else 0.0
+            )
+        )
+        if len(timeline) > _TIMELINE_CAP:
+            # keep the edges: onset context and the close are the
+            # causal story; the middle of a long burn is repetition
+            head = timeline[: _TIMELINE_CAP // 2]
+            tail = timeline[-(_TIMELINE_CAP - len(head)) :]
+            dropped = len(timeline) - len(head) - len(tail)
+            timeline = (
+                head
+                + [
+                    {
+                        "t": None,
+                        "kind": "elided",
+                        "name": "timeline_elided",
+                        "detail": {"dropped": dropped},
+                    }
+                ]
+                + tail
+            )
+        return timeline
+
+    def _close(self, now: float):
+        incident = self._open
+        self._open = None
+        if incident is None:
+            return
+        context_close = self._snapshot_context()
+        start = incident["onset_at"] - self._lookback_secs
+        events, spans = self._window_records(start, now)
+        cause, rationale = classify_cause(
+            incident["violations"],
+            incident["context_open"],
+            context_close,
+            events,
+        )
+        record = {
+            "incident": incident["incident"],
+            "opened_at": incident["opened_at"],
+            "onset_at": incident["onset_at"],
+            "closed_at": now,
+            "duration_secs": now - incident["onset_at"],
+            "objectives": sorted(
+                {
+                    v.get("objective")
+                    for v in incident["violations"]
+                    if v.get("objective")
+                }
+            ),
+            "violations": incident["violations"],
+            "recoveries": incident["recoveries"],
+            "suspected_cause": cause,
+            "rationale": rationale,
+            "profile_windows": incident["profile_windows"],
+            "context_open": incident["context_open"],
+            "context_close": context_close,
+            "timeline": self._build_timeline(incident, events, spans, now),
+        }
+        self.closed.append(record)
+        path = self._write_artifact(record)
+        self._safe_emit(
+            EVENT_INCIDENT_CLOSE,
+            incident=record["incident"],
+            suspected_cause=cause,
+            duration_secs=record["duration_secs"],
+            objectives=record["objectives"],
+            artifact=path or "",
+        )
+
+    def _write_artifact(self, record: dict) -> str | None:
+        if not self._dir:
+            return None
+        try:
+            incidents_dir = os.path.join(self._dir, INCIDENTS_DIRNAME)
+            os.makedirs(incidents_dir, exist_ok=True)
+            path = os.path.join(
+                incidents_dir, f"incident_{record['incident']}.json"
+            )
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(record, f, indent=1, sort_keys=True, default=str)
+            return path
+        except OSError:
+            return None
+
+
+def read_incidents(run_dir: str) -> list[dict]:
+    """All incident artifacts under ``run_dir`` (any depth — report
+    callers hand the run root, artifacts live under per-run
+    ``incidents/`` dirs), ordered by (path, incident number)."""
+    found: list[tuple[str, int, dict]] = []
+    for dirpath, _dirnames, filenames in os.walk(run_dir):
+        if os.path.basename(dirpath) != INCIDENTS_DIRNAME:
+            continue
+        for filename in sorted(filenames):
+            if not (
+                filename.startswith("incident_")
+                and filename.endswith(".json")
+            ):
+                continue
+            path = os.path.join(dirpath, filename)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    record = json.load(f)
+            except (OSError, ValueError):
+                continue
+            record["_path"] = os.path.relpath(path, run_dir)
+            found.append(
+                (dirpath, int(record.get("incident", 0)), record)
+            )
+    return [record for _d, _n, record in sorted(found, key=lambda x: x[:2])]

@@ -200,9 +200,18 @@ class MasterTelemetry:
         self._tb_service = None
         self._tb_mirrored_version = -1
         self._reform_span = None
+        # the SLO watchdog engine, when --slo_config armed one (set by
+        # the master via set_slo_engine; None = plane off, and every
+        # surface below skips it so behavior is byte-identical)
+        self.slo_engine = None
         r.add_collect_callback(self._collect)
 
     # ---- wiring ------------------------------------------------------------
+
+    def set_slo_engine(self, engine):
+        """Hook the armed SLO engine into the scrape mirror and the
+        /healthz ``slo`` block."""
+        self.slo_engine = engine
 
     def attach(self, task_dispatcher, servicer, tb_service=None):
         self._task_d = task_dispatcher
@@ -365,6 +374,11 @@ class MasterTelemetry:
                     "Background staging time overlapped with device "
                     "compute",
                 ).set_total(prefetch_totals.get("stage_ms", 0))
+        if self.slo_engine is not None:
+            # scrape-time mirror of the watchdog's detector state onto
+            # the elasticdl_slo_* families (registered inside the
+            # engine — the one registration site of each)
+            self.slo_engine.mirror_metrics(self.registry)
 
     def _collect_worker_ages(self):
         """Per-worker heartbeat-age series, cardinality-bounded.
@@ -512,7 +526,7 @@ class MasterTelemetry:
                     ).items()
                     if key not in (KEY_HOST_RSS, KEY_DEVICE_IN_USE)
                 )
-            return {
+            payload = {
                 "status": "quiescing" if quiescing else "ok",
                 "job_type": job_type,
                 "generation": servicer.cluster_version if servicer else 0,
@@ -532,6 +546,11 @@ class MasterTelemetry:
                 ),
                 "memory": memory,
             }
+            # the slo block appears only when the watchdog is armed —
+            # an unarmed master's payload stays byte-identical
+            if self.slo_engine is not None:
+                payload["slo"] = self.slo_engine.health_block()
+            return payload
 
         return health
 

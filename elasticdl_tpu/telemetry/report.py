@@ -232,6 +232,9 @@ def analyze_events(events: list[dict], faults: list[dict]) -> dict:
     memory = memory_section(events)
     if memory is not None:
         out["memory"] = memory
+    slo = slo_section(events)
+    if slo is not None:
+        out["slo"] = slo
     return out
 
 
@@ -799,6 +802,114 @@ def memory_section(events: list[dict]) -> dict | None:
     return out
 
 
+def slo_section(events: list[dict]) -> dict | None:
+    """SLO transition timeline from ``slo_violation`` /
+    ``slo_recovered`` events (telemetry/slo.py): every burn-rate
+    firing with the measured value vs its threshold, recovery count,
+    and which objectives were still firing when the log ended.  None
+    (key absent) when the run never fired, so watchdog-less reports
+    are unchanged."""
+    transitions = sorted(
+        (
+            e
+            for e in events
+            if e.get("event") in ("slo_violation", "slo_recovered")
+        ),
+        key=lambda e: e.get("monotonic", 0.0),
+    )
+    if not transitions:
+        return None
+    firing: dict[str, dict] = {}
+    violations = []
+    recoveries = 0
+    for event in transitions:
+        objective = str(event.get("objective"))
+        if event.get("event") == "slo_violation":
+            entry = {
+                "objective": objective,
+                "signal": event.get("signal"),
+                "value": event.get("value"),
+                "threshold": event.get("threshold"),
+                "burn_fast": event.get("burn_fast"),
+                "burn_slow": event.get("burn_slow"),
+                "monotonic": event.get("monotonic"),
+            }
+            violations.append(entry)
+            firing[objective] = entry
+        else:
+            recoveries += 1
+            firing.pop(objective, None)
+    return {
+        "violations": violations,
+        "recoveries": recoveries,
+        "still_firing": sorted(firing),
+    }
+
+
+def incidents_section(run_dir: str) -> dict | None:
+    """Postmortem digest from every ``incidents/incident_<n>.json``
+    under the run dir (telemetry/incident.py writes them at close).
+    The artifacts are the full causal record; this section carries the
+    operator's first-page view — cause, duration, objectives, where
+    the profiler captured — plus any incident the event log says is
+    STILL open (an ``incident_open`` without a matching close writes
+    no artifact).  None (key absent) when the run had no incidents."""
+    from elasticdl_tpu.telemetry.incident import read_incidents
+
+    incidents = read_incidents(run_dir)
+    entries = [
+        {
+            "incident": record.get("incident"),
+            "suspected_cause": record.get("suspected_cause"),
+            "rationale": record.get("rationale"),
+            "duration_secs": record.get("duration_secs"),
+            "objectives": record.get("objectives", []),
+            "violations": len(record.get("violations", [])),
+            "profile_windows": [
+                w.get("window_id")
+                for w in record.get("profile_windows", [])
+            ],
+            "timeline_entries": len(record.get("timeline", [])),
+            "artifact": record.get("_path"),
+        }
+        for record in incidents
+    ]
+    # still-open incidents never wrote an artifact — recover them from
+    # the event logs (open without close = the run ended unhealthy)
+    open_incidents = []
+    for path in _find_files(run_dir, EVENTS_FILENAME):
+        opens: dict = {}
+        for event in read_events(path):
+            if event.get("event") == "incident_open":
+                opens[event.get("incident")] = event
+            elif event.get("event") == "incident_close":
+                opens.pop(event.get("incident"), None)
+        for number, event in sorted(opens.items(), key=lambda x: str(x[0])):
+            open_incidents.append(
+                {
+                    "incident": number,
+                    "objective": event.get("objective"),
+                    "signal": event.get("signal"),
+                    "log": os.path.relpath(path, run_dir),
+                }
+            )
+    if not entries and not open_incidents:
+        return None
+    return {
+        "total": len(entries) + len(open_incidents),
+        "closed": entries,
+        "open": open_incidents,
+        "causes": {
+            cause: sum(
+                1 for e in entries if e["suspected_cause"] == cause
+            )
+            for cause in sorted(
+                {e["suspected_cause"] for e in entries if e["suspected_cause"]}
+            )
+        },
+    }
+
+
 def control_plane_section(run_dir: str) -> dict | None:
     """Control-plane scale: heartbeat fan-in shape, per-event master
     CPU, sweep/fence latency and scrape cost vs world size — read from
@@ -850,6 +961,9 @@ def build_report(run_dir: str) -> dict:
     control_plane = control_plane_section(run_dir)
     if control_plane is not None:
         report["control_plane"] = control_plane
+    incidents = incidents_section(run_dir)
+    if incidents is not None:
+        report["incidents"] = incidents
     return report
 
 
@@ -1189,6 +1303,26 @@ def _format_text(report: dict) -> str:
                         pressure.get("host_available_bytes"),
                     )
                 )
+        slo = run.get("slo")
+        if slo:
+            lines.append(
+                "slo: {} violation(s), {} recovery(ies){}".format(
+                    len(slo["violations"]),
+                    slo["recoveries"],
+                    "  STILL FIRING: " + ", ".join(slo["still_firing"])
+                    if slo["still_firing"]
+                    else "",
+                )
+            )
+            for violation in slo["violations"]:
+                lines.append(
+                    "  violated {}: {} = {} (threshold {})".format(
+                        violation["objective"],
+                        violation["signal"],
+                        violation["value"],
+                        violation["threshold"],
+                    )
+                )
         for worker, rate in run["records_per_sec_by_worker"].items():
             lines.append(f"throughput: worker {worker}: {rate:.1f} records/s")
         if run["worker_time_ms"]:
@@ -1197,7 +1331,121 @@ def _format_text(report: dict) -> str:
                 for name, total in sorted(run["worker_time_ms"].items())
             )
             lines.append(f"worker time buckets: {buckets}")
+    incidents = report.get("incidents")
+    if incidents:
+        lines.append(
+            "incidents: {} total ({} closed, {} still open)".format(
+                incidents["total"],
+                len(incidents["closed"]),
+                len(incidents["open"]),
+            )
+        )
+        for entry in incidents["closed"]:
+            windows = entry["profile_windows"]
+            lines.append(
+                "  incident {}: {} for {:.1f}s  objectives: {}  "
+                "profile windows: {}  [{}]".format(
+                    entry["incident"],
+                    entry["suspected_cause"],
+                    float(entry["duration_secs"] or 0.0),
+                    ", ".join(entry["objectives"]) or "n/a",
+                    ", ".join(str(w) for w in windows) if windows else "none",
+                    entry["artifact"],
+                )
+            )
+            lines.append(f"    rationale: {entry['rationale']}")
+        for entry in incidents["open"]:
+            lines.append(
+                "  incident {}: STILL OPEN (opened on {}, log {})".format(
+                    entry["incident"],
+                    entry["objective"],
+                    entry["log"],
+                )
+            )
     return "\n".join(lines)
+
+
+def summarize_report(report: dict) -> dict:
+    """Machine-readable digest of a full report (``--summary-json``):
+    a top-level ``verdict`` plus the counts CI actually branches on.
+    Pure over the report dict so tests drive it with canned reports.
+
+    Verdict ladder (worst wins): ``fail`` when any chaos/fleetsim
+    invariant failed, an incident is still open, or an SLO objective
+    was still firing at log end; ``degraded`` when incidents or SLO
+    violations occurred but everything recovered; ``no_data`` when
+    nothing produced a single event or artifact; ``ok`` otherwise."""
+    reasons = []
+    slo_violations = 0
+    slo_recoveries = 0
+    still_firing: list[str] = []
+    events_total = 0
+    for rel, run in report.get("runs", {}).items():
+        events_total += run.get("events_total", 0)
+        slo = run.get("slo")
+        if slo:
+            slo_violations += len(slo["violations"])
+            slo_recoveries += slo["recoveries"]
+            for objective in slo["still_firing"]:
+                still_firing.append(objective)
+                reasons.append(
+                    f"slo objective {objective} still firing ({rel})"
+                )
+    chaos = report.get("chaos_result")
+    if chaos is not None and not chaos.get("invariants_ok", True):
+        reasons.append("chaos invariants failed")
+    fleetsim_runs = (report.get("control_plane") or {}).get("runs", [])
+    for sim in fleetsim_runs:
+        if not sim.get("invariants_ok", True):
+            reasons.append(
+                f"fleetsim invariants failed ({sim.get('plan')})"
+            )
+    incidents = report.get("incidents") or {}
+    for entry in incidents.get("open", []):
+        reasons.append(f"incident {entry['incident']} still open")
+    if reasons:
+        verdict = "fail"
+    elif incidents.get("total") or slo_violations:
+        verdict = "degraded"
+        reasons.append(
+            "incidents/slo violations occurred but all recovered"
+        )
+    elif not report.get("runs") and chaos is None and not fleetsim_runs:
+        verdict = "no_data"
+        reasons.append("no telemetry, chaos, or fleetsim artifacts found")
+    else:
+        verdict = "ok"
+    return {
+        "verdict": verdict,
+        "reasons": reasons,
+        "run_dir": report.get("run_dir"),
+        "runs": len(report.get("runs", {})),
+        "events_total": events_total,
+        "slo": {
+            "violations": slo_violations,
+            "recoveries": slo_recoveries,
+            "still_firing": sorted(set(still_firing)),
+        },
+        "incidents": {
+            "total": incidents.get("total", 0),
+            "open": len(incidents.get("open", [])),
+            "causes": incidents.get("causes", {}),
+        },
+        "chaos": {
+            "plan": chaos.get("plan"),
+            "invariants_ok": chaos.get("invariants_ok"),
+        }
+        if chaos is not None
+        else None,
+        "fleetsim": [
+            {
+                "plan": sim.get("plan"),
+                "world_size": sim.get("world_size"),
+                "invariants_ok": sim.get("invariants_ok"),
+            }
+            for sim in fleetsim_runs
+        ],
+    }
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -1211,6 +1459,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument(
         "--output", default="", help="Also write the JSON report here"
+    )
+    parser.add_argument(
+        "--summary-json",
+        default="",
+        dest="summary_json",
+        help="Write a machine-readable digest (top-level verdict + the "
+        "counts CI branches on) to this path",
     )
     return parser
 
@@ -1229,9 +1484,16 @@ def main(argv=None) -> int:
         with open(args.output, "w", encoding="utf-8") as f:
             json.dump(report, f, indent=2, default=str)
             f.write("\n")
+    if args.summary_json:
+        with open(args.summary_json, "w", encoding="utf-8") as f:
+            json.dump(summarize_report(report), f, indent=2, default=str)
+            f.write("\n")
     # a run dir with no telemetry yet is a VALID state (job starting,
     # telemetry disabled), reported explicitly above — not an error.
     # Only a non-directory argument (rc 2, earlier) is caller misuse.
+    # The summary artifact carries the VERDICT; the process rc stays
+    # "did the report build", so watch pipelines can read severity
+    # without conflating it with tool failure.
     return 0
 
 

@@ -84,6 +84,7 @@ SPAN_STEP_ANATOMY = "step_anatomy"  # one dispatch phase (phase= attr)
 SPAN_SERVING_REQUEST = "serving_request"  # serving: one request (sampled)
 SPAN_MODEL_SWAP = "model_swap"  # serving: one hot model swap
 SPAN_FLEET_FAULT = "fleet_fault"  # fleetsim: one mass-fault injection
+SPAN_SLO_WATCH = "slo_watch"  # slo: burn window, first bad eval -> fire
 
 
 def gen_trace_id() -> str:

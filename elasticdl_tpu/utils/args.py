@@ -683,6 +683,23 @@ def _add_master_params(parser: argparse.ArgumentParser):
         ),
     )
     parser.add_argument(
+        "--slo_config",
+        default=None,
+        required=False,
+        help=(
+            "Arm the SLO watchdog plane: 'default' for the built-in "
+            "objectives, a path to a JSON objective file, or inline "
+            "JSON.  The master evaluates multi-window burn-rate "
+            "detectors over its telemetry each poll tick, emits "
+            "slo_violation events + elasticdl_slo_* metrics, flips the "
+            "/healthz slo block, auto-arms an on-demand profiler "
+            "window, and writes incidents/incident_<n>.json "
+            "postmortems under --telemetry_dir.  Unset (the default) "
+            "constructs nothing: worker argv and behavior are "
+            "byte-identical to a watchdog-less build"
+        ),
+    )
+    parser.add_argument(
         "--standby_workers",
         type=int,
         default=-1,
@@ -898,6 +915,10 @@ _MASTER_ONLY_FLAGS = frozenset(
         # device-path pipelining travels by
         # ELASTICDL_TPU_DEVICE_PREFETCH, same contract
         "device_prefetch",
+        # the SLO watchdog runs only in the master's run loop; the
+        # config travels by ELASTICDL_TPU_SLO_CONFIG (never argv) so
+        # worker command lines stay byte-identical when off
+        "slo_config",
     }
 )
 

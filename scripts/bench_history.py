@@ -1,0 +1,301 @@
+"""Bench-history trend table: fold every ``BENCH_r*.json`` /
+``SERVING_BENCH_r*.json`` round into one per-model view with deltas.
+
+::
+
+    python scripts/bench_history.py [--repo DIR] [--json]
+
+The per-round artifacts are append-only driver snapshots (``n``,
+``cmd``, ``rc``, ``tail``, ``parsed``) and come in three health states
+this script must not conflate:
+
+- ``ok``                 — ``parsed`` holds the bench result JSON;
+- ``device_unreachable`` — the bench ran but the device never answered
+  (``parsed.value`` null with an ``error``, r05-style): the round is
+  STAMPED in the table, never treated as a regression, and never used
+  as a comparison base;
+- ``recovered_from_tail`` — ``parsed`` is null because the result line
+  was truncated in the captured tail (r04-style): per-model numbers
+  are recovered by regex from the tail fragment, flagged as recovered.
+
+Deltas are computed against the LAST DEVICE-REACHED round before each
+round — comparing against an unreachable round would make the next
+healthy round look like an infinite speedup.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+# per-model throughput inside a (possibly truncated) result line:
+#   "mnist": {"samples_per_sec_per_chip": 93376.6, ...
+# also matches the e2e spelling ("mnist_e2e": {"e2e_samples_...")
+_MODEL_RE = re.compile(
+    r'"(\w+)":\s*\{\s*"(?:e2e_)?samples_per_sec_per_chip":\s*'
+    r"([0-9][0-9_.eE+-]*)"
+)
+# the headline metric when the front of the line survived
+_HEADLINE_RE = re.compile(
+    r'\{"metric":\s*"([^"]+)",\s*"value":\s*([0-9][0-9_.eE+-]*)'
+)
+
+
+def _round_number(filename: str) -> int:
+    match = re.search(r"_r(\d+)\.json$", filename)
+    return int(match.group(1)) if match else -1
+
+
+def _models_from_parsed(parsed: dict) -> dict[str, float]:
+    models = {}
+    for name, stats in (parsed.get("models") or {}).items():
+        value = stats.get("samples_per_sec_per_chip") or stats.get(
+            "e2e_samples_per_sec_per_chip"
+        )
+        if isinstance(value, (int, float)):
+            models[name] = float(value)
+    return models
+
+
+def _models_from_tail(tail: str) -> dict[str, float]:
+    """Regex recovery for a truncated result line: every per-model
+    ``samples_per_sec_per_chip`` fragment that survived in the tail."""
+    models = {}
+    for name, value in _MODEL_RE.findall(tail or ""):
+        try:
+            models[name] = float(value)
+        except ValueError:
+            continue
+    return models
+
+
+def load_round(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        raw = json.load(f)
+    entry = {
+        "round": raw.get("n", _round_number(os.path.basename(path))),
+        "file": os.path.basename(path),
+        "rc": raw.get("rc"),
+        "status": "ok",
+        "headline_metric": None,
+        "headline_value": None,
+        "vs_baseline": None,
+        "models": {},
+        "error": None,
+    }
+    parsed = raw.get("parsed")
+    tail = raw.get("tail") or ""
+    if isinstance(parsed, dict):
+        entry["headline_metric"] = parsed.get("metric")
+        entry["headline_value"] = parsed.get("value")
+        entry["vs_baseline"] = parsed.get("vs_baseline")
+        entry["models"] = _models_from_parsed(parsed)
+        if parsed.get("value") is None and parsed.get("error"):
+            entry["status"] = "device_unreachable"
+            entry["error"] = parsed["error"]
+    else:
+        # parsed is null: the driver captured a tail whose result line
+        # was truncated — recover what survived rather than dropping
+        # the whole round from the history
+        entry["models"] = _models_from_tail(tail)
+        headline = _HEADLINE_RE.search(tail)
+        if headline:
+            entry["headline_metric"] = headline.group(1)
+            entry["headline_value"] = float(headline.group(2))
+        if entry["models"] or entry["headline_value"] is not None:
+            entry["status"] = "recovered_from_tail"
+        else:
+            entry["status"] = "unparsable"
+    return entry
+
+
+def load_serving_round(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        raw = json.load(f)
+    points = [
+        {
+            "qps_target": p.get("qps_target"),
+            "qps_completed": p.get("qps_completed"),
+            "latency_p95_ms": (p.get("latency_ms") or {}).get("p95"),
+            "errors": p.get("errors"),
+        }
+        for p in raw.get("points", [])
+    ]
+    # headline = the highest offered-load point that completed cleanly
+    clean = [p for p in points if not p.get("errors")]
+    headline = max(
+        clean or points,
+        key=lambda p: p.get("qps_completed") or 0.0,
+        default=None,
+    )
+    return {
+        "round": _round_number(os.path.basename(path)),
+        "file": os.path.basename(path),
+        "status": "ok" if points else "unparsable",
+        "stamped_at": raw.get("stamped_at"),
+        "steady_state_recompiles": raw.get("steady_state_recompiles"),
+        "points": points,
+        "max_qps_completed": headline.get("qps_completed")
+        if headline
+        else None,
+        "latency_p95_ms_at_max": headline.get("latency_p95_ms")
+        if headline
+        else None,
+    }
+
+
+def _delta_pct(value: float | None, base: float | None) -> float | None:
+    if value is None or not base:
+        return None
+    return round((value - base) / base * 100.0, 1)
+
+
+def build_history(repo: str) -> dict:
+    """The full trend structure (pure over the artifact set — tests
+    point it at canned directories)."""
+    train = [
+        load_round(os.path.join(repo, name))
+        for name in sorted(os.listdir(repo))
+        if re.fullmatch(r"BENCH_r\d+\.json", name)
+    ]
+    train.sort(key=lambda e: e["round"])
+    serving = [
+        load_serving_round(os.path.join(repo, name))
+        for name in sorted(os.listdir(repo))
+        if re.fullmatch(r"SERVING_BENCH_r\d+\.json", name)
+    ]
+    serving.sort(key=lambda e: e["round"])
+
+    # deltas vs the last round where the device answered
+    last_reached = None
+    for entry in train:
+        if last_reached is not None:
+            entry["baseline_round"] = last_reached["round"]
+            entry["model_delta_pct"] = {
+                name: _delta_pct(value, last_reached["models"].get(name))
+                for name, value in entry["models"].items()
+            }
+            entry["headline_delta_pct"] = _delta_pct(
+                entry["headline_value"], last_reached["headline_value"]
+            )
+        if entry["status"] in ("ok", "recovered_from_tail"):
+            last_reached = entry
+    prev = None
+    for entry in serving:
+        if prev is not None:
+            entry["qps_delta_pct"] = _delta_pct(
+                entry["max_qps_completed"], prev["max_qps_completed"]
+            )
+        if entry["status"] == "ok":
+            prev = entry
+    model_names = sorted({m for e in train for m in e["models"]})
+    return {
+        "repo": repo,
+        "train_rounds": train,
+        "serving_rounds": serving,
+        "models": model_names,
+    }
+
+
+def _format_cell(entry: dict, model: str) -> str:
+    value = entry["models"].get(model)
+    if value is None:
+        return "-"
+    delta = (entry.get("model_delta_pct") or {}).get(model)
+    cell = f"{value:,.0f}"
+    if delta is not None:
+        cell += f" ({delta:+.1f}%)"
+    return cell
+
+
+def format_history(history: dict) -> str:
+    lines = []
+    train = history["train_rounds"]
+    if train:
+        lines.append("training bench history (samples/sec/chip):")
+        header = ["model"] + [f"r{e['round']:02d}" for e in train]
+        rows = [header]
+        for model in history["models"]:
+            rows.append(
+                [model] + [_format_cell(e, model) for e in train]
+            )
+        widths = [
+            max(len(row[col]) for row in rows)
+            for col in range(len(header))
+        ]
+        for row in rows:
+            lines.append(
+                "  "
+                + "  ".join(
+                    cell.rjust(width) if i else cell.ljust(width)
+                    for i, (cell, width) in enumerate(zip(row, widths))
+                )
+            )
+        for entry in train:
+            if entry["status"] == "device_unreachable":
+                lines.append(
+                    f"  r{entry['round']:02d}: DEVICE UNREACHABLE — "
+                    f"{entry['error']} (excluded from deltas)"
+                )
+            elif entry["status"] == "recovered_from_tail":
+                lines.append(
+                    f"  r{entry['round']:02d}: result line truncated; "
+                    f"{len(entry['models'])} model(s) recovered from "
+                    "the tail"
+                )
+            elif entry["status"] == "unparsable":
+                lines.append(
+                    f"  r{entry['round']:02d}: no result recovered"
+                )
+    serving = history["serving_rounds"]
+    if serving:
+        lines.append("serving bench history:")
+        for entry in serving:
+            delta = entry.get("qps_delta_pct")
+            lines.append(
+                "  r{:02d}: max {} qps completed, p95 {} ms at max load, "
+                "{} steady-state recompiles{}".format(
+                    entry["round"],
+                    entry["max_qps_completed"],
+                    entry["latency_p95_ms_at_max"],
+                    entry["steady_state_recompiles"],
+                    f"  ({delta:+.1f}% qps)" if delta is not None else "",
+                )
+            )
+    if not train and not serving:
+        lines.append("no BENCH_r*.json / SERVING_BENCH_r*.json found")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python scripts/bench_history.py",
+        description="Trend table over per-round bench artifacts",
+    )
+    parser.add_argument(
+        "--repo",
+        default=os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ),
+        help="Directory holding BENCH_r*.json (default: repo root)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="Emit the history as JSON"
+    )
+    args = parser.parse_args(argv)
+    if not os.path.isdir(args.repo):
+        print(f"not a directory: {args.repo}", file=sys.stderr)
+        return 2
+    history = build_history(args.repo)
+    if args.json:
+        print(json.dumps(history, indent=2, default=str))
+    else:
+        print(format_history(history))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

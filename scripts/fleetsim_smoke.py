@@ -13,9 +13,10 @@ journal, 1000 simulated workers on a virtual clock) and asserts:
 3. ``fleet_master_kill_fanin`` (master SIGKILL under full fan-in)
    PASSES with every surviving worker re-homed and the journal
    bytes-per-event budget measured;
-4. a seeded budget regression (``--corrupt slow_sweep``) and a seeded
-   accounting corruption (``--corrupt lost_task``) both FAIL — the
-   gates are falsifiable, not vacuous;
+4. a seeded budget regression (``--corrupt slow_sweep``), a seeded
+   accounting corruption (``--corrupt lost_task``), and a silenced SLO
+   watchdog (``--corrupt mute_slo``) all FAIL — the gates are
+   falsifiable, not vacuous;
 5. the /metrics per-worker series cardinality cap engaged at 1000
    workers (aggregate-above-threshold series, not 1000 gauges);
 6. ``telemetry.report`` surfaces the control-plane scale section from
@@ -101,6 +102,17 @@ def main() -> int:
             series = result["scale"]["scrape"]["worker_series"]
             if series > 8:
                 fail(f"per-worker series cap did not engage: {series}")
+            # the SLO watchdog judged the run on the virtual clock and
+            # the shared percentile tracker measured a fleet-scale p95
+            # (ROADMAP: virtual-time p95 gate at n=1000)
+            slo = result["scale"]["slo"]
+            if slo["evaluations"] <= 0:
+                fail("SLO watchdog never evaluated at fleet scale")
+            if slo["p95_samples"] < 4 or slo["p95_step_ms"] is None:
+                fail(
+                    "virtual-clock p95 unmeasured at 1000 workers: "
+                    f"{slo['p95_samples']} samples"
+                )
         if digests[0] != digests[1]:
             fail(
                 f"nondeterministic event log: {digests[0][:16]} != "
@@ -166,6 +178,7 @@ def main() -> int:
             ("slow_sweep", "budget_compliance"),
             ("lost_task", "exactly_once"),
             ("series_flood", "budget_compliance"),
+            ("mute_slo", "slo_detection"),
         ):
             workdir = os.path.join(tmp, f"corrupt_{corrupt}")
             os.makedirs(workdir)

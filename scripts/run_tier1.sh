@@ -53,6 +53,14 @@
 # [last-writer-wins, not a ratchet] under the series cardinality cap,
 # and an on-demand request_profile round trip must produce a loadable
 # capture + profile_window_* events with replays absorbed)
+# + slo smoke (SLO watchdog end to end: a real LocalExecutor run with
+# an injected input-pipeline regression must make the burn-rate
+# detector fire EXACTLY once, flip /healthz, auto-arm a real
+# request_profile capture, and close exactly one incident whose
+# postmortem attributes the injected phase [input-bound / host_fetch];
+# telemetry.report's machine summary must reach the degraded verdict,
+# and a mute_slo-corrupted fleetsim run must exit 1 with the
+# slo_detection invariant tripped)
 # + the ROADMAP.md test command, verbatim.
 # Run from the repo root: scripts/run_tier1.sh
 cd "$(dirname "$0")/.." || exit 2
@@ -79,4 +87,5 @@ timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/serving_smoke.py || exit 
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/fleetsim_smoke.py || exit 1
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/memory_smoke.py || exit 1
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/embedding_smoke.py || exit 1
+timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/slo_smoke.py || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,0 +1,604 @@
+#!/usr/bin/env python3
+"""Tier-1 SLO watchdog smoke (wired into scripts/run_tier1.sh).
+
+End-to-end falsifiable story for the watchdog plane (telemetry/slo.py +
+telemetry/incident.py): a REAL training run with an injected
+input-pipeline regression must be caught, attributed, profiled, and
+postmortemed — and a silenced watchdog must fail the fleet gate.
+
+1. **Injected regression** — a workdir model-zoo module re-exports the
+   builtin mnist spec but its ``dataset_fn`` sleeps per record over the
+   middle ~third of the stream; the single-threaded host pipeline
+   serializes the sleeps, so the instrumented LocalExecutor run's
+   ``step_anatomy`` events show ``host_fetch`` dominating exactly that
+   window (the injection seam is itself gated: healthy head for the
+   auto-baseline, slow middle, healthy tail for recovery).
+2. **Burn-rate verdict** — the SAME engine the master runs replays the
+   run's measured signals on an injectable clock (one heartbeat-cadence
+   tick per dispatch, the shared ``StepTimePercentileTracker`` fed from
+   the run's real step cadence): the step-time objective fires exactly
+   ONCE (multi-window burn + hysteresis — no flap on the healthy tail),
+   flips the ``/healthz`` ``slo`` block, auto-arms ``request_profile``
+   on a real MasterServicer, opens exactly ONE incident, and recovers
+   exactly once, closing it.
+3. **Postmortem artifact** — ``incidents/incident_1.json`` parses, its
+   ``suspected_cause`` is ``input-bound`` with ``host_fetch`` named in
+   the rationale (the injected phase, attributed from the anatomy
+   deltas across the incident window), and it points at the armed
+   profile window.
+4. **Auto-armed capture** — the armed window rides a real heartbeat
+   down, arms the worker-side ``StepProfiler`` through
+   ``apply_profile_command``, and a short jitted loop produces capture
+   artifacts + ``profile_window_open``/``close`` events for the SAME
+   window id the incident recorded; a replayed command is absorbed.
+5. **Report + falsification** — ``telemetry.report``'s machine summary
+   over the watchdog's event log reaches the ``degraded`` verdict (one
+   incident, recovered, input-bound), and a small-world fleetsim run
+   with ``--corrupt mute_slo`` (detectors silenced) exits 1 with the
+   ``slo_detection`` invariant FAILED — the gate is falsifiable both
+   ways.
+
+The disabled path (``--slo_config`` unset -> no engine, byte-identical
+argv/behavior) is pinned by tests/test_slo.py, not here.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "")
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# 36 one-step dispatches: 12 healthy (baseline), ~8 slow (burn), ~16
+# healthy (recovery) — the detector timeline below is derived from this
+NUM_RECORDS = 2304
+MINIBATCH = 64
+RECORDS_PER_TASK = 576
+# records (768, 1280] each sleep 100ms in the parse: ~6.4s/batch against
+# a sub-second healthy step, far past the 3x auto-baseline factor.  The
+# margin is deliberately wide: the incident's open->close anatomy delta
+# must stay host_fetch-dominated (input-bound) even when a loaded CI
+# host inflates the real device-compute times by an order of magnitude
+SLOW_AFTER_RECORDS = 768
+SLOW_UNTIL_RECORDS = 1280
+SLEEP_SECS = 0.100
+# a dispatch whose fetch wait exceeds this is "slow" (healthy fetches
+# are tens of ms; injected ones are seconds)
+SLOW_FETCH_MS = 1000.0
+# the replay evaluates once per dispatch on a virtual heartbeat cadence
+TICK_SECS = 10.0
+# short percentile window so the healthy tail evicts the burn and the
+# detector can watch the run RECOVER within 36 dispatches
+TRACKER_WINDOW = 8
+
+# the declarative config under test: one objective (step-time p95 vs a
+# learned baseline) so "exactly one violation" is exact, not modulo
+# which objectives happened to join
+SLO_CONFIG = {
+    "objectives": [
+        {
+            "name": "step_time_p95",
+            "signal": "step_time_p95_ms",
+            "comparator": "above",
+            "baseline_factor": 3.0,
+        }
+    ],
+    "profile_steps": 4,
+}
+
+ZOO_MODULE = '''\
+"""Mnist zoo module with a deterministic input-pipeline regression.
+
+Re-exports the builtin mnist spec but replaces ``dataset_fn`` with a
+parse that sleeps per record over a middle window of the stream.  No
+``batch_parse``/``shuffle``: the per-element path is lazy, so each
+sleep lands in the host fetch wait of the batch that consumes it
+(a shuffle buffer would front-load the whole window into one fetch).
+"""
+
+import time
+
+import numpy as np
+
+from elasticdl_tpu.data.reader import decode_example
+from elasticdl_tpu.models.mnist_functional_api import (  # noqa: F401
+    custom_model,
+    eval_metrics_fn,
+    loss,
+    optimizer,
+)
+from elasticdl_tpu.trainer.state import Modes
+
+SLOW_AFTER = {slow_after}
+SLOW_UNTIL = {slow_until}
+SLEEP_SECS = {sleep_secs}
+
+_parsed = 0
+
+
+def dataset_fn(dataset, mode, metadata):
+    def _parse(record):
+        global _parsed
+        if mode == Modes.TRAINING:
+            _parsed += 1
+            if SLOW_AFTER < _parsed <= SLOW_UNTIL:
+                time.sleep(SLEEP_SECS)
+        ex = decode_example(record)
+        image = ex["image"].astype(np.float32) / 255.0
+        if mode == Modes.PREDICTION:
+            return {{"image": image}}
+        return {{"image": image}}, ex["label"].astype(np.int32)
+
+    return dataset.map(_parse)
+'''
+
+
+def _fail(message: str) -> int:
+    print(f"slo_smoke: {message}", file=sys.stderr)
+    return 1
+
+
+class _Clock:
+    """Settable clock for the replay (engine + tracker are clock-
+    injectable by contract)."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _train_with_regression(workdir: str) -> int | list:
+    """Gate 1: instrumented run through the injected-slowdown zoo
+    module; returns the dispatch-ordered step_anatomy events."""
+    from elasticdl_tpu.data.recordio_gen import synthetic
+    from elasticdl_tpu.telemetry import anatomy as anatomy_mod
+    from elasticdl_tpu.telemetry import tracing, worker_hooks
+    from elasticdl_tpu.telemetry.events import read_events
+    from elasticdl_tpu.trainer.local_executor import LocalExecutor
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    zoo = os.path.join(workdir, "zoo")
+    os.makedirs(zoo)
+    with open(
+        os.path.join(zoo, "slow_input_mnist.py"), "w", encoding="utf-8"
+    ) as f:
+        f.write(
+            ZOO_MODULE.format(
+                slow_after=SLOW_AFTER_RECORDS,
+                slow_until=SLOW_UNTIL_RECORDS,
+                sleep_secs=SLEEP_SECS,
+            )
+        )
+    train = synthetic.gen_mnist(
+        os.path.join(workdir, "train"),
+        num_records=NUM_RECORDS,
+        num_shards=1,
+        seed=17,
+    )
+    telemetry_dir = os.path.join(workdir, "telemetry")
+    args = parse_master_args(
+        [
+            "--model_zoo",
+            zoo,
+            "--model_def",
+            "slow_input_mnist.custom_model",
+            "--training_data",
+            train,
+            "--minibatch_size",
+            str(MINIBATCH),
+            "--records_per_task",
+            str(RECORDS_PER_TASK),
+            "--num_epochs",
+            "1",
+            "--compute_dtype",
+            "float32",
+            "--steps_per_dispatch",
+            "1",
+            "--telemetry_dir",
+            telemetry_dir,
+            "--step_anatomy",
+            "true",
+        ]
+    )
+    try:
+        LocalExecutor(args).run()
+    finally:
+        anatomy_mod.uninstall()
+        worker_hooks.uninstall()
+        tracing.uninstall()
+
+    events = read_events(os.path.join(telemetry_dir, "events.jsonl"))
+    anat = sorted(
+        (e for e in events if e.get("event") == "step_anatomy"),
+        key=lambda e: e.get("monotonic", 0.0),
+    )
+    expected = NUM_RECORDS // MINIBATCH
+    if len(anat) < expected - 2:
+        return _fail(
+            f"only {len(anat)} step_anatomy dispatches (expected "
+            f"~{expected})"
+        )
+    slow = [
+        i
+        for i, e in enumerate(anat, 1)
+        if float(e.get("host_fetch_ms", 0.0)) > SLOW_FETCH_MS
+    ]
+    if len(slow) < 4:
+        return _fail(
+            f"injected regression not visible: only {len(slow)} "
+            f"dispatches with host_fetch > {SLOW_FETCH_MS}ms"
+        )
+    # detector preconditions this injection shape must provide: enough
+    # healthy head for the auto-baseline (p95 warmup + baseline evals
+    # resolve at dispatch 9) and enough healthy tail to evict the burn
+    # from the percentile window and clear the fast window
+    if slow[0] < 11:
+        return _fail(
+            f"regression onset at dispatch {slow[0]} — too early for "
+            "the auto-baseline to have resolved (need >= 11)"
+        )
+    if len(anat) - slow[-1] < TRACKER_WINDOW + 3:
+        return _fail(
+            f"only {len(anat) - slow[-1]} healthy dispatches after the "
+            f"regression (need >= {TRACKER_WINDOW + 3} for recovery)"
+        )
+    # the injected phase is host_fetch, not the device path
+    for i in slow:
+        e = anat[i - 1]
+        device = (
+            float(e.get("assemble_ms", 0.0))
+            + float(e.get("h2d_transfer_ms", 0.0))
+            + float(e.get("device_compute_ms", 0.0))
+        )
+        if float(e.get("host_fetch_ms", 0.0)) <= device:
+            return _fail(
+                f"slow dispatch {i}: host_fetch "
+                f"{e.get('host_fetch_ms'):.0f}ms did not dominate the "
+                f"device path ({device:.0f}ms)"
+            )
+    return anat
+
+
+def _watchdog_verdict(workdir: str, anat: list) -> int | dict:
+    """Gates 2+3: the real engine over the run's measured signals —
+    one violation, one incident, one auto-armed window, one recovery,
+    and a parsing postmortem that attributes the injected phase."""
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.rpc import messages as msg
+    from elasticdl_tpu.telemetry import slo as slo_mod
+    from elasticdl_tpu.telemetry.incident import (
+        IncidentManager,
+        read_incidents,
+    )
+    from elasticdl_tpu.telemetry.master_hooks import MasterTelemetry
+
+    watchdog_dir = os.path.join(workdir, "watchdog")
+    os.makedirs(watchdog_dir)
+    dispatcher = TaskDispatcher(
+        {"s": (0, 64)}, records_per_task=64, num_epochs=1
+    )
+    servicer = MasterServicer(64, dispatcher)
+    telemetry = MasterTelemetry(telemetry_dir=watchdog_dir)
+    telemetry.attach(dispatcher, servicer)
+
+    eval_clock = _Clock()  # virtual heartbeat cadence
+    data_clock = _Clock()  # the run's real step cadence
+    # cumulative fleet-style phase totals rebuilt from the run's events
+    # (the master snapshots servicer.phase_stats_totals(); the replay
+    # holds the same shape at each tick)
+    cum: dict = {}
+
+    def context() -> dict:
+        return {"anatomy": {k: dict(v) for k, v in cum.items()}}
+
+    incidents = IncidentManager(
+        telemetry_dir=watchdog_dir,
+        emit=telemetry.events.emit,
+        clock=eval_clock,
+        context_fn=context,
+    )
+    armed: list[int] = []
+
+    def arm_profiler(num_steps: int):
+        # the master's _slo_arm_profiler idiom: request_profile on the
+        # real servicer, attach the window to the open incident
+        response = servicer.request_profile(
+            msg.RequestProfileRequest(num_steps=num_steps)
+        )
+        if response.accepted:
+            incidents.note_profile_window(
+                {"window_id": response.window_id}
+            )
+            armed.append(response.window_id)
+
+    engine = slo_mod.SLOEngine(
+        slo_mod.parse_slo_config(json.dumps(SLO_CONFIG)),
+        clock=eval_clock,
+        emit=telemetry.events.emit,
+        tracer=telemetry.tracer,
+        arm_profiler=arm_profiler,
+        incidents=incidents,
+    )
+    # the shared-tracker wiring: THE percentile definition site, fed
+    # from the run's real step cadence (short window so the healthy
+    # tail can evict the burn within this run's length)
+    engine.tracker = slo_mod.StepTimePercentileTracker(
+        window=TRACKER_WINDOW, clock=data_clock
+    )
+    telemetry.set_slo_engine(engine)
+    health = telemetry.build_health_fn("training")
+
+    burn_health = None
+    for tick, event in enumerate(anat, 1):
+        data_clock.t = float(event.get("monotonic", 0.0))
+        engine.tracker.note_version(0, tick)
+        for key, value in event.items():
+            if not key.endswith("_ms") or key == "wall_ms":
+                continue
+            slot = cum.setdefault(key[: -len("_ms")], {"ms": 0.0})
+            slot["ms"] += float(value)
+        eval_clock.t = tick * TICK_SECS
+        engine.evaluate({}, now=eval_clock.t)
+        if burn_health is None and engine.active_violations():
+            burn_health = health().get("slo")
+
+    kinds = [t["kind"] for t in engine.transitions]
+    if kinds != ["violation", "recovery"]:
+        return _fail(
+            f"expected exactly one violation then one recovery, got "
+            f"{kinds} (objectives: "
+            f"{[t['objective'] for t in engine.transitions]})"
+        )
+    if engine.transitions[0]["objective"] != "step_time_p95":
+        return _fail(
+            f"wrong objective fired: {engine.transitions[0]}"
+        )
+    if burn_health is None or burn_health.get("ok"):
+        return _fail(
+            f"/healthz slo block never flipped during the burn: "
+            f"{burn_health!r}"
+        )
+    if not health().get("slo", {}).get("ok"):
+        return _fail("/healthz slo block still degraded after recovery")
+    if incidents.total_count != 1 or incidents.open_count != 0:
+        return _fail(
+            f"expected 1 closed incident, got total="
+            f"{incidents.total_count} open={incidents.open_count}"
+        )
+    if len(armed) != 1:
+        return _fail(
+            f"profiler armed {len(armed)} times (expected exactly 1)"
+        )
+
+    records = read_incidents(watchdog_dir)
+    if len(records) != 1:
+        return _fail(
+            f"{len(records)} incident artifacts under {watchdog_dir}"
+        )
+    record = records[0]
+    if record.get("suspected_cause") != "input-bound":
+        return _fail(
+            "postmortem misattributed the injected regression: "
+            f"{record.get('suspected_cause')!r} "
+            f"({record.get('rationale')!r})"
+        )
+    if "host_fetch" not in record.get("rationale", ""):
+        return _fail(
+            f"rationale does not name the injected phase: "
+            f"{record.get('rationale')!r}"
+        )
+    if record.get("objectives") != ["step_time_p95"]:
+        return _fail(f"artifact objectives: {record.get('objectives')}")
+    windows = [
+        w.get("window_id") for w in record.get("profile_windows", [])
+    ]
+    if windows != armed:
+        return _fail(
+            f"artifact profile windows {windows} != armed {armed}"
+        )
+    if not any(
+        entry.get("name") == "slo_violation"
+        for entry in record.get("timeline", [])
+    ):
+        return _fail("artifact timeline lost the violation")
+
+    # the scrape mirror: one firing on the elasticdl_slo_* families
+    text = telemetry.registry.exposition()
+    needle = 'elasticdl_slo_violations_total{objective="step_time_p95"} 1'
+    if needle not in text:
+        return _fail(f"/metrics missing {needle!r}")
+
+    telemetry.events.flush()
+    return {
+        "watchdog_dir": watchdog_dir,
+        "servicer": servicer,
+        "window_id": armed[0],
+        "violation": engine.transitions[0],
+    }
+
+
+def _profile_capture(workdir: str, servicer, window_id: int) -> int | dict:
+    """Gate 4: the auto-armed window rides a heartbeat into a real
+    StepProfiler capture (the PR-14 command path, replays absorbed)."""
+    import glob
+
+    import jax
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.rpc import messages as msg
+    from elasticdl_tpu.telemetry import worker_hooks
+    from elasticdl_tpu.telemetry.events import read_events
+    from elasticdl_tpu.utils.profiling import (
+        StepProfiler,
+        apply_profile_command,
+    )
+
+    telemetry_dir = os.path.join(workdir, "capture_telemetry")
+    worker_hooks.install(telemetry_dir)
+    try:
+        response = servicer.heartbeat(msg.HeartbeatRequest(worker_id=0))
+        if not response.profile:
+            return _fail(
+                "heartbeat did not carry the auto-armed profile command"
+            )
+        profiler = StepProfiler("")
+        if not apply_profile_command(
+            profiler, response.profile, telemetry_dir=telemetry_dir,
+            tag="w0",
+        ):
+            return _fail("apply_profile_command did not arm")
+        replay = servicer.heartbeat(msg.HeartbeatRequest(worker_id=0))
+        if apply_profile_command(
+            profiler, replay.profile, telemetry_dir=telemetry_dir,
+            tag="w0",
+        ):
+            return _fail("replayed profile command re-armed the window")
+
+        step = jax.jit(lambda x: (x @ x.T).sum())
+        value = jnp.ones((64, 64))
+        for _ in range(SLO_CONFIG["profile_steps"] + 2):
+            profiler.on_step()
+            step(value).block_until_ready()
+        profiler.stop()
+
+        events = read_events(os.path.join(telemetry_dir, "events.jsonl"))
+        names = [e.get("event") for e in events]
+        if "profile_window_open" not in names:
+            return _fail("no profile_window_open event from the capture")
+        closed = [
+            e for e in events if e.get("event") == "profile_window_close"
+        ]
+        if not closed or closed[0].get("window_id") != window_id:
+            return _fail(
+                f"capture window id mismatch: {closed!r} vs incident's "
+                f"{window_id}"
+            )
+        capture_root = os.path.join(
+            telemetry_dir, "profile", f"window_{window_id}_w0"
+        )
+        artifacts = [
+            p
+            for p in glob.glob(
+                os.path.join(capture_root, "**", "*"), recursive=True
+            )
+            if os.path.isfile(p)
+        ]
+        if not artifacts:
+            return _fail(f"no capture artifacts under {capture_root}")
+        return {"artifacts": len(artifacts)}
+    finally:
+        worker_hooks.uninstall()
+
+
+def _report_verdict(watchdog_dir: str) -> int | dict:
+    """Gate 5a: the machine-readable report over the watchdog's logs
+    reaches the degraded-but-recovered verdict with the right cause."""
+    from elasticdl_tpu.telemetry.report import (
+        build_report,
+        summarize_report,
+    )
+
+    summary = summarize_report(build_report(watchdog_dir))
+    if summary["verdict"] != "degraded":
+        return _fail(
+            f"report verdict {summary['verdict']!r} (expected "
+            f"'degraded'): {summary['reasons']}"
+        )
+    slo = summary["slo"]
+    if slo["violations"] != 1 or slo["recoveries"] != 1 or slo["still_firing"]:
+        return _fail(f"report slo summary wrong: {slo}")
+    inc = summary["incidents"]
+    if (
+        inc["total"] != 1
+        or inc["open"] != 0
+        or inc["causes"] != {"input-bound": 1}
+    ):
+        return _fail(f"report incident summary wrong: {inc}")
+    return summary
+
+
+def _fleetsim_mute(workdir: str) -> int | dict:
+    """Gate 5b: a silenced watchdog must FAIL the fleet gate (rc 1,
+    slo_detection invariant tripped) — detection is falsifiable."""
+    from elasticdl_tpu.fleetsim.runner import run_plan
+
+    mute_dir = os.path.join(workdir, "fleet_mute")
+    os.makedirs(mute_dir)
+    logging.disable(logging.CRITICAL)  # netem chaos logs spam stdout
+    try:
+        result = run_plan(
+            "fleet_mass_preemption",
+            mute_dir,
+            workers=48,
+            num_tasks=120,
+            seed=11,
+            corrupt="mute_slo",
+        )
+    finally:
+        logging.disable(logging.NOTSET)
+    if result["rc"] != 1:
+        return _fail(
+            f"--corrupt mute_slo exited {result['rc']} (expected 1)"
+        )
+    failed = {
+        i["name"]
+        for i in result["invariants"]
+        if i["status"] == "FAIL"
+    }
+    if "slo_detection" not in failed:
+        return _fail(
+            f"mute_slo tripped {sorted(failed)}, not slo_detection"
+        )
+    return {"failed": sorted(failed)}
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory() as workdir:
+        anat = _train_with_regression(workdir)
+        if isinstance(anat, int):
+            return anat
+        verdict = _watchdog_verdict(workdir, anat)
+        if isinstance(verdict, int):
+            return verdict
+        captured = _profile_capture(
+            workdir, verdict["servicer"], verdict["window_id"]
+        )
+        if isinstance(captured, int):
+            return captured
+        reported = _report_verdict(verdict["watchdog_dir"])
+        if isinstance(reported, int):
+            return reported
+        muted = _fleetsim_mute(workdir)
+        if isinstance(muted, int):
+            return muted
+
+    violation = verdict["violation"]
+    print(
+        "slo_smoke: OK ({} dispatches, step_time_p95 fired once at "
+        "{:.0f}ms vs threshold {:.0f}ms then recovered | incident 1 "
+        "input-bound, profile window {} with {} artifacts | report "
+        "verdict degraded | mute_slo tripped {})".format(
+            len(anat),
+            violation["value"],
+            violation["threshold"],
+            verdict["window_id"],
+            captured["artifacts"],
+            ", ".join(muted["failed"]),
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
