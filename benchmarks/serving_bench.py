@@ -13,6 +13,18 @@ d2h_transfer / untracked — the PR-9 phase discipline per request), with
 the mean sum-residual asserted ~0 so a p99 miss is attributable to
 queueing vs transfer vs compute by reading the artifact.
 
+Two observability-plane blocks ride each point:
+
+- ``trace_attribution`` — every request carries a client root span, so
+  the replica's queue/engine spans land in per-request traces and the
+  analyzer's serving critical path (sum-exact boundary sweep) reports
+  the queue-vs-compute split of the measured wall, independent of the
+  server's self-reported phases;
+- ``slo`` — the point's signals (latency p99, queue_wait share, error
+  rate) judged against the serving watchdog's default objectives: the
+  same thresholds a production router would fire on, as a per-point
+  pass/fail verdict.
+
     python benchmarks/serving_bench.py \
         [--model_dir DIR] [--qps 20,40,80] [--duration_secs 3] \
         [--rows_mix 1,4,8] [--minibatch_size 8] [--seed 0] \
@@ -97,6 +109,73 @@ def _sample_row_shape(model_dir: str):
     )
 
 
+def _slo_verdict(point: dict) -> dict:
+    """The point's signals judged against the serving watchdog's
+    DEFAULT objectives (fleet-state objectives — replica floor, swap
+    reachability — have no meaning for one in-process replica and are
+    omitted).  A bench artifact thereby says not just what the latency
+    WAS but whether a default-config router would have fired on it."""
+    from elasticdl_tpu.serving.watchdog import DEFAULT_SERVING_OBJECTIVES
+    from elasticdl_tpu.telemetry import slo as slo_mod
+
+    attempts = point["completed"] + point["errors"]
+    signals = {}
+    p99 = point["latency_ms"].get("p99")
+    if p99 is not None:
+        signals[slo_mod.SIGNAL_SERVING_LATENCY_P99_MS] = p99
+    share = (point["anatomy"].get("queue_wait") or {}).get("share")
+    if share is not None:
+        signals[slo_mod.SIGNAL_QUEUE_WAIT_SHARE] = share
+    if attempts:
+        signals[slo_mod.SIGNAL_SERVING_ERROR_RATE] = (
+            point["errors"] / attempts
+        )
+    objectives = {}
+    for spec in DEFAULT_SERVING_OBJECTIVES:
+        value = signals.get(spec["signal"])
+        if value is None:
+            continue
+        threshold = float(spec["threshold"])
+        bad = (
+            value > threshold
+            if spec["comparator"] == "above"
+            else value < threshold
+        )
+        objectives[spec["name"]] = {
+            "signal": spec["signal"],
+            "value": round(float(value), 4),
+            "comparator": spec["comparator"],
+            "threshold": threshold,
+            "ok": not bad,
+        }
+    return {
+        "ok": all(o["ok"] for o in objectives.values()),
+        "objectives": objectives,
+    }
+
+
+def _trace_attribution(point_dir: str) -> dict | None:
+    """The analyzer's serving critical path over this point's traces:
+    the queue-vs-compute split of measured request wall (sum-exact
+    boundary sweep), plus honest coverage for the client-side time no
+    server span explains."""
+    from elasticdl_tpu.telemetry import tracing
+    from elasticdl_tpu.telemetry.trace import analyze_telemetry_dir
+
+    tracing.flush()
+    serving = analyze_telemetry_dir(point_dir).get("serving")
+    if not serving:
+        return None
+    return {
+        "requests": serving["requests"],
+        "wall_secs_total": serving["wall_secs_total"],
+        "phases_secs": serving["phases_secs"],
+        "coverage": serving["coverage"],
+        "dispatch_groups": serving["dispatch_groups"],
+        "linked_dispatch_groups": serving["linked_dispatch_groups"],
+    }
+
+
 def run_point(
     client,
     qps: float,
@@ -108,6 +187,7 @@ def run_point(
     rng: np.random.RandomState,
 ) -> dict:
     from elasticdl_tpu.rpc import messages as msg
+    from elasticdl_tpu.telemetry import tracing
 
     n_requests = max(1, int(qps * duration_secs))
     gaps = rng.exponential(1.0 / qps, size=n_requests)
@@ -128,16 +208,29 @@ def run_point(
         # pickup: once the pool saturates, pickup-relative timing would
         # exclude exactly the queueing delay overload exists to measure
         # (silently closing the loop)
+        tracer = tracing.get_tracer()
+        span = (
+            tracer.start_span(
+                tracing.SPAN_PREDICT_REQUEST, request_id=f"bench-{i}"
+            )
+            if tracer is not None
+            else None
+        )
         try:
             response = client.predict(
                 msg.PredictRequest(
-                    request_id=f"bench-{i}", features=payloads[i]
+                    request_id=f"bench-{i}",
+                    features=payloads[i],
+                    trace=span.context if span is not None else {},
                 )
             )
         except Exception:  # noqa: BLE001 — an outage mid-point is data
             with lock:
                 errors[0] += 1
             return
+        finally:
+            if span is not None:
+                span.end()
         wall_ms = (time.monotonic() - scheduled_at) * 1000.0
         if response is None or response.error:
             with lock:
@@ -253,19 +346,30 @@ def main(argv=None) -> int:
             raise SystemExit(f"serving_bench: warmup failed: {warm.error}")
         compile0 = client.serving_status().compile_count
         points = []
-        for qps in [float(x) for x in args.qps.split(",") if x]:
-            points.append(
-                run_point(
-                    client,
-                    qps,
-                    args.duration_secs,
-                    rows_mix,
-                    row_shape,
-                    dtype,
-                    key,
-                    rng,
-                )
+        from elasticdl_tpu.telemetry import tracing
+
+        for n, qps in enumerate(
+            [float(x) for x in args.qps.split(",") if x]
+        ):
+            # one spans.jsonl per point: client roots + the replica's
+            # queue/engine children (same process, same tracer), so the
+            # attribution below covers exactly this point's requests
+            point_dir = os.path.join(workdir, f"trace_point_{n}")
+            tracing.install(point_dir, role="client")
+            point = run_point(
+                client,
+                qps,
+                args.duration_secs,
+                rows_mix,
+                row_shape,
+                dtype,
+                key,
+                rng,
             )
+            point["trace_attribution"] = _trace_attribution(point_dir)
+            point["slo"] = _slo_verdict(point)
+            tracing.uninstall()
+            points.append(point)
         status = client.serving_status()
         artifact = {
             "bench": "serving",
@@ -289,11 +393,20 @@ def main(argv=None) -> int:
     with open(args.output, "w", encoding="utf-8") as f:
         json.dump(artifact, f, indent=2)
     for point in points:
+        attribution = point.get("trace_attribution") or {}
+        phases = attribution.get("phases_secs") or {}
+        attributed = sum(v for k, v in phases.items() if k != "unattributed")
+        queue_share = (
+            phases.get("queue_wait", 0.0) / attributed if attributed else None
+        )
         print(
             f"qps {point['qps_target']:>6.1f}: offered "
             f"{point['qps_offered']:>7.1f}, p50 "
             f"{point['latency_ms']['p50']}ms, p99 "
-            f"{point['latency_ms']['p99']}ms, errors {point['errors']}"
+            f"{point['latency_ms']['p99']}ms, errors {point['errors']}, "
+            f"trace queue share "
+            f"{queue_share if queue_share is None else round(queue_share, 3)}, "
+            f"slo {'OK' if point['slo']['ok'] else 'VIOLATED'}"
         )
     print(
         f"serving_bench: OK -> {args.output} "

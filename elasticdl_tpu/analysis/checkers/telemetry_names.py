@@ -100,6 +100,15 @@ REQUIRED_SPAN_NAMES = frozenset(
         "profile_window",
         # the SLO watchdog burn window: first bad evaluation -> fire
         "slo_watch",
+        # serving fleet tracing: one trace per request — client root,
+        # router (re)route children, replica queue/engine split, and
+        # the batched dispatch group LINKED to its member traces
+        "predict_request",
+        "route",
+        "reroute",
+        "queue",
+        "engine",
+        "serving_dispatch",
     }
 )
 REQUIRED_PHASE_NAMES = frozenset(
@@ -147,6 +156,16 @@ REQUIRED_METRIC_NAMES = frozenset(
         "elasticdl_slo_objective_ok",
         "elasticdl_slo_burn_rate",
         "elasticdl_slo_incidents_total",
+        # serving fleet fan-in: router-side per-replica families over
+        # the probe-beat merge (replica= label under the PR-13
+        # cardinality cap) — registered at one site each inside
+        # serving/metrics.py FleetMetrics._collect
+        "elasticdl_serving_replica_queue_rows",
+        "elasticdl_serving_replica_outstanding",
+        "elasticdl_serving_replica_probe_age_secs",
+        "elasticdl_serving_replica_shed_total",
+        "elasticdl_serving_replica_errors_total",
+        "elasticdl_serving_replica_phase_ms_total",
     }
 )
 

@@ -109,7 +109,11 @@ IDEMPOTENCY: dict[str, tuple[str, str]] = {
     "serving_status": (
         "read-only",
         "pure snapshot of replica counters/version; doubles as the "
-        "serving plane's liveness probe, so it MUST be retry-safe",
+        "serving plane's liveness probe AND the probe-beat telemetry "
+        "ride-along (monotone counters + phase totals + memory ledger "
+        "in the response, max/last-merged router-side), so it MUST be "
+        "retry-safe — the payload is read-only on the replica and the "
+        "router merge absorbs replays",
     ),
     "swap_model": (
         "versioned-put",

@@ -326,6 +326,10 @@ class PredictRequest:
     request_id: str = ""
     features: bytes = b""  # pack_array_tree frames
     rows: int = 0
+    # PR-3 trace context ({"trace_id", "span_id"}): the client's root
+    # span, so the router's (re)route children and the replica's
+    # queue/engine spans land in the SAME trace.  Empty when the client
+    # does not trace; old payloads decode to {} — wire-compatible
     trace: dict = field(default_factory=dict)
 
 
@@ -349,6 +353,10 @@ class ServingStatusRequest:
     """Replica/router status snapshot; doubles as the liveness probe."""
 
     detail: bool = False
+    # trace context of the caller (probe beats usually omit it; an
+    # operator's traced status read parents the replica's work).  Old
+    # payloads decode to {} — wire-compatible
+    trace: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -367,6 +375,22 @@ class ServingStatusResponse:
     canonical_rows: int = 0
     # router responses: one status dict per live replica (detail=True)
     replicas: list = field(default_factory=list)
+    # probe-beat telemetry fan-in (the PR-8/9 heartbeat pattern riding
+    # the RPC that keeps flowing — here the liveness probe itself):
+    # monotone request/error counters since process start.  The router
+    # max-merges per replica (utils/merge.max_merge_counters), so
+    # duplicated or reordered probe replies are absorbed.  Empty when
+    # telemetry is off; old payloads decode to {} — wire-compatible
+    counters: dict = field(default_factory=dict)
+    # monotone per-phase {ms, count, buckets} serving-request totals in
+    # the step-anatomy heartbeat shape (bucket keys stringified for
+    # msgpack), max-merged per replica and fed to the router's SLO
+    # watchdog.  Empty when telemetry is off; old payloads decode to {}
+    phases: dict = field(default_factory=dict)
+    # memory-ledger snapshot {"at", "current", "peak"} — NON-monotone,
+    # merged last-writer-wins like the heartbeat field of the same
+    # name.  Empty when the ledger is off; old payloads decode to {}
+    memory: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -378,6 +402,11 @@ class SwapModelRequest:
 
     model_dir: str = ""
     min_version: int = -1
+    # trace context of the operator's swap request: the router's
+    # per-replica fan-out spans and every replica's model_swap span
+    # parent into it, so one swap = one trace across the fleet.  Empty
+    # when untraced; old payloads decode to {} — wire-compatible
+    trace: dict = field(default_factory=dict)
 
 
 @dataclass

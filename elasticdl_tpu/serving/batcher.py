@@ -120,12 +120,18 @@ class Ticket:
         "_error",
         "_done",
         "model_version",
+        "trace",
     )
 
-    def __init__(self, request_id: str, features, rows: int):
+    def __init__(self, request_id: str, features, rows: int, trace=None):
         self.request_id = request_id
         self.features = features
         self.rows = rows
+        # trace context of the SUBMITTING request ({"trace_id",
+        # "span_id"} or {}): the engine parents this request's
+        # queue/engine spans into it and links the shared dispatch
+        # group's span to it
+        self.trace: dict = dict(trace) if trace else {}
         # queued feature bytes (memory-ledger accounting) — THE shared
         # leaf-byte rule, so nested feature trees count correctly
         from elasticdl_tpu.telemetry.memory import pytree_bytes
@@ -268,11 +274,11 @@ class MicroBatcher:
 
     # ---- submitter threads -------------------------------------------------
 
-    def submit(self, request_id: str, features) -> Ticket:
+    def submit(self, request_id: str, features, trace=None) -> Ticket:
         rows = tree_rows(features)
         if rows <= 0:
             raise ShapeMismatchError("request carries zero rows")
-        ticket = Ticket(request_id, features, rows)
+        ticket = Ticket(request_id, features, rows, trace=trace)
         with self._lock:
             if self._closed:
                 raise ServingShutdownError("batcher is shut down")

@@ -44,6 +44,7 @@ from elasticdl_tpu.telemetry.events import (
     EVENT_MODEL_SWAP,
     EVENT_SERVING_REQUEST,
 )
+from elasticdl_tpu.telemetry.registry import SERVING_LATENCY_BUCKETS
 from elasticdl_tpu.utils.log_utils import default_logger as logger
 
 _PHASE_TOTAL = "total"
@@ -160,6 +161,13 @@ class ServingEngine:
         self.requests_served = 0
         self.rows_served = 0
         self.swaps_applied = 0
+        # probe-beat phase totals (monotone, heartbeat-snapshot wire
+        # shape: {phase: {"ms", "count", "buckets"}}, bucket keys
+        # stringified for msgpack) — shipped on every serving_status
+        # response so the router can max-merge per replica and feed its
+        # SLO watchdog without a second RPC
+        self._beat_lock = threading.Lock()
+        self._phase_totals: dict[str, dict] = {}  # guarded-by: _beat_lock
         # memory-ledger accounting: the served leaves, the pre-build
         # flats, and — during a hot swap — the incoming leaves while
         # the outgoing ones are still resident (the transient double
@@ -393,6 +401,7 @@ class ServingEngine:
             PHASE_DEVICE_COMPUTE: t3 - t2,
             PHASE_D2H_TRANSFER: t4 - t3,
         }
+        self._record_dispatch_span(tickets, group, t_c0, t4, version)
         self.metrics.dispatches.inc()
         self.metrics.batch_fill.observe(group.n_real / self.canonical_rows)
         if self.metrics.dispatches.value % 64 == 0:
@@ -450,11 +459,48 @@ class ServingEngine:
         }
         for name, secs in phases.items():
             fields[f"{name}_ms"] = secs * 1000.0
+        if ticket.trace:
+            fields["trace_id"] = ticket.trace.get("trace_id", "")
         worker_hooks.emit_event(EVENT_SERVING_REQUEST, **fields)
+        self._note_phase_totals(phases, total)
         from elasticdl_tpu.telemetry import tracing
 
         tracer = tracing.get_tracer()
-        if tracer is not None:
+        if tracer is None:
+            return
+        if ticket.trace:
+            # traced request: the client opted in, so the replica-side
+            # decomposition records unconditionally in the SAME trace —
+            # queue (submit -> first dispatch) + engine (first dispatch
+            # -> delivered) partition the request wall exactly
+            first = ticket.first_dispatch_at or ticket.finished_at
+            tracer.record_span(
+                tracing.SPAN_SERVING_QUEUE,
+                ticket.submitted_at,
+                first,
+                trace_ctx=ticket.trace,
+                request_id=ticket.request_id,
+                rows=int(ticket.rows),
+            )
+            tracer.record_span(
+                tracing.SPAN_SERVING_ENGINE,
+                first,
+                ticket.finished_at,
+                trace_ctx=ticket.trace,
+                request_id=ticket.request_id,
+                dispatches=int(ticket.dispatches),
+                model_version=int(ticket.model_version),
+            )
+            tracer.record_span(
+                tracing.SPAN_SERVING_REQUEST,
+                ticket.submitted_at,
+                ticket.finished_at,
+                trace_ctx=ticket.trace,
+                request_id=ticket.request_id,
+                rows=int(ticket.rows),
+                model_version=int(ticket.model_version),
+            )
+        else:
             tracer.record_span(
                 tracing.SPAN_SERVING_REQUEST,
                 ticket.submitted_at,
@@ -464,9 +510,95 @@ class ServingEngine:
                 model_version=int(ticket.model_version),
             )
 
+    def _record_dispatch_span(self, tickets, group, t0, t4, version):
+        """One ``serving_dispatch`` span per batch group, LINKED (not
+        parented — one group serves many traces) to every member
+        request's trace, the batching analogue of the recovered-task
+        links.  Recorded whenever any member is traced; otherwise it
+        rides the sampler like the other per-dispatch spans."""
+        from elasticdl_tpu.telemetry import tracing
+
+        tracer = tracing.get_tracer()
+        if tracer is None:
+            return
+        links = [
+            {
+                "trace_id": t.trace.get("trace_id", ""),
+                "span_id": t.trace.get("span_id", ""),
+            }
+            for t in tickets
+            if t.trace
+        ]
+        if not links and not tracer.should_sample(
+            tracing.SPAN_SERVING_DISPATCH
+        ):
+            return
+        tracer.record_span(
+            tracing.SPAN_SERVING_DISPATCH,
+            t0,
+            t4,
+            requests=len(tickets),
+            n_real=int(group.n_real),
+            canonical_rows=int(self.canonical_rows),
+            model_version=int(version),
+            links=links,
+        )
+
+    def _note_phase_totals(self, phases: dict, total: float):
+        """Accumulate one completed request into the monotone probe-beat
+        totals (heartbeat-snapshot wire shape)."""
+        items = list(phases.items())
+        items.append((_PHASE_TOTAL, total))
+        with self._beat_lock:
+            for name, secs in items:
+                stats = self._phase_totals.get(name)
+                if stats is None:
+                    stats = self._phase_totals[name] = {
+                        "ms": 0.0,
+                        "count": 0,
+                        "buckets": {},
+                    }
+                stats["ms"] += secs * 1000.0
+                stats["count"] += 1
+                key = "inf"
+                for bound in SERVING_LATENCY_BUCKETS:
+                    if secs <= bound:
+                        key = str(bound)
+                        break
+                buckets = stats["buckets"]
+                buckets[key] = buckets.get(key, 0) + 1
+
+    def phase_totals_snapshot(self) -> dict:
+        """Deep copy of the monotone per-phase totals — the
+        ``serving_status`` probe-beat payload."""
+        with self._beat_lock:
+            return {
+                name: {
+                    "ms": stats["ms"],
+                    "count": stats["count"],
+                    "buckets": dict(stats["buckets"]),
+                }
+                for name, stats in self._phase_totals.items()
+            }
+
+    def counters_snapshot(self) -> dict:
+        """Monotone counters since process start (probe-beat payload);
+        the router max-merges these so replays are absorbed."""
+        m = self.metrics
+        return {
+            "requests": int(m.requests.value),
+            "rows": int(m.rows.value),
+            "rejected": int(m.rejected.value),
+            "errors": int(m.errors.value),
+            "swaps": int(m.swaps.value),
+            "dispatches": int(m.dispatches.value),
+        }
+
     # ---- hot swap ----------------------------------------------------------
 
-    def swap_from_export(self, model_dir: str, min_version: int = -1):
+    def swap_from_export(
+        self, model_dir: str, min_version: int = -1, trace=None
+    ):
         """Swap to the model exported at ``model_dir``.  Refuses a
         version that would not ADVANCE the served one — that staleness
         guard is what makes ``swap_model`` a safe versioned-put under
@@ -486,19 +618,24 @@ class ServingEngine:
                 f"export version {version} < required {min_version}"
             )
         flat_params, flat_state = self._load_flats(model_dir)
-        return self._swap_flats(flat_params, flat_state, version, model_dir)
+        return self._swap_flats(
+            flat_params, flat_state, version, model_dir, trace=trace
+        )
 
     def swap_state_dicts(
         self, flat_params: dict, flat_state: dict, version: int,
-        source: str = "in-memory",
+        source: str = "in-memory", trace=None,
     ):
         """Swap from flat name-keyed arrays — the same form the export
         npz, the checkpoint files and the replication blobs all carry,
         so a training job's ``ReplicaStore``/checkpoint stream can feed
         a serving replica without touching disk."""
-        return self._swap_flats(flat_params, flat_state, int(version), source)
+        return self._swap_flats(
+            flat_params, flat_state, int(version), source, trace=trace
+        )
 
-    def _swap_flats(self, flat_params, flat_state, version, source):
+    def _swap_flats(self, flat_params, flat_state, version, source,
+                    trace=None):
         t0 = time.monotonic()
         with self._swap_lock:
             if version <= self._version:
@@ -581,10 +718,14 @@ class ServingEngine:
         )
         tracer = tracing.get_tracer()
         if tracer is not None:
+            # with an operator trace context the swap span parents into
+            # the fan-out trace (one swap = one trace across replicas)
             tracer.record_span(
                 tracing.SPAN_MODEL_SWAP,
                 t0,
                 t0 + secs,
+                trace_ctx=trace if trace else None,
+                replica_id=self.replica_id,
                 model_version=int(version),
             )
         logger.info(

@@ -110,8 +110,25 @@ def build_parser() -> argparse.ArgumentParser:
         default=-1,
         help="/metrics + /healthz port (0 = ephemeral, negative = off)",
     )
+    parser.add_argument(
+        "--slo_config",
+        default="",
+        help=(
+            "Router-side SLO watchdog over the probe-beat fan-in: "
+            "'default', inline JSON, or a file path (empty = off; "
+            "frontend-only — replica argv never carries it)"
+        ),
+    )
     parser.add_argument("--telemetry_dir", default="")
     parser.add_argument("--addr_file", default="")
+    parser.add_argument(
+        "--metrics_addr_file",
+        default="",
+        help=(
+            "Publish the bound /metrics address (the addr-file idiom "
+            "for an ephemeral --metrics_port 0; frontend-only)"
+        ),
+    )
     # spawned-replica internals
     parser.add_argument("--role", default="frontend", choices=["frontend", "replica"])
     parser.add_argument("--replica_id", type=int, default=0)
@@ -146,7 +163,14 @@ def _install_telemetry(args):
         worker_hooks.TELEMETRY_DIR_ENV, ""
     )
     worker_hooks.install(telemetry_dir)
-    tracing.install(telemetry_dir)
+    # spans carry the serving role so the trace export lays out one
+    # track per replica and one for the router (trace.py's serving
+    # track rule) instead of piling every process onto "worker 0"
+    tracing.install(
+        telemetry_dir,
+        role="replica" if getattr(args, "role", "") == "replica" else "router",
+        worker_id=getattr(args, "replica_id", 0),
+    )
     compile_tracker.install()
     # the serving plane's byte owners (batcher queue, served leaves incl.
     # the swap's double residency) register against THIS process's
@@ -204,40 +228,55 @@ def run_replica(args) -> int:
         replica.close()
         if metrics_server is not None:
             metrics_server.stop()
+        # the replica buffers spans (queue/engine/dispatch); a graceful
+        # SIGTERM must not strand the tail of the request traces
+        from elasticdl_tpu.telemetry import tracing
+
+        tracing.flush()
     return 0
 
 
 # ---- frontend role -----------------------------------------------------------
 
 
+def _replica_argv(args, i: int, workdir: str) -> list[str]:
+    """A spawned replica's exact command line — pure so the argv
+    byte-identity test can pin it: observability settings (telemetry
+    dir, SLO config, sample rate) travel by ENV, never argv, so this
+    list is byte-identical whether the watchdog/tracing flags are on
+    or off (the worker-argv contract, applied to serving)."""
+    argv = [
+        sys.executable,
+        "-m",
+        "elasticdl_tpu.serving.main",
+        "--role",
+        "replica",
+        "--replica_id",
+        str(i),
+        "--model_dir",
+        args.model_dir,
+        "--port",
+        "0",
+        "--port_file",
+        os.path.join(workdir, f"replica_{i}.port"),
+        "--minibatch_size",
+        str(args.minibatch_size),
+        "--max_wait_ms",
+        str(args.max_wait_ms),
+        "--max_queue_rows",
+        str(args.max_queue_rows),
+        "--metrics_port",
+        "-1",
+    ]
+    if args.watch_model:
+        argv.append("--watch_model")
+    return argv
+
+
 def _spawn_replicas(args, workdir: str) -> list[subprocess.Popen]:
     procs = []
     for i in range(args.num_replicas):
-        argv = [
-            sys.executable,
-            "-m",
-            "elasticdl_tpu.serving.main",
-            "--role",
-            "replica",
-            "--replica_id",
-            str(i),
-            "--model_dir",
-            args.model_dir,
-            "--port",
-            "0",
-            "--port_file",
-            os.path.join(workdir, f"replica_{i}.port"),
-            "--minibatch_size",
-            str(args.minibatch_size),
-            "--max_wait_ms",
-            str(args.max_wait_ms),
-            "--max_queue_rows",
-            str(args.max_queue_rows),
-            "--metrics_port",
-            "-1",
-        ]
-        if args.watch_model:
-            argv.append("--watch_model")
+        argv = _replica_argv(args, i, workdir)
         env = dict(os.environ)
         if args.telemetry_dir:
             from elasticdl_tpu.telemetry.worker_hooks import TELEMETRY_DIR_ENV
@@ -281,7 +320,7 @@ def run_frontend(args) -> int:
     )
     from elasticdl_tpu.serving.router import ServingRouter
 
-    _install_telemetry(args)
+    telemetry_dir = _install_telemetry(args)
     deadlines = (
         DeadlinePolicy.from_secs(args.rpc_deadline_secs)
         if args.rpc_deadline_secs
@@ -290,6 +329,24 @@ def run_frontend(args) -> int:
     router = ServingRouter(
         deadlines=deadlines, evict_after_secs=args.evict_after_secs
     )
+    if args.slo_config:
+        # parse BEFORE spawning: a bad config must fail the frontend,
+        # not orphan replica subprocesses
+        from elasticdl_tpu.serving.watchdog import (
+            ServingWatchdog,
+            parse_serving_slo_config,
+        )
+        from elasticdl_tpu.telemetry import tracing, worker_hooks
+
+        slo_config = parse_serving_slo_config(args.slo_config)
+        if slo_config is not None:
+            router.watchdog = ServingWatchdog(
+                router,
+                slo_config,
+                telemetry_dir=telemetry_dir,
+                emit=worker_hooks.emit_event,
+                tracer=tracing.get_tracer(),
+            )
     workdir = tempfile.mkdtemp(prefix="edl_serving_")
     procs = _spawn_replicas(args, workdir)
     try:
@@ -341,15 +398,39 @@ def run_frontend(args) -> int:
         registry.add_collect_callback(
             lambda _r: live_gauge.set(len(router.live_replicas()))
         )
+        # per-replica fleet families over the probe-beat fan-in
+        # (cardinality-capped, pruned with the registry)
+        from elasticdl_tpu.serving.metrics import FleetMetrics
+
+        FleetMetrics(router, registry)
+        if router.watchdog is not None:
+            registry.add_collect_callback(
+                lambda _r: router.watchdog.mirror_metrics(registry)
+            )
 
         def health():
             status = router.serving_status(msg.ServingStatusRequest())
-            return {
+            snap = router.fleet_snapshot()
+            block = {
                 "role": "frontend",
-                "live_replicas": len(router.live_replicas()),
+                "live_replicas": len(snap["live"]),
                 "model_version": status.model_version,
                 "queue_rows": status.queue_rows,
+                "replicas": {
+                    str(rid): {
+                        "last_probe_age_secs": round(
+                            r["last_probe_age_secs"], 3
+                        ),
+                        "outstanding": r["outstanding"],
+                        "evict_in_secs": round(r["evict_in_secs"], 3),
+                        "live": r["live"],
+                    }
+                    for rid, r in snap["replicas"].items()
+                },
             }
+            if router.watchdog is not None:
+                block["slo"] = router.watchdog.health_block()
+            return block
 
         from elasticdl_tpu.telemetry.httpd import TelemetryHTTPServer
 
@@ -357,6 +438,10 @@ def run_frontend(args) -> int:
             registry, health_fn=health, port=args.metrics_port
         )
         metrics_server.start()
+        if args.metrics_addr_file:
+            _write_atomic(
+                args.metrics_addr_file, f"localhost:{metrics_server.port}"
+            )
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_a: stop.set())
@@ -383,6 +468,11 @@ def run_frontend(args) -> int:
                 proc.kill()
         if metrics_server is not None:
             metrics_server.stop()
+        # same contract as the replica: the router's (re)route spans
+        # must survive a graceful shutdown
+        from elasticdl_tpu.telemetry import tracing
+
+        tracing.flush()
     return 0
 
 

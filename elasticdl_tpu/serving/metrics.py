@@ -75,3 +75,115 @@ class ServingMetrics:
         hist = self._latency.get(phase)
         if hist is not None:
             hist.observe(secs)
+
+
+class FleetMetrics:
+    """Router-side per-replica families over the probe-beat fan-in.
+
+    Scrape-time mirror (the SLO-plane pattern): a collect callback
+    reads ONE ``fleet_snapshot()`` and writes every family — no state
+    of its own, so the /metrics page can never disagree with /healthz
+    about the same replica.  The ``replica`` label rides the PR-13
+    cardinality contract: replicas beyond ``worker_series_budget()``
+    COLLAPSE into ``replica="other"`` (sums for counters/queue depth,
+    worst-case max for the probe age — a silent replica hidden in the
+    overflow bucket must still show), and ``prune_children`` drops the
+    label sets of forgotten replicas so a scrape after an eviction
+    storm is not a graveyard of stale series.
+    """
+
+    def __init__(self, router, registry: MetricsRegistry):
+        self.router = router
+        self.registry = registry
+        registry.add_collect_callback(self._collect)
+
+    def _collect(self, registry):
+        from elasticdl_tpu.telemetry.master_hooks import (
+            worker_series_budget,
+        )
+
+        snap = self.router.fleet_snapshot()
+        replicas = snap["replicas"]
+        budget = max(1, worker_series_budget())
+        rids = sorted(replicas)
+        named = set(rids if len(rids) <= budget else rids[: budget - 1])
+
+        slots: dict[str, dict] = {}
+        phase_ms: dict[tuple[str, str], float] = {}
+        for rid in rids:
+            r = replicas[rid]
+            key = str(rid) if rid in named else "other"
+            slot = slots.setdefault(
+                key,
+                {
+                    "queue_rows": 0,
+                    "outstanding": 0,
+                    "probe_age": 0.0,
+                    "shed": 0,
+                    "errors": 0,
+                },
+            )
+            slot["queue_rows"] += int(r["queue_rows"])
+            slot["outstanding"] += int(r["outstanding"])
+            slot["probe_age"] = max(
+                slot["probe_age"], float(r["last_probe_age_secs"])
+            )
+            counters = r["counters"]
+            slot["shed"] += int(counters.get("rejected", 0))
+            slot["errors"] += int(counters.get("errors", 0))
+            for phase, stats in r["phases"].items():
+                pkey = (key, phase)
+                phase_ms[pkey] = phase_ms.get(pkey, 0.0) + float(
+                    stats["ms"]
+                )
+
+        for key, slot in slots.items():
+            labels = {"replica": key}
+            registry.gauge(
+                "elasticdl_serving_replica_queue_rows",
+                "Rows queued on the replica at its last probe",
+                labels=labels,
+            ).set(slot["queue_rows"])
+            registry.gauge(
+                "elasticdl_serving_replica_outstanding",
+                "In-flight routed requests holding a lease on the replica",
+                labels=labels,
+            ).set(slot["outstanding"])
+            registry.gauge(
+                "elasticdl_serving_replica_probe_age_secs",
+                "Seconds since the replica last answered the probe beat",
+                labels=labels,
+            ).set(slot["probe_age"])
+            registry.counter(
+                "elasticdl_serving_replica_shed_total",
+                "Requests the replica shed (bounded-queue overload)",
+                labels=labels,
+            ).set_total(slot["shed"])
+            registry.counter(
+                "elasticdl_serving_replica_errors_total",
+                "Requests the replica failed (dispatch/shape errors)",
+                labels=labels,
+            ).set_total(slot["errors"])
+        for (key, phase), ms in phase_ms.items():
+            registry.counter(
+                "elasticdl_serving_replica_phase_ms_total",
+                "Cumulative per-phase request milliseconds by replica",
+                labels={"replica": key, "phase": phase},
+            ).set_total(ms)
+
+        keep = [{"replica": key} for key in slots]
+        for name in (
+            "elasticdl_serving_replica_queue_rows",
+            "elasticdl_serving_replica_outstanding",
+            "elasticdl_serving_replica_probe_age_secs",
+            "elasticdl_serving_replica_shed_total",
+            "elasticdl_serving_replica_errors_total",
+        ):
+            registry.prune_children(name, keep)
+        registry.prune_children(
+            "elasticdl_serving_replica_phase_ms_total",
+            [
+                {"replica": key, "phase": phase}
+                for (key, phase) in phase_ms
+            ],
+        )

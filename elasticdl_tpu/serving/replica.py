@@ -67,14 +67,19 @@ class ServingReplicaServicer:
         from elasticdl_tpu.telemetry import worker_hooks
         from elasticdl_tpu.telemetry.events import EVENT_SERVING_REQUEST
 
-        worker_hooks.emit_event(
-            EVENT_SERVING_REQUEST,
-            request_id=request.request_id,
-            rows=int(request.rows),
-            replica_id=self.replica_id,
-            error=kind,
-            shed=bool(shed),
-        )
+        fields = {
+            "request_id": request.request_id,
+            "rows": int(request.rows),
+            "replica_id": self.replica_id,
+            "error": kind,
+            "shed": bool(shed),
+        }
+        trace = getattr(request, "trace", None)
+        if trace:
+            # a FAILED traced request must stay findable in the span
+            # log: tag the error event with its trace id
+            fields["trace_id"] = trace.get("trace_id", "")
+        worker_hooks.emit_event(EVENT_SERVING_REQUEST, **fields)
 
     def predict(self, request: msg.PredictRequest) -> msg.PredictResponse:
         try:
@@ -87,7 +92,9 @@ class ServingReplicaServicer:
                 # and conform() below would have no spec to check
                 self.engine.ensure_built(features)
             features = self.engine.conform(features)
-            ticket = self.batcher.submit(request.request_id, features)
+            ticket = self.batcher.submit(
+                request.request_id, features, trace=request.trace
+            )
         except ServingOverloadError as ex:
             # rejected == load shed by the bounded queue, ONLY: status
             # consumers size capacity off this counter, so a malformed
@@ -134,6 +141,8 @@ class ServingReplicaServicer:
     ) -> msg.ServingStatusResponse:
         from elasticdl_tpu.telemetry import compile_tracker
 
+        from elasticdl_tpu.telemetry import memory as memory_mod
+
         engine = self.engine
         return msg.ServingStatusResponse(
             replica_id=self.replica_id,
@@ -145,6 +154,12 @@ class ServingReplicaServicer:
             swaps=int(engine.swaps_applied),
             queue_rows=int(self.batcher.queue_rows()),
             canonical_rows=int(engine.canonical_rows),
+            # probe-beat telemetry: the liveness probe that keeps
+            # flowing carries the monotone totals (PR-8 pattern), so
+            # the router's fan-in costs zero extra RPCs
+            counters=engine.counters_snapshot(),
+            phases=engine.phase_totals_snapshot(),
+            memory=memory_mod.heartbeat_snapshot(),
         )
 
     def swap_model(self, request: msg.SwapModelRequest) -> msg.SwapModelResponse:
@@ -152,7 +167,9 @@ class ServingReplicaServicer:
 
         try:
             accepted, version, reason = self.engine.swap_from_export(
-                request.model_dir, min_version=request.min_version
+                request.model_dir,
+                min_version=request.min_version,
+                trace=request.trace,
             )
         except (OSError, ValueError, KeyError) as ex:
             return msg.SwapModelResponse(

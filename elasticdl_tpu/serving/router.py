@@ -27,6 +27,10 @@ from concurrent.futures import ThreadPoolExecutor
 from elasticdl_tpu.rpc import messages as msg
 from elasticdl_tpu.serving.replica import ServingClient
 from elasticdl_tpu.utils.log_utils import default_logger as logger
+from elasticdl_tpu.utils.merge import (
+    max_merge_counters,
+    max_merge_phase_stats,
+)
 
 DEFAULT_EVICT_AFTER_SECS = 10.0
 DEFAULT_FORGET_AFTER_SECS = 120.0
@@ -42,6 +46,11 @@ class _ReplicaHandle:
         "outstanding",
         "last_seen",
         "last_status",
+        "counters",
+        "phases",
+        "memory",
+        "memory_at",
+        "swap_unreachable",
     )
 
     def __init__(self, replica_id: int, addr: str, client: ServingClient):
@@ -51,6 +60,18 @@ class _ReplicaHandle:
         self.outstanding = 0  # guarded-by: router._lock
         self.last_seen = time.monotonic()  # guarded-by: router._lock
         self.last_status: msg.ServingStatusResponse | None = None
+        # probe-beat fan-in state: monotone counters and per-phase
+        # totals max-merged from serving_status payloads (a probe that
+        # raced an older snapshot cannot roll a counter back), memory
+        # ledger last-writer-wins by its own stamp  # guarded-by: _lock
+        self.counters: dict[str, int] = {}
+        self.phases: dict[str, dict] = {}
+        self.memory: dict = {}
+        self.memory_at: float = -1.0
+        # set when the last swap fan-out could not reach this replica;
+        # cleared by the next successful probe (the replica is back —
+        # the watchdog's swap_unreachable signal recovers)
+        self.swap_unreachable = False  # guarded-by: _lock
 
 
 def _retryable_failure(ex) -> bool:
@@ -82,6 +103,15 @@ class ServingRouter:
         self._next_id = 0  # guarded-by: _lock
         self._stop = threading.Event()
         self._probe_thread: threading.Thread | None = None
+        # fleet-wide running totals, maintained INCREMENTALLY by the
+        # per-replica merges (never recomputed by iterating replicas —
+        # a forgotten replica's contribution survives, so fleet totals
+        # stay monotone across evictions)  # guarded-by: _lock
+        self._fleet_counters: dict[str, int] = {}
+        self._fleet_phases: dict[str, dict] = {}
+        # optional SLO watchdog (serving/watchdog.py), ticked at the
+        # end of every probe sweep; None when the flag is off
+        self.watchdog = None
 
     # ---- registry ----------------------------------------------------------
 
@@ -143,9 +173,7 @@ class ServingRouter:
             except Exception:  # noqa: BLE001 — a dead replica IS the
                 # signal; the eviction horizon decides, not one failure
                 return
-            with self._lock:
-                handle.last_seen = time.monotonic()
-                handle.last_status = status
+            self._absorb_status(handle, status)
 
         if handles:
             with ThreadPoolExecutor(
@@ -165,6 +193,42 @@ class ServingRouter:
                 self._forget_after,
             )
             self.remove_replica(rid)
+        watchdog = self.watchdog
+        if watchdog is not None:
+            try:
+                watchdog.tick()
+            except Exception:  # noqa: BLE001 — the watchdog observes
+                # the beat; a watchdog bug must not kill the beat
+                logger.exception("Serving SLO watchdog tick failed")
+
+    def _absorb_status(self, handle, status):
+        """Fold one serving_status payload into the handle's merged
+        state and the fleet totals (the probe-beat fan-in): counters
+        and phase totals are MONOTONE on the replica, so a stale
+        payload racing a fresher one max-merges to a no-op; the memory
+        ledger snapshot is a gauge and goes last-writer-wins on its
+        own ``at`` stamp, never the arrival order."""
+        with self._lock:
+            handle.last_seen = time.monotonic()
+            handle.last_status = status
+            handle.swap_unreachable = False
+            if status.counters:
+                max_merge_counters(
+                    handle.counters,
+                    status.counters,
+                    totals=self._fleet_counters,
+                )
+            if status.phases:
+                max_merge_phase_stats(
+                    handle.phases,
+                    status.phases,
+                    totals=self._fleet_phases,
+                )
+            if status.memory:
+                at = float(status.memory.get("at", 0.0))
+                if at >= handle.memory_at:
+                    handle.memory = status.memory
+                    handle.memory_at = at
 
     def _probe_loop(self):
         while not self._stop.wait(self._probe_interval):
@@ -198,10 +262,39 @@ class ServingRouter:
             if ok:
                 handle.last_seen = time.monotonic()
 
+    def _route_span(self, ctx, attempt, t0, replica_id, error="", **attrs):
+        """One routing attempt as a child span of the REQUEST's trace:
+        the first attempt is ``route``, every retry is ``reroute`` —
+        parented into the same trace, so a re-sent request stays ONE
+        trace with the detour visible.  Only traced requests pay; an
+        untraced request skips the tracer entirely."""
+        if not ctx:
+            return
+        from elasticdl_tpu.telemetry import tracing
+
+        tracer = tracing.get_tracer()
+        if tracer is None:
+            return
+        name = (
+            tracing.SPAN_SERVING_ROUTE
+            if attempt == 0
+            else tracing.SPAN_SERVING_REROUTE
+        )
+        attrs = dict(
+            attrs, replica_id=int(replica_id), attempt=int(attempt)
+        )
+        if error:
+            attrs["error"] = error
+        tracer.record_span(
+            name, t0, time.monotonic(), trace_ctx=ctx, **attrs
+        )
+
     def predict(self, request: msg.PredictRequest) -> msg.PredictResponse:
         tried: set[int] = set()
+        ctx = request.trace or None
         last_error = "no live serving replicas"
-        for _attempt in range(MAX_ROUTE_ATTEMPTS):
+        for attempt in range(MAX_ROUTE_ATTEMPTS):
+            t0 = time.monotonic()
             handle = self._pick(tried)
             if handle is None:
                 break
@@ -214,6 +307,9 @@ class ServingRouter:
                 if not _retryable_failure(ex):
                     raise
                 last_error = f"replica {handle.replica_id}: {ex}"
+                self._route_span(
+                    ctx, attempt, t0, handle.replica_id, error=last_error
+                )
                 continue
             self._release(handle, ok=True)
             if response.error and response.retryable:
@@ -221,7 +317,11 @@ class ServingRouter:
                 last_error = (
                     f"replica {handle.replica_id}: {response.error}"
                 )
+                self._route_span(
+                    ctx, attempt, t0, handle.replica_id, error=last_error
+                )
                 continue
+            self._route_span(ctx, attempt, t0, handle.replica_id)
             return response
         return msg.PredictResponse(error=last_error, retryable=True)
 
@@ -258,9 +358,7 @@ class ServingRouter:
         live = []
         for h, status in fetched:
             if status is not None:
-                with self._lock:
-                    h.last_seen = time.monotonic()
-                    h.last_status = status
+                self._absorb_status(h, status)
                 live.append(h)
             elif (
                 now - h.last_seen <= self._evict_after
@@ -303,15 +401,23 @@ class ServingRouter:
         the fan-out not-accepted."""
         with self._lock:
             handles = list(self._replicas.values())
+        ctx = request.trace or None
         outcomes = []
         all_converged = bool(handles)
         version = -1
         for handle in handles:
+            # every fan-out leg is a ``route`` child of the SWAP's
+            # trace (one swap = one trace): the replica's model_swap
+            # span parents into the same trace via request.trace, so
+            # the export shows which leg ran where
+            t0 = time.monotonic()
             try:
                 response = handle.client.swap_model(request)
             except Exception as ex:  # noqa: BLE001 — an unreachable
                 # replica's swap outcome is reported, not raised
                 all_converged = False
+                with self._lock:
+                    handle.swap_unreachable = True
                 outcomes.append(
                     {
                         "replica_id": handle.replica_id,
@@ -320,7 +426,18 @@ class ServingRouter:
                         "reason": f"unreachable: {ex}",
                     }
                 )
+                self._route_span(
+                    ctx,
+                    0,
+                    t0,
+                    handle.replica_id,
+                    error="unreachable",
+                    method="swap_model",
+                )
                 continue
+            self._route_span(
+                ctx, 0, t0, handle.replica_id, method="swap_model"
+            )
             # a stale refusal IS convergence: the replica already
             # serves this version or newer (replay absorbed) — read
             # from the structured field, never the reason wording
@@ -345,6 +462,63 @@ class ServingRouter:
             or "no replicas registered",
             replicas=outcomes,
         )
+
+    # ---- observability read side --------------------------------------------
+
+    def fleet_snapshot(self) -> dict:
+        """Consistent point-in-time copy of the fan-in state — the ONE
+        read the fleet metrics collector, /healthz and the SLO watchdog
+        all consume (one lock hold, no RPCs: everything here arrived on
+        the probe beat).  Counters/phases are copied so callers can
+        diff ticks without racing the next merge."""
+        now = time.monotonic()
+        with self._lock:
+            replicas = {}
+            for rid, h in self._replicas.items():
+                age = max(0.0, now - h.last_seen)
+                status = h.last_status
+                replicas[rid] = {
+                    "replica_id": rid,
+                    "addr": h.addr,
+                    "outstanding": int(h.outstanding),
+                    "last_probe_age_secs": age,
+                    "live": age <= self._evict_after,
+                    # countdown to eviction (0 == already evicted):
+                    # /healthz shows how close each replica is to
+                    # dropping out of rotation
+                    "evict_in_secs": max(0.0, self._evict_after - age),
+                    "queue_rows": int(status.queue_rows) if status else 0,
+                    "model_version": (
+                        int(status.model_version) if status else -1
+                    ),
+                    "counters": dict(h.counters),
+                    "phases": {
+                        phase: {
+                            "ms": slot["ms"],
+                            "count": slot["count"],
+                            "buckets": dict(slot["buckets"]),
+                        }
+                        for phase, slot in h.phases.items()
+                    },
+                    "memory": h.memory,
+                    "swap_unreachable": bool(h.swap_unreachable),
+                }
+            return {
+                "at": now,
+                "replicas": replicas,
+                "live": [
+                    rid for rid, r in replicas.items() if r["live"]
+                ],
+                "counters": dict(self._fleet_counters),
+                "phases": {
+                    phase: {
+                        "ms": slot["ms"],
+                        "count": slot["count"],
+                        "buckets": dict(slot["buckets"]),
+                    }
+                    for phase, slot in self._fleet_phases.items()
+                },
+            }
 
     def close(self):
         self._stop.set()

@@ -1,0 +1,450 @@
+"""Router-side SLO watchdog: the PR-17 burn-rate plane over the
+serving fleet's probe-beat fan-in.
+
+The training watchdog judges signals the master derives from
+heartbeats; the serving watchdog judges signals the ROUTER derives
+from ``fleet_snapshot()`` — the merged probe-beat state — once per
+probe sweep (``ServingRouter.probe_once`` ticks it).  Nothing here
+talks RPC: every input already arrived on the beat, so a watchdog tick
+is pure arithmetic over two snapshots.
+
+Signals are PER-TICK DELTAS of the monotone fan-in totals, not
+cumulative values: a cumulative p99 would average the incident away
+against hours of healthy history, exactly the failure burn-rate
+windows exist to avoid.  Between two ticks the bucket counts' delta is
+a well-formed histogram of just that interval's requests (monotone
+per replica + max-merge ⇒ the delta is non-negative regardless of
+probe reordering), so the per-tick p99 is exact to bucket resolution.
+
+Incidents ride the PR-17 ``IncidentManager`` unchanged, with two
+serving-specific seams: ``classify_fn`` swaps the training rule set
+for :func:`classify_serving_cause` (queue-bound / compute-bound /
+replica-down / swap-in-progress), and every violation transition is
+enriched with the OFFENDING replica id before it enters the incident
+(transitions are copied verbatim into the artifact, so the postmortem
+names the replica, not just the fleet).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from elasticdl_tpu.telemetry import incident as incident_mod
+from elasticdl_tpu.telemetry import slo as slo_mod
+from elasticdl_tpu.telemetry.registry import SERVING_LATENCY_BUCKETS
+
+# serving-default objectives: thresholds a CPU-backed smoke can trip
+# deliberately but healthy fleets sit far under.  ``--slo_config`` with
+# explicit objectives overrides wholesale (same contract as training).
+DEFAULT_SERVING_OBJECTIVES = (
+    {
+        "name": "serving_latency_p99",
+        "signal": slo_mod.SIGNAL_SERVING_LATENCY_P99_MS,
+        "comparator": "above",
+        "threshold": 500.0,
+    },
+    {
+        "name": "serving_queue_wait",
+        "signal": slo_mod.SIGNAL_QUEUE_WAIT_SHARE,
+        "comparator": "above",
+        "threshold": 0.5,
+    },
+    {
+        "name": "serving_error_rate",
+        "signal": slo_mod.SIGNAL_SERVING_ERROR_RATE,
+        "comparator": "above",
+        "threshold": 0.05,
+    },
+    {
+        "name": "serving_replica_floor",
+        "signal": slo_mod.SIGNAL_SERVING_LIVE_REPLICAS,
+        "comparator": "below",
+        "threshold": 1.0,
+    },
+    {
+        "name": "serving_swap_unreachable",
+        "signal": slo_mod.SIGNAL_SERVING_SWAP_UNREACHABLE,
+        "comparator": "above",
+        "threshold": 0.0,
+    },
+)
+
+
+def parse_serving_slo_config(raw: str | None) -> dict | None:
+    """``--slo_config`` for the router: same grammar as the training
+    plane (None/"default"/inline JSON/path), but a config that names no
+    objectives gets the SERVING defaults, not the training ones."""
+    if not raw:
+        return None
+    stripped = raw.strip()
+    if stripped.lower() in ("default", "defaults", "on", "1", "true"):
+        doc: dict = {}
+    elif stripped.startswith("{"):
+        doc = json.loads(stripped)
+    else:
+        with open(stripped, encoding="utf-8") as f:
+            doc = json.load(f)
+    if not doc.get("objectives"):
+        doc["objectives"] = [dict(o) for o in DEFAULT_SERVING_OBJECTIVES]
+    return slo_mod.parse_slo_config(json.dumps(doc))
+
+
+# ---- pure signal derivation --------------------------------------------------
+
+
+def _phase_ms(phases: dict, name: str) -> float:
+    try:
+        return float((phases.get(name) or {}).get("ms", 0.0))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def _counter(counters: dict, name: str) -> int:
+    try:
+        return int(counters.get(name, 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+def _delta_buckets(prev: dict, cur: dict) -> dict:
+    """Per-tick histogram: current minus previous bucket counts
+    (non-negative by monotonicity; a racing merge can only make the
+    next tick's delta larger, never this one negative)."""
+    out = {}
+    for key, n in (cur or {}).items():
+        try:
+            d = int(n) - int((prev or {}).get(key, 0))
+        except (TypeError, ValueError):
+            continue
+        if d > 0:
+            out[key] = d
+    return out
+
+
+def p99_ms_from_buckets(buckets: dict) -> float | None:
+    """Bucket-resolution p99 of a per-tick delta histogram keyed by
+    str(upper-bound-secs) (``"inf"`` for the overflow bucket, reported
+    as 2x the ladder's top — a number a threshold can compare, where
+    the honest answer is only "slower than the ladder")."""
+    items = []
+    for key, n in buckets.items():
+        try:
+            bound, n = float(key), int(n)
+        except (TypeError, ValueError):
+            continue
+        if n > 0:
+            items.append((bound, n))
+    if not items:
+        return None
+    items.sort()
+    total = sum(n for _b, n in items)
+    target = 0.99 * total
+    cum = 0
+    for bound, n in items:
+        cum += n
+        if cum >= target:
+            if bound == float("inf"):
+                bound = SERVING_LATENCY_BUCKETS[-1] * 2.0
+            return bound * 1000.0
+    return items[-1][0] * 1000.0
+
+
+def derive_serving_signals(prev: dict, snap: dict) -> tuple[dict, dict]:
+    """(signals, offenders) between two ``fleet_snapshot()`` ticks.
+
+    ``signals`` feeds ``SLOEngine.evaluate``; a signal with no traffic
+    this tick is OMITTED (the objective stays dormant — an idle fleet
+    must not fire a latency alarm, the engine's missing-signal rule).
+    ``offenders`` maps each signal to the replica id that moved it most
+    this tick — the name the incident enrichment attaches.
+    """
+    signals: dict = {}
+    offenders: dict = {}
+
+    total_delta = _delta_buckets(
+        (prev.get("phases") or {}).get("total", {}).get("buckets"),
+        (snap.get("phases") or {}).get("total", {}).get("buckets"),
+    )
+    p99 = p99_ms_from_buckets(total_delta)
+    if p99 is not None:
+        signals[slo_mod.SIGNAL_SERVING_LATENCY_P99_MS] = p99
+
+    # per-tick phase-ms deltas -> queue_wait share, via the shared
+    # derivation (the "total" pseudo-phase would double the wall, so it
+    # is excluded before the share is taken)
+    delta_phases = {}
+    for phase, slot in (snap.get("phases") or {}).items():
+        if phase == "total":
+            continue
+        d = _phase_ms(snap["phases"], phase) - _phase_ms(
+            prev.get("phases") or {}, phase
+        )
+        if d > 0:
+            delta_phases[phase] = {"ms": d}
+    share = slo_mod.signals_from_phase_totals(delta_phases).get(
+        slo_mod.SIGNAL_QUEUE_WAIT_SHARE
+    )
+    if share is not None:
+        signals[slo_mod.SIGNAL_QUEUE_WAIT_SHARE] = share
+
+    prev_c = prev.get("counters") or {}
+    cur_c = snap.get("counters") or {}
+    d_ok = _counter(cur_c, "requests") - _counter(prev_c, "requests")
+    d_bad = (
+        _counter(cur_c, "errors")
+        + _counter(cur_c, "rejected")
+        - _counter(prev_c, "errors")
+        - _counter(prev_c, "rejected")
+    )
+    attempts = d_ok + d_bad
+    if attempts > 0:
+        signals[slo_mod.SIGNAL_SERVING_ERROR_RATE] = d_bad / attempts
+
+    # instantaneous signals: liveness and swap reachability are states,
+    # not rates — they evaluate every tick
+    replicas = snap.get("replicas") or {}
+    signals[slo_mod.SIGNAL_SERVING_LIVE_REPLICAS] = float(
+        len(snap.get("live") or [])
+    )
+    unreachable = sorted(
+        rid for rid, r in replicas.items() if r.get("swap_unreachable")
+    )
+    signals[slo_mod.SIGNAL_SERVING_SWAP_UNREACHABLE] = float(
+        len(unreachable)
+    )
+
+    # offender attribution: per-replica per-tick deltas
+    best = {"queue": (0.0, None), "total": (0.0, None), "err": (0, None)}
+    for rid, cur_r in replicas.items():
+        prev_r = (prev.get("replicas") or {}).get(rid) or {}
+        d_queue = _phase_ms(
+            cur_r.get("phases") or {}, "queue_wait"
+        ) - _phase_ms(prev_r.get("phases") or {}, "queue_wait")
+        d_total = _phase_ms(
+            cur_r.get("phases") or {}, "total"
+        ) - _phase_ms(prev_r.get("phases") or {}, "total")
+        d_err = (
+            _counter(cur_r.get("counters") or {}, "errors")
+            + _counter(cur_r.get("counters") or {}, "rejected")
+            - _counter(prev_r.get("counters") or {}, "errors")
+            - _counter(prev_r.get("counters") or {}, "rejected")
+        )
+        # a replica still queue-deep at the tick counts even if its
+        # merged totals did not move (nothing COMPLETED — the worst
+        # case of queue-bound, not the absence of it)
+        d_queue += float(cur_r.get("queue_rows") or 0) * 1e-9
+        if d_queue > best["queue"][0]:
+            best["queue"] = (d_queue, rid)
+        if d_total > best["total"][0]:
+            best["total"] = (d_total, rid)
+        if d_err > best["err"][0]:
+            best["err"] = (d_err, rid)
+    if best["queue"][1] is not None:
+        offenders[slo_mod.SIGNAL_QUEUE_WAIT_SHARE] = best["queue"][1]
+    if best["total"][1] is not None:
+        offenders[slo_mod.SIGNAL_SERVING_LATENCY_P99_MS] = best["total"][1]
+    if best["err"][1] is not None:
+        offenders[slo_mod.SIGNAL_SERVING_ERROR_RATE] = best["err"][1]
+    down = sorted(
+        rid for rid, r in replicas.items() if not r.get("live")
+    )
+    if down:
+        offenders[slo_mod.SIGNAL_SERVING_LIVE_REPLICAS] = down[0]
+    if unreachable:
+        offenders[slo_mod.SIGNAL_SERVING_SWAP_UNREACHABLE] = unreachable[0]
+    return signals, offenders
+
+
+# ---- serving cause classification --------------------------------------------
+
+
+def classify_serving_cause(
+    violations: list[dict],
+    context_open: dict | None,
+    context_close: dict | None,
+    window_events: list[dict] | None = None,
+) -> tuple[str, str]:
+    """Serving rule set for the incident ``classify_fn`` seam.
+
+    Specificity order mirrors the training classifier: a replica that
+    stopped answering probes explains everything downstream of it, a
+    swap that could not reach a replica explains a version skew, and
+    only then does the anatomy delta split queue-bound (time died
+    WAITING) vs compute-bound (time died COMPUTING)."""
+    del window_events  # the serving timeline rides the artifact as-is
+
+    def offender(signal: str) -> object:
+        for v in violations:
+            if v.get("signal") == signal and v.get("replica_id") is not None:
+                return v["replica_id"]
+        for v in violations:
+            if v.get("replica_id") is not None:
+                return v["replica_id"]
+        return None
+
+    signals = {v.get("signal") for v in violations}
+    if slo_mod.SIGNAL_SERVING_LIVE_REPLICAS in signals:
+        rid = offender(slo_mod.SIGNAL_SERVING_LIVE_REPLICAS)
+        return (
+            incident_mod.CAUSE_REPLICA_DOWN,
+            f"live-replica floor violated; replica {rid} stopped "
+            "answering probes"
+            if rid is not None
+            else "live-replica floor violated with no replica in rotation",
+        )
+    if slo_mod.SIGNAL_SERVING_SWAP_UNREACHABLE in signals:
+        rid = offender(slo_mod.SIGNAL_SERVING_SWAP_UNREACHABLE)
+        return (
+            incident_mod.CAUSE_SWAP_IN_PROGRESS,
+            f"model swap fan-out could not reach replica {rid}; the "
+            "fleet is version-skewed until it returns",
+        )
+    open_ph = (context_open or {}).get("anatomy") or {}
+    close_ph = (context_close or {}).get("anatomy") or {}
+    queue = _phase_ms(close_ph, "queue_wait") - _phase_ms(
+        open_ph, "queue_wait"
+    )
+    total = _phase_ms(close_ph, "total") - _phase_ms(open_ph, "total")
+    compute = max(0.0, total - queue)
+    rid = offender(slo_mod.SIGNAL_QUEUE_WAIT_SHARE)
+    if rid is None:
+        rid = offender(slo_mod.SIGNAL_SERVING_LATENCY_P99_MS)
+    who = f" (worst: replica {rid})" if rid is not None else ""
+    if queue >= compute:
+        return (
+            incident_mod.CAUSE_QUEUE_BOUND,
+            f"queue_wait grew {queue:.1f}ms vs {compute:.1f}ms "
+            f"in-dispatch across the incident{who}",
+        )
+    return (
+        incident_mod.CAUSE_COMPUTE_BOUND,
+        f"in-dispatch time grew {compute:.1f}ms vs {queue:.1f}ms "
+        f"queue_wait across the incident{who}",
+    )
+
+
+class _AttributingIncidents:
+    """IncidentManager facade that stamps the offending replica onto
+    every violation transition before it enters the episode — the
+    transition dict is copied VERBATIM into the artifact, so the extra
+    key rides through to the postmortem (and to classify's rationale)
+    with no incident-format change."""
+
+    def __init__(self, inner: incident_mod.IncidentManager, offender_fn):
+        self._inner = inner
+        self._offender_fn = offender_fn
+
+    def on_violation(self, transition: dict, now: float):
+        transition = dict(transition)
+        rid = self._offender_fn(transition.get("signal"))
+        if rid is not None:
+            transition["replica_id"] = rid
+        self._inner.on_violation(transition, now)
+
+    def on_recovery(self, transition: dict, now: float, all_clear: bool):
+        self._inner.on_recovery(transition, now, all_clear)
+
+    def note_profile_window(self, window):
+        self._inner.note_profile_window(window)
+
+    @property
+    def open_count(self) -> int:
+        return self._inner.open_count
+
+    @property
+    def total_count(self) -> int:
+        return self._inner.total_count
+
+    @property
+    def open_incident(self):
+        return self._inner.open_incident
+
+    @property
+    def closed(self):
+        return self._inner.closed
+
+
+class ServingWatchdog:
+    """The router's SLO plane: one ``tick()`` per probe sweep.
+
+    Owns a PR-17 :class:`SLOEngine` (burn-rate detection, event/span
+    emission, elasticdl_slo_* mirroring — all reused, none re-derived)
+    and an :class:`IncidentManager` whose context snapshots are the
+    router's fan-in state and whose cause rules are serving-specific.
+    The clock is injectable for tests; production leaves the default.
+    """
+
+    def __init__(
+        self,
+        router,
+        config: dict,
+        telemetry_dir: str = "",
+        emit=None,
+        tracer=None,
+        clock=time.monotonic,
+    ):
+        self.router = router
+        self._clock = clock
+        self.incidents = incident_mod.IncidentManager(
+            telemetry_dir=telemetry_dir,
+            emit=emit,
+            clock=clock,
+            context_fn=self._context,
+            classify_fn=classify_serving_cause,
+        )
+        self.engine = slo_mod.SLOEngine(
+            config,
+            clock=clock,
+            emit=emit,
+            tracer=tracer,
+            incidents=_AttributingIncidents(
+                self.incidents, self._offender
+            ),
+        )
+        self._prev: dict | None = None
+        self._offenders: dict = {}
+
+    def _context(self) -> dict:
+        """Incident context snapshot: the classifier's anatomy is the
+        FLEET phase totals (cumulative — classify takes open/close
+        deltas), plus the per-replica brief the postmortem reader
+        starts from."""
+        snap = self.router.fleet_snapshot()
+        return {
+            "anatomy": snap["phases"],
+            "serving": {
+                "live": snap["live"],
+                "counters": snap["counters"],
+                "replicas": {
+                    rid: {
+                        "queue_rows": r["queue_rows"],
+                        "outstanding": r["outstanding"],
+                        "last_probe_age_secs": r["last_probe_age_secs"],
+                        "model_version": r["model_version"],
+                        "swap_unreachable": r["swap_unreachable"],
+                    }
+                    for rid, r in snap["replicas"].items()
+                },
+            },
+        }
+
+    def _offender(self, signal):
+        return self._offenders.get(signal)
+
+    def tick(self) -> list[dict]:
+        """One evaluation over the delta since the previous tick.  The
+        first tick only seeds the baseline (the /healthz first-read
+        rule: a restart must not manufacture a burn)."""
+        snap = self.router.fleet_snapshot()
+        prev, self._prev = self._prev, snap
+        if prev is None:
+            return []
+        signals, self._offenders = derive_serving_signals(prev, snap)
+        return self.engine.evaluate(signals, now=snap["at"])
+
+    def health_block(self) -> dict:
+        return self.engine.health_block()
+
+    def mirror_metrics(self, registry):
+        self.engine.mirror_metrics(registry)

@@ -49,6 +49,11 @@ CAUSE_COMPUTE_BOUND = "compute-bound"
 CAUSE_NETWORK_DEGRADED = "network-degraded"
 CAUSE_MEMORY_PRESSURE = "memory-pressure"
 CAUSE_CONTROL_PLANE = "control-plane"
+# serving-plane causes (classified by serving/watchdog.py's
+# classify_serving_cause through the classify_fn seam below)
+CAUSE_QUEUE_BOUND = "queue-bound"
+CAUSE_REPLICA_DOWN = "replica-down"
+CAUSE_SWAP_IN_PROGRESS = "swap-in-progress"
 
 # events whose presence in the window marks control-plane churn
 _CONTROL_PLANE_EVENTS = frozenset(
@@ -186,11 +191,17 @@ class IncidentManager:
         clock=time.monotonic,
         context_fn=None,
         lookback_secs: float = DEFAULT_LOOKBACK_SECS,
+        classify_fn=None,
     ):
         self._dir = telemetry_dir or ""
         self._emit = emit
         self._clock = clock
         self._context_fn = context_fn
+        # cause-classification seam: the training plane's rule set is
+        # the default; the serving watchdog swaps in its own (same
+        # signature) so serving incidents speak queue-bound /
+        # replica-down, not input-bound
+        self._classify_fn = classify_fn or classify_cause
         self._lookback_secs = float(lookback_secs)
         self._seq = 0
         self._open: dict | None = None
@@ -404,7 +415,7 @@ class IncidentManager:
         context_close = self._snapshot_context()
         start = incident["onset_at"] - self._lookback_secs
         events, spans = self._window_records(start, now)
-        cause, rationale = classify_cause(
+        cause, rationale = self._classify_fn(
             incident["violations"],
             incident["context_open"],
             context_close,

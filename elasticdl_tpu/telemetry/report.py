@@ -1380,6 +1380,8 @@ def summarize_report(report: dict) -> dict:
     slo_recoveries = 0
     still_firing: list[str] = []
     events_total = 0
+    serving_runs = 0
+    serving_totals = {"requests": 0, "rows": 0, "sheds": 0, "errors": 0}
     for rel, run in report.get("runs", {}).items():
         events_total += run.get("events_total", 0)
         slo = run.get("slo")
@@ -1391,6 +1393,11 @@ def summarize_report(report: dict) -> dict:
                 reasons.append(
                     f"slo objective {objective} still firing ({rel})"
                 )
+        serving = run.get("serving")
+        if serving:
+            serving_runs += 1
+            for key in serving_totals:
+                serving_totals[key] += int(serving.get(key, 0))
     chaos = report.get("chaos_result")
     if chaos is not None and not chaos.get("invariants_ok", True):
         reasons.append("chaos invariants failed")
@@ -1431,6 +1438,12 @@ def summarize_report(report: dict) -> dict:
             "open": len(incidents.get("open", [])),
             "causes": incidents.get("causes", {}),
         },
+        # serving runs ride the same verdict ladder (their incidents
+        # and SLO blocks land via the shared paths above); the digest
+        # adds the traffic counts CI asserts on, None when no run served
+        "serving": {"runs": serving_runs, **serving_totals}
+        if serving_runs
+        else None,
         "chaos": {
             "plan": chaos.get("plan"),
             "invariants_ok": chaos.get("invariants_ok"),

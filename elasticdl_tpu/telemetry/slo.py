@@ -73,6 +73,12 @@ SIGNAL_E2E_VS_ROOFLINE = "e2e_vs_roofline"
 SIGNAL_MEMORY_HEADROOM_SHARE = "memory_headroom_share"
 SIGNAL_RPC_OUTAGE_RISE = "rpc_outage_rise"
 SIGNAL_QUEUE_WAIT_SHARE = "queue_wait_share"
+# serving-fleet signals (derived by serving/watchdog.py from the
+# router's probe-beat fan-in; absent on training runs)
+SIGNAL_SERVING_LATENCY_P99_MS = "serving_latency_p99_ms"
+SIGNAL_SERVING_ERROR_RATE = "serving_error_rate"
+SIGNAL_SERVING_LIVE_REPLICAS = "serving_live_replicas"
+SIGNAL_SERVING_SWAP_UNREACHABLE = "serving_swap_unreachable"
 
 # outage-class RPC counters whose rise feeds SIGNAL_RPC_OUTAGE_RISE
 # (the same classes the /healthz degraded-network flag watches)

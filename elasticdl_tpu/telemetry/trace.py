@@ -55,12 +55,18 @@ from elasticdl_tpu.telemetry.tracing import (
     SPAN_JOURNAL_REPLAY,
     SPAN_MASTER_RESTART,
     SPAN_MESH_RESIZE,
+    SPAN_PREDICT_REQUEST,
     SPAN_REFORM,
     SPAN_REFORM_FENCE,
     SPAN_REFORM_RELAUNCH,
     SPAN_REPLICA_HARVEST,
     SPAN_REPLICA_RESTORE,
     SPAN_RPC_DEGRADED,
+    SPAN_SERVING_DISPATCH,
+    SPAN_SERVING_ENGINE,
+    SPAN_SERVING_QUEUE,
+    SPAN_SERVING_REROUTE,
+    SPAN_SERVING_ROUTE,
     SPAN_TRAINER_BUILD,
     SPAN_WORKER_REHOME,
     SPAN_WORLD_INITIALIZE,
@@ -107,12 +113,22 @@ class _Tracks:
         self.metadata: list[dict] = []
 
     def pid(self, run: str, role: str, worker_id, generation) -> int:
+        prefix = f"{run} " if run else ""
         if role == "master":
             key = (run, "master", None)
-            label = f"{run} master" if run else "master"
+            label = f"{prefix}master"
+        elif role in ("router", "client"):
+            # singleton serving actors: one track each (a router has no
+            # generations — its lifetime IS the fleet's)
+            key = (run, role, None)
+            label = f"{prefix}{role}"
+        elif role == "replica":
+            # one track per serving replica, so a request's trace reads
+            # client -> router -> replica N top to bottom
+            key = (run, "replica", worker_id)
+            label = f"{prefix}replica {worker_id}"
         else:
             key = (run, worker_id, generation)
-            prefix = f"{run} " if run else ""
             label = f"{prefix}worker {worker_id} gen {generation}"
         pid = self._pids.get(key)
         if pid is None:
@@ -633,6 +649,99 @@ def _steady_state(events: list[dict]) -> dict:
     return out
 
 
+# uncovered time inside a predict request, named for what the pipeline
+# is doing after the preceding phase: after routing the request sits in
+# the replica queue, after queueing it computes, after compute the
+# response returns through router to client
+_SERVING_BRIDGE = {
+    "route": "queue_wait",
+    "queue_wait": "compute",
+    "compute": "response_return",
+}
+
+
+def _serving_critical_path(spans: list[dict]) -> dict:
+    """Per-request critical path of the serving plane: each
+    ``predict_request`` root's wall is attributed over its trace's
+    router/replica child spans with the SAME sum-exact boundary sweep
+    the reform analysis uses — route, queue_wait, compute, the
+    response's return leg, and honest ``unattributed`` for traces with
+    missing children.  Sums (per trace AND in total) equal the measured
+    request wall exactly."""
+    roots = [
+        s
+        for s in _spans_named(spans, SPAN_PREDICT_REQUEST)
+        if s.get("start") is not None and s.get("end") is not None
+    ]
+    if not roots:
+        return {}
+    by_trace: dict[str, list[dict]] = defaultdict(list)
+    for span in spans:
+        if span.get("trace_id"):
+            by_trace[span["trace_id"]].append(span)
+    totals: dict[str, float] = defaultdict(float)
+    wall_total = 0.0
+    reroutes = 0
+    for root in sorted(roots, key=lambda s: s["start"]):
+        members = [
+            s
+            for s in by_trace.get(root.get("trace_id"), [])
+            if s is not root
+            and s.get("start") is not None
+            and s.get("end") is not None
+        ]
+        # pipeline order (later listed wins overlaps): the route span
+        # covers the whole downstream RPC, so the replica's finer
+        # queue/compute split takes the overlap and "route" keeps only
+        # the router's own pick/transport time
+        intervals = []
+        for span in members:
+            if span.get("span") in (
+                SPAN_SERVING_ROUTE,
+                SPAN_SERVING_REROUTE,
+            ):
+                intervals.append(("route", span["start"], span["end"]))
+                if span.get("span") == SPAN_SERVING_REROUTE:
+                    reroutes += 1
+        for span in members:
+            if span.get("span") == SPAN_SERVING_QUEUE:
+                intervals.append(
+                    ("queue_wait", span["start"], span["end"])
+                )
+        for span in members:
+            if span.get("span") == SPAN_SERVING_ENGINE:
+                intervals.append(("compute", span["start"], span["end"]))
+        phases = _attribute_gap(
+            intervals,
+            root["start"],
+            root["end"],
+            tail_name="response_return",
+            bridge=_SERVING_BRIDGE,
+        )
+        for name, secs in phases.items():
+            totals[name] += secs
+        wall_total += max(0.0, root["end"] - root["start"])
+    dispatches = _spans_named(spans, SPAN_SERVING_DISPATCH)
+    attributed = sum(
+        v for k, v in totals.items() if k != "unattributed"
+    )
+    return {
+        "requests": len(roots),
+        "reroutes": reroutes,
+        "wall_secs_total": round(wall_total, 6),
+        "phases_secs": {
+            k: round(v, 6) for k, v in sorted(totals.items())
+        },
+        "coverage": round(attributed / wall_total, 4)
+        if wall_total
+        else None,
+        "dispatch_groups": len(dispatches),
+        "linked_dispatch_groups": sum(
+            1 for s in dispatches if s.get("links")
+        ),
+    }
+
+
 def analyze_telemetry_dir(telemetry_dir: str) -> dict:
     """Analysis of ONE run's spans+events pair (pure function of the
     logs; the unit tests drive it with canned files)."""
@@ -714,6 +823,9 @@ def analyze_telemetry_dir(telemetry_dir: str) -> dict:
     }
     if steady_state:
         out["steady_state"] = steady_state
+    serving = _serving_critical_path(spans)
+    if serving:
+        out["serving"] = serving
     return out
 
 
@@ -795,6 +907,27 @@ def _format_analysis(report: dict) -> str:
                         (stats["share"] or 0.0) * 100.0,
                     )
                 )
+        serving = run.get("serving")
+        if serving:
+            lines.append(
+                "serving: {} request(s) / {} reroute(s), wall {:.3f}s, "
+                "coverage {}".format(
+                    serving["requests"],
+                    serving["reroutes"],
+                    serving["wall_secs_total"],
+                    f"{serving['coverage'] * 100:.0f}%"
+                    if serving["coverage"] is not None
+                    else "n/a",
+                )
+            )
+            for phase, secs in serving["phases_secs"].items():
+                lines.append(f"  {phase:<20s} {secs:8.3f}s")
+            lines.append(
+                "  dispatch groups: {} ({} linked)".format(
+                    serving["dispatch_groups"],
+                    serving["linked_dispatch_groups"],
+                )
+            )
         for gen, stats in run["stragglers"].items():
             for worker, w in stats["workers"].items():
                 flag = "  STRAGGLER" if w["straggler"] else ""

@@ -85,6 +85,16 @@ SPAN_SERVING_REQUEST = "serving_request"  # serving: one request (sampled)
 SPAN_MODEL_SWAP = "model_swap"  # serving: one hot model swap
 SPAN_FLEET_FAULT = "fleet_fault"  # fleetsim: one mass-fault injection
 SPAN_SLO_WATCH = "slo_watch"  # slo: burn window, first bad eval -> fire
+# serving fleet request tracing: one predict request is ONE trace —
+# the client's root, the router's (re)route children, the replica's
+# queue-vs-engine split, and the shared dispatch group LINKED (not
+# parented: one group serves many traces) to every member request
+SPAN_PREDICT_REQUEST = "predict_request"  # client: root, send -> response
+SPAN_SERVING_ROUTE = "route"  # router: first routing attempt
+SPAN_SERVING_REROUTE = "reroute"  # router: retry/eviction re-attempt
+SPAN_SERVING_QUEUE = "queue"  # replica: submit -> first dispatch
+SPAN_SERVING_ENGINE = "engine"  # replica: first dispatch -> delivered
+SPAN_SERVING_DISPATCH = "serving_dispatch"  # replica: one batch group
 
 
 def gen_trace_id() -> str:

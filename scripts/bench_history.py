@@ -112,6 +112,18 @@ def load_round(path: str) -> dict:
     return entry
 
 
+def _point_queue_share(point: dict) -> float | None:
+    """Trace-derived queue share of a point (r02+ artifacts carry
+    ``trace_attribution``; r01 predates it — absent stays None)."""
+    phases = (point.get("trace_attribution") or {}).get("phases_secs") or {}
+    attributed = sum(
+        v for k, v in phases.items() if k != "unattributed"
+    )
+    if not attributed:
+        return None
+    return round(phases.get("queue_wait", 0.0) / attributed, 4)
+
+
 def load_serving_round(path: str) -> dict:
     with open(path, encoding="utf-8") as f:
         raw = json.load(f)
@@ -121,6 +133,9 @@ def load_serving_round(path: str) -> dict:
             "qps_completed": p.get("qps_completed"),
             "latency_p95_ms": (p.get("latency_ms") or {}).get("p95"),
             "errors": p.get("errors"),
+            # observability-plane columns (None for pre-r02 artifacts)
+            "queue_share": _point_queue_share(p),
+            "slo_ok": (p.get("slo") or {}).get("ok"),
         }
         for p in raw.get("points", [])
     ]
@@ -131,6 +146,7 @@ def load_serving_round(path: str) -> dict:
         key=lambda p: p.get("qps_completed") or 0.0,
         default=None,
     )
+    slo_flags = [p["slo_ok"] for p in points if p["slo_ok"] is not None]
     return {
         "round": _round_number(os.path.basename(path)),
         "file": os.path.basename(path),
@@ -143,6 +159,13 @@ def load_serving_round(path: str) -> dict:
         else None,
         "latency_p95_ms_at_max": headline.get("latency_p95_ms")
         if headline
+        else None,
+        "queue_share_at_max": headline.get("queue_share")
+        if headline
+        else None,
+        # None when the round predates per-point SLO verdicts (r01)
+        "slo_ok_points": f"{sum(slo_flags)}/{len(slo_flags)}"
+        if slo_flags
         else None,
     }
 
@@ -255,13 +278,21 @@ def format_history(history: dict) -> str:
         lines.append("serving bench history:")
         for entry in serving:
             delta = entry.get("qps_delta_pct")
+            extras = ""
+            if entry.get("queue_share_at_max") is not None:
+                extras += (
+                    f", queue share {entry['queue_share_at_max']} at max"
+                )
+            if entry.get("slo_ok_points") is not None:
+                extras += f", slo ok {entry['slo_ok_points']} points"
             lines.append(
                 "  r{:02d}: max {} qps completed, p95 {} ms at max load, "
-                "{} steady-state recompiles{}".format(
+                "{} steady-state recompiles{}{}".format(
                     entry["round"],
                     entry["max_qps_completed"],
                     entry["latency_p95_ms_at_max"],
                     entry["steady_state_recompiles"],
+                    extras,
                     f"  ({delta:+.1f}% qps)" if delta is not None else "",
                 )
             )

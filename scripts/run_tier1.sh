@@ -30,7 +30,12 @@
 # requests per-row identical to the trainer's direct forward with
 # sum-exact per-request phases, compile counter FLAT across arbitrary
 # request sizes AND across a hot model swap under in-flight traffic
-# with zero failed requests)
+# with zero failed requests; each mixed request traced end to end —
+# ONE trace across client/router/replica with a linked dispatch group
+# and a sum-exact analyzer critical path; a queue flood fires the
+# router-side SLO watchdog exactly once with a queue-bound incident
+# naming the replica, healthy traffic recovers it, and /healthz +
+# /metrics expose the per-replica probe-beat fan-in)
 # + fleetsim smoke (1000 simulated workers drive the REAL master on a
 # virtual clock: mass preemption, rolling slice loss, and master-kill-
 # under-fan-in must all PASS exactly-once + scaling budgets [master CPU

@@ -7,9 +7,9 @@ subprocess over gRPC):
 
 1. train a tiny MNIST job and export it; serve the export;
 2. fire mixed-size CONCURRENT requests (1, 7, canonical, canonical+3
-   rows): every response must be per-row IDENTICAL to the training
-   trainer's direct forward, and every response's phase decomposition
-   must sum exactly to its total;
+   rows), each under a client-side trace: every response must be
+   per-row IDENTICAL to the training trainer's direct forward, and
+   every response's phase decomposition must sum exactly to its total;
 3. compile-once: after one warmup request the replica's process-wide
    compile counter must stay FLAT across all the mixed traffic —
    arbitrary request sizes hit one pre-compiled XLA program;
@@ -17,13 +17,25 @@ subprocess over gRPC):
    while a hammer thread keeps requests in flight — ZERO failed
    requests, the served version advances, post-swap outputs match the
    new weights, and the compile counter is STILL flat;
-5. the telemetry dir (env-forwarded to the replica like a worker)
+5. SLO watchdog: a deliberate queue flood trips the router-side
+   ``serving_queue_wait`` objective EXACTLY once (slo_violation +
+   incident_open), light follow-up traffic recovers it (slo_recovered
+   + incident_close), and the incident postmortem classifies the cause
+   as queue-bound naming the offending replica;  /healthz carries the
+   per-replica probe ages and the slo block flip, /metrics the
+   ``elasticdl_serving_replica_*`` fan-in families;
+6. the telemetry dir (env-forwarded to the replica like a worker)
    carries ``serving_request`` events with sum-exact phases and one
-   ``model_swap`` event.
+   ``model_swap`` event — and after a graceful shutdown, ONE trace per
+   mixed request spanning all three processes (client root, router
+   route, replica queue/engine) with the batched dispatch group LINKED
+   to its member traces; the analyzer's serving critical path and the
+   Chrome export both read it back.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import subprocess
@@ -31,6 +43,7 @@ import sys
 import tempfile
 import threading
 import time
+import urllib.request
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
@@ -40,10 +53,38 @@ sys.path.insert(
 
 CANONICAL = 8
 
+# one objective, tuned so only a SUSTAINED flood fires it: fire needs
+# min_evals consecutive bad probe ticks (fire_share 1.0) inside the
+# fast window, so the smoke's short bursts (mixed phase, swap hammer)
+# can never produce 3-in-4s all-bad; the flood holds the queue deep for
+# seconds and always does
+SLO_CONFIG = json.dumps(
+    {
+        "objectives": [
+            {
+                "name": "serving_queue_wait",
+                "signal": "queue_wait_share",
+                "comparator": "above",
+                "threshold": 0.6,
+                "windows": {
+                    "fast_secs": 4.0,
+                    "slow_secs": 8.0,
+                    "min_evals": 3,
+                },
+            }
+        ]
+    }
+)
+
 
 def _fail(message: str) -> int:
     print(f"serving_smoke: {message}", file=sys.stderr)
     return 1
+
+
+def _http_get(addr: str, path: str) -> str:
+    with urllib.request.urlopen(f"http://{addr}{path}", timeout=5) as r:
+        return r.read().decode("utf-8")
 
 
 def main() -> int:  # noqa: PLR0915 — one linear smoke scenario
@@ -54,6 +95,7 @@ def main() -> int:  # noqa: PLR0915 — one linear smoke scenario
     from elasticdl_tpu.rpc import messages as msg
     from elasticdl_tpu.rpc.deadline import DeadlinePolicy
     from elasticdl_tpu.serving.replica import ServingClient
+    from elasticdl_tpu.telemetry import tracing
     from elasticdl_tpu.trainer.local_executor import LocalExecutor
     from elasticdl_tpu.utils.args import parse_master_args
     from elasticdl_tpu.utils.export_utils import export_model
@@ -98,8 +140,14 @@ def main() -> int:  # noqa: PLR0915 — one linear smoke scenario
     export_model(export_v2, state_v2, None, args)
     v2 = v1 + 5
 
+    # the smoke process IS the serving client: its root spans land in
+    # the same spans.jsonl the router/replica write, so one request
+    # reads back as one trace across three processes
+    tracing.install(telemetry_dir, role="client")
+
     # ---- serve export_v1 through the real CLI -------------------------------
     addr_file = os.path.join(workdir, "serving.addr")
+    metrics_addr_file = os.path.join(workdir, "metrics.addr")
     proc = subprocess.Popen(
         [
             sys.executable,
@@ -117,30 +165,45 @@ def main() -> int:  # noqa: PLR0915 — one linear smoke scenario
             str(CANONICAL),
             "--max_wait_ms",
             "2",
+            "--max_queue_rows",
+            "4096",
             "--telemetry_dir",
             telemetry_dir,
             "--metrics_port",
-            "-1",
+            "0",
+            "--metrics_addr_file",
+            metrics_addr_file,
+            "--slo_config",
+            SLO_CONFIG,
         ],
         env=dict(os.environ),
     )
     client = None
     try:
         deadline = time.monotonic() + 120
-        addr = ""
+        addr = metrics_addr = ""
         while time.monotonic() < deadline:
             if proc.poll() is not None:
                 return _fail(f"serving CLI exited rc={proc.returncode}")
-            try:
-                with open(addr_file, encoding="utf-8") as f:
-                    addr = f.read().strip()
-                if addr:
-                    break
-            except OSError:
-                pass
+            for path, have in ((addr_file, addr), (metrics_addr_file, metrics_addr)):
+                if have:
+                    continue
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        text = f.read().strip()
+                    if path == addr_file:
+                        addr = text
+                    else:
+                        metrics_addr = text
+                except OSError:
+                    pass
+            if addr and metrics_addr:
+                break
             time.sleep(0.1)
         if not addr:
             return _fail("frontend never published its address")
+        if not metrics_addr:
+            return _fail("frontend never published its /metrics address")
         client = ServingClient(addr, deadlines=DeadlinePolicy.from_secs(30))
 
         rng = np.random.RandomState(0)
@@ -148,13 +211,25 @@ def main() -> int:  # noqa: PLR0915 — one linear smoke scenario
         def feats(n: int) -> dict:
             return {"image": rng.rand(n, 28, 28, 1).astype(np.float32)}
 
-        def predict(request_id: str, features: dict):
-            return client.predict(
-                msg.PredictRequest(
-                    request_id=request_id,
-                    features=msg.pack_array_tree(features),
+        def predict(request_id: str, features: dict, traced: bool = False):
+            trace = {}
+            span = None
+            if traced:
+                span = tracing.get_tracer().start_span(
+                    tracing.SPAN_PREDICT_REQUEST, request_id=request_id
                 )
-            )
+                trace = span.context
+            try:
+                return client.predict(
+                    msg.PredictRequest(
+                        request_id=request_id,
+                        features=msg.pack_array_tree(features),
+                        trace=trace,
+                    )
+                )
+            finally:
+                if span is not None:
+                    span.end()
 
         # warmup: the first dispatch pays the one compile
         warm = predict("warmup", feats(CANONICAL))
@@ -164,14 +239,14 @@ def main() -> int:  # noqa: PLR0915 — one linear smoke scenario
         if status0.compile_count <= 0:
             return _fail("replica reports zero compiles after warmup")
 
-        # mixed sizes, concurrently
+        # mixed sizes, concurrently, each under its own client trace
         from concurrent.futures import ThreadPoolExecutor
 
         sizes = [1, 7, CANONICAL, CANONICAL + 3]
         inputs = [feats(n) for n in sizes]
         with ThreadPoolExecutor(len(sizes)) as pool:
             futures = [
-                pool.submit(predict, f"mixed-{i}", x)
+                pool.submit(predict, f"mixed-{i}", x, True)
                 for i, x in enumerate(inputs)
             ]
             responses = [f.result() for f in futures]
@@ -283,14 +358,132 @@ def main() -> int:  # noqa: PLR0915 — one linear smoke scenario
                 f"{status0.compile_count} -> {status2.compile_count}"
             )
 
-        # ---- telemetry: serving events landed -------------------------------
+        # ---- /healthz + /metrics: the fan-in is scrapeable ------------------
+        health = json.loads(_http_get(metrics_addr, "/healthz"))
+        replica0 = (health.get("replicas") or {}).get("0")
+        if not replica0 or "last_probe_age_secs" not in replica0:
+            return _fail(f"/healthz missing per-replica probe age: {health}")
+        if "outstanding" not in replica0 or "evict_in_secs" not in replica0:
+            return _fail(f"/healthz replica block incomplete: {replica0}")
+        slo_block = health.get("slo")
+        if not slo_block or not slo_block.get("ok"):
+            return _fail(f"/healthz slo block not healthy pre-flood: {slo_block}")
+        metrics_text = _http_get(metrics_addr, "/metrics")
+        for needle in (
+            'elasticdl_serving_replica_queue_rows{replica="0"}',
+            'elasticdl_serving_replica_probe_age_secs{replica="0"}',
+            "elasticdl_serving_replica_phase_ms_total",
+        ):
+            if needle not in metrics_text:
+                return _fail(f"/metrics missing {needle!r}")
+
+        # ---- SLO watchdog: flood -> fire once -> recover ---------------------
+        flood_stop = threading.Event()
+
+        def flood():
+            i = 0
+            while not flood_stop.is_set():
+                r = predict(f"flood-{i}", feats(48))
+                if r.error:
+                    failures.append(r.error)
+                i += 1
+
+        flood_threads = [
+            threading.Thread(target=flood, daemon=True) for _ in range(6)
+        ]
+        for t in flood_threads:
+            t.start()
+        fired_block = None
+        fire_deadline = time.monotonic() + 45
+        while time.monotonic() < fire_deadline:
+            block = json.loads(_http_get(metrics_addr, "/healthz")).get("slo")
+            if block and not block.get("ok"):
+                fired_block = block
+                break
+            time.sleep(0.3)
+        flood_stop.set()
+        for t in flood_threads:
+            t.join(timeout=15)
+        if fired_block is None:
+            return _fail("queue flood never tripped the serving_queue_wait SLO")
+        if failures:
+            return _fail(
+                f"{len(failures)} flood requests failed "
+                f"(first: {failures[0]})"
+            )
+
+        # recovery needs HEALTHY traffic: the watchdog's signals are
+        # per-tick deltas, so an idle fleet is dormant and the latched
+        # objective would never clear — light sequential canonical
+        # requests give it all-good fast-window samples
+        recovered = False
+        recover_deadline = time.monotonic() + 45
+        i = 0
+        while time.monotonic() < recover_deadline:
+            predict(f"recover-{i}", feats(CANONICAL))
+            i += 1
+            block = json.loads(_http_get(metrics_addr, "/healthz")).get("slo")
+            if block and block.get("ok") and not block.get("incidents_open"):
+                recovered = True
+                break
+            time.sleep(0.25)
+        if not recovered:
+            return _fail("slo block never recovered after the flood stopped")
+
+        # exactly-once transition discipline, straight from the event log
         from elasticdl_tpu.telemetry.events import (
+            EVENT_INCIDENT_CLOSE,
+            EVENT_INCIDENT_OPEN,
             EVENT_MODEL_SWAP,
             EVENT_SERVING_REQUEST,
+            EVENT_SLO_RECOVERED,
+            EVENT_SLO_VIOLATION,
             read_events,
         )
 
         events = read_events(os.path.join(telemetry_dir, "events.jsonl"))
+        counts = {
+            name: sum(1 for e in events if e.get("event") == name)
+            for name in (
+                EVENT_SLO_VIOLATION,
+                EVENT_SLO_RECOVERED,
+                EVENT_INCIDENT_OPEN,
+                EVENT_INCIDENT_CLOSE,
+            )
+        }
+        if any(n != 1 for n in counts.values()):
+            return _fail(f"SLO transitions not exactly-once: {counts}")
+
+        # the postmortem: queue-bound, naming the flooded replica
+        from elasticdl_tpu.telemetry.incident import read_incidents
+
+        records = read_incidents(telemetry_dir)
+        if len(records) != 1:
+            return _fail(f"{len(records)} incident artifacts, expected 1")
+        record = records[0]
+        if record.get("suspected_cause") not in ("queue-bound", "compute-bound"):
+            return _fail(
+                f"incident cause {record.get('suspected_cause')!r} "
+                f"({record.get('rationale')!r})"
+            )
+        if record.get("suspected_cause") != "queue-bound":
+            return _fail(
+                "flood misclassified (queue flood must read queue-bound): "
+                f"{record.get('rationale')!r}"
+            )
+        if not any(
+            v.get("replica_id") == 0 for v in record.get("violations", [])
+        ):
+            return _fail(
+                f"incident does not name replica 0: {record.get('violations')}"
+            )
+        if "replica 0" not in record.get("rationale", ""):
+            return _fail(
+                f"rationale does not name the replica: "
+                f"{record.get('rationale')!r}"
+            )
+
+        # ---- telemetry: serving events landed -------------------------------
         n_requests = sum(
             1 for e in events if e.get("event") == EVENT_SERVING_REQUEST
         )
@@ -304,12 +497,114 @@ def main() -> int:  # noqa: PLR0915 — one linear smoke scenario
         if n_swaps != 1:
             return _fail(f"{n_swaps} model_swap events, expected 1")
 
+        # ---- graceful shutdown, then the cross-process traces ----------------
+        client.close()
+        client = None
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            return _fail("frontend did not exit on SIGTERM")
+        tracing.flush()
+
+        spans = tracing.read_spans(
+            os.path.join(telemetry_dir, tracing.SPANS_FILENAME)
+        )
+        roots = {
+            s.get("request_id"): s
+            for s in spans
+            if s.get("span") == tracing.SPAN_PREDICT_REQUEST
+        }
+        for i in range(len(sizes)):
+            root = roots.get(f"mixed-{i}")
+            if root is None:
+                return _fail(f"no predict_request root span for mixed-{i}")
+            tid = root["trace_id"]
+            members = [s for s in spans if s.get("trace_id") == tid]
+            names = {s.get("span") for s in members}
+            roles = {s.get("role") for s in members}
+            if not {"predict_request", "route", "queue", "engine"} <= names:
+                return _fail(
+                    f"trace {tid} (mixed-{i}) incomplete: spans {sorted(names)}"
+                )
+            if not {"client", "router", "replica"} <= roles:
+                return _fail(
+                    f"trace {tid} (mixed-{i}) does not span all three "
+                    f"processes: roles {sorted(roles)}"
+                )
+
+        traced_ids = {roots[f"mixed-{i}"]["trace_id"] for i in range(len(sizes))}
+
+        def _link_tids(span: dict) -> set:
+            out = set()
+            for link in span.get("links") or []:
+                out.add(link.get("trace_id") if isinstance(link, dict) else link)
+            return out
+
+        linked = set()
+        for span in spans:
+            if span.get("span") == tracing.SPAN_SERVING_DISPATCH:
+                linked |= _link_tids(span) & traced_ids
+        if not linked:
+            return _fail(
+                "no serving_dispatch span links back to a traced request"
+            )
+
+        # the analyzer reads the same story back: a serving critical
+        # path with a queue-vs-compute split that sums to request wall
+        from elasticdl_tpu.telemetry.trace import (
+            analyze_telemetry_dir,
+            build_chrome_trace,
+        )
+
+        report = analyze_telemetry_dir(telemetry_dir)
+        serving = report.get("serving")
+        if not serving or serving["requests"] < len(sizes):
+            return _fail(f"analyzer serving section missing/short: {serving}")
+        # the attribution sweep's invariant: phases (including honest
+        # "unattributed" for client-side stub/GIL time outside the
+        # router/replica spans) sum EXACTLY to the measured request wall
+        phase_sum = sum(serving["phases_secs"].values())
+        if abs(phase_sum - serving["wall_secs_total"]) > 1e-3:
+            return _fail(
+                f"serving critical path not sum-exact: phases total "
+                f"{phase_sum} vs wall {serving['wall_secs_total']}"
+            )
+        if serving["coverage"] is None or serving["coverage"] < 0.6:
+            return _fail(
+                f"serving critical path coverage {serving['coverage']} "
+                f"(phases: {serving['phases_secs']})"
+            )
+        for phase in ("queue_wait", "compute"):
+            if serving["phases_secs"].get(phase, 0.0) <= 0.0:
+                return _fail(
+                    f"serving critical path lost {phase!r}: "
+                    f"{serving['phases_secs']}"
+                )
+        if serving["linked_dispatch_groups"] < 1:
+            return _fail("analyzer saw no linked dispatch groups")
+
+        chrome = build_chrome_trace(telemetry_dir)
+        json.dumps(chrome)  # must be valid Chrome JSON
+        track_names = {
+            e.get("args", {}).get("name")
+            for e in chrome.get("traceEvents", [])
+            if e.get("name") == "process_name"
+        }
+        if not {"client", "router", "replica 0"} <= track_names:
+            return _fail(
+                f"Chrome export missing serving tracks: {sorted(track_names)}"
+            )
+
         print(
             "serving_smoke: OK "
-            f"(mixed sizes {sizes} all exact, compile count flat at "
+            f"(mixed sizes {sizes} all exact+traced, compile count flat at "
             f"{status0.compile_count} across traffic AND swap "
             f"{v1}->{v2}, {hammered[0]} in-flight requests with 0 "
-            f"failures, {n_requests} serving_request events)"
+            f"failures, SLO fired/recovered exactly once (queue-bound, "
+            f"replica 0), {n_requests} serving_request events, "
+            f"{serving['requests']} traced requests at coverage "
+            f"{serving['coverage']})"
         )
         return 0
     finally:

@@ -1,0 +1,991 @@
+"""Serving fleet observability: cross-process request tracing,
+probe-beat telemetry fan-in, and the router-side SLO watchdog.
+
+Three planes under test: (1) one request = ONE trace — the client's
+``predict_request`` root, the router's route/reroute children, the
+replica's queue/engine split, and the batched dispatch group LINKED to
+every member trace; (2) replicas ship monotone counters + phase totals
+on the ``serving_status`` probe beat and the router max-merges them
+into per-replica and fleet state; (3) the watchdog turns per-tick
+deltas of that fan-in into burn-rate signals and incidents that NAME
+the offending replica with a queue-bound / compute-bound cause.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import msgpack
+import numpy as np
+import pytest
+
+from elasticdl_tpu.rpc import messages as msg
+from elasticdl_tpu.serving import watchdog as wd
+from elasticdl_tpu.serving.batcher import MicroBatcher
+from elasticdl_tpu.serving.router import ServingRouter, _ReplicaHandle
+from elasticdl_tpu.telemetry import slo as slo_mod
+from elasticdl_tpu.telemetry import tracing
+from elasticdl_tpu.telemetry.incident import (
+    CAUSE_COMPUTE_BOUND,
+    CAUSE_QUEUE_BOUND,
+    CAUSE_REPLICA_DOWN,
+    CAUSE_SWAP_IN_PROGRESS,
+    read_incidents,
+)
+from elasticdl_tpu.telemetry.tracing import (
+    SPAN_PREDICT_REQUEST,
+    SPAN_SERVING_DISPATCH,
+    SPAN_SERVING_ENGINE,
+    SPAN_SERVING_QUEUE,
+    SPAN_SERVING_REROUTE,
+    SPAN_SERVING_ROUTE,
+    gen_span_id,
+    gen_trace_id,
+    read_spans,
+)
+
+IRIS_DEF = "odps_iris_dnn_model.odps_iris_dnn_model.custom_model"
+ROWS = 8
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    tracing.uninstall()
+    yield
+    tracing.uninstall()
+
+
+def _ctx() -> dict:
+    return {"trace_id": gen_trace_id(), "span_id": gen_span_id()}
+
+
+def _all_spans(tmp_path) -> list[dict]:
+    tracer = tracing.get_tracer()
+    if tracer is not None:
+        tracer.flush()
+    return read_spans(os.path.join(str(tmp_path), tracing.SPANS_FILENAME))
+
+
+# ---- wire compat ------------------------------------------------------------
+
+
+def test_serving_trace_fields_roundtrip():
+    ctx = _ctx()
+    for message in (
+        msg.PredictRequest(request_id="r", trace=dict(ctx)),
+        msg.ServingStatusRequest(trace=dict(ctx)),
+        msg.SwapModelRequest(model_dir="/m", trace=dict(ctx)),
+    ):
+        decoded = msg.decode(msg.encode(message))
+        assert decoded.trace == ctx, type(message).__name__
+
+
+def test_probe_beat_payload_roundtrips():
+    response = msg.ServingStatusResponse(
+        replica_id=2,
+        counters={"requests": 5, "errors": 1},
+        phases={"total": {"ms": 9.5, "count": 5, "buckets": {"0.01": 5}}},
+        memory={"at": 12.0, "components": {}},
+    )
+    decoded = msg.decode(msg.encode(response))
+    assert decoded.counters == {"requests": 5, "errors": 1}
+    assert decoded.phases["total"]["buckets"] == {"0.01": 5}
+    assert decoded.memory["at"] == 12.0
+
+
+def test_old_serving_payloads_without_new_fields_decode():
+    """A pre-observability peer's msgpack payload (no trace / probe-beat
+    keys) must decode into the new dataclasses with empty defaults."""
+    bodies = {
+        "PredictRequest": {"request_id": "r", "features": b"", "rows": 0},
+        "ServingStatusRequest": {"detail": False},
+        "SwapModelRequest": {"model_dir": "/m", "min_version": -1},
+        "ServingStatusResponse": {"replica_id": 0, "model_version": 3},
+    }
+    for kind, body in bodies.items():
+        buf = msgpack.packb({"kind": kind, "body": body}, use_bin_type=True)
+        decoded = msg.decode(buf)
+        if hasattr(decoded, "trace"):
+            assert decoded.trace == {}, kind
+    status = msg.decode(
+        msgpack.packb(
+            {
+                "kind": "ServingStatusResponse",
+                "body": {"replica_id": 0, "model_version": 3},
+            },
+            use_bin_type=True,
+        )
+    )
+    assert status.counters == {} and status.phases == {}
+    assert status.memory == {}
+
+
+# ---- replica-side spans (engine + batcher) ----------------------------------
+
+
+def _export_iris(out_dir: str, version: int):
+    import jax
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.trainer.state import TrainState, init_model
+    from elasticdl_tpu.trainer.step import resolve_optimizer
+    from elasticdl_tpu.utils.export_utils import export_model
+    from elasticdl_tpu.utils.model_utils import get_model_spec
+
+    spec = get_model_spec("", IRIS_DEF)
+    model = spec.build_model()
+    sample = {"features": np.zeros((1, 4), np.float32)}
+    params, model_state = init_model(model, sample)
+    params = jax.tree_util.tree_map(lambda x: x + 0.01, params)
+    state = TrainState.create(
+        model.apply, params, resolve_optimizer(spec.optimizer), model_state
+    )
+    state = state.replace(step=jnp.asarray(version, jnp.int32))
+    args = argparse.Namespace(
+        model_zoo="", model_def=IRIS_DEF, model_params_dict={}
+    )
+    return export_model(out_dir, state, spec, args)
+
+
+@pytest.fixture
+def export_v1(tmp_path):
+    return _export_iris(str(tmp_path / "export_v1"), version=3)
+
+
+def _feats(n: int, seed: int = 0) -> dict:
+    rng = np.random.RandomState(seed)
+    return {"features": rng.rand(n, 4).astype(np.float32)}
+
+
+def _run_traced(engine, request_id, features, trace):
+    batcher = MicroBatcher(engine.canonical_rows, max_wait_secs=0.0)
+    ticket = batcher.submit(request_id, features, trace=trace)
+    while not ticket.done:
+        group = batcher.next_group(0.1)
+        if group is None:
+            break
+        engine.run_group(group)
+    return ticket
+
+
+def test_engine_traced_request_records_queue_engine_split(
+    export_v1, tmp_path
+):
+    from elasticdl_tpu.serving.engine import ServingEngine
+
+    tracing.install(str(tmp_path), role="replica", worker_id=0)
+    engine = ServingEngine(export_v1, ROWS)
+    ctx = _ctx()
+    ticket = _run_traced(engine, "traced-1", _feats(ROWS * 2 + 1), ctx)
+    assert ticket.error is None
+    spans = _all_spans(tmp_path)
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["span"], []).append(s)
+    queue = by_name[SPAN_SERVING_QUEUE][0]
+    eng = by_name[SPAN_SERVING_ENGINE][0]
+    # both children of the client's root span, in the SAME trace
+    for child in (queue, eng):
+        assert child["trace_id"] == ctx["trace_id"]
+        assert child["parent_span_id"] == ctx["span_id"]
+        assert child["role"] == "replica"
+    # queue (submit -> first dispatch) + engine (first dispatch ->
+    # delivered) partition the request wall exactly
+    assert queue["end"] == eng["start"]
+    wall = eng["end"] - queue["start"]
+    assert abs(wall - ticket.total_secs()) < 1e-6
+
+
+def test_dispatch_span_links_every_member_trace(export_v1, tmp_path):
+    from elasticdl_tpu.serving.engine import ServingEngine
+
+    tracing.install(str(tmp_path), role="replica", worker_id=0)
+    engine = ServingEngine(export_v1, ROWS)
+    ctx_a, ctx_b = _ctx(), _ctx()
+    batcher = MicroBatcher(ROWS, max_wait_secs=0.0)
+    a = batcher.submit("a", _feats(3), trace=ctx_a)
+    b = batcher.submit("b", _feats(3, seed=1), trace=ctx_b)
+    while not (a.done and b.done):
+        group = batcher.next_group(0.1)
+        if group is None:
+            break
+        engine.run_group(group)
+    dispatches = [
+        s for s in _all_spans(tmp_path) if s["span"] == SPAN_SERVING_DISPATCH
+    ]
+    # the group is one span LINKED (not parented — one group serves many
+    # traces) to every member request's root
+    linked = {
+        link["trace_id"] for d in dispatches for link in d.get("links", [])
+    }
+    assert {ctx_a["trace_id"], ctx_b["trace_id"]} <= linked
+
+
+def test_hot_swap_under_tracing_parents_swap_span(export_v1, tmp_path):
+    from elasticdl_tpu.serving.engine import ServingEngine
+    from elasticdl_tpu.telemetry.tracing import SPAN_MODEL_SWAP
+
+    tracing.install(str(tmp_path), role="replica", worker_id=0)
+    export_v2 = _export_iris(str(tmp_path / "export_v2"), version=9)
+    engine = ServingEngine(export_v1, ROWS)
+    ctx = _ctx()
+    accepted, version, _reason = engine.swap_from_export(
+        export_v2, trace=ctx
+    )
+    assert accepted and version == 9
+    swaps = [
+        s for s in _all_spans(tmp_path) if s["span"] == SPAN_MODEL_SWAP
+    ]
+    assert swaps and swaps[0]["trace_id"] == ctx["trace_id"]
+    assert swaps[0]["parent_span_id"] == ctx["span_id"]
+
+
+# ---- router: route spans + probe-beat fan-in --------------------------------
+
+
+class _FakeClient:
+    def __init__(self, outcome, status=None):
+        self.outcome = outcome  # callable or canned response
+        self.status = status
+        self.calls = 0
+        self.swap_outcome = None
+
+    def predict(self, request):
+        self.calls += 1
+        if callable(self.outcome):
+            return self.outcome(request)
+        return self.outcome
+
+    def serving_status(self, request=None):
+        if callable(self.status):
+            return self.status()
+        return self.status or msg.ServingStatusResponse(
+            replica_id=0, model_version=1
+        )
+
+    def swap_model(self, request):
+        if callable(self.swap_outcome):
+            return self.swap_outcome(request)
+        return self.swap_outcome or msg.SwapModelResponse(
+            accepted=True, model_version=5
+        )
+
+    def close(self):
+        pass
+
+
+def _inject(router, replica_id, client):
+    handle = _ReplicaHandle(replica_id, f"fake:{replica_id}", client)
+    router._replicas[replica_id] = handle
+    return handle
+
+
+def _unavailable(_request):
+    import grpc
+
+    from elasticdl_tpu.chaos.netem import InjectedRpcError
+
+    raise InjectedRpcError(grpc.StatusCode.UNAVAILABLE, "down")
+
+
+def test_router_records_route_then_reroute_in_same_trace(tmp_path):
+    tracing.install(str(tmp_path), role="router")
+    router = ServingRouter()
+    ok = msg.PredictResponse(outputs=b"", model_version=1, rows=1)
+    dead, live = _FakeClient(_unavailable), _FakeClient(ok)
+    _inject(router, 0, dead)
+    _inject(router, 1, live)
+    router._replicas[1].outstanding = 1  # dead replica preferred first
+    ctx = _ctx()
+    response = router.predict(
+        msg.PredictRequest(request_id="r", trace=dict(ctx))
+    )
+    assert not response.error
+    spans = _all_spans(tmp_path)
+    route = next(s for s in spans if s["span"] == SPAN_SERVING_ROUTE)
+    reroute = next(s for s in spans if s["span"] == SPAN_SERVING_REROUTE)
+    # the detour stays ONE trace: both attempts parent under the root
+    for s in (route, reroute):
+        assert s["trace_id"] == ctx["trace_id"]
+        assert s["parent_span_id"] == ctx["span_id"]
+    assert route["replica_id"] == 0 and route["error"]
+    assert reroute["replica_id"] == 1 and "error" not in reroute
+    assert reroute["attempt"] == 1
+
+
+def test_untraced_request_records_no_route_spans(tmp_path):
+    tracing.install(str(tmp_path), role="router")
+    router = ServingRouter()
+    ok = msg.PredictResponse(outputs=b"", model_version=1, rows=1)
+    _inject(router, 0, _FakeClient(ok))
+    router.predict(msg.PredictRequest(request_id="r"))
+    assert _all_spans(tmp_path) == []
+
+
+def _beat_status(requests, queue_ms, total_ms, at=1.0):
+    return msg.ServingStatusResponse(
+        replica_id=0,
+        model_version=1,
+        queue_rows=0,
+        counters={"requests": requests, "errors": 0, "rejected": 0},
+        phases={
+            "queue_wait": {
+                "ms": queue_ms,
+                "count": requests,
+                "buckets": {"0.01": requests},
+            },
+            "total": {
+                "ms": total_ms,
+                "count": requests,
+                "buckets": {"0.01": requests},
+            },
+        },
+        memory={"at": at, "rss_bytes": 1},
+    )
+
+
+def test_probe_beat_fan_in_merges_monotone_and_fleet_totals():
+    router = ServingRouter()
+    client = _FakeClient(None, status=_beat_status(5, 10.0, 50.0, at=1.0))
+    handle = _inject(router, 0, client)
+    router.probe_once()
+    assert handle.counters["requests"] == 5
+    assert handle.phases["total"]["ms"] == 50.0
+    # a stale/duplicated payload racing a fresher one max-merges to a
+    # no-op; a fresher one advances both the handle and the fleet totals
+    client.status = _beat_status(3, 6.0, 30.0, at=0.5)  # stale replay
+    router.probe_once()
+    assert handle.counters["requests"] == 5
+    assert handle.memory["at"] == 1.0  # last-writer-wins by stamp
+    client.status = _beat_status(9, 20.0, 90.0, at=2.0)
+    router.probe_once()
+    assert handle.counters["requests"] == 9
+    assert router._fleet_counters["requests"] == 9
+    assert router._fleet_phases["total"]["ms"] == 90.0
+    assert handle.memory["at"] == 2.0
+    # fleet totals survive eviction (incremental, never recomputed)
+    router.remove_replica(0)
+    assert router._fleet_counters["requests"] == 9
+
+
+def test_fleet_snapshot_shape_and_probe_age():
+    router = ServingRouter(evict_after_secs=100.0)
+    client = _FakeClient(None, status=_beat_status(2, 1.0, 5.0))
+    _inject(router, 0, client)
+    router.probe_once()
+    snap = router.fleet_snapshot()
+    assert snap["live"] == [0]
+    r = snap["replicas"][0]
+    assert r["last_probe_age_secs"] < 5.0
+    assert 0.0 < r["evict_in_secs"] <= 100.0
+    assert r["live"] and not r["swap_unreachable"]
+    assert r["counters"]["requests"] == 2
+    assert snap["phases"]["total"]["ms"] == 5.0
+    # the copies are diff-safe: mutating the snapshot must not touch
+    # the router's merged state
+    snap["phases"]["total"]["ms"] = 0.0
+    assert router.fleet_snapshot()["phases"]["total"]["ms"] == 5.0
+
+
+def test_swap_partial_failure_marks_unreachable_and_probe_clears(tmp_path):
+    tracing.install(str(tmp_path), role="router")
+    router = ServingRouter()
+    good = _FakeClient(None, status=_beat_status(1, 1.0, 2.0))
+    bad = _FakeClient(None, status=_beat_status(1, 1.0, 2.0))
+
+    def _swap_unreachable(_request):
+        raise ConnectionError("replica gone")
+
+    bad.swap_outcome = _swap_unreachable
+    _inject(router, 0, good)
+    h1 = _inject(router, 1, bad)
+    ctx = _ctx()
+    response = router.swap_model(
+        msg.SwapModelRequest(model_dir="/m", trace=dict(ctx))
+    )
+    assert not response.accepted
+    assert "unreachable" in response.reason
+    assert h1.swap_unreachable
+    # every fan-out leg is a route child of the swap's trace; the
+    # failed leg carries the error
+    legs = [
+        s for s in _all_spans(tmp_path) if s["span"] == SPAN_SERVING_ROUTE
+    ]
+    assert {s["replica_id"] for s in legs} == {0, 1}
+    failed = next(s for s in legs if s["replica_id"] == 1)
+    assert failed["error"] == "unreachable"
+    assert failed["method"] == "swap_model"
+    # the next successful probe clears the flag (the watchdog's
+    # swap_unreachable signal recovers)
+    router.probe_once()
+    assert not h1.swap_unreachable
+
+
+# ---- watchdog: signal derivation --------------------------------------------
+
+
+def test_p99_from_bucket_deltas():
+    assert wd.p99_ms_from_buckets({}) is None
+    assert wd.p99_ms_from_buckets({"0.001": 98, "0.1": 2}) == 100.0
+    assert wd.p99_ms_from_buckets({"0.005": 100}) == 5.0
+    # overflow bucket reports as 2x the ladder top — comparable, honest
+    from elasticdl_tpu.telemetry.registry import SERVING_LATENCY_BUCKETS
+
+    assert (
+        wd.p99_ms_from_buckets({"inf": 10})
+        == SERVING_LATENCY_BUCKETS[-1] * 2000.0
+    )
+
+
+def test_delta_buckets_positive_only():
+    prev = {"0.01": 5, "0.1": 2}
+    cur = {"0.01": 9, "0.1": 2, "inf": 1}
+    assert wd._delta_buckets(prev, cur) == {"0.01": 4, "inf": 1}
+    assert wd._delta_buckets(cur, prev) == {}
+
+
+def _tick_snap(at, replicas, live=None):
+    """fleet_snapshot-shaped dict from {rid: (queue_ms, compute_ms,
+    requests, errors, queue_rows)} cumulative per-replica state."""
+    out_replicas = {}
+    fleet_phases = {"queue_wait": 0.0, "device_compute": 0.0, "total": 0.0}
+    fleet_counters = {"requests": 0, "errors": 0, "rejected": 0}
+    fleet_buckets: dict[str, int] = {}
+    for rid, (queue, compute, requests, errors, queue_rows) in (
+        replicas.items()
+    ):
+        buckets = {"0.05": requests}
+        out_replicas[rid] = {
+            "replica_id": rid,
+            "addr": f"fake:{rid}",
+            "outstanding": 0,
+            "last_probe_age_secs": 0.1,
+            "live": live is None or rid in live,
+            "evict_in_secs": 9.0,
+            "queue_rows": queue_rows,
+            "model_version": 1,
+            "counters": {
+                "requests": requests,
+                "errors": errors,
+                "rejected": 0,
+            },
+            "phases": {
+                "queue_wait": {
+                    "ms": queue,
+                    "count": requests,
+                    "buckets": {},
+                },
+                "device_compute": {
+                    "ms": compute,
+                    "count": requests,
+                    "buckets": {},
+                },
+                "total": {
+                    "ms": queue + compute,
+                    "count": requests,
+                    "buckets": buckets,
+                },
+            },
+            "memory": {},
+            "swap_unreachable": False,
+        }
+        fleet_phases["queue_wait"] += queue
+        fleet_phases["device_compute"] += compute
+        fleet_phases["total"] += queue + compute
+        fleet_counters["requests"] += requests
+        fleet_counters["errors"] += errors
+        for key, n in buckets.items():
+            fleet_buckets[key] = fleet_buckets.get(key, 0) + n
+    return {
+        "at": at,
+        "replicas": out_replicas,
+        "live": [r for r, v in out_replicas.items() if v["live"]],
+        "counters": fleet_counters,
+        "phases": {
+            "queue_wait": {
+                "ms": fleet_phases["queue_wait"],
+                "count": fleet_counters["requests"],
+                "buckets": {},
+            },
+            "device_compute": {
+                "ms": fleet_phases["device_compute"],
+                "count": fleet_counters["requests"],
+                "buckets": {},
+            },
+            "total": {
+                "ms": fleet_phases["total"],
+                "count": fleet_counters["requests"],
+                "buckets": fleet_buckets,
+            },
+        },
+    }
+
+
+def test_derive_serving_signals_deltas_and_offenders():
+    prev = _tick_snap(
+        0.0, {0: (10.0, 90.0, 10, 0, 0), 1: (10.0, 90.0, 10, 0, 0)}
+    )
+    cur = _tick_snap(
+        10.0, {0: (20.0, 180.0, 20, 0, 0), 1: (910.0, 190.0, 20, 2, 40)}
+    )
+    signals, offenders = wd.derive_serving_signals(prev, cur)
+    # queue share of THIS TICK's deltas: (10+900)/(10+900+90+100)
+    assert signals[slo_mod.SIGNAL_QUEUE_WAIT_SHARE] == pytest.approx(
+        910.0 / 1100.0
+    )
+    # p99 from the total-bucket delta histogram (all in the 0.05 slot)
+    assert signals[slo_mod.SIGNAL_SERVING_LATENCY_P99_MS] == 50.0
+    # error rate over this tick's attempts: 2 bad / (20 ok + 2 bad)
+    assert signals[slo_mod.SIGNAL_SERVING_ERROR_RATE] == pytest.approx(
+        2.0 / 22.0
+    )
+    assert signals[slo_mod.SIGNAL_SERVING_LIVE_REPLICAS] == 2.0
+    assert signals[slo_mod.SIGNAL_SERVING_SWAP_UNREACHABLE] == 0.0
+    # replica 1 moved queue_wait, total AND errors the most this tick
+    assert offenders[slo_mod.SIGNAL_QUEUE_WAIT_SHARE] == 1
+    assert offenders[slo_mod.SIGNAL_SERVING_LATENCY_P99_MS] == 1
+    assert offenders[slo_mod.SIGNAL_SERVING_ERROR_RATE] == 1
+
+
+def test_derive_serving_signals_idle_tick_stays_dormant():
+    snap = _tick_snap(0.0, {0: (10.0, 90.0, 10, 0, 0)})
+    signals, _offenders = wd.derive_serving_signals(snap, dict(snap))
+    # no traffic this tick: latency/error objectives stay DORMANT (an
+    # idle fleet must not fire a latency alarm) — only the
+    # instantaneous state signals evaluate
+    assert slo_mod.SIGNAL_SERVING_LATENCY_P99_MS not in signals
+    assert slo_mod.SIGNAL_SERVING_ERROR_RATE not in signals
+    assert signals[slo_mod.SIGNAL_SERVING_LIVE_REPLICAS] == 1.0
+
+
+def test_parse_serving_slo_config_injects_serving_defaults(tmp_path):
+    assert wd.parse_serving_slo_config("") is None
+    config = wd.parse_serving_slo_config("default")
+    names = {o["name"] for o in config["objectives"]}
+    assert "serving_latency_p99" in names
+    assert "serving_replica_floor" in names
+    explicit = wd.parse_serving_slo_config(
+        '{"objectives": [{"name": "x", "signal": "s", '
+        '"comparator": "above", "threshold": 1.0}]}'
+    )
+    assert [o["name"] for o in explicit["objectives"]] == ["x"]
+
+
+# ---- watchdog: cause classification -----------------------------------------
+
+
+def test_classify_replica_down_wins_and_names_replica():
+    cause, rationale = wd.classify_serving_cause(
+        [
+            {
+                "signal": slo_mod.SIGNAL_QUEUE_WAIT_SHARE,
+                "replica_id": 0,
+            },
+            {
+                "signal": slo_mod.SIGNAL_SERVING_LIVE_REPLICAS,
+                "replica_id": 2,
+            },
+        ],
+        None,
+        None,
+    )
+    assert cause == CAUSE_REPLICA_DOWN
+    assert "replica 2" in rationale
+
+
+def test_classify_swap_in_progress():
+    cause, rationale = wd.classify_serving_cause(
+        [
+            {
+                "signal": slo_mod.SIGNAL_SERVING_SWAP_UNREACHABLE,
+                "replica_id": 1,
+            }
+        ],
+        None,
+        None,
+    )
+    assert cause == CAUSE_SWAP_IN_PROGRESS
+    assert "replica 1" in rationale
+
+
+def test_classify_queue_vs_compute_from_anatomy_delta():
+    open_ctx = {
+        "anatomy": {
+            "queue_wait": {"ms": 100.0},
+            "total": {"ms": 1000.0},
+        }
+    }
+    queue_close = {
+        "anatomy": {
+            "queue_wait": {"ms": 5100.0},
+            "total": {"ms": 7000.0},
+        }
+    }
+    cause, rationale = wd.classify_serving_cause(
+        [{"signal": slo_mod.SIGNAL_QUEUE_WAIT_SHARE, "replica_id": 3}],
+        open_ctx,
+        queue_close,
+    )
+    assert cause == CAUSE_QUEUE_BOUND
+    assert "replica 3" in rationale
+    compute_close = {
+        "anatomy": {
+            "queue_wait": {"ms": 200.0},
+            "total": {"ms": 9000.0},
+        }
+    }
+    cause, _r = wd.classify_serving_cause(
+        [{"signal": slo_mod.SIGNAL_SERVING_LATENCY_P99_MS}],
+        open_ctx,
+        compute_close,
+    )
+    assert cause == CAUSE_COMPUTE_BOUND
+
+
+# ---- watchdog: the full loop ------------------------------------------------
+
+
+class _ScriptedRouter:
+    """fleet_snapshot stub with a settable current snapshot (the
+    watchdog reads it at tick AND at incident open/close context)."""
+
+    def __init__(self):
+        self.snap = _tick_snap(0.0, {0: (0.0, 0.0, 0, 0, 0)})
+
+    def fleet_snapshot(self) -> dict:
+        return self.snap
+
+
+def test_watchdog_fires_once_names_replica_and_recovers(tmp_path):
+    events: list[tuple[str, dict]] = []
+    config = wd.parse_serving_slo_config(
+        json.dumps(
+            {
+                "objectives": [
+                    {
+                        "name": "serving_queue_wait",
+                        "signal": slo_mod.SIGNAL_QUEUE_WAIT_SHARE,
+                        "comparator": "above",
+                        "threshold": 0.5,
+                    }
+                ]
+            }
+        )
+    )
+    router = _ScriptedRouter()
+    watchdog = wd.ServingWatchdog(
+        router,
+        config,
+        telemetry_dir=str(tmp_path),
+        emit=lambda event, **fields: events.append((event, fields)),
+    )
+
+    # cumulative per-replica state: replica 0 healthy throughout,
+    # replica 1 goes queue-bound for the middle stretch
+    state = {0: [0.0, 0.0, 0], 1: [0.0, 0.0, 0]}
+    at = 0.0
+
+    def tick(r1_queue_ms, r1_compute_ms):
+        nonlocal at
+        at += 10.0
+        state[0][0] += 1.0
+        state[0][1] += 99.0
+        state[0][2] += 10
+        state[1][0] += r1_queue_ms
+        state[1][1] += r1_compute_ms
+        state[1][2] += 10
+        router.snap = _tick_snap(
+            at,
+            {
+                rid: (s[0], s[1], s[2], 0, 0)
+                for rid, s in state.items()
+            },
+        )
+        watchdog.tick()
+
+    watchdog.tick()  # first tick only seeds the baseline
+    for _ in range(12):
+        tick(1.0, 99.0)  # healthy: queue share ~1%
+    for _ in range(12):
+        tick(900.0, 100.0)  # queue-bound burn: share ~82%
+    for _ in range(12):
+        tick(1.0, 99.0)  # recovery
+
+    names = [e for e, _f in events]
+    assert names.count("slo_violation") == 1
+    assert names.count("slo_recovered") == 1
+    assert names.count("incident_open") == 1
+    assert names.count("incident_close") == 1
+    records = read_incidents(str(tmp_path))
+    assert len(records) == 1
+    record = records[0]
+    assert record["suspected_cause"] == CAUSE_QUEUE_BOUND
+    # the postmortem names the offending replica, in the enriched
+    # violation transition AND the rationale
+    assert record["violations"][0]["replica_id"] == 1
+    assert "replica 1" in record["rationale"]
+    assert record["objectives"] == ["serving_queue_wait"]
+
+
+def test_watchdog_health_and_metrics_delegate(tmp_path):
+    from elasticdl_tpu.telemetry.registry import MetricsRegistry
+
+    config = wd.parse_serving_slo_config("default")
+    watchdog = wd.ServingWatchdog(_ScriptedRouter(), config)
+    block = watchdog.health_block()
+    assert "objectives" in block
+    registry = MetricsRegistry()
+    watchdog.mirror_metrics(registry)
+    assert "elasticdl_slo_objective_ok" in registry.exposition()
+
+
+# ---- fleet /metrics families ------------------------------------------------
+
+
+class _SnapshotRouter:
+    def __init__(self, snap):
+        self.snap = snap
+
+    def fleet_snapshot(self):
+        return self.snap
+
+
+def test_fleet_metrics_families_render_per_replica(tmp_path):
+    from elasticdl_tpu.serving.metrics import FleetMetrics
+    from elasticdl_tpu.telemetry.registry import MetricsRegistry
+
+    snap = _tick_snap(
+        1.0, {0: (5.0, 20.0, 4, 1, 3), 1: (2.0, 10.0, 2, 0, 0)}
+    )
+    registry = MetricsRegistry()
+    FleetMetrics(_SnapshotRouter(snap), registry)
+    text = registry.exposition()
+    assert 'elasticdl_serving_replica_queue_rows{replica="0"} 3' in text
+    assert 'elasticdl_serving_replica_errors_total{replica="0"} 1' in text
+    assert (
+        'elasticdl_serving_replica_phase_ms_total'
+        '{phase="queue_wait",replica="1"}' in text
+        or 'elasticdl_serving_replica_phase_ms_total'
+        '{replica="1",phase="queue_wait"}' in text
+    )
+    assert 'replica="other"' not in text
+
+
+def test_fleet_metrics_collapse_over_cardinality_budget(monkeypatch):
+    from elasticdl_tpu.serving.metrics import FleetMetrics
+    from elasticdl_tpu.telemetry.master_hooks import WORKER_SERIES_MAX_ENV
+    from elasticdl_tpu.telemetry.registry import MetricsRegistry
+
+    monkeypatch.setenv(WORKER_SERIES_MAX_ENV, "2")
+    snap = _tick_snap(
+        1.0,
+        {rid: (1.0, 2.0, 1, 0, rid) for rid in range(4)},
+    )
+    # make one overflow replica silent for a long time: its probe age
+    # must surface as the "other" bucket's MAX, not vanish
+    snap["replicas"][3]["last_probe_age_secs"] = 42.0
+    registry = MetricsRegistry()
+    FleetMetrics(_SnapshotRouter(snap), registry)
+    text = registry.exposition()
+    assert 'replica="0"' in text
+    assert 'replica="1"' not in text  # collapsed
+    assert 'replica="other"' in text
+    # other = replicas 1+2+3: queue_rows 1+2+3, probe age max 42
+    assert (
+        'elasticdl_serving_replica_queue_rows{replica="other"} 6' in text
+    )
+    assert (
+        'elasticdl_serving_replica_probe_age_secs{replica="other"} 42'
+        in text
+    )
+
+
+# ---- trace analysis ---------------------------------------------------------
+
+
+def _canned_serving_spans(trace_id: str) -> list[dict]:
+    root_span = gen_span_id()
+    base = {"trace_id": trace_id, "worker_id": 0, "process_id": 0}
+    return [
+        dict(
+            base,
+            span=SPAN_PREDICT_REQUEST,
+            span_id=root_span,
+            role="client",
+            start=0.0,
+            end=1.0,
+            request_id="r1",
+        ),
+        dict(
+            base,
+            span=SPAN_SERVING_ROUTE,
+            span_id=gen_span_id(),
+            parent_span_id=root_span,
+            role="router",
+            start=0.0,
+            end=0.95,
+            replica_id=0,
+            attempt=0,
+        ),
+        dict(
+            base,
+            span=SPAN_SERVING_QUEUE,
+            span_id=gen_span_id(),
+            parent_span_id=root_span,
+            role="replica",
+            start=0.1,
+            end=0.3,
+        ),
+        dict(
+            base,
+            span=SPAN_SERVING_ENGINE,
+            span_id=gen_span_id(),
+            parent_span_id=root_span,
+            role="replica",
+            start=0.3,
+            end=0.9,
+        ),
+        {
+            "span": SPAN_SERVING_DISPATCH,
+            "span_id": gen_span_id(),
+            "trace_id": gen_trace_id(),
+            "role": "replica",
+            "worker_id": 0,
+            "start": 0.3,
+            "end": 0.9,
+            "links": [{"trace_id": trace_id, "span_id": root_span}],
+        },
+    ]
+
+
+def test_serving_critical_path_sums_exactly():
+    from elasticdl_tpu.telemetry.trace import _serving_critical_path
+
+    trace_id = gen_trace_id()
+    section = _serving_critical_path(_canned_serving_spans(trace_id))
+    assert section["requests"] == 1
+    assert section["reroutes"] == 0
+    phases = section["phases_secs"]
+    # route keeps only the router's own pick/transport time (0.0-0.1
+    # before the replica starts, 0.9-0.95 shipping the reply back up);
+    # the replica's finer queue/compute split takes the overlap, and
+    # the residual after every span is the response's return leg
+    assert phases["route"] == pytest.approx(0.15, abs=1e-6)
+    assert phases["queue_wait"] == pytest.approx(0.2, abs=1e-6)
+    assert phases["compute"] == pytest.approx(0.6, abs=1e-6)
+    assert phases["response_return"] == pytest.approx(0.05, abs=1e-6)
+    assert sum(phases.values()) == pytest.approx(
+        section["wall_secs_total"], abs=1e-6
+    )
+    assert section["dispatch_groups"] == 1
+    assert section["linked_dispatch_groups"] == 1
+
+
+def test_analyze_dir_includes_serving_section(tmp_path):
+    from elasticdl_tpu.telemetry.trace import analyze_telemetry_dir
+
+    spans = _canned_serving_spans(gen_trace_id())
+    with open(tmp_path / "spans.jsonl", "w", encoding="utf-8") as f:
+        for span in spans:
+            f.write(json.dumps(span) + "\n")
+    (tmp_path / "events.jsonl").write_text("")
+    analysis = analyze_telemetry_dir(str(tmp_path))
+    serving = analysis["serving"]
+    assert serving["requests"] == 1
+    assert serving["coverage"] == pytest.approx(1.0, abs=1e-3)
+
+
+def test_chrome_export_lays_out_serving_tracks(tmp_path):
+    from elasticdl_tpu.telemetry.trace import build_chrome_trace
+
+    spans = _canned_serving_spans(gen_trace_id())
+    with open(tmp_path / "spans.jsonl", "w", encoding="utf-8") as f:
+        for span in spans:
+            f.write(json.dumps(span) + "\n")
+    chrome = build_chrome_trace(str(tmp_path))
+    json.dumps(chrome)  # valid Chrome JSON
+    names = {
+        e["args"]["name"]
+        for e in chrome["traceEvents"]
+        if e.get("name") == "process_name"
+    }
+    # one track per serving actor: client -> router -> replica N
+    assert {"client", "router", "replica 0"} <= names
+    slices = [e for e in chrome["traceEvents"] if e.get("ph") == "X"]
+    assert {s["name"] for s in slices} >= {
+        SPAN_PREDICT_REQUEST,
+        SPAN_SERVING_ROUTE,
+        SPAN_SERVING_QUEUE,
+        SPAN_SERVING_ENGINE,
+    }
+
+
+# ---- argv byte-identity + report digest -------------------------------------
+
+
+def test_replica_argv_byte_identical_with_observability_on():
+    from elasticdl_tpu.serving.main import _replica_argv, build_parser
+
+    base = [
+        "--model_dir",
+        "/m",
+        "--num_replicas",
+        "2",
+    ]
+    plain = build_parser().parse_args(base)
+    observed = build_parser().parse_args(
+        base
+        + [
+            "--slo_config",
+            "default",
+            "--telemetry_dir",
+            "/tmp/t",
+            "--metrics_port",
+            "0",
+        ]
+    )
+    assert _replica_argv(plain, 0, "/w") == _replica_argv(observed, 0, "/w")
+
+
+def test_summary_json_covers_serving_runs():
+    from elasticdl_tpu.telemetry.report import summarize_report
+
+    report = {
+        "run_dir": "/r",
+        "runs": {
+            "a": {
+                "events_total": 4,
+                "serving": {
+                    "requests": 10,
+                    "rows": 50,
+                    "sheds": 1,
+                    "errors": 2,
+                },
+            },
+            "b": {"events_total": 1},
+        },
+    }
+    summary = summarize_report(report)
+    assert summary["serving"] == {
+        "runs": 1,
+        "requests": 10,
+        "rows": 50,
+        "sheds": 1,
+        "errors": 2,
+    }
+    assert summary["verdict"] == "ok"
+    no_serving = summarize_report({"runs": {"b": {"events_total": 1}}})
+    assert no_serving["serving"] is None
+
+
+def test_predict_client_raise_names_failed_traces():
+    """The residual-failure raise carries the failed trace ids (the
+    satellite bugfix): simulated by the same formatting path."""
+    from elasticdl_tpu.serving import predict_client
+
+    # _client_tracer without a telemetry dir stays off (no install)
+    os.environ.pop("ELASTICDL_TPU_TELEMETRY_DIR", None)
+    assert predict_client._client_tracer() is None
