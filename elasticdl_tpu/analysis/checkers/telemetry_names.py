@@ -77,6 +77,11 @@ REQUIRED_EVENT_NAMES = frozenset(
         "slo_recovered",
         "incident_open",
         "incident_close",
+        # streaming subsystem (elasticdl_tpu/streaming): the watermark/
+        # lag tick pair and the live train->serve push (freshness ledger)
+        "stream_watermark",
+        "stream_lag",
+        "live_push",
     }
 )
 REQUIRED_SPAN_NAMES = frozenset(
@@ -109,6 +114,9 @@ REQUIRED_SPAN_NAMES = frozenset(
         "queue",
         "engine",
         "serving_dispatch",
+        # streaming: one span per live train->serve push (harvest ->
+        # swap accepted)
+        "live_push",
     }
 )
 REQUIRED_PHASE_NAMES = frozenset(
@@ -166,6 +174,12 @@ REQUIRED_METRIC_NAMES = frozenset(
         "elasticdl_serving_replica_shed_total",
         "elasticdl_serving_replica_errors_total",
         "elasticdl_serving_replica_phase_ms_total",
+        # streaming subsystem: the backlog signal pair (lag in records,
+        # source/trained watermark by role=) and the live-push counter —
+        # registered at one site each inside MasterTelemetry's collect
+        "elasticdl_stream_lag_records",
+        "elasticdl_stream_watermark",
+        "elasticdl_stream_live_push_total",
     }
 )
 

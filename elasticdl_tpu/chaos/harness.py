@@ -75,6 +75,13 @@ _REPLICA_RECOVERABLE_KINDS = frozenset(
 # the state HAS sharded rows — the shape of "a shard's only replica
 # died" — so the sharded extension of cross_slice_replica_coverage must
 # trip (requires replication and a model with row-sharded tables).
+# ``drop_stream_window`` (streaming runs only) vanishes one leased
+# stream window from the dispatcher's active set and marks it already-
+# reported, so neither timeout reclaim nor worker recovery ever
+# requeues it — the trained watermark stalls at the hole and the
+# bounded_lag invariant's final-drain clause must trip (the run itself
+# still terminates: ``finished()`` gates on mint-drain, not on
+# trained == watermark).
 CORRUPTIONS = (
     "",
     "double_report",
@@ -84,6 +91,7 @@ CORRUPTIONS = (
     "same_slice_ring",
     "drop_dedup",
     "drop_shard_parts",
+    "drop_stream_window",
 )
 
 # model-zoo presets the harness can run: model_def + the synthetic
@@ -143,6 +151,23 @@ class ChaosJobConfig:
     rpc_deadline_secs: float | None = None
     rpc_retry_secs: float | None = None
     task_timeout_secs: float | None = None
+    # streaming (watermark-lease) mode: train over a stream:// origin
+    # instead of generated recordio shards — no epochs, no checkpoints
+    # (the replica ring is the only durability, so streaming runs want
+    # replication=True); record accounting gates on the stream total
+    # and the bounded_lag invariant replaces epoch parity
+    streaming: bool = False
+    stream_total: int = 0  # records the bounded-prefix source publishes
+    stream_rate: float = 0.0  # watermark advance in records/sec
+    stream_initial: int = 0  # records already published at t0
+    # bounded_lag threshold in RECORDS; 0 = auto (6 windows, floored at
+    # 256 — roomy enough for a reform outage at the smoke's rates, tight
+    # enough that a stalled stream trips it)
+    stream_lag_limit: int = 0
+    # live train->serve push target ("host:port" of a serving frontend
+    # or replica); "" = no live push.  The streaming smoke points this
+    # at a real serving CLI and hammers it with traffic during the run
+    live_push_addr: str = ""
 
 
 def _master_args(config: ChaosJobConfig, train_dir: str, ckpt_dir: str):
@@ -188,10 +213,26 @@ def _master_args(config: ChaosJobConfig, train_dir: str, ckpt_dir: str):
             "AllreduceStrategy",
             "--num_workers",
             str(config.num_workers),
-            "--checkpoint_dir",
-            ckpt_dir,
-            "--checkpoint_steps",
-            str(config.checkpoint_steps),
+            *(
+                # checkpoint-free durability: a streaming run persists
+                # through the replica ring ONLY (the PR-4 disk fallback
+                # then degrades to a fresh start, which bounded_lag
+                # absorbs as requeued windows)
+                []
+                if config.streaming
+                else [
+                    "--checkpoint_dir",
+                    ckpt_dir,
+                    "--checkpoint_steps",
+                    str(config.checkpoint_steps),
+                ]
+            ),
+            *(["--streaming", "true"] if config.streaming else []),
+            *(
+                ["--live_push_addr", config.live_push_addr]
+                if config.live_push_addr
+                else []
+            ),
             "--heartbeat_timeout_secs",
             str(config.heartbeat_timeout_secs),
             # telemetry event log (master lifecycle + worker step
@@ -263,6 +304,11 @@ def _install_corruption(master, checker: InvariantChecker, mode: str):
       report for a no-longer-active lease (i.e. a netem-duplicated
       delivery) is counted AGAIN instead of dropped, so the
       exactly-once and duplicate-delivery invariants must trip.
+    - ``drop_stream_window``: the first leased stream window vanishes
+      (dropped from the active set, marked already-reported) — a
+      lost-lease bug the watermark accounting must surface: the trained
+      watermark can never cross the hole, so ``bounded_lag``'s
+      final-drain clause must trip while the run still terminates.
     """
     from elasticdl_tpu.utils.constants import TaskType
 
@@ -344,6 +390,30 @@ def _install_corruption(master, checker: InvariantChecker, mode: str):
                 )
 
         task_d.report = no_dedup_report
+    elif mode == "drop_stream_window":
+        task_d = master.task_d
+        orig_get = task_d.get
+
+        def dropping_get(worker_id):
+            task_id, task = orig_get(worker_id)
+            if (
+                not fired
+                and task is not None
+                and task.type == TaskType.TRAINING
+            ):
+                fired.append(task_id)
+                # the lease vanishes: gone from the active set AND
+                # pre-marked reported, so neither the timeout reclaim
+                # nor worker-death recovery can ever requeue it — the
+                # exact shape of a lost-lease bug.  The worker still
+                # trains the window (its report is then dropped as a
+                # duplicate), so the job keeps moving and terminates.
+                with task_d._lock:
+                    task_d._active.pop(task_id, None)
+                    task_d._reported_task_ids.add(task_id)
+            return task_id, task
+
+        task_d.get = dropping_get
 
 
 class _CapacityDriver(threading.Thread):
@@ -839,6 +909,91 @@ def _check_cross_slice_coverage(
     }
 
 
+def _check_bounded_lag(
+    config: ChaosJobConfig,
+    events: list[dict],
+    final_status: dict | None,
+) -> dict | None:
+    """Streaming replacement for epoch parity: under fault, the lag
+    behind the source watermark must stay bounded, and the final drain
+    must be complete (trained watermark == stream total — a window
+    whose lease was lost forever leaves a hole the trained watermark
+    can never cross).  None on epoch-mode runs."""
+    if not config.streaming:
+        return None
+    limit = config.stream_lag_limit or max(
+        256, 6 * config.records_per_task
+    )
+    lags = [
+        int(e.get("lag_records", 0))
+        for e in events
+        if e.get("event") == "stream_lag"
+    ]
+    violations = []
+    if not lags:
+        violations.append(
+            "streaming run produced no stream_lag events — watermark "
+            "telemetry missing"
+        )
+    else:
+        worst = max(lags)
+        if worst > limit:
+            violations.append(
+                f"lag peaked at {worst} records > bound {limit} — "
+                "backlog not bounded under fault"
+            )
+    trained = (final_status or {}).get("trained_watermark")
+    if config.stream_total and trained != config.stream_total:
+        violations.append(
+            f"final drain incomplete: trained watermark {trained} != "
+            f"stream total {config.stream_total} (a leased window was "
+            "lost and never requeued)"
+        )
+    return {
+        "name": "bounded_lag",
+        "status": "FAIL" if violations else "PASS",
+        "violations": violations,
+        "max_lag_records": max(lags) if lags else None,
+        "lag_limit_records": limit,
+    }
+
+
+def _check_freshness_monotone(
+    config: ChaosJobConfig, events: list[dict]
+) -> dict | None:
+    """The served model's trained-watermark must never decrease across
+    live pushes: an accepted push with an older watermark than a
+    previously accepted one means serving regressed to staler state.
+    Vacuously PASS (with ``pushes: 0``) on streaming runs without a
+    live-push target; None on epoch-mode runs."""
+    if not config.streaming:
+        return None
+    pushes = sorted(
+        (
+            e
+            for e in events
+            if e.get("event") == "live_push" and e.get("accepted")
+        ),
+        key=lambda e: e.get("monotonic", 0.0),
+    )
+    violations = []
+    high = None
+    for push in pushes:
+        trained = int(push.get("trained_watermark", -1))
+        if high is not None and trained < high:
+            violations.append(
+                f"served trained-watermark regressed: {trained} after "
+                f"{high} (model version {push.get('model_version')})"
+            )
+        high = trained if high is None else max(high, trained)
+    return {
+        "name": "freshness_monotone",
+        "status": "FAIL" if violations else "PASS",
+        "violations": violations,
+        "pushes": len(pushes),
+    }
+
+
 def run_chaos_job(config: ChaosJobConfig) -> dict:
     """Run one chaos'd job end to end; returns the report dict.
 
@@ -884,21 +1039,41 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
         raise ValueError(
             f"unknown dataset {config.dataset!r}; valid: {DATASETS}"
         )
-    gen = (
-        synthetic.gen_frappe
-        if config.dataset == "frappe"
-        else synthetic.gen_mnist
-    )
-    train = gen(
-        os.path.join(config.workdir, "train"),
-        num_records=config.num_records,
-        num_shards=2,
-        seed=config.data_seed,
-    )
+    if config.streaming:
+        if config.stream_total <= 0:
+            # a truly unbounded source never closes, so finished()
+            # never fires and the harness would only ever time out
+            raise ValueError(
+                "streaming chaos runs need a bounded prefix: set "
+                "ChaosJobConfig.stream_total > 0"
+            )
+        # no recordio shards: records are a pure function of
+        # (seed, index), so the origin string IS the dataset
+        train = (
+            f"stream://{config.dataset}?seed={config.data_seed}"
+            f"&total={config.stream_total}&rate={config.stream_rate}"
+            f"&initial={config.stream_initial}"
+        )
+    else:
+        gen = (
+            synthetic.gen_frappe
+            if config.dataset == "frappe"
+            else synthetic.gen_mnist
+        )
+        train = gen(
+            os.path.join(config.workdir, "train"),
+            num_records=config.num_records,
+            num_shards=2,
+            seed=config.data_seed,
+        )
     ckpt = os.path.join(config.workdir, "ckpt")
     args = _master_args(config, train, ckpt)
 
-    expected_records = config.num_epochs * config.num_records
+    expected_records = (
+        config.stream_total
+        if config.streaming
+        else config.num_epochs * config.num_records
+    )
     checker = InvariantChecker(expected_records=expected_records)
 
     from elasticdl_tpu.master.master import SimulatedMasterCrash
@@ -944,6 +1119,15 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
             "--corrupt drop_shard_parts requires replication on and a "
             "model whose tables are row-sharded (it strips sharded rows "
             "from the replica push payloads)"
+        )
+    if config.corrupt == "drop_stream_window" and not config.streaming:
+        # the corruption vanishes a leased STREAM window; an epoch-mode
+        # run has no watermark accounting to trip, so the "corrupted
+        # runs must exit non-zero" contract would silently pass green
+        raise ValueError(
+            "--corrupt drop_stream_window requires a streaming run "
+            "(ChaosJobConfig.streaming=True) — epoch-mode runs have no "
+            "watermark accounting to falsify"
         )
     if config.corrupt == "same_slice_ring" and not (
         config.replication and config.num_slices > 1
@@ -1201,6 +1385,7 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
             config.replication
             or config.num_slices > 1
             or config.master_ha
+            or config.streaming
         )
         else []
     )
@@ -1221,6 +1406,17 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
         invariants["invariants"].append(cross_slice)
         if cross_slice["status"] == "FAIL":
             invariants["ok"] = False
+    stream_status = (
+        master.task_d.stream_status() if config.streaming else None
+    )
+    for stream_check in (
+        _check_bounded_lag(config, telemetry_events, stream_status),
+        _check_freshness_monotone(config, telemetry_events),
+    ):
+        if stream_check is not None:
+            invariants["invariants"].append(stream_check)
+            if stream_check["status"] == "FAIL":
+                invariants["ok"] = False
     multislice_stats = None
     if config.num_slices > 1:
         from elasticdl_tpu.telemetry.report import multislice_section
@@ -1292,6 +1488,13 @@ def run_chaos_job(config: ChaosJobConfig) -> dict:
         report["multislice"] = multislice_stats
     if master_ha_stats is not None:
         report["master_ha"] = master_ha_stats
+    if config.streaming:
+        from elasticdl_tpu.telemetry.report import streaming_section
+
+        report["streaming"] = {
+            "final": stream_status,
+            **(streaming_section(telemetry_events) or {}),
+        }
     if config.master_ha:
         report["master_lives"] = life + 1
     if not records_ok:

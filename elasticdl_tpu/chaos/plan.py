@@ -519,6 +519,27 @@ def builtin_plans(num_workers: int = 2) -> dict[str, FaultPlan]:
             "MASTER_RETRYABLE_METHODS dedup contract, proven under "
             "actual duplication",
         ),
+        "streaming_preempt_under_load": FaultPlan(
+            name="streaming_preempt_under_load",
+            faults=[
+                Fault(
+                    kind=FaultKind.PREEMPT,
+                    fault_id="stream-preempt-p%d" % last,
+                    # streaming smokes run a short bounded prefix (each
+                    # worker sees ~4 steps, not the epoch-mode budget
+                    # _KILL_STEP assumes), so arm early enough that the
+                    # kill lands while windows are still in flight
+                    at_step=3,
+                    process_id=last,
+                )
+            ],
+            notes="SIGKILL one worker mid-STREAM (watermark-lease mode, "
+            "no epochs, no checkpoints): the leased windows must "
+            "requeue, the replica ring must restore at the replicated "
+            "watermark, and lag behind the source watermark must stay "
+            "bounded — the epoch-parity invariant is replaced by "
+            "bounded_lag + freshness_monotone",
+        ),
         "shrink_then_restore": FaultPlan(
             name="shrink_then_restore",
             faults=[

@@ -25,6 +25,10 @@ def create_data_reader(
             records_per_task=records_per_task,
             **kwargs,
         )
+    if data_origin.startswith("stream://"):
+        from elasticdl_tpu.streaming.reader import StreamDataReader
+
+        return StreamDataReader(data_origin=data_origin, **kwargs)
     from elasticdl_tpu.data.odps_reader import is_odps_configured
 
     if data_origin.startswith("odps://") or is_odps_configured():

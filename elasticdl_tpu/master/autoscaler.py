@@ -158,6 +158,12 @@ def build_autoscaler(args, fleet_slices: int) -> Autoscaler | None:
     None (the dormant default — no observer, no tick, no state)."""
     p95 = getattr(args, "autoscale_p95_step_ms", None)
     backlog = getattr(args, "autoscale_backlog_tasks", None)
+    if bool(getattr(args, "streaming", False)):
+        # watermark-lease mode: --stream_lag_tasks is the dedicated
+        # backlog threshold (lag behind the source watermark in task-
+        # window units — the master converts before evaluate()); it
+        # falls back to the shared --autoscale_backlog_tasks knob
+        backlog = getattr(args, "stream_lag_tasks", None) or backlog
     if p95 is None and backlog is None:
         return None
     return Autoscaler(

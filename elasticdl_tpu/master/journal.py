@@ -461,6 +461,33 @@ def replay(records: list[dict]) -> dict | None:
             else disp["pending"]
         )
 
+    def stream_minted(task: dict):
+        """A ``tasks_created`` delta in watermark-lease mode is a window
+        mint: the offset cursor (and the source watermark floor — the
+        source had published at least this much) advance with it."""
+        stream = disp.get("stream")
+        if stream is None or int(task["type"]) != int(TaskType.TRAINING):
+            return
+        end = int(task["end"])
+        stream["next_offset"] = max(int(stream.get("next_offset", 0)), end)
+        stream["source_watermark"] = max(
+            int(stream.get("source_watermark", 0)), end
+        )
+
+    def stream_trained(task: dict):
+        """A successful window report advances the trained watermark
+        over the gap-free prefix — the same pop loop the live
+        dispatcher runs (``_stream_complete_locked``)."""
+        stream = disp.get("stream")
+        if stream is None or int(task["type"]) != int(TaskType.TRAINING):
+            return
+        completed = stream.setdefault("completed", {})
+        completed[str(task["start"])] = int(task["end"])
+        watermark = int(stream.get("trained_watermark", 0))
+        while str(watermark) in completed:
+            watermark = int(completed.pop(str(watermark)))
+        stream["trained_watermark"] = watermark
+
     for rec in records[snap_index + 1 :]:
         kind = rec.get("kind")
         if kind == "epoch":
@@ -472,6 +499,7 @@ def replay(records: list[dict]) -> dict | None:
                 disp["next_task_uid"] = max(
                     int(disp.get("next_task_uid", 0)), int(t.get("uid", 0))
                 )
+                stream_minted(t)
             if tasks:
                 counters_for(int(tasks[0]["type"]))["total_records"] += int(
                     rec.get("records", 0)
@@ -506,6 +534,8 @@ def replay(records: list[dict]) -> dict | None:
                 queue_for(int(rec.get("task_type", 0))).append(
                     entry["task"]
                 )
+            else:
+                stream_trained(entry["task"])
         elif kind == "reclaim":
             entry = disp["active"].pop(str(rec["task_id"]), None)
             if entry is not None:

@@ -86,14 +86,28 @@ class Master:
                 return {}
             return create(data_origin=origin, **reader_params).create_shards()
 
+        # ---- streaming (watermark-lease) mode: --streaming flips the
+        # dispatcher from epoch-sliced shards to windows minted lazily
+        # up to the source watermark.  Training shards are skipped
+        # entirely (the stream has no create_shards view); validation /
+        # prediction origins keep the classic path alongside
+        training_data = getattr(args, "training_data", "")
+        self.stream_source = None
+        if bool(getattr(args, "streaming", False)):
+            from elasticdl_tpu.streaming.source import build_stream_source
+
+            self.stream_source = build_stream_source(training_data)
+
         self.task_d = TaskDispatcher(
-            shards_for(getattr(args, "training_data", "")),
+            {} if self.stream_source is not None else shards_for(training_data),
             shards_for(getattr(args, "validation_data", "")),
             shards_for(getattr(args, "prediction_data", "")),
             records_per_task=args.records_per_task,
             num_epochs=args.num_epochs,
             task_timeout_secs=getattr(args, "task_timeout_secs", 0.0),
             shuffle_seed=getattr(args, "shuffle_seed", None),
+            stream_source=self.stream_source,
+            stream_origin=training_data if self.stream_source is not None else "",
         )
 
         # ---- tensorboard + evaluation services
@@ -238,6 +252,32 @@ class Master:
                 else None
             )
             self.servicer.set_replica_directory(self.replica_directory)
+
+        # ---- live train->serve push (streaming subsystem; off by
+        # default: with no --live_push_addr nothing is constructed).
+        # Rides the replica ring — without --replication there is no
+        # state to harvest, so the pusher is skipped with a warning
+        self.live_pusher = None
+        live_push_addr = getattr(args, "live_push_addr", None) or ""
+        if live_push_addr:
+            if self.replica_directory is None:
+                logger.warning(
+                    "--live_push_addr set without --replication; live "
+                    "push disabled (the push harvests the replica ring)"
+                )
+            else:
+                from elasticdl_tpu.rpc.deadline import DeadlinePolicy
+                from elasticdl_tpu.streaming.live_push import LivePusher
+
+                deadline_secs = getattr(args, "rpc_deadline_secs", None)
+                self.live_pusher = LivePusher(
+                    live_push_addr,
+                    self.replica_directory,
+                    telemetry=self.telemetry,
+                    deadlines=DeadlinePolicy.from_secs(deadline_secs)
+                    if deadline_secs is not None
+                    else None,
+                )
 
         # ---- master high availability (off by default: with no
         # --master_journal_dir every path below is dormant and behavior
@@ -682,6 +722,14 @@ class Master:
                     # burn-rate detectors (violations emit, auto-arm the
                     # profiler, and open incidents from inside evaluate)
                     self._slo_tick()
+                if self.task_d.streaming:
+                    # watermark-lease mode: publish the watermark pair +
+                    # lag (deduped inside — an idle tick emits nothing)
+                    status = self.task_d.stream_status()
+                    if status is not None:
+                        self.telemetry.stream_tick(status)
+                if self.live_pusher is not None and not dead:
+                    self._live_push_tick()
                 if (
                     self.reform_events
                     and "latency_secs" not in self.reform_events[-1]
@@ -711,6 +759,14 @@ class Master:
                 time.sleep(poll_secs)
         except KeyboardInterrupt:
             logger.warning("Interrupted; shutting down")
+        if self.task_d.streaming:
+            # the run loop can break on finished() before the tick that
+            # would record the terminal pair — emit it explicitly so the
+            # event log's last stream_watermark shows the drained state
+            # (the bounded-lag checker's final-drain evidence)
+            status = self.task_d.stream_status()
+            if status is not None:
+                self.telemetry.stream_tick(status)
         self.stop()
         return 1 if self._job_failed else 0
 
@@ -1059,6 +1115,19 @@ class Master:
             return
         snap = self.task_d.snapshot()
         backlog = snap["pending"] + snap["pending_eval"]
+        if self.task_d.streaming:
+            # watermark-lease mode: pending counts only the windows
+            # already MINTED, which is bounded by what workers lease —
+            # the true backlog is the lag behind the source watermark,
+            # expressed in task-window units so one threshold flag
+            # (--stream_lag_tasks / --autoscale_backlog_tasks) covers
+            # both modes
+            status = self.task_d.stream_status()
+            if status is not None:
+                per_task = max(
+                    1, int(getattr(self._args, "records_per_task", 1) or 1)
+                )
+                backlog = int(status["lag"]) // per_task
         current = getattr(im, "world_num_slices", 1)
         decision = self.autoscaler.evaluate(backlog, current)
         if decision is None:
@@ -1079,6 +1148,23 @@ class Master:
             decision["reason"],
         )
         self.request_reform(f"autoscale:{decision['action']}")
+
+    def _live_push_tick(self):
+        """Run-loop tick: fan the replica ring's freshest complete
+        snapshot into serving when the model version advanced (the
+        pusher itself gates on version + attempt interval, so an idle
+        tick costs two integer compares)."""
+        im = self.instance_manager
+        if im is None:
+            return
+        ids = im.worker_ids()
+        self.live_pusher.tick(
+            model_version=self.servicer.get_model_version(),
+            generation=self.servicer.cluster_version,
+            num_sources=getattr(im, "world_size", len(ids)),
+            live_worker_ids=ids,
+            stream_status=self.task_d.stream_status(),
+        )
 
     # ---- SLO watchdog plumbing ----------------------------------------------
 

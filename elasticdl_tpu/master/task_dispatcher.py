@@ -106,12 +106,25 @@ class TaskDispatcher:
         task_timeout_secs: float = 0.0,
         shuffle_seed: int | None = None,
         clock=time.monotonic,
+        stream_source=None,
+        stream_origin: str = "",
     ):
         """Shard dicts map ``shard_name -> (start_index, num_records)``
         (the output of a data reader's ``create_shards()``).  ``clock``
         is the lease clock — injectable so the fleet simulator
         (elasticdl_tpu.fleetsim) can drive lease timeouts on a virtual
-        clock; production always passes the default."""
+        clock; production always passes the default.
+
+        ``stream_source`` switches the dispatcher into **watermark-lease
+        mode** (streaming subsystem): instead of slicing finite shards
+        into epochs, training tasks are minted lazily as
+        ``[offset, offset + records_per_task)`` windows of an unbounded
+        stream, up to the source's published watermark.  Lease/report/
+        reclaim/requeue and exactly-once accounting are byte-identical
+        to the epoch path — a window IS a task — and ``finished()``
+        never fires while the source is open.  ``stream_origin`` is the
+        ``stream://`` origin stamped as every window's shard_name (the
+        worker-side reader regenerates records from it)."""
         self._lock = threading.Lock()
         self._callback_lock = threading.Lock()
         self._rng = random.Random(shuffle_seed)
@@ -141,6 +154,18 @@ class TaskDispatcher:
         # compile delta must still be banked).  One int per lease, same
         # footprint as the servicer's eval-metrics dedup set.
         self._reported_task_ids: set[int] = set()  # guarded-by: _lock
+
+        # ---- watermark-lease (streaming) state ----
+        self._stream = stream_source
+        self._stream_origin = stream_origin
+        self._stream_next_offset = 0  # guarded-by: _lock
+        # completed windows not yet contiguous with the trained
+        # watermark: start -> end.  Windows complete out of order (many
+        # workers, requeues); the trained watermark only advances over a
+        # gap-free prefix, which is what makes it safe to restore from
+        # (every record below it trained exactly once).
+        self._stream_completed: dict[int, int] = {}  # guarded-by: _lock
+        self._trained_watermark = 0  # guarded-by: _lock
 
         self._counters: dict[TaskType, JobCounters] = {}  # guarded-by: _lock
         self._done_callbacks: list[Callable[[], None]] = []
@@ -251,6 +276,53 @@ class TaskDispatcher:
         )
         self._notify("on_tasks_created", tasks)
 
+    # lock-holding: _lock
+    def _mint_stream_tasks_locked(self):
+        """Mint window tasks up to the source watermark (streaming mode).
+
+        Full ``records_per_task`` windows only while the source is open
+        — the ragged tail is minted once the source closes, so window
+        boundaries are stable across masters (journal replay mints
+        nothing; minted windows ride ``tasks_created`` records like any
+        epoch slice).  Minted windows keep offset order: the pending
+        stack pops oldest-first so the trained watermark advances as a
+        prefix instead of stranding behind a hole."""
+        watermark = self._stream.watermark()
+        closed = self._stream.closed()
+        tasks: list[Task] = []
+        counters = self._counters.setdefault(TaskType.TRAINING, JobCounters())
+        while True:
+            end = min(self._stream_next_offset + self._records_per_task,
+                      watermark)
+            if end <= self._stream_next_offset:
+                break
+            if end - self._stream_next_offset < self._records_per_task \
+                    and not closed:
+                break  # partial window: wait for the watermark (or close)
+            self._next_task_uid += 1
+            tasks.append(
+                Task(
+                    shard_name=self._stream_origin,
+                    start=self._stream_next_offset,
+                    end=end,
+                    type=TaskType.TRAINING,
+                    uid=self._next_task_uid,
+                )
+            )
+            counters.total_records += end - self._stream_next_offset
+            self._stream_next_offset = end
+        if not tasks:
+            return
+        # pending is a stack (pop from the end): reversed insert = FIFO
+        self._pending.extend(reversed(tasks))
+        logger.info(
+            "Minted %d stream window(s) up to watermark %d (lag %d)",
+            len(tasks),
+            watermark,
+            watermark - self._trained_watermark,
+        )
+        self._notify("on_tasks_created", tasks)
+
     # ---- task leasing -----------------------------------------------------
 
     # lock-holding: _lock
@@ -267,7 +339,9 @@ class TaskDispatcher:
         (reference task_dispatcher.py:237-258)."""
         with self._lock:
             self._reclaim_expired_locked()
-            if not self._pending and self._epoch < self._num_epochs - 1:
+            if self._stream is not None:
+                self._mint_stream_tasks_locked()
+            elif not self._pending and self._epoch < self._num_epochs - 1:
                 self._epoch += 1
                 # journal observers need the epoch-cursor advance BEFORE
                 # the created tasks so replay applies them in order
@@ -401,6 +475,8 @@ class TaskDispatcher:
             ):
                 eval_completed = True
             else:
+                if self._stream is not None and task.type == TaskType.TRAINING:
+                    self._stream_complete_locked(task)
                 logger.info(
                     "Task %d completed; %d remaining",
                     task_id,
@@ -418,6 +494,17 @@ class TaskDispatcher:
         if eval_completed:
             self._evaluation_service.complete_task(
                 eval_job_id=task.extended.get("eval_job_id")
+            )
+
+    # lock-holding: _lock
+    def _stream_complete_locked(self, task: Task):
+        """Record a trained window; advance the trained watermark over
+        the gap-free prefix.  Exactly-once is upstream (a window reaches
+        here once per the report dedup), so the pops never double."""
+        self._stream_completed[task.start] = task.end
+        while self._trained_watermark in self._stream_completed:
+            self._trained_watermark = self._stream_completed.pop(
+                self._trained_watermark
             )
 
     def recover_tasks(self, worker_id: int):
@@ -465,6 +552,21 @@ class TaskDispatcher:
 
     def finished(self) -> bool:
         with self._lock:
+            if self._stream is not None:
+                # streaming: never finished while the source is open (a
+                # WAIT response keeps the workers polling), and once it
+                # closes, finished means the backlog fully drained —
+                # every published record minted, every window reported.
+                stream_pending = (
+                    not self._stream.closed()
+                    or self._stream_next_offset < self._stream.watermark()
+                )
+                return not (
+                    stream_pending
+                    or self._pending
+                    or self._pending_eval
+                    or self._active
+                )
             # epochs are opened LAZILY by get() — an un-started epoch is
             # still pending work.  Without this term, a worker death at
             # the last task of an epoch lets the master's poll loop see
@@ -570,6 +672,26 @@ class TaskDispatcher:
     def epoch(self) -> int:
         return self._epoch
 
+    @property
+    def streaming(self) -> bool:
+        return self._stream is not None
+
+    def stream_status(self) -> dict | None:
+        """The streaming backlog signal: ``lag = source_watermark -
+        trained_watermark`` is what the autoscaler rides and what the
+        bounded-lag chaos invariant bounds.  ``None`` in epoch mode."""
+        if self._stream is None:
+            return None
+        with self._lock:
+            watermark = self._stream.watermark()
+            return {
+                "source_watermark": watermark,
+                "trained_watermark": self._trained_watermark,
+                "lag": max(0, watermark - self._trained_watermark),
+                "next_offset": self._stream_next_offset,
+                "closed": self._stream.closed(),
+            }
+
     # lock-holding: _lock
     def _counters_for(self, task_type: TaskType) -> JobCounters:
         return self._counters.setdefault(task_type, JobCounters())
@@ -625,8 +747,21 @@ class TaskDispatcher:
 
     # lock-holding: _lock
     def _state_snapshot_locked(self) -> dict:
+        stream = None
+        if self._stream is not None:
+            stream = {
+                "next_offset": self._stream_next_offset,
+                "trained_watermark": self._trained_watermark,
+                "completed": {
+                    str(s): e for s, e in self._stream_completed.items()
+                },
+                # journaled so a restarted master re-floors its source:
+                # the watermark must never regress across a master life
+                "source_watermark": self._stream.watermark(),
+            }
         return {
             "epoch": self._epoch,
+            "stream": stream,
             "next_task_id": self._next_task_id,
             "next_task_uid": self._next_task_uid,
             "pending": [t.to_dict() for t in self._pending],
@@ -679,6 +814,17 @@ class TaskDispatcher:
                 )
                 for name, c in state.get("counters", {}).items()
             }
+            stream = state.get("stream")
+            if stream is not None and self._stream is not None:
+                self._stream_next_offset = int(stream["next_offset"])
+                self._trained_watermark = int(stream["trained_watermark"])
+                self._stream_completed = {
+                    int(s): int(e)
+                    for s, e in stream.get("completed", {}).items()
+                }
+                advance_to = getattr(self._stream, "advance_to", None)
+                if advance_to is not None:
+                    advance_to(int(stream.get("source_watermark", 0)))
 
     def reconcile_leases(
         self, worker_id: int, presented: set[int]

@@ -119,7 +119,10 @@ IDEMPOTENCY: dict[str, tuple[str, str]] = {
         "versioned-put",
         "a swap to a version <= the replica's current one is refused as "
         "stale (engine guard), so a re-delivered swap is absorbed — the "
-        "router fans it to every replica with retries on",
+        "router fans it to every replica with retries on; the streaming "
+        "live push rides the same method with an inline snapshot "
+        "payload and the same guard, so a replayed push converges as "
+        "stale instead of double-applying",
     ),
 }
 

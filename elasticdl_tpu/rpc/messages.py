@@ -407,6 +407,20 @@ class SwapModelRequest:
     # parent into it, so one swap = one trace across the fleet.  Empty
     # when untraced; old payloads decode to {} — wire-compatible
     trace: dict = field(default_factory=dict)
+    # live train->serve push (streaming subsystem): a non-empty
+    # ``payload`` carries an encoded replica snapshot
+    # (replication/blob.py) to swap from directly — no export dir, no
+    # disk.  ``version`` stamps the swap (the versioned-put guard is
+    # unchanged: a version <= the served one is refused as stale);
+    # ``source`` labels provenance for the model_swap event; the two
+    # watermarks ride along so the replica's swap telemetry carries the
+    # freshness pair (trained-at-push vs source).  All default-valued,
+    # so old payloads decode cleanly — wire-compatible
+    payload: bytes = b""
+    version: int = -1
+    source: str = ""
+    trained_watermark: int = -1
+    source_watermark: int = -1
 
 
 @dataclass

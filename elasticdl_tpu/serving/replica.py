@@ -166,11 +166,38 @@ class ServingReplicaServicer:
         from elasticdl_tpu.serving.engine import STALE_SWAP_PREFIX
 
         try:
-            accepted, version, reason = self.engine.swap_from_export(
-                request.model_dir,
-                min_version=request.min_version,
-                trace=request.trace,
-            )
+            if request.payload:
+                # live train->serve push: the payload IS the model — an
+                # encoded replica snapshot straight from the training
+                # job's ReplicaStore ring, swapped in without touching
+                # disk.  Same versioned-put guard as the export path
+                # (engine refuses version <= served as stale).
+                from elasticdl_tpu.replication.blob import decode_snapshot
+
+                dense, _parts = decode_snapshot(request.payload)
+                prefix = "params/"
+                flat_params = {
+                    k[len(prefix):]: v
+                    for k, v in dense.items()
+                    if k.startswith(prefix)
+                }
+                flat_state = {
+                    k: v for k, v in dense.items()
+                    if not k.startswith(prefix)
+                }
+                accepted, version, reason = self.engine.swap_state_dicts(
+                    flat_params,
+                    flat_state,
+                    int(request.version),
+                    source=request.source or "live-push",
+                    trace=request.trace,
+                )
+            else:
+                accepted, version, reason = self.engine.swap_from_export(
+                    request.model_dir,
+                    min_version=request.min_version,
+                    trace=request.trace,
+                )
         except (OSError, ValueError, KeyError) as ex:
             return msg.SwapModelResponse(
                 accepted=False,

@@ -1,0 +1,27 @@
+"""Streaming subsystem: continuous training over an unbounded source.
+
+The pieces, in data-flow order:
+
+- ``source``   — the stream *watermark* publisher (how many records
+  exist so far, and whether the source has closed).  The in-process
+  :class:`~elasticdl_tpu.streaming.source.QueueStreamSource` backs CPU
+  tests and smokes; an ODPS-shaped partition tailer covers the real
+  path behind the same two-method contract.
+- ``reader``   — :class:`~elasticdl_tpu.streaming.reader.StreamDataReader`,
+  an :class:`~elasticdl_tpu.data.reader.AbstractDataReader` over a
+  ``stream://`` origin.  Records are a pure function of
+  ``(seed, index)`` so master and workers need no shared state: any
+  worker can serve any leased ``[offset, offset+n)`` window.
+- the dispatcher's watermark-lease mode lives in
+  ``master/task_dispatcher.py`` (tasks minted lazily up to the
+  watermark; ``lag = source_watermark - trained_watermark`` is the
+  backlog signal), and the live train->serve push in
+  ``live_push.py`` (ReplicaStore commit fanned into serving
+  ``swap_state_dicts``).
+"""
+
+from elasticdl_tpu.streaming.source import (  # noqa: F401
+    QueueStreamSource,
+    StreamSpec,
+    parse_stream_origin,
+)

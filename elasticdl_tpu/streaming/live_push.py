@@ -1,0 +1,178 @@
+"""Live train->serve push: the ReplicaStore ring feeding serving.
+
+The streaming loop's last hop.  In watermark-lease mode the training
+job has no epochs and no checkpoints — the replica ring IS durability —
+so "deploy the latest model" cannot mean "export a directory and point
+a swap at it".  Instead the master, which already knows how to pull a
+complete verified state off the ring (``ReplicaDirectory.harvest``,
+the PR-4 reform path), reuses that harvest OUTSIDE reform: whenever the
+model version advances past the last push, it assembles the freshest
+complete snapshot from the live workers' replica servers and fans the
+encoded blob straight into the serving plane's ``swap_model`` as an
+inline payload (:class:`~elasticdl_tpu.rpc.messages.SwapModelRequest`
+``payload=``).  The replica decodes and applies it through
+``engine.swap_state_dicts`` — same treedef, same placement, zero
+recompiles, in-flight requests draining on the old version.
+
+Address semantics: ``--live_push_addr`` may point at a single replica
+or at the serving router — ``swap_model`` is a versioned-put either
+way, so re-delivery and fan-out retries are absorbed (a push that lands
+twice is refused as stale the second time, which the pusher treats as
+success).
+
+Every attempt lands in the freshness ledger via
+``MasterTelemetry.live_push`` — trained-watermark-at-push vs source
+watermark is the served model's staleness, the number the
+``freshness_monotone`` chaos invariant and the report's streaming
+section ride.
+"""
+
+from __future__ import annotations
+
+import time
+
+from elasticdl_tpu.utils.log_utils import default_logger as logger
+
+# a failed harvest (incomplete coverage mid-push) retries on a later
+# tick; this floor keeps the pusher from hammering the replica servers
+# with probe fan-outs every poll second while the ring catches up
+MIN_ATTEMPT_INTERVAL_SECS = 1.0
+
+
+class LivePusher:
+    """Pushes harvested replica snapshots into serving on version advance.
+
+    Owned by the master and ticked from its run loop (same cadence as
+    ``_autoscale_tick``).  Stateless across restarts on purpose: a
+    restarted master re-pushes the current version once — absorbed as
+    stale by the versioned-put guard."""
+
+    def __init__(
+        self,
+        addr: str,
+        directory,
+        telemetry=None,
+        deadlines=None,
+        min_interval_secs: float = MIN_ATTEMPT_INTERVAL_SECS,
+        clock=time.monotonic,
+    ):
+        self._addr = addr
+        self._directory = directory
+        self._telemetry = telemetry
+        self._deadlines = deadlines
+        self._min_interval = float(min_interval_secs)
+        self._clock = clock
+        self._last_pushed_version = -1
+        self._last_attempt = float("-inf")
+        self.pushes_accepted = 0
+        self.pushes_refused = 0
+        self.harvest_skips = 0
+
+    @property
+    def last_pushed_version(self) -> int:
+        return self._last_pushed_version
+
+    def tick(
+        self,
+        *,
+        model_version: int,
+        generation: int,
+        num_sources: int,
+        live_worker_ids: list,
+        stream_status: dict | None = None,
+    ) -> bool:
+        """One run-loop tick: harvest + push if the version advanced.
+
+        Returns True when a push was accepted (or absorbed as stale —
+        the serving plane is at/past this version either way)."""
+        if int(model_version) <= max(self._last_pushed_version, 0):
+            # version 0 = nothing trained yet: no worker can have staged
+            # a replica, so probing the ring would only log a spurious
+            # coverage-incomplete warning every tick through the first
+            # (compile-heavy) step
+            return False
+        now = self._clock()
+        if now - self._last_attempt < self._min_interval:
+            return False
+        self._last_attempt = now
+        try:
+            stage = self._directory.harvest(
+                live_worker_ids=list(live_worker_ids),
+                num_sources=int(num_sources),
+                generation=int(generation),
+                staged_for=int(generation),
+            )
+        except Exception:  # noqa: BLE001 — a push must never take down
+            # the training master; the next tick retries
+            logger.exception("Live push: harvest failed; will retry")
+            return False
+        if stage is None:
+            # incomplete coverage (a worker mid-push or just preempted):
+            # not an error — the ring converges and a later tick pushes
+            self.harvest_skips += 1
+            return False
+        version = int(stage["version"])
+        if version <= self._last_pushed_version:
+            # the ring has not caught up to the advertised model
+            # version yet; push when a complete set at a newer version
+            # exists
+            return False
+        return self._push(version, stage["payload"], stream_status)
+
+    def _push(self, version: int, payload: bytes, stream_status) -> bool:
+        from elasticdl_tpu.rpc import messages as msg
+        from elasticdl_tpu.serving.replica import ServingClient
+
+        status = stream_status or {}
+        trained = int(status.get("trained_watermark", -1))
+        source_wm = int(status.get("source_watermark", -1))
+        t0 = time.monotonic()
+        client = None
+        try:
+            client = ServingClient(self._addr, deadlines=self._deadlines)
+            resp = client.swap_model(
+                msg.SwapModelRequest(
+                    payload=payload,
+                    version=version,
+                    source=f"live-push@{trained}",
+                    trained_watermark=trained,
+                    source_watermark=source_wm,
+                )
+            )
+        except Exception as ex:  # noqa: BLE001 — serving being down must
+            # not stall training; the next version advance retries
+            logger.warning("Live push of version %d failed: %s", version, ex)
+            self._note(version, trained, source_wm, False, t0, str(ex))
+            return False
+        finally:
+            if client is not None:
+                client.close()
+        # stale == the serving plane is already at/past this version
+        # (a replayed push, or another master raced us): converged
+        converged = bool(resp.accepted or resp.stale)
+        if converged:
+            self._last_pushed_version = version
+            self.pushes_accepted += 1
+        else:
+            self.pushes_refused += 1
+            logger.warning(
+                "Live push of version %d refused: %s", version, resp.reason
+            )
+        self._note(
+            version, trained, source_wm, bool(resp.accepted), t0, resp.reason
+        )
+        return converged
+
+    def _note(self, version, trained, source_wm, accepted, t0, reason):
+        if self._telemetry is None:
+            return
+        self._telemetry.live_push(
+            model_version=version,
+            trained_watermark=trained,
+            source_watermark=source_wm,
+            accepted=accepted,
+            replica=self._addr,
+            swap_ms=(time.monotonic() - t0) * 1000.0,
+            started_at=t0,
+            reason=reason or "",
+        )

@@ -1,0 +1,89 @@
+"""Unbounded-source reader behind the ``AbstractDataReader`` seam.
+
+``stream://mnist?seed=3&total=4096&rate=2000`` names a record stream
+whose record ``i`` is a *pure function of (seed, i)*: the same class
+templates the synthetic generators use (fixed ``RandomState(1234)``)
+plus per-record noise from an RNG derived from ``(seed, i)``.  That
+purity is the whole design — master and workers share no queue state,
+so any worker can serve any leased ``[offset, offset+n)`` window, a
+reclaimed window re-reads identical bytes on another worker, and the
+live-push parity test can recompute the exact records a watermark
+covers.
+
+``create_shards()`` is empty: a stream has no finite shard map — the
+dispatcher's watermark-lease mode mints window tasks against the
+source watermark instead of slicing shards.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from elasticdl_tpu.data.reader import AbstractDataReader, Metadata, encode_example
+from elasticdl_tpu.streaming.source import StreamSpec, parse_stream_origin
+
+# dataset -> (image shape, num classes); schemas mirror
+# data/recordio_gen/synthetic.py so the stock model zoo trains unchanged
+_SCHEMAS = {
+    "mnist": ((28, 28), 10),
+    "cifar10": ((32, 32, 3), 10),
+}
+
+_TEMPLATE_CACHE: dict[str, np.ndarray] = {}
+
+
+def _templates(dataset: str) -> np.ndarray:
+    if dataset not in _SCHEMAS:
+        raise ValueError(
+            f"unknown stream dataset {dataset!r}; known: {sorted(_SCHEMAS)}"
+        )
+    if dataset not in _TEMPLATE_CACHE:
+        shape, num_classes = _SCHEMAS[dataset]
+        # the SAME fixed template RNG as the synthetic generators, so a
+        # stream:// run learns the same underlying distribution
+        rng = np.random.RandomState(1234)
+        _TEMPLATE_CACHE[dataset] = rng.uniform(
+            0, 255, size=(num_classes, *shape)
+        )
+    return _TEMPLATE_CACHE[dataset]
+
+
+def stream_record(dataset: str, seed: int, index: int) -> dict[str, np.ndarray]:
+    """Record ``index`` of the stream — deterministic, order-free."""
+    shape, num_classes = _SCHEMAS[dataset]
+    templates = _templates(dataset)
+    # per-index RNG: independent of read order, identical on every host
+    rng = np.random.RandomState((seed * 1_000_003 + index) % (2**31 - 1))
+    label = rng.randint(num_classes)
+    img = templates[label] + rng.normal(0, 32.0, size=shape)
+    return {
+        "image": np.clip(img, 0, 255).astype(np.uint8),
+        "label": np.int64(label),
+    }
+
+
+class StreamDataReader(AbstractDataReader):
+    def __init__(self, data_origin: str = "", **kwargs):
+        super().__init__(**kwargs)
+        self._origin = data_origin
+        self._spec: StreamSpec = parse_stream_origin(data_origin)
+        _templates(self._spec.dataset)  # fail fast on unknown schema
+
+    @property
+    def spec(self) -> StreamSpec:
+        return self._spec
+
+    def read_records(self, task) -> Iterator[bytes]:
+        for i in range(task.start, task.end):
+            yield encode_example(
+                stream_record(self._spec.dataset, self._spec.seed, i)
+            )
+
+    def create_shards(self) -> dict[str, tuple[int, int]]:
+        return {}
+
+    @property
+    def metadata(self) -> Metadata:
+        return Metadata(extra={"format": "stream", "dataset": self._spec.dataset})

@@ -1,0 +1,174 @@
+"""Stream sources: who decides how many records exist.
+
+A stream source publishes exactly two facts — the **source watermark**
+(records ``[0, watermark)`` exist and may be leased) and whether the
+source has **closed** (the watermark will never advance again).  The
+dispatcher's watermark-lease mode consumes nothing else, so any feed
+that can answer those two questions plugs in: the in-process seeded
+queue below for CPU tests/smokes, an ODPS partition tailer for the
+real path, or a test double that calls ``advance`` by hand.
+
+Watermarks are monotone by contract: once published, a watermark never
+regresses (a restarted master re-floors the source at the journaled
+watermark via ``advance_to``), which is what makes
+``lag = source_watermark - trained_watermark`` a meaningful backlog
+signal and the freshness ledger's staleness well-defined.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from urllib.parse import parse_qs, urlparse
+
+STREAM_SCHEME = "stream://"
+
+
+@dataclass(frozen=True)
+class StreamSpec:
+    """Parsed form of a ``stream://`` data origin.
+
+    ``stream://mnist?seed=3&total=4096&rate=2000`` — dataset schema,
+    generator seed, bounded prefix length (``total``; 0 = truly
+    unbounded), and watermark advance rate in records/sec (0 = only
+    explicit ``advance`` calls move the watermark).
+    """
+
+    dataset: str
+    seed: int = 0
+    total: int = 0
+    rate: float = 0.0
+    params: dict = field(default_factory=dict)
+
+
+def is_stream_origin(data_origin: str) -> bool:
+    return bool(data_origin) and data_origin.startswith(STREAM_SCHEME)
+
+
+def parse_stream_origin(data_origin: str) -> StreamSpec:
+    if not is_stream_origin(data_origin):
+        raise ValueError(
+            f"not a stream:// origin: {data_origin!r}"
+        )
+    parsed = urlparse(data_origin)
+    query = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
+    return StreamSpec(
+        dataset=parsed.netloc or parsed.path.lstrip("/"),
+        seed=int(query.pop("seed", 0)),
+        total=int(query.pop("total", 0)),
+        rate=float(query.pop("rate", 0.0)),
+        params=query,
+    )
+
+
+class QueueStreamSource:
+    """In-process seeded stream: the CPU-test stand-in for a real queue
+    service.
+
+    The watermark advances at ``rate`` records/sec of wall clock (or by
+    explicit ``advance``/``advance_to`` calls — the chaos/test hook),
+    capped at ``total`` when the stream is a bounded prefix.  A bounded
+    prefix is what gives smokes and chaos runs a termination path: the
+    source *closes* at ``total`` and the dispatcher's ``finished()``
+    can finally fire once the backlog drains.
+    """
+
+    def __init__(
+        self,
+        total: int = 0,
+        rate_per_sec: float = 0.0,
+        initial: int = 0,
+        clock=time.monotonic,
+    ):
+        self._total = int(total)
+        self._rate = float(rate_per_sec)
+        self._clock = clock
+        self._t0 = clock()
+        self._lock = threading.Lock()
+        self._floor = int(initial)  # guarded-by: _lock
+
+    @classmethod
+    def from_spec(cls, spec: StreamSpec, clock=time.monotonic):
+        return cls(
+            total=spec.total,
+            rate_per_sec=spec.rate,
+            # records already published at t0 (rides the origin query so
+            # smokes/chaos can start with a leasable backlog)
+            initial=int(spec.params.get("initial", 0)),
+            clock=clock,
+        )
+
+    def watermark(self) -> int:
+        with self._lock:
+            w = self._floor
+            if self._rate > 0:
+                w = max(w, int(self._rate * (self._clock() - self._t0)))
+            if self._total > 0:
+                w = min(w, self._total)
+            # monotone even if the clock misbehaves
+            self._floor = max(self._floor, w)
+            return self._floor
+
+    def closed(self) -> bool:
+        """True once the watermark can never advance again."""
+        return self._total > 0 and self.watermark() >= self._total
+
+    def advance(self, n: int) -> int:
+        """Test/chaos hook: publish ``n`` more records."""
+        with self._lock:
+            target = self._floor + int(n)
+        return self.advance_to(target)
+
+    def advance_to(self, watermark: int) -> int:
+        """Floor the watermark at ``watermark`` (monotone; used by a
+        restarted master to resume at the journaled watermark)."""
+        with self._lock:
+            w = int(watermark)
+            if self._total > 0:
+                w = min(w, self._total)
+            self._floor = max(self._floor, w)
+            return self._floor
+
+
+class OdpsTailingSource:  # pragma: no cover - requires the odps SDK
+    """ODPS-shaped real path: tail a table partition's record count.
+
+    The reference system streams from ODPS/queue services; here the
+    same contract is met by polling the table size — the row count IS
+    the watermark, and a sentinel ``closed`` partition marker (or an
+    explicit ``close()``) ends the stream.  Import-gated exactly like
+    ``data/odps_reader.py``: construction raises unless the SDK is
+    importable, and nothing else in the subsystem imports this module
+    member eagerly.
+    """
+
+    def __init__(self, table: str, partition: str | None = None, **kwargs):
+        try:
+            from elasticdl_tpu.data.odps_reader import ODPSDataReader
+        except ImportError as exc:
+            raise ImportError(
+                "OdpsTailingSource requires the 'odps' SDK"
+            ) from exc
+        self._reader = ODPSDataReader(
+            table=table, partition=partition, **kwargs
+        )
+        self._closed = False
+
+    def watermark(self) -> int:
+        shards = self._reader.create_shards()
+        return sum(n for _, n in shards.values())
+
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        self._closed = True
+
+
+def build_stream_source(data_origin: str, clock=time.monotonic):
+    """Construct the master-side source for a ``stream://`` origin."""
+    spec = parse_stream_origin(data_origin)
+    if spec.dataset.startswith("odps:"):  # pragma: no cover - SDK path
+        return OdpsTailingSource(table=spec.dataset[len("odps:"):])
+    return QueueStreamSource.from_spec(spec, clock=clock)

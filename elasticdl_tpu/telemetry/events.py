@@ -109,6 +109,16 @@ EVENT_SLO_VIOLATION = "slo_violation"
 EVENT_SLO_RECOVERED = "slo_recovered"
 EVENT_INCIDENT_OPEN = "incident_open"
 EVENT_INCIDENT_CLOSE = "incident_close"
+# streaming subsystem (elasticdl_tpu.streaming): one event per master
+# poll tick in watermark-lease mode carrying the source/trained
+# watermark pair (stream_watermark) and the lag derived from it
+# (stream_lag — the autoscaler's backlog signal and the bounded-lag
+# chaos invariant's evidence); one event per live train->serve push
+# (live_push) stamping trained-watermark-at-swap vs source watermark —
+# the freshness ledger's rows (staleness = source - trained at push)
+EVENT_STREAM_WATERMARK = "stream_watermark"
+EVENT_STREAM_LAG = "stream_lag"
+EVENT_LIVE_PUSH = "live_push"
 
 EVENTS_FILENAME = "events.jsonl"
 

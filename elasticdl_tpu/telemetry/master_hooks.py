@@ -200,6 +200,9 @@ class MasterTelemetry:
         self._tb_service = None
         self._tb_mirrored_version = -1
         self._reform_span = None
+        # last (source, trained) watermark pair emitted, so an idle
+        # stream's poll ticks do not flood the event log
+        self._last_stream_emit: tuple | None = None
         # the SLO watchdog engine, when --slo_config armed one (set by
         # the master via set_slo_engine; None = plane off, and every
         # surface below skips it so behavior is byte-identical)
@@ -276,6 +279,24 @@ class MasterTelemetry:
                         "Worker wall-clock buckets (utils.timing_utils)",
                         labels={"bucket": key[len("time_") : -len("_ms")]},
                     ).set_total(value)
+            # streaming (watermark-lease) backlog signal — the one
+            # registration site of the elasticdl_stream_{lag,watermark}
+            # gauges; absent entirely in epoch mode
+            if getattr(self._task_d, "streaming", False):
+                status = self._task_d.stream_status()
+                if status is not None:
+                    self.registry.gauge(
+                        "elasticdl_stream_lag_records",
+                        "Streaming backlog: source watermark minus "
+                        "trained watermark, in records",
+                    ).set(status["lag"])
+                    for role in ("source", "trained"):
+                        self.registry.gauge(
+                            "elasticdl_stream_watermark",
+                            "Stream watermarks by role (source=records "
+                            "published, trained=gap-free trained prefix)",
+                            labels={"role": role},
+                        ).set(status[f"{role}_watermark"])
         # set_total is monotone (max), so a re-formed generation's fresh
         # per-process counters can never walk the exposed total backward
         self._compiles.set_total(compiles)
@@ -912,6 +933,80 @@ class MasterTelemetry:
             action=action,
             from_slices=from_slices,
             to_slices=to_slices,
+        )
+
+    def stream_tick(self, status: dict):
+        """Run-loop tick in watermark-lease mode: emit the watermark
+        pair and the derived lag.  Deduped on the (source, trained)
+        pair — a tick where neither watermark moved emits nothing, so
+        an idle stream costs no event-log growth."""
+        from elasticdl_tpu.telemetry.events import (
+            EVENT_STREAM_LAG,
+            EVENT_STREAM_WATERMARK,
+        )
+
+        key = (status["source_watermark"], status["trained_watermark"])
+        if key == self._last_stream_emit:
+            return
+        self._last_stream_emit = key
+        self.events.emit(
+            EVENT_STREAM_WATERMARK,
+            source_watermark=status["source_watermark"],
+            trained_watermark=status["trained_watermark"],
+            next_offset=status["next_offset"],
+            closed=bool(status["closed"]),
+        )
+        self.events.emit(
+            EVENT_STREAM_LAG,
+            lag_records=status["lag"],
+            source_watermark=status["source_watermark"],
+            trained_watermark=status["trained_watermark"],
+        )
+
+    def live_push(
+        self,
+        *,
+        model_version: int,
+        trained_watermark: int,
+        source_watermark: int,
+        accepted: bool,
+        replica: str,
+        swap_ms: float,
+        started_at: float,
+        reason: str = "",
+    ):
+        """One live train->serve push: the freshness ledger's row.
+        ``staleness`` is records the served model is behind the source
+        at the moment of the swap."""
+        from elasticdl_tpu.telemetry.events import EVENT_LIVE_PUSH
+        from elasticdl_tpu.telemetry.tracing import SPAN_LIVE_PUSH
+
+        self.registry.counter(
+            "elasticdl_stream_live_push_total",
+            "Live train->serve pushes (replica-ring commit fanned into "
+            "serving swap_state_dicts); accepted= marks the stale-"
+            "refused ones",
+            labels={"accepted": "true" if accepted else "false"},
+        ).inc()
+        self.events.emit(
+            EVENT_LIVE_PUSH,
+            model_version=model_version,
+            trained_watermark=trained_watermark,
+            source_watermark=source_watermark,
+            staleness=max(0, source_watermark - trained_watermark),
+            accepted=bool(accepted),
+            replica=replica,
+            swap_ms=swap_ms,
+            reason=reason,
+        )
+        self.tracer.record_span(
+            SPAN_LIVE_PUSH,
+            started_at,
+            time.monotonic(),
+            model_version=model_version,
+            trained_watermark=trained_watermark,
+            accepted=bool(accepted),
+            replica=replica,
         )
 
     def replica_harvest(

@@ -235,6 +235,9 @@ def analyze_events(events: list[dict], faults: list[dict]) -> dict:
     slo = slo_section(events)
     if slo is not None:
         out["slo"] = slo
+    streaming = streaming_section(events)
+    if streaming is not None:
+        out["streaming"] = streaming
     return out
 
 
@@ -679,6 +682,69 @@ def serving_section(events: list[dict]) -> dict | None:
         out["latency_p50_ms"] = round(percentile(totals, 50), 3)
         out["latency_p95_ms"] = round(percentile(totals, 95), 3)
         out["latency_p99_ms"] = round(percentile(totals, 99), 3)
+    return out
+
+
+def streaming_section(events: list[dict]) -> dict | None:
+    """Streaming-mode aggregate: watermark progression from
+    ``stream_watermark``/``stream_lag`` ticks (final watermarks, lag
+    percentiles, max lag — the bounded-lag evidence) and the freshness
+    ledger from ``live_push`` events — one row per live train->serve
+    push with the trained-watermark-at-swap vs source-watermark pair
+    (``staleness`` = how many records behind the source the SERVED
+    model was the moment it went live).  None (key absent) when the
+    run never streamed, so epoch-mode reports are unchanged."""
+    ticks = sorted(
+        (e for e in events if e.get("event") == "stream_watermark"),
+        key=lambda e: e.get("monotonic", 0.0),
+    )
+    lags = [
+        float(e["lag_records"])
+        for e in events
+        if e.get("event") == "stream_lag" and "lag_records" in e
+    ]
+    pushes = sorted(
+        (e for e in events if e.get("event") == "live_push"),
+        key=lambda e: e.get("monotonic", 0.0),
+    )
+    if not ticks and not lags and not pushes:
+        return None
+    out: dict = {"watermark_ticks": len(ticks)}
+    if ticks:
+        last = ticks[-1]
+        out["source_watermark"] = int(last.get("source_watermark", 0))
+        out["trained_watermark"] = int(last.get("trained_watermark", 0))
+        out["closed"] = bool(last.get("closed", False))
+    if lags:
+        out["lag_records"] = {
+            "max": int(max(lags)),
+            "p50": round(percentile(lags, 50), 1),
+            "p95": round(percentile(lags, 95), 1),
+            "last": int(lags[-1]),
+        }
+    if pushes:
+        accepted = [e for e in pushes if e.get("accepted")]
+        staleness = [
+            int(e.get("staleness", 0)) for e in accepted
+        ]
+        out["freshness"] = {
+            "pushes": len(pushes),
+            "accepted": len(accepted),
+            "refused": len(pushes) - len(accepted),
+            "max_staleness_records": max(staleness) if staleness else None,
+            "ledger": [
+                {
+                    "model_version": e.get("model_version"),
+                    "trained_watermark": e.get("trained_watermark"),
+                    "source_watermark": e.get("source_watermark"),
+                    "staleness": e.get("staleness"),
+                    "accepted": bool(e.get("accepted")),
+                    "swap_ms": e.get("swap_ms"),
+                    "monotonic": e.get("monotonic"),
+                }
+                for e in pushes
+            ],
+        }
     return out
 
 
@@ -1323,6 +1389,46 @@ def _format_text(report: dict) -> str:
                         violation["threshold"],
                     )
                 )
+        streaming = run.get("streaming")
+        if streaming:
+            lines.append(
+                "streaming: trained watermark {} / source {}{}".format(
+                    streaming.get("trained_watermark", "?"),
+                    streaming.get("source_watermark", "?"),
+                    " (source closed)"
+                    if streaming.get("closed")
+                    else "",
+                )
+            )
+            lag = streaming.get("lag_records")
+            if lag:
+                lines.append(
+                    "  lag: max {} p50 {} p95 {} last {} record(s)".format(
+                        lag["max"], lag["p50"], lag["p95"], lag["last"]
+                    )
+                )
+            fresh = streaming.get("freshness")
+            if fresh:
+                lines.append(
+                    "  freshness: {} push(es), {} accepted, {} refused, "
+                    "max staleness {} record(s)".format(
+                        fresh["pushes"],
+                        fresh["accepted"],
+                        fresh["refused"],
+                        fresh["max_staleness_records"],
+                    )
+                )
+                for row in fresh["ledger"]:
+                    lines.append(
+                        "    push v{}: trained {} / source {} "
+                        "(staleness {}){}".format(
+                            row["model_version"],
+                            row["trained_watermark"],
+                            row["source_watermark"],
+                            row["staleness"],
+                            "" if row["accepted"] else "  REFUSED",
+                        )
+                    )
         for worker, rate in run["records_per_sec_by_worker"].items():
             lines.append(f"throughput: worker {worker}: {rate:.1f} records/s")
         if run["worker_time_ms"]:

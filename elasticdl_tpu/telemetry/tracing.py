@@ -95,6 +95,7 @@ SPAN_SERVING_REROUTE = "reroute"  # router: retry/eviction re-attempt
 SPAN_SERVING_QUEUE = "queue"  # replica: submit -> first dispatch
 SPAN_SERVING_ENGINE = "engine"  # replica: first dispatch -> delivered
 SPAN_SERVING_DISPATCH = "serving_dispatch"  # replica: one batch group
+SPAN_LIVE_PUSH = "live_push"  # master: harvest -> serving swap accepted
 
 
 def gen_trace_id() -> str:

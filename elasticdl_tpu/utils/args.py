@@ -699,6 +699,53 @@ def _add_master_params(parser: argparse.ArgumentParser):
             "byte-identical to a watchdog-less build"
         ),
     )
+    # streaming subsystem (continuous training).  Defaults None for the
+    # same byte-identical-argv rule: with streaming off, nothing about
+    # these flags reaches a worker or a golden manifest
+    parser.add_argument(
+        "--streaming",
+        type=parse_bool,
+        default=None,
+        required=False,
+        help=(
+            "Watermark-lease mode: --training_data names a stream:// "
+            "origin, the dispatcher mints [offset, offset+n) window "
+            "tasks up to the source watermark instead of slicing "
+            "epochs, finished() holds off until the source closes and "
+            "the backlog drains, and lag = source_watermark - "
+            "trained_watermark becomes the autoscaler's backlog "
+            "signal.  Unset = epoch mode (workers see the same argv "
+            "either way — the stream:// origin rides --training_data)"
+        ),
+    )
+    parser.add_argument(
+        "--stream_lag_tasks",
+        type=pos_int,
+        default=None,
+        required=False,
+        help=(
+            "Streaming autoscaler trigger: grow the world by one slice "
+            "when the stream lag reaches this many windows "
+            "(lag_records / records_per_task).  Unset falls back to "
+            "--autoscale_backlog_tasks over the same window-denominated "
+            "backlog"
+        ),
+    )
+    parser.add_argument(
+        "--live_push_addr",
+        default=None,
+        required=False,
+        help=(
+            "Close the train->serve loop: after each replica-ring "
+            "commit at a new model version, harvest the freshest "
+            "complete replica set and push its flat state dict into "
+            "the serving replica at this address (swap_model with an "
+            "inline payload -> engine.swap_state_dicts; zero failed "
+            "in-flight requests).  Each push lands a live_push event "
+            "stamping trained-vs-source watermark — the freshness "
+            "ledger.  Unset constructs nothing"
+        ),
+    )
     parser.add_argument(
         "--standby_workers",
         type=int,
@@ -919,6 +966,12 @@ _MASTER_ONLY_FLAGS = frozenset(
         # config travels by ELASTICDL_TPU_SLO_CONFIG (never argv) so
         # worker command lines stay byte-identical when off
         "slo_config",
+        # the streaming subsystem is master business end to end: the
+        # dispatcher mints windows, the run loop pushes live swaps —
+        # workers only ever see the stream:// origin via --training_data
+        "streaming",
+        "stream_lag_tasks",
+        "live_push_addr",
     }
 )
 

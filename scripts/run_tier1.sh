@@ -36,6 +36,15 @@
 # router-side SLO watchdog exactly once with a queue-bound incident
 # naming the replica, healthy traffic recovers it, and /healthz +
 # /metrics expose the per-replica probe-beat fan-in)
+# + streaming smoke (watermark-lease mode end to end: an unbounded-
+# source CPU run — no epochs, no checkpoints, replica ring as the only
+# durability — survives a mid-stream preemption with bounded lag and
+# exactly-once window accounting, the drop_stream_window corruption
+# MUST trip bounded_lag, and a live streaming job's ReplicaStore
+# commits hot-swap a real serving CLI under hammer traffic with zero
+# failed in-flight requests, a flat compile counter, and a freshness
+# ledger [trained-watermark-at-swap vs source watermark] rendered by
+# telemetry.report)
 # + fleetsim smoke (1000 simulated workers drive the REAL master on a
 # virtual clock: mass preemption, rolling slice loss, and master-kill-
 # under-fan-in must all PASS exactly-once + scaling budgets [master CPU
@@ -89,6 +98,7 @@ timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/replication_smoke.py || e
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/master_ha_smoke.py || exit 1
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/multislice_smoke.py || exit 1
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/serving_smoke.py || exit 1
+timeout -k 10 550 env JAX_PLATFORMS=cpu python scripts/streaming_smoke.py || exit 1
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/fleetsim_smoke.py || exit 1
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/memory_smoke.py || exit 1
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/embedding_smoke.py || exit 1

@@ -1,0 +1,388 @@
+#!/usr/bin/env python3
+"""Tier-1 streaming smoke (wired into scripts/run_tier1.sh).
+
+The streaming subsystem's contract, end to end on CPU:
+
+1. PREEMPT UNDER LOAD: an unbounded-source (bounded-prefix) streaming
+   job — watermark-lease dispatch, NO epochs, NO checkpoints — survives
+   a mid-stream SIGKILL of one worker with replication on: the leased
+   windows requeue, the re-formed world restores from peer RAM at the
+   replicated watermark (``replication_no_lost_steps``), accounting
+   stays exactly-once, and ``lag = source_watermark -
+   trained_watermark`` stays bounded (``bounded_lag``) with the stream
+   fully drained at exit;
+2. FALSIFIABILITY: the ``drop_stream_window`` corruption (a leased
+   window silently lost, never requeued) MUST trip ``bounded_lag`` —
+   the trained watermark can never cross the hole, so a green
+   invariant that cannot fail is worthless;
+3. LIVE PUSH UNDER HAMMER: a LIVE streaming job's ReplicaStore commits
+   fan into a REAL serving CLI (frontend + 1 replica subprocess over
+   gRPC) via ``--live_push_addr`` while hammer threads keep predict
+   requests in flight — ZERO failed in-flight requests, the served
+   version advances past the boot export with the replica's compile
+   counter FLAT (the inline-payload swap reuses the compiled program),
+   and ``telemetry.report`` renders the freshness ledger: one row per
+   push with trained-watermark-at-swap vs source watermark (staleness).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# one 64-record window at batch 32 is 2 steps; 256 records = 4 windows
+# = 8 steps across the fleet.  rate 64/s closes the bounded prefix in
+# ~3s of wall clock (well inside lockstep startup), initial 64 gives
+# the dispatcher a leasable backlog at t0.
+STREAM_TOTAL = 256
+STREAM_RATE = 64.0
+STREAM_INITIAL = 64
+SERVE_BATCH = 8
+
+
+def _fail(message: str) -> int:
+    print(f"streaming_smoke: {message}", file=sys.stderr)
+    return 1
+
+
+def _inv(report: dict, name: str) -> dict | None:
+    for inv in report.get("invariants", []):
+        if inv.get("name") == name:
+            return inv
+    return None
+
+
+def _stream_config(workdir: str, plan, **overrides):
+    from elasticdl_tpu.chaos.harness import ChaosJobConfig
+
+    kwargs = dict(
+        plan=plan,
+        workdir=workdir,
+        num_workers=2,
+        streaming=True,
+        stream_total=STREAM_TOTAL,
+        stream_rate=STREAM_RATE,
+        stream_initial=STREAM_INITIAL,
+        replication=True,
+        run_timeout_secs=240.0,
+    )
+    kwargs.update(overrides)
+    return ChaosJobConfig(**kwargs)
+
+
+def main() -> int:  # noqa: PLR0915 — one linear smoke scenario
+    import numpy as np
+
+    from elasticdl_tpu.chaos.harness import run_chaos_job
+    from elasticdl_tpu.chaos.plan import resolve_plan
+
+    root = tempfile.mkdtemp(prefix="edl_streaming_smoke_")
+
+    # ---- stage 1: preempt under load --------------------------------------
+    report = run_chaos_job(
+        _stream_config(
+            os.path.join(root, "preempt"),
+            resolve_plan("streaming_preempt_under_load", 2),
+        )
+    )
+    if report["timed_out"]:
+        return _fail("preempt-under-load run timed out")
+    if report["rc"] != 0 or not report["records_ok"]:
+        return _fail(
+            f"preempt-under-load run not green (rc={report['rc']}, "
+            f"records_ok={report['records_ok']})"
+        )
+    if not report["invariants_ok"]:
+        failed = [
+            i["name"]
+            for i in report["invariants"]
+            if i["status"] == "FAIL"
+        ]
+        return _fail(f"preempt-under-load invariants failed: {failed}")
+    for name in ("bounded_lag", "replication_no_lost_steps", "exactly_once"):
+        inv = _inv(report, name)
+        if inv is None or inv["status"] != "PASS":
+            return _fail(f"invariant {name} did not PASS: {inv}")
+    final = (report.get("streaming") or {}).get("final") or {}
+    if final.get("trained_watermark") != STREAM_TOTAL or not final.get(
+        "closed"
+    ):
+        return _fail(f"stream not drained at exit: {final}")
+    lag_limit = _inv(report, "bounded_lag").get("lag_limit_records")
+    print(
+        "streaming_smoke: preempt-under-load OK "
+        f"(trained watermark {final['trained_watermark']}/{STREAM_TOTAL}, "
+        f"max lag {_inv(report, 'bounded_lag').get('max_lag_records')} "
+        f"<= limit {lag_limit}, restore from peer RAM)"
+    )
+
+    # ---- stage 2: the corruption must trip bounded_lag --------------------
+    report = run_chaos_job(
+        _stream_config(
+            os.path.join(root, "corrupt"),
+            resolve_plan("none", 2),
+            corrupt="drop_stream_window",
+        )
+    )
+    if report["timed_out"]:
+        return _fail("drop_stream_window run timed out (must terminate)")
+    if report["invariants_ok"]:
+        return _fail(
+            "drop_stream_window did NOT trip the invariants — "
+            "bounded_lag is not falsifiable"
+        )
+    inv = _inv(report, "bounded_lag")
+    if inv is None or inv["status"] != "FAIL":
+        return _fail(f"bounded_lag did not FAIL under the corruption: {inv}")
+    print(
+        "streaming_smoke: drop_stream_window trips bounded_lag OK "
+        f"({inv['violations'][0]})"
+    )
+
+    # ---- stage 3: live push into a real serving CLI under hammer ----------
+    import jax  # noqa: F401 — ensures the CPU backend is initialized here
+
+    from elasticdl_tpu.data.recordio_gen import synthetic
+    from elasticdl_tpu.rpc import messages as msg
+    from elasticdl_tpu.rpc.deadline import DeadlinePolicy
+    from elasticdl_tpu.serving.replica import ServingClient
+    from elasticdl_tpu.trainer.local_executor import LocalExecutor
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    live_dir = os.path.join(root, "live")
+    os.makedirs(live_dir, exist_ok=True)
+
+    # boot export: a 1-step seed train (version 1) so every streaming
+    # push version (task boundaries: 2, 4, 6, 8) clears the engine's
+    # versioned-put guard
+    seed_train = synthetic.gen_mnist(
+        os.path.join(live_dir, "seed_train"),
+        num_records=SERVE_BATCH,
+        num_shards=1,
+        seed=1,
+    )
+    export_v0 = os.path.join(live_dir, "export_v0")
+    executor = LocalExecutor(
+        parse_master_args(
+            [
+                "--model_def",
+                "mnist_functional_api.mnist_functional_api.custom_model",
+                "--training_data",
+                seed_train,
+                "--minibatch_size",
+                str(SERVE_BATCH),
+                "--records_per_task",
+                str(SERVE_BATCH),
+                "--num_epochs",
+                "1",
+                "--compute_dtype",
+                "float32",
+                "--output",
+                export_v0,
+            ]
+        )
+    )
+    executor.run()
+    v0 = int(executor.state.step)
+
+    addr_file = os.path.join(live_dir, "serving.addr")
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "elasticdl_tpu.serving.main",
+            "--model_dir",
+            export_v0,
+            "--num_replicas",
+            "1",
+            "--port",
+            "0",
+            "--addr_file",
+            addr_file,
+            "--minibatch_size",
+            str(SERVE_BATCH),
+            "--max_wait_ms",
+            "2",
+        ],
+        env=dict(os.environ),
+    )
+    client = None
+    try:
+        deadline = time.monotonic() + 120
+        addr = ""
+        while time.monotonic() < deadline and not addr:
+            if proc.poll() is not None:
+                return _fail(f"serving CLI exited rc={proc.returncode}")
+            try:
+                with open(addr_file, encoding="utf-8") as f:
+                    addr = f.read().strip()
+            except OSError:
+                time.sleep(0.1)
+        if not addr:
+            return _fail("serving frontend never published its address")
+        client = ServingClient(addr, deadlines=DeadlinePolicy.from_secs(30))
+
+        rng = np.random.RandomState(0)
+
+        def feats(n: int) -> dict:
+            return {"image": rng.rand(n, 28, 28, 1).astype(np.float32)}
+
+        warm = client.predict(
+            msg.PredictRequest(
+                request_id="warmup", features=msg.pack_array_tree(feats(SERVE_BATCH))
+            )
+        )
+        if warm.error:
+            return _fail(f"warmup predict failed: {warm.error}")
+        status0 = client.serving_status()
+        if status0.model_version != v0:
+            return _fail(
+                f"boot version {status0.model_version}, expected {v0}"
+            )
+
+        # the hammer: in-flight traffic for the WHOLE streaming run —
+        # every live push lands under load
+        stop = threading.Event()
+        failures: list[str] = []
+        hammered = [0]
+
+        def hammer():
+            i = 0
+            while not stop.is_set():
+                response = client.predict(
+                    msg.PredictRequest(
+                        request_id=f"hammer-{i}",
+                        features=msg.pack_array_tree(feats(3)),
+                    )
+                )
+                if response.error:
+                    failures.append(response.error)
+                hammered[0] += 1
+                i += 1
+                time.sleep(0.05)
+
+        thread = threading.Thread(target=hammer, daemon=True)
+        thread.start()
+
+        report = run_chaos_job(
+            _stream_config(
+                os.path.join(live_dir, "run"),
+                resolve_plan("none", 2),
+                live_push_addr=addr,
+            )
+        )
+        stop.set()
+        thread.join(timeout=15)
+
+        if report["rc"] != 0 or not report["invariants_ok"]:
+            return _fail(
+                f"live-push streaming run not green (rc={report['rc']}, "
+                f"invariants_ok={report['invariants_ok']})"
+            )
+        if failures:
+            return _fail(
+                f"{len(failures)}/{hammered[0]} in-flight requests failed "
+                f"across live pushes (first: {failures[0]})"
+            )
+        if hammered[0] == 0:
+            return _fail("hammer thread never got a request through")
+
+        fresh = (report.get("streaming") or {}).get("freshness") or {}
+        if not fresh.get("accepted"):
+            return _fail(f"no accepted live push in the freshness ledger: {fresh}")
+        ledger = fresh.get("ledger") or []
+        accepted_rows = [r for r in ledger if r["accepted"]]
+        for row in accepted_rows:
+            if row["staleness"] != (
+                row["source_watermark"] - row["trained_watermark"]
+            ) or row["staleness"] < 0:
+                return _fail(f"freshness ledger row inconsistent: {row}")
+        last_pushed = max(r["model_version"] for r in accepted_rows)
+
+        status1 = client.serving_status()
+        if status1.model_version <= v0:
+            return _fail(
+                "served version never advanced past the boot export "
+                f"({status1.model_version} <= {v0}) despite "
+                f"{fresh['accepted']} accepted push(es)"
+            )
+        if status1.model_version != last_pushed:
+            return _fail(
+                f"served version {status1.model_version} != last accepted "
+                f"push v{last_pushed}"
+            )
+        if status1.compile_count != status0.compile_count:
+            return _fail(
+                "RECOMPILE across live pushes: compile count "
+                f"{status0.compile_count} -> {status1.compile_count}"
+            )
+
+        # a replayed push must be ABSORBED, not double-applied: re-send
+        # the served version (stale by the versioned-put guard) and the
+        # fleet must still report convergence with the version unmoved
+        from elasticdl_tpu.telemetry import events as ev
+
+        events = ev.read_events(
+            os.path.join(live_dir, "run", "telemetry", "events.jsonl")
+        )
+        n_push_events = sum(
+            1 for e in events if e.get("event") == "live_push"
+        )
+        if n_push_events != fresh["pushes"]:
+            return _fail(
+                f"{n_push_events} live_push events vs ledger "
+                f"{fresh['pushes']}"
+            )
+
+        # telemetry.report renders the same ledger (the acceptance
+        # surface: staleness per swap, REFUSED marker discipline)
+        from elasticdl_tpu.telemetry.report import _format_text, build_report
+
+        run_report = build_report(os.path.join(live_dir, "run"))
+        streams = [
+            run.get("streaming")
+            for run in run_report.get("runs", {}).values()
+            if run.get("streaming")
+        ]
+        if not streams or not any(s.get("freshness") for s in streams):
+            return _fail("telemetry.report has no streaming freshness section")
+        text = _format_text(run_report)
+        if "freshness:" not in text or "push v" not in text:
+            return _fail(
+                "telemetry.report text does not render the freshness ledger"
+            )
+
+        print(
+            "streaming_smoke: live push OK "
+            f"(served {v0} -> {status1.model_version} across "
+            f"{fresh['accepted']} accepted / {fresh['refused']} refused "
+            f"push(es), {hammered[0]} in-flight requests with 0 failures, "
+            f"compile count flat at {status0.compile_count}, max staleness "
+            f"{fresh['max_staleness_records']} record(s))"
+        )
+        return 0
+    finally:
+        if client is not None:
+            client.close()
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

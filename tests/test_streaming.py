@@ -1,0 +1,656 @@
+"""Streaming subsystem: watermark-lease dispatch over an unbounded
+source, checkpoint-free durability via journal replay, the bounded-lag
+and freshness invariants (and their falsifiability), the live
+train->serve push, the lag-driven autoscaler trigger, and flag hygiene.
+
+The stream record contract is load-bearing for everything here: record
+``i`` of ``stream://<dataset>?seed=S`` is a pure function of ``(S, i)``,
+so any worker can serve any leased window and a replayed window re-reads
+identical bytes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.streaming.source import (
+    QueueStreamSource,
+    build_stream_source,
+    is_stream_origin,
+    parse_stream_origin,
+)
+from elasticdl_tpu.utils.constants import TaskType
+
+ORIGIN = "stream://mnist?seed=7&total=256&rate=0&initial=256"
+WINDOW = 64
+
+
+def _dispatcher(source, records_per_task: int = WINDOW) -> TaskDispatcher:
+    return TaskDispatcher(
+        {},
+        records_per_task=records_per_task,
+        num_epochs=1,
+        stream_source=source,
+        stream_origin=ORIGIN,
+    )
+
+
+# ---- source + origin parsing ------------------------------------------------
+
+
+def test_parse_stream_origin():
+    assert is_stream_origin("stream://mnist?seed=1")
+    assert not is_stream_origin("/data/train")
+    spec = parse_stream_origin(ORIGIN)
+    assert spec.dataset == "mnist"
+    assert spec.seed == 7 and spec.total == 256 and spec.rate == 0.0
+    assert spec.params == {"initial": "256"}
+    with pytest.raises(ValueError):
+        parse_stream_origin("file:///nope")
+
+
+def test_queue_source_watermark_monotone_and_close():
+    source = QueueStreamSource(total=128, rate_per_sec=0.0, initial=32)
+    assert source.watermark() == 32 and not source.closed()
+    assert source.advance(64) == 96
+    # advance_to is a FLOOR: a lower target never regresses the watermark
+    assert source.advance_to(50) == 96
+    # the cap: a bounded prefix closes at total and stays there
+    assert source.advance(1000) == 128
+    assert source.closed()
+
+
+def test_build_stream_source_reads_initial():
+    source = build_stream_source(ORIGIN)
+    assert source.watermark() == 256 and source.closed()
+
+
+def test_stream_record_deterministic():
+    from elasticdl_tpu.streaming.reader import StreamDataReader, stream_record
+
+    a = stream_record("mnist", 7, 41)
+    b = stream_record("mnist", 7, 41)
+    assert np.array_equal(a["image"], b["image"]) and a["label"] == b["label"]
+    c = stream_record("mnist", 7, 42)
+    assert not np.array_equal(a["image"], c["image"]) or a["label"] != c["label"]
+
+    # two independent readers over the same leased window: identical bytes
+    class _Win:
+        start, end = 40, 44
+
+    r1 = list(StreamDataReader(data_origin=ORIGIN).read_records(_Win))
+    r2 = list(StreamDataReader(data_origin=ORIGIN).read_records(_Win))
+    assert len(r1) == 4 and r1 == r2
+    assert StreamDataReader(data_origin=ORIGIN).create_shards() == {}
+
+
+# ---- watermark-lease dispatcher semantics -----------------------------------
+
+
+class TestWatermarkLease:
+    def test_windows_mint_fifo_up_to_watermark(self):
+        d = _dispatcher(QueueStreamSource(total=0, initial=160))
+        tid1, t1 = d.get(0)
+        tid2, t2 = d.get(1)
+        assert (t1.start, t1.end) == (0, 64)
+        assert (t2.start, t2.end) == (64, 128)
+        # [128, 160) is a partial window and the source is OPEN: held
+        # back until the watermark reaches a full window (or close)
+        tid3, t3 = d.get(0)
+        assert tid3 == -1 and t3 is None
+
+    def test_partial_window_minted_on_close(self):
+        d = _dispatcher(QueueStreamSource(total=96, initial=96))
+        _, t1 = d.get(0)
+        _, t2 = d.get(0)
+        assert (t1.start, t1.end) == (0, 64)
+        assert (t2.start, t2.end) == (64, 96)  # closed: the tail flushes
+
+    def test_out_of_order_completion_gap_free_prefix(self):
+        d = _dispatcher(QueueStreamSource(total=256, initial=256))
+        leases = [d.get(0) for _ in range(4)]
+        # completing [64,128) first: the trained watermark must NOT
+        # advance over the [0,64) hole
+        d.report(leases[1][0], True)
+        assert d.stream_status()["trained_watermark"] == 0
+        d.report(leases[0][0], True)
+        assert d.stream_status()["trained_watermark"] == 128
+        d.report(leases[3][0], True)
+        d.report(leases[2][0], True)
+        status = d.stream_status()
+        assert status["trained_watermark"] == 256 and status["lag"] == 0
+
+    def test_failed_window_requeues_and_leases_first(self):
+        d = _dispatcher(QueueStreamSource(total=256, initial=256))
+        tid1, t1 = d.get(0)
+        d.report(tid1, False)  # failure: the window goes back
+        tid1b, t1b = d.get(1)
+        assert (t1b.start, t1b.end) == (t1.start, t1.end)
+        assert tid1b != tid1  # a fresh lease id — the old one is dead
+
+    def test_duplicate_report_is_dropped(self):
+        d = _dispatcher(QueueStreamSource(total=256, initial=256))
+        tid, _ = d.get(0)
+        d.report(tid, True)
+        before = d.stream_status()["trained_watermark"]
+        d.report(tid, True)  # duplicate delivery: absorbed
+        assert d.stream_status()["trained_watermark"] == before
+        counters = d.counters(TaskType.TRAINING)
+        assert counters.total_records == 256  # counted at mint, once
+
+    def test_finished_gates_on_source_close(self):
+        source = QueueStreamSource(total=128, initial=64)
+        d = _dispatcher(source)
+        tid, _ = d.get(0)
+        d.report(tid, True)
+        # drained NOW, but the source is open: more records will come,
+        # so the job must not finish
+        assert not d.finished()
+        source.advance(64)  # reaches total=128: the source closes
+        tid, task = d.get(0)
+        assert (task.start, task.end) == (64, 128)
+        assert not d.finished()  # window in flight
+        d.report(tid, True)
+        assert d.finished()
+        assert d.stream_status()["closed"]
+
+    def test_stream_status_lag(self):
+        d = _dispatcher(QueueStreamSource(total=0, initial=192))
+        assert d.stream_status()["lag"] == 192
+        tid, _ = d.get(0)
+        d.report(tid, True)
+        status = d.stream_status()
+        assert status["trained_watermark"] == 64 and status["lag"] == 128
+
+    def test_epoch_mode_has_no_stream_status(self):
+        d = TaskDispatcher({"f": (0, 10)}, records_per_task=10, num_epochs=1)
+        assert not d.streaming and d.stream_status() is None
+
+
+# ---- journal replay: checkpoint-free durability -----------------------------
+
+
+def test_stream_state_snapshot_replay_equivalence():
+    """A restarted master restores the dispatcher at the exact stream
+    cursor: same trained watermark, same out-of-order completion set,
+    same next offset — and the fresh source is re-floored at the
+    journaled watermark so it can never regress."""
+    source_a = QueueStreamSource(total=256, initial=256)
+    a = _dispatcher(source_a)
+    leases = [a.get(0) for _ in range(3)]
+    a.report(leases[1][0], True)  # out-of-order: [64,128) done, [0,64) not
+    snap = a.state_snapshot()
+
+    # the restarted master's source starts cold (watermark 0) — replay
+    # must re-floor it
+    b = _dispatcher(QueueStreamSource(total=256, initial=0))
+    b.restore_state(snap)
+    assert b.stream_status() == a.stream_status()
+    assert b.stream_status()["source_watermark"] == 256
+
+    # the restored lease ids stay live: completing them advances the
+    # trained watermark over the gap exactly as in the original life
+    b.report(leases[0][0], True)
+    assert b.stream_status()["trained_watermark"] == 128
+    # and minting continues where the cursor left off
+    _, t4 = b.get(2)
+    assert (t4.start, t4.end) == (192, 256)
+
+
+# ---- invariant checkers: bounded_lag + freshness_monotone -------------------
+
+
+def _stream_config(tmp_path, **overrides):
+    from elasticdl_tpu.chaos.harness import ChaosJobConfig
+    from elasticdl_tpu.chaos.plan import resolve_plan
+
+    kwargs = dict(
+        plan=resolve_plan("none", 2),
+        workdir=str(tmp_path),
+        streaming=True,
+        stream_total=256,
+    )
+    kwargs.update(overrides)
+    return ChaosJobConfig(**kwargs)
+
+
+class TestBoundedLag:
+    def test_pass_within_bound(self, tmp_path):
+        from elasticdl_tpu.chaos.harness import _check_bounded_lag
+
+        result = _check_bounded_lag(
+            _stream_config(tmp_path),
+            [{"event": "stream_lag", "lag_records": 300}],
+            {"trained_watermark": 256},
+        )
+        # auto bound: max(256, 6 * records_per_task=64) = 384
+        assert result["status"] == "PASS"
+        assert result["lag_limit_records"] == 384
+
+    def test_fails_on_lag_over_bound(self, tmp_path):
+        from elasticdl_tpu.chaos.harness import _check_bounded_lag
+
+        result = _check_bounded_lag(
+            _stream_config(tmp_path, stream_lag_limit=100),
+            [{"event": "stream_lag", "lag_records": 101}],
+            {"trained_watermark": 256},
+        )
+        assert result["status"] == "FAIL"
+        assert "101" in result["violations"][0]
+
+    def test_fails_on_incomplete_drain(self, tmp_path):
+        """The drop_stream_window corruption's signature: a lost window
+        leaves a hole the trained watermark can never cross."""
+        from elasticdl_tpu.chaos.harness import _check_bounded_lag
+
+        result = _check_bounded_lag(
+            _stream_config(tmp_path),
+            [{"event": "stream_lag", "lag_records": 10}],
+            {"trained_watermark": 192},
+        )
+        assert result["status"] == "FAIL"
+        assert "drain incomplete" in result["violations"][0]
+
+    def test_fails_on_missing_telemetry(self, tmp_path):
+        from elasticdl_tpu.chaos.harness import _check_bounded_lag
+
+        result = _check_bounded_lag(
+            _stream_config(tmp_path), [], {"trained_watermark": 256}
+        )
+        assert result["status"] == "FAIL"
+
+    def test_none_on_epoch_mode(self, tmp_path):
+        from elasticdl_tpu.chaos.harness import _check_bounded_lag
+
+        assert (
+            _check_bounded_lag(
+                _stream_config(tmp_path, streaming=False, stream_total=0),
+                [],
+                None,
+            )
+            is None
+        )
+
+
+class TestFreshnessMonotone:
+    @staticmethod
+    def _push(version, trained, mono, accepted=True):
+        return {
+            "event": "live_push",
+            "model_version": version,
+            "trained_watermark": trained,
+            "monotonic": mono,
+            "accepted": accepted,
+        }
+
+    def test_pass_on_monotone_pushes(self, tmp_path):
+        from elasticdl_tpu.chaos.harness import _check_freshness_monotone
+
+        result = _check_freshness_monotone(
+            _stream_config(tmp_path),
+            [self._push(2, 64, 1.0), self._push(4, 128, 2.0)],
+        )
+        assert result["status"] == "PASS" and result["pushes"] == 2
+
+    def test_fails_on_regressed_watermark(self, tmp_path):
+        from elasticdl_tpu.chaos.harness import _check_freshness_monotone
+
+        result = _check_freshness_monotone(
+            _stream_config(tmp_path),
+            [self._push(4, 128, 1.0), self._push(6, 64, 2.0)],
+        )
+        assert result["status"] == "FAIL"
+        assert "regressed" in result["violations"][0]
+
+    def test_refused_pushes_do_not_count(self, tmp_path):
+        from elasticdl_tpu.chaos.harness import _check_freshness_monotone
+
+        result = _check_freshness_monotone(
+            _stream_config(tmp_path),
+            [
+                self._push(4, 128, 1.0),
+                self._push(6, 64, 2.0, accepted=False),
+            ],
+        )
+        assert result["status"] == "PASS" and result["pushes"] == 1
+
+    def test_vacuous_pass_without_pushes(self, tmp_path):
+        from elasticdl_tpu.chaos.harness import _check_freshness_monotone
+
+        result = _check_freshness_monotone(_stream_config(tmp_path), [])
+        assert result["status"] == "PASS" and result["pushes"] == 0
+
+
+# ---- live pusher: tick gating + push/absorb ---------------------------------
+
+
+class _FakeDirectory:
+    def __init__(self):
+        self.calls = 0
+        self.stage = None
+
+    def harvest(self, **kwargs):
+        self.calls += 1
+        return self.stage
+
+
+class _FakeTelemetry:
+    def __init__(self):
+        self.rows = []
+
+    def live_push(self, **kwargs):
+        self.rows.append(kwargs)
+
+
+class _FakeServingClient:
+    """Stands in for ServingClient; scripted swap responses."""
+
+    responses: list = []
+    sent: list = []
+
+    def __init__(self, addr, deadlines=None):
+        pass
+
+    def swap_model(self, request):
+        _FakeServingClient.sent.append(request)
+        return _FakeServingClient.responses.pop(0)
+
+    def close(self):
+        pass
+
+
+class TestLivePusher:
+    def _pusher(self, directory, telemetry=None, now=None):
+        from elasticdl_tpu.streaming.live_push import LivePusher
+
+        now = now if now is not None else [0.0]
+        pusher = LivePusher(
+            "localhost:1",
+            directory,
+            telemetry=telemetry,
+            clock=lambda: now[0],
+        )
+        return pusher, now
+
+    def test_no_harvest_before_first_step(self):
+        directory = _FakeDirectory()
+        pusher, _now = self._pusher(directory)
+        assert not pusher.tick(
+            model_version=0,
+            generation=0,
+            num_sources=2,
+            live_worker_ids=[0, 1],
+        )
+        assert directory.calls == 0  # nothing trained -> nothing staged
+
+    def test_interval_gate_and_harvest_skip(self):
+        directory = _FakeDirectory()
+        pusher, now = self._pusher(directory)
+        tick = dict(
+            model_version=2,
+            generation=0,
+            num_sources=2,
+            live_worker_ids=[0, 1],
+        )
+        assert not pusher.tick(**tick)
+        assert directory.calls == 1 and pusher.harvest_skips == 1
+        # within the min interval: no probe hammering while the ring
+        # catches up
+        now[0] += 0.5
+        assert not pusher.tick(**tick)
+        assert directory.calls == 1
+        now[0] += 1.0
+        assert not pusher.tick(**tick)
+        assert directory.calls == 2
+
+    def test_push_accept_then_replay_absorbed(self, monkeypatch):
+        from elasticdl_tpu.rpc import messages as msg
+        from elasticdl_tpu.serving import replica as replica_mod
+
+        monkeypatch.setattr(
+            replica_mod, "ServingClient", _FakeServingClient
+        )
+        _FakeServingClient.sent = []
+        _FakeServingClient.responses = [
+            msg.SwapModelResponse(accepted=True, model_version=2),
+            # a replayed/raced push refused as STALE is convergence
+            msg.SwapModelResponse(
+                accepted=False,
+                model_version=4,
+                reason="stale swap: serving 4",
+                stale=True,
+            ),
+        ]
+        directory = _FakeDirectory()
+        telemetry = _FakeTelemetry()
+        pusher, now = self._pusher(directory, telemetry)
+
+        directory.stage = {
+            "generation": 0,
+            "version": 2,
+            "checksum": "x",
+            "payload": b"blob-v2",
+            "sources": 2,
+        }
+        status = {"source_watermark": 192, "trained_watermark": 128}
+        assert pusher.tick(
+            model_version=2,
+            generation=0,
+            num_sources=2,
+            live_worker_ids=[0, 1],
+            stream_status=status,
+        )
+        assert pusher.last_pushed_version == 2
+        assert pusher.pushes_accepted == 1
+        sent = _FakeServingClient.sent[0]
+        assert sent.payload == b"blob-v2" and sent.version == 2
+        assert sent.trained_watermark == 128 and sent.source_watermark == 192
+        row = telemetry.rows[0]
+        assert row["accepted"] and row["trained_watermark"] == 128
+
+        # version gate: same version never re-pushes
+        now[0] += 2.0
+        assert not pusher.tick(
+            model_version=2,
+            generation=0,
+            num_sources=2,
+            live_worker_ids=[0, 1],
+        )
+        assert len(_FakeServingClient.sent) == 1
+
+        # the stale refusal: converged (serving already at/past 4), the
+        # ledger records it as not-accepted
+        directory.stage = dict(directory.stage, version=4, payload=b"blob-v4")
+        assert pusher.tick(
+            model_version=4,
+            generation=0,
+            num_sources=2,
+            live_worker_ids=[0, 1],
+            stream_status=status,
+        )
+        assert pusher.last_pushed_version == 4
+        assert not telemetry.rows[1]["accepted"]
+
+
+# ---- live-push parity: payload swap == export of the same state -------------
+
+
+def test_live_push_payload_parity(tmp_path):
+    """The served outputs after an inline-payload swap are IDENTICAL to
+    serving a disk export of the same trainer state — the payload path
+    (flat_state_arrays -> encode_snapshot -> swap_model) loses nothing,
+    with the compile counter flat and a replayed payload absorbed as
+    stale."""
+    import argparse
+
+    import jax
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.parallel.elastic import flat_state_arrays
+    from elasticdl_tpu.replication.blob import encode_snapshot
+    from elasticdl_tpu.rpc import messages as msg
+    from elasticdl_tpu.serving.batcher import MicroBatcher
+    from elasticdl_tpu.serving.engine import ServingEngine
+    from elasticdl_tpu.serving.replica import ServingReplicaServicer
+    from elasticdl_tpu.telemetry import compile_tracker
+    from elasticdl_tpu.trainer.state import TrainState, init_model
+    from elasticdl_tpu.trainer.step import resolve_optimizer
+    from elasticdl_tpu.utils.export_utils import export_model
+    from elasticdl_tpu.utils.model_utils import get_model_spec
+
+    rows = 8
+    iris_def = "odps_iris_dnn_model.odps_iris_dnn_model.custom_model"
+    ns = argparse.Namespace(
+        model_zoo="", model_def=iris_def, model_params_dict={}
+    )
+    spec = get_model_spec("", iris_def)
+    model = spec.build_model()
+    sample = {"features": np.zeros((1, 4), np.float32)}
+    params, model_state = init_model(model, sample)
+
+    def mk_state(scale, step):
+        scaled = jax.tree_util.tree_map(lambda x: x * scale + 0.01, params)
+        state = TrainState.create(
+            model.apply, scaled, resolve_optimizer(spec.optimizer), model_state
+        )
+        return state.replace(step=jnp.asarray(step, jnp.int32))
+
+    export_v1 = export_model(
+        str(tmp_path / "export_v1"), mk_state(1.0, 3), spec, ns
+    )
+    engine = ServingEngine(export_v1, rows)
+    servicer = ServingReplicaServicer(
+        engine, MicroBatcher(rows, max_wait_secs=0.0)
+    )
+    feats = {
+        "features": np.random.RandomState(0).rand(5, 4).astype(np.float32)
+    }
+    before = engine.predict_rows(feats)
+
+    # the trainer at "watermark 128": version 9, perturbed weights —
+    # the snapshot encoded EXACTLY as replication/live-push wires it
+    state_v2 = mk_state(3.0, 9)
+    flat = {
+        k: np.asarray(v) for k, v in flat_state_arrays(state_v2).items()
+    }
+    payload = encode_snapshot(flat, {})
+
+    compile_tracker.install()
+    flat0 = compile_tracker.compile_count()
+    resp = servicer.swap_model(
+        msg.SwapModelRequest(
+            payload=payload,
+            version=9,
+            source="live-push@128",
+            trained_watermark=128,
+            source_watermark=192,
+        )
+    )
+    assert resp.accepted and resp.model_version == 9, resp.reason
+    after = engine.predict_rows(feats)
+    assert not np.allclose(before, after)
+    assert compile_tracker.compile_count() == flat0  # program reused
+
+    # reference: a full disk export of the same state served fresh
+    export_v2 = export_model(str(tmp_path / "export_v2"), state_v2, spec, ns)
+    reference = ServingEngine(export_v2, rows).predict_rows(feats)
+    np.testing.assert_allclose(after, reference, atol=1e-6)
+
+    # replay: the identical push is refused as stale, state untouched
+    resp2 = servicer.swap_model(
+        msg.SwapModelRequest(payload=payload, version=9)
+    )
+    assert not resp2.accepted and resp2.stale
+    np.testing.assert_array_equal(after, engine.predict_rows(feats))
+
+
+# ---- autoscaler: grow on stream lag -----------------------------------------
+
+
+class TestStreamAutoscaler:
+    def _args(self, **overrides):
+        import argparse
+
+        ns = argparse.Namespace(
+            streaming=True,
+            stream_lag_tasks=None,
+            autoscale_p95_step_ms=None,
+            autoscale_backlog_tasks=None,
+            autoscale_cooldown_secs=0.0,
+            autoscale_shrink=None,
+            min_slices=None,
+        )
+        for key, value in overrides.items():
+            setattr(ns, key, value)
+        return ns
+
+    def test_stream_lag_tasks_alone_builds_autoscaler(self):
+        from elasticdl_tpu.master.autoscaler import build_autoscaler
+
+        scaler = build_autoscaler(self._args(stream_lag_tasks=4), 2)
+        assert scaler is not None and scaler.backlog_tasks == 4
+        assert build_autoscaler(self._args(), 2) is None
+
+    def test_grow_on_lag_threshold(self):
+        from elasticdl_tpu.master.autoscaler import build_autoscaler
+
+        scaler = build_autoscaler(self._args(stream_lag_tasks=4), 2)
+        # lag 3 windows: below threshold, no decision
+        assert scaler.evaluate(3, current_slices=1, now=100.0) is None
+        decision = scaler.evaluate(4, current_slices=1, now=200.0)
+        assert decision["action"] == "grow"
+        assert decision["to_slices"] == 2
+        assert "backlog 4" in decision["reason"]
+
+    def test_epoch_mode_ignores_stream_lag_tasks(self):
+        from elasticdl_tpu.master.autoscaler import build_autoscaler
+
+        args = self._args(streaming=False, stream_lag_tasks=4)
+        assert build_autoscaler(args, 2) is None
+
+
+# ---- flag hygiene: master-only, argv byte-identical -------------------------
+
+
+def test_streaming_flags_master_only_argv_byte_identical():
+    from elasticdl_tpu.utils.args import (
+        build_worker_arguments,
+        parse_master_args,
+    )
+
+    base = [
+        "--model_def",
+        "m.custom_model",
+        "--training_data",
+        ORIGIN,
+    ]
+    plain = parse_master_args(base)
+    for flag in ("streaming", "stream_lag_tasks", "live_push_addr"):
+        assert getattr(plain, flag) is None, flag
+    streaming = parse_master_args(
+        base
+        + [
+            "--streaming",
+            "true",
+            "--stream_lag_tasks",
+            "4",
+            "--live_push_addr",
+            "localhost:9999",
+        ]
+    )
+    assert streaming.streaming is True
+    assert streaming.stream_lag_tasks == 4
+    # byte-identical worker argv whether the master flags are set or
+    # not: streaming is master business end to end, workers only see
+    # the stream:// origin through --training_data
+    assert build_worker_arguments(
+        streaming, 0, "localhost:1"
+    ) == build_worker_arguments(plain, 0, "localhost:1")
+    argv = build_worker_arguments(streaming, 0, "localhost:1")
+    assert not any(
+        "stream_lag" in a or "live_push" in a or a == "--streaming"
+        for a in argv
+    )
+    assert ORIGIN in argv  # the origin itself DOES ride --training_data
