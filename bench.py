@@ -785,7 +785,15 @@ def _measure_anatomy_window(
             + list(extra_argv)
             + override
         )
+        # boundary_stall is a process-global monotone counter
+        # (heartbeat-shipped in production): per-window attribution is
+        # a before/after diff over this window's own wall clock
+        from elasticdl_tpu.trainer import device_pipeline as _dp
+
+        snap_before = _dp.heartbeat_snapshot()
+        wall_t0 = time.perf_counter()
         LocalExecutor(args).run()
+        wall_ms = (time.perf_counter() - wall_t0) * 1000.0
         from elasticdl_tpu.telemetry.events import read_events
         from elasticdl_tpu.telemetry.report import (
             goodput_section,
@@ -799,6 +807,19 @@ def _measure_anatomy_window(
         if not section:
             return {"error": "no step_anatomy events recorded"}
         overall = dict(section["overall"])
+        snap_after = _dp.heartbeat_snapshot()
+        stall_ms = snap_after.get("boundary_stall_ms", 0) - snap_before.get(
+            "boundary_stall_ms", 0
+        )
+        overall["boundary_stall"] = {
+            "boundaries": snap_after.get("boundaries", 0)
+            - snap_before.get("boundaries", 0),
+            "stall_ms": stall_ms,
+            # of the window's own wall, NOT the dispatch-phase sum: the
+            # counter measures BETWEEN dispatches, outside the anatomy
+            # taxonomy's sum-exact per-dispatch phases
+            "share_of_wall": round(stall_ms / wall_ms, 4) if wall_ms else 0,
+        }
         memory = memory_section(events)
         if memory:
             # the falsifiable headroom numbers the sharded-embedding
@@ -1066,6 +1087,11 @@ COMPACT_KEY_LEGEND = {
         "same measured roofline ratio with --device_prefetch OFF — the "
         "serial-staging baseline the pipelining is gated against"
     ),
+    "bst": (
+        "boundary_stall share of the roofm window's wall (device-idle "
+        "time between tasks; --boundary_fusion's target)"
+    ),
+    "bst0": "boundary_stall share of the roofm0 (prefetch OFF) window",
     "bind": "binding budget ceiling: h=host decode, d=device path",
     "deg": "1 = degraded link window detected (see full detail)",
     "acc": "[accuracy, 1 if >= threshold]",
@@ -1078,6 +1104,23 @@ COMPACT_KEY_LEGEND = {
         "every-process-reads-every-task decode overhead)"
     ),
 }
+
+
+def _pipeline_config() -> dict:
+    """The device-pipeline knobs this run resolved (env-driven, so the
+    artifact must record them — two rounds with different depths are
+    not comparable without it)."""
+    from elasticdl_tpu.trainer.device_pipeline import (
+        resolve_boundary_fusion,
+        resolve_device_prefetch,
+        resolve_pipeline_depth,
+    )
+
+    return {
+        "device_prefetch_env": resolve_device_prefetch(),
+        "boundary_fusion_env": resolve_boundary_fusion(),
+        "pipeline_depth": resolve_pipeline_depth(),
+    }
 
 
 def _round_sig(x: float, sig: int = 4) -> float:
@@ -1165,6 +1208,15 @@ def _compact_models(models: dict) -> dict:
             c["roofm"] = anatomy["e2e_vs_roofline"]
         if off.get("e2e_vs_roofline") is not None:
             c["roofm0"] = off["e2e_vs_roofline"]
+        # boundary-stall share of each anatomy window's wall — the
+        # between-task idle the roofm ratio cannot see (it is outside
+        # the per-dispatch phase sum)
+        on_stall = (on.get("boundary_stall") or {}).get("share_of_wall")
+        if on_stall is not None:
+            c["bst"] = on_stall
+        off_stall = (off.get("boundary_stall") or {}).get("share_of_wall")
+        if off_stall is not None:
+            c["bst0"] = off_stall
         if m.get("link_degraded") or m.get("link_degraded_retry"):
             c["deg"] = 1
         out[name] = c
@@ -1430,6 +1482,7 @@ def main():
         "vs_baseline": head.get("vs_baseline"),
         "device": device_kind,
         "models": models,
+        "config": _pipeline_config(),
         "compact_key_legend": COMPACT_KEY_LEGEND,
         "baseline_source": (
             "benchmarks/baseline.json "

@@ -129,6 +129,7 @@ REQUIRED_PHASE_NAMES = frozenset(
         "untracked",
         "queue_wait",
         "d2h_transfer",
+        "boundary_stall",
     }
 )
 REQUIRED_METRIC_NAMES = frozenset(
@@ -141,6 +142,7 @@ REQUIRED_METRIC_NAMES = frozenset(
         "elasticdl_device_prefetch_groups_total",
         "elasticdl_device_prefetch_stall_ms_total",
         "elasticdl_device_prefetch_stage_ms_total",
+        "elasticdl_boundary_stall_ms_total",
         "elasticdl_serving_latency_seconds",
         "elasticdl_serving_requests_total",
         "elasticdl_serving_swaps_total",

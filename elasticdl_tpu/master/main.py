@@ -75,6 +75,23 @@ def build_master(args) -> Master:
             )
 
             envs.setdefault(DEVICE_PREFETCH_ENV, "1")
+        if getattr(args, "boundary_fusion", None):
+            # cross-task staging rides the same env contract (and the
+            # same uniformity argument — the whole world fuses or none)
+            from elasticdl_tpu.trainer.device_pipeline import (
+                BOUNDARY_FUSION_ENV,
+            )
+
+            envs.setdefault(BOUNDARY_FUSION_ENV, "1")
+        pipeline_depth = getattr(args, "pipeline_depth", None)
+        if pipeline_depth is not None:
+            # the tunable retire window / staging bound, env-forwarded
+            # so worker argv stays byte-identical when unset
+            from elasticdl_tpu.trainer.device_pipeline import (
+                PIPELINE_DEPTH_ENV,
+            )
+
+            envs.setdefault(PIPELINE_DEPTH_ENV, str(pipeline_depth))
         journal_dir = getattr(args, "master_journal_dir", None) or ""
         retry_secs = getattr(args, "rpc_retry_secs", None)
         if journal_dir:

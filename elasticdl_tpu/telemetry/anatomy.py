@@ -96,6 +96,12 @@ PHASE_UNTRACKED = "untracked"
 # sum-exact residual discipline, per REQUEST instead of per dispatch
 PHASE_QUEUE_WAIT = "queue_wait"
 PHASE_D2H_TRANSFER = "d2h_transfer"
+# boundary-stall counter (trainer/device_pipeline.py): device-idle time
+# between the last retire of task N and the first dispatch of task N+1.
+# A COUNTER in the phase vocabulary, not a member of TRACKED_PHASES /
+# ALL_PHASES — it spans dispatch windows, so adding it to the per-
+# dispatch sum would break the sum-exactness contract
+PHASE_BOUNDARY_STALL = "boundary_stall"
 
 # the measured (timer-covered) phases, in pipeline order
 TRACKED_PHASES = (

@@ -395,6 +395,11 @@ class MasterTelemetry:
                     "Background staging time overlapped with device "
                     "compute",
                 ).set_total(prefetch_totals.get("stage_ms", 0))
+                self.registry.counter(
+                    "elasticdl_boundary_stall_ms_total",
+                    "Device-idle time between the last retire of one "
+                    "task and the first dispatch of the next",
+                ).set_total(prefetch_totals.get("boundary_stall_ms", 0))
         if self.slo_engine is not None:
             # scrape-time mirror of the watchdog's detector state onto
             # the elasticdl_slo_* families (registered inside the

@@ -172,16 +172,19 @@ def read_host_total() -> int | None:
 
 def read_device_memory() -> dict:
     """Accelerator allocator stats summed over local devices:
-    ``{"bytes_in_use", "peak_bytes_in_use"}``, or ``{}`` on backends
+    ``{"bytes_in_use", "peak_bytes_in_use", "bytes_limit"}`` (the limit
+    is 0 where the allocator reports none), or ``{}`` on backends
     without allocator stats (CPU returns ``None`` from
-    ``memory_stats()``) — the graceful-None contract."""
+    ``memory_stats()``) — the graceful-None contract.  The limit minus
+    in-use is the headroom the device stager's admission control
+    budgets against."""
     try:
         import jax
 
         devices = jax.local_devices()
     except Exception:  # noqa: BLE001 — no backend is a valid state
         return {}
-    in_use = peak = 0
+    in_use = peak = limit = 0
     found = False
     for device in devices:
         try:
@@ -196,9 +199,14 @@ def read_device_memory() -> dict:
             stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
             or 0
         )
+        limit += int(stats.get("bytes_limit", 0) or 0)
     if not found:
         return {}
-    return {"bytes_in_use": in_use, "peak_bytes_in_use": peak}
+    return {
+        "bytes_in_use": in_use,
+        "peak_bytes_in_use": peak,
+        "bytes_limit": limit,
+    }
 
 
 def host_memory_health() -> dict:

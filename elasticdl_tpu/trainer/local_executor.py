@@ -119,11 +119,23 @@ class LocalExecutor:
         # env): resolved ONCE here; it selects the staged dispatch loop
         # and turns on batch-buffer donation in the trainer
         from elasticdl_tpu.trainer.device_pipeline import (
+            resolve_boundary_fusion,
             resolve_device_prefetch,
+            resolve_pipeline_depth,
         )
 
         self._device_prefetch = resolve_device_prefetch(
             getattr(args, "device_prefetch", None)
+        )
+        # cross-task staging (--boundary_fusion) and the tunable window
+        # (--pipeline_depth): master-only, env-forwarded; defaults keep
+        # the classic per-task drain at depth 2.  Fusion requires the
+        # staged dispatch loop, so it is gated on device_prefetch.
+        self._boundary_fusion = self._device_prefetch and resolve_boundary_fusion(
+            getattr(args, "boundary_fusion", None)
+        )
+        self._pipeline_depth = resolve_pipeline_depth(
+            getattr(args, "pipeline_depth", None)
         )
         if getattr(args, "steps_per_dispatch", 1) == "auto":
             # measure the link overhead off the first dispatch's
@@ -286,18 +298,6 @@ class LocalExecutor:
         loop passes one so host decode overlaps device compute); default
         builds the task's pipeline inline (retry paths, tests)."""
         from elasticdl_tpu.trainer.stacking import run_stacked_steps
-        from elasticdl_tpu.telemetry.tracing import record_step_span
-        from elasticdl_tpu.telemetry.worker_hooks import record_step
-
-        def _pre(features):
-            self._ensure_trainer(features)
-            # the profiler counts CALLS, one per minibatch == one per
-            # step; no version argument (the version only advances at
-            # the dispatch, so it would repeat within a group — ADVICE
-            # r3 finding 3)
-            self._profiler.on_step()
-            record_step(self._version, self._args.minibatch_size)
-            record_step_span(self._version)
 
         return run_stacked_steps(
             lambda: self._trainer,
@@ -305,13 +305,27 @@ class LocalExecutor:
             if batches is not None
             else self._task_dataset(self._train_reader, task, Modes.TRAINING),
             getattr(self._args, "steps_per_dispatch", 1) or 1,
-            pre_batch=_pre,
+            pre_batch=self._pre_batch,
             post_group=self._post_step_hooks,
             dispatch_ctx=lambda: self._timing.record("batch_process"),
             canonical_rows=self._canonical_rows,
             anatomy=self._anatomy_mod.get_recorder(),
             device_prefetch=self._device_prefetch,
+            pipeline_depth=self._pipeline_depth,
         )
+
+    def _pre_batch(self, features):
+        from elasticdl_tpu.telemetry.tracing import record_step_span
+        from elasticdl_tpu.telemetry.worker_hooks import record_step
+
+        self._ensure_trainer(features)
+        # the profiler counts CALLS, one per minibatch == one per
+        # step; no version argument (the version only advances at
+        # the dispatch, so it would repeat within a group — ADVICE
+        # r3 finding 3)
+        self._profiler.on_step()
+        record_step(self._version, self._args.minibatch_size)
+        record_step_span(self._version)
 
     def _post_step_hooks(self):
         # milestone-CROSSING, not exact-multiple: with steps_per_dispatch
@@ -433,16 +447,59 @@ class LocalExecutor:
             ),
             max_buffered_batches=max(4, 2 * k),
         )
+        from elasticdl_tpu.trainer.device_pipeline import (
+            clear_boundary_mark,
+            note_task_boundary,
+        )
+
         try:
-            for tid, task, batches in prefetcher:
-                with self._timing.record("task_process"):
-                    total += self._train_task(task, batches)
-                dispatcher.report(tid, True)
-                # task boundaries are the single-process run's periodic
-                # memory cadence (no heartbeat thread to ride)
-                self._memory_mod.sample()
+            if self._boundary_fusion:
+                # cross-task staging (--boundary_fusion): one persistent
+                # stager walks the whole task stream, and the per-task
+                # bookkeeping below runs as the task_done callback after
+                # each task's window drains (exactly-once preserved)
+                from elasticdl_tpu.trainer.device_pipeline import (
+                    run_pipelined_task_stream,
+                )
+
+                def _task_done(tid, task, records):
+                    dispatcher.report(tid, True)
+                    # task boundaries are the single-process run's
+                    # periodic memory cadence (no heartbeat to ride)
+                    self._memory_mod.sample()
+
+                total = run_pipelined_task_stream(
+                    lambda: self._trainer,
+                    iter(prefetcher),
+                    getattr(self._args, "steps_per_dispatch", 1) or 1,
+                    pre_batch=self._pre_batch,
+                    post_group=self._post_step_hooks,
+                    dispatch_ctx=lambda: self._timing.record(
+                        "batch_process"
+                    ),
+                    canonical_rows=self._canonical_rows,
+                    anatomy=self._anatomy_mod.get_recorder(),
+                    task_done=_task_done,
+                    pipeline_depth=self._pipeline_depth,
+                )
+            else:
+                for tid, task, batches in prefetcher:
+                    with self._timing.record("task_process"):
+                        total += self._train_task(task, batches)
+                    # the training call drained its window: the device
+                    # is idle from here until the next task's first
+                    # dispatch — that whole gap (report + sample
+                    # included) is the boundary_stall counter
+                    note_task_boundary()
+                    dispatcher.report(tid, True)
+                    # task boundaries are the single-process run's
+                    # periodic memory cadence (no heartbeat to ride)
+                    self._memory_mod.sample()
             ok = True
         finally:
+            # a pending mark must not leak into a later run in this
+            # process (the smoke runs several windows back to back)
+            clear_boundary_mark()
             prefetcher.close()
             try:
                 # an in-flight async checkpoint (or a parked write error)

@@ -260,6 +260,7 @@ def run_stacked_steps(
     canonical_rows: int | None = None,
     anatomy=None,
     device_prefetch: bool = False,
+    pipeline_depth: int | None = None,
 ) -> int:
     """Drive ``batches`` of ``(features, labels)`` through the trainer in
     groups of ``k`` steps per dispatch; returns records processed.
@@ -305,6 +306,9 @@ def run_stacked_steps(
     over retired groups.  Requires ``canonical_rows`` (staging buffers
     must never change shape); ignored — one boolean branch, right here
     — on the legacy path and when off.
+
+    ``pipeline_depth`` (``--pipeline_depth``, default 2): the prefetch
+    path's retire window / staging bound; unused on the serial path.
     """
     if device_prefetch and canonical_rows is not None:
         from elasticdl_tpu.trainer.device_pipeline import (
@@ -321,7 +325,13 @@ def run_stacked_steps(
             deterministic_auto=deterministic_auto,
             canonical_rows=canonical_rows,
             anatomy=anatomy,
+            pipeline_depth=pipeline_depth,
         )
+    # boundary-stall instrumentation (trainer/device_pipeline.py): the
+    # first flush after a task boundary closes the pending mark — one
+    # global load per flush when no mark is pending
+    from elasticdl_tpu.trainer.device_pipeline import note_boundary_dispatch
+
     ctx = dispatch_ctx or contextlib.nullcontext
     group: list = []
     first_shape = None
@@ -348,6 +358,7 @@ def run_stacked_steps(
         if not group:
             return
         trainer = get_trainer()
+        note_boundary_dispatch()
         steps = len(group)
         n_records = sum(n for _f, _l, n in group)
         if anatomy is None:
@@ -422,6 +433,7 @@ def run_stacked_steps(
         if not group:
             return
         trainer = get_trainer()
+        note_boundary_dispatch()
         n_records = sum(_batch_size(g[1]) for g in group)
         if len(group) == 1:
             features, labels = group[0]
@@ -473,6 +485,7 @@ def run_stacked_steps(
                 for _ in range(item.num_steps):
                     pre_batch(item.sample_features)
             trainer = get_trainer()
+            note_boundary_dispatch()
             if anatomy is None:
                 with ctx():
                     if canonical:

@@ -339,10 +339,44 @@ def _add_train_params(parser: argparse.ArgumentParser):
             "dispatch group computes, donate batch/mask buffers to the "
             "jitted step (steady-state dispatches allocate no fresh "
             "device buffers), and retire dispatch outputs one group "
-            "behind in a bounded in-flight window of 2 — with the full "
-            "barrier kept at task boundaries and under --step_anatomy.  "
+            "behind in a bounded in-flight window (--pipeline_depth, "
+            "default 2) — with the drain kept at task boundaries "
+            "(fusable via --boundary_fusion) and under "
+            "--step_anatomy.  "
             "Workers inherit it via ELASTICDL_TPU_DEVICE_PREFETCH "
             "(never argv); default off"
+        ),
+    )
+    parser.add_argument(
+        "--boundary_fusion",
+        type=parse_bool,
+        default=None,
+        required=False,
+        help=(
+            "Cross-task staging (requires --device_prefetch): keep the "
+            "device pipeline alive across TASK boundaries — the stager "
+            "pre-stages the next task's dispatch groups while the "
+            "current task's last groups compute, and the boundary "
+            "barrier shrinks to retiring the previous task's in-flight "
+            "window (exactly-once preserved: a task reports only after "
+            "its own groups retired).  Workers inherit it via "
+            "ELASTICDL_TPU_BOUNDARY_FUSION (never argv); default off"
+        ),
+    )
+    parser.add_argument(
+        "--pipeline_depth",
+        type=pos_int,
+        default=None,
+        required=False,
+        help=(
+            "Device-pipeline depth (requires --device_prefetch): the "
+            "retire-behind window and staging-queue bound, in dispatch "
+            "groups.  The memory ledger's device_stager component "
+            "bounds how deep staging actually runs (admission against "
+            "live device headroom / ELASTICDL_TPU_STAGING_BUDGET_BYTES "
+            "with a loud degrade to 1 on pressure).  Workers inherit "
+            "it via ELASTICDL_TPU_PIPELINE_DEPTH (never argv); "
+            "default 2 — today's proven window"
         ),
     )
     parser.add_argument(
@@ -960,8 +994,12 @@ _MASTER_ONLY_FLAGS = frozenset(
         # argv) so worker command lines stay byte-identical when off
         "step_anatomy",
         # device-path pipelining travels by
-        # ELASTICDL_TPU_DEVICE_PREFETCH, same contract
+        # ELASTICDL_TPU_DEVICE_PREFETCH, same contract; cross-task
+        # staging and the pipeline window ride the same contract via
+        # ELASTICDL_TPU_BOUNDARY_FUSION / ELASTICDL_TPU_PIPELINE_DEPTH
         "device_prefetch",
+        "boundary_fusion",
+        "pipeline_depth",
         # the SLO watchdog runs only in the master's run loop; the
         # config travels by ELASTICDL_TPU_SLO_CONFIG (never argv) so
         # worker command lines stay byte-identical when off

@@ -159,10 +159,20 @@ class LockstepWorker:
         # on this thread, placement is process-local)
         from elasticdl_tpu.trainer.device_pipeline import (
             resolve_device_prefetch,
+            resolve_pipeline_depth,
         )
 
         self._device_prefetch = resolve_device_prefetch(
             getattr(args, "device_prefetch", None)
+        )
+        # tunable retire window (--pipeline_depth, master-forwarded).
+        # Cross-task staging (--boundary_fusion) is deliberately NOT
+        # wired here: the lockstep schedule's reform fence quiesces at
+        # task boundaries, and groups staged across a fence on some
+        # processes but not others would be a world-divergence hazard —
+        # the boundary-only barrier IS the lockstep safety argument.
+        self._pipeline_depth = resolve_pipeline_depth(
+            getattr(args, "pipeline_depth", None)
         )
         # deterministic fault injection (chaos subsystem): a no-op unless
         # the master exported a plan into this process's environment
@@ -533,7 +543,16 @@ class LockstepWorker:
                 # happens — the dispatch sequence stays a pure function
                 # of (task data, k), identical on every process
                 device_prefetch=self._device_prefetch,
+                pipeline_depth=self._pipeline_depth,
             )
+        # boundary-stall instrumentation: arm the mark as soon as the
+        # task's dispatches drained, so the boundary bookkeeping below
+        # (report, version, checkpoint) is inside the measured gap; the
+        # next task's first dispatch closes it (timing only — never
+        # dispatch shapes or order)
+        from elasticdl_tpu.trainer.device_pipeline import note_task_boundary
+
+        note_task_boundary()
         self._report_task_result(
             task.task_id, include_timing=True, trace=task.trace
         )
@@ -880,6 +899,14 @@ class LockstepWorker:
             self._dump_state_if_requested()
             ok = True
         finally:
+            # a pending boundary mark must not survive the run loop (it
+            # would attribute post-run idle time to a later dispatch in
+            # the same process — tests and smokes share processes)
+            from elasticdl_tpu.trainer.device_pipeline import (
+                clear_boundary_mark,
+            )
+
+            clear_boundary_mark()
             try:
                 # a job must not report complete with an unwritten (async)
                 # checkpoint in flight — but a failed flush must not

@@ -163,11 +163,23 @@ class Worker:
         # env (the flag never reaches worker argv) — stages the next
         # batch's placement off-thread and donates batch buffers
         from elasticdl_tpu.trainer.device_pipeline import (
+            resolve_boundary_fusion,
             resolve_device_prefetch,
+            resolve_pipeline_depth,
         )
 
         self._device_prefetch = resolve_device_prefetch(
             getattr(args, "device_prefetch", None)
+        )
+        # cross-task staging (--boundary_fusion, master-forwarded env)
+        # keeps ONE stager alive across task boundaries; the tunable
+        # window (--pipeline_depth) sizes its staging queue.  Fusion
+        # requires the staged path, so it is gated on device_prefetch.
+        self._boundary_fusion = self._device_prefetch and resolve_boundary_fusion(
+            getattr(args, "boundary_fusion", None)
+        )
+        self._pipeline_depth = resolve_pipeline_depth(
+            getattr(args, "pipeline_depth", None)
         )
         if getattr(args, "steps_per_dispatch", 1) == "auto":
             # measure the link overhead off the first dispatch's
@@ -517,8 +529,19 @@ class Worker:
                 PHASE_STEP_BOOKKEEPING,
             )
 
+        from elasticdl_tpu.trainer.device_pipeline import (
+            clear_boundary_mark,
+            note_boundary_dispatch,
+            note_task_boundary,
+        )
+
         def boundary(n, err):
             if tds.report_record_done(n, err):
+                # arm the boundary-stall clock FIRST: the device is
+                # idle from here (the task's last group completed)
+                # until the next group's dispatch closes the mark, so
+                # the boundary bookkeeping below is inside the counter
+                note_task_boundary()
                 # task boundary: report version (may trigger
                 # step-based eval) and drain any eval tasks.
                 # Polling here instead of every batch
@@ -553,6 +576,7 @@ class Worker:
                 # the dispatch's host_fetch phase
                 batches = anat.wrap_fetches(batches)
             for batch in batches:
+                note_boundary_dispatch()
                 if isinstance(batch, PreStacked):
                     err = self._process_stacked_group(batch)
                     n = batch.num_records
@@ -567,14 +591,47 @@ class Worker:
                 total += n
                 account(n, steps, err)
 
+        def handle_staged_group(task, staged):
+            # one staged group's dispatch + accounting, shared by the
+            # per-task and the fused (cross-task) staged loops
+            nonlocal total
+            host = staged.host
+            if staged.error is not None:
+                # staging (pad/place) failed off-thread: fall back to
+                # the serial path for this group, which re-places from
+                # host under the per-minibatch retry — the exact
+                # containment the serial loop gives these errors
+                # (decode errors still crash via the stager's upstream
+                # handler, the documented contract).  The fallback is
+                # per GROUP, so a boundary-timed staging error serial-
+                # izes only the task it belongs to.
+                logger.warning(
+                    "Device staging failed (%s); retrying the "
+                    "group from host",
+                    staged.error,
+                )
+                staged = None
+            note_boundary_dispatch()
+            if isinstance(host, PreStacked):
+                err = self._process_stacked_group(host, staged=staged)
+                n = host.num_records
+                steps = host.num_steps
+            else:
+                features, labels, n = host[0]
+                err = self._process_minibatch(
+                    task.type, features, labels, staged=staged
+                )
+                steps = 1
+            total += n
+            account(n, steps, err)
+
         def run_staged(task, batches):
             # device-path pipelining: a staging thread pads + places the
             # NEXT batch while the current one dispatches; the consumer-
             # visible wait lands in the h2d_transfer phase at the stager
             # seam.  Plain batches stage as singles groups of one (the
-            # per-batch accounting below is unchanged), PreStacked
-            # groups stage whole.
-            nonlocal total
+            # per-batch accounting is unchanged), PreStacked groups
+            # stage whole.
             from elasticdl_tpu.trainer.device_pipeline import DeviceStager
 
             stager = DeviceStager(
@@ -588,39 +645,113 @@ class Worker:
                     staged = stager.next_staged(anat)
                     if staged is None:
                         break
-                    host = staged.host
-                    if staged.error is not None:
-                        # staging (pad/place) failed off-thread: fall
-                        # back to the serial path for this group, which
-                        # re-places from host under the per-minibatch
-                        # retry — the exact containment the serial loop
-                        # gives these errors (decode errors still crash
-                        # via the stager's upstream handler, the
-                        # documented contract)
-                        logger.warning(
-                            "Device staging failed (%s); retrying the "
-                            "group from host",
-                            staged.error,
-                        )
-                        staged = None
-                    if isinstance(host, PreStacked):
-                        err = self._process_stacked_group(
-                            host, staged=staged
-                        )
-                        n = host.num_records
-                        steps = host.num_steps
-                    else:
-                        features, labels, n = host[0]
-                        err = self._process_minibatch(
-                            task.type, features, labels, staged=staged
-                        )
-                        steps = 1
-                    total += n
-                    account(n, steps, err)
+                    handle_staged_group(task, staged)
             finally:
                 stager.close()
 
+        def run_fused(stream):
+            # cross-task staging (--boundary_fusion): ONE stager walks
+            # the whole task stream.  TaskMarks delimit tasks, so the
+            # per-task trace span opens/closes at the right groups, a
+            # trailing partial never merges across tasks, and while
+            # this thread runs a boundary's bookkeeping (the last
+            # group's `account` reports the task) the stager is already
+            # placing the NEXT task's groups on device.  Exactly-once:
+            # `account` reports per retired group as always, and if
+            # this loop unwinds (reclaim fence, preemption) the stager
+            # closes and staged-but-undispatched groups die un-taken —
+            # never dispatched, never reported.
+            from elasticdl_tpu.trainer import device_pipeline as dp
+
+            def feed():
+                # runs on the stager thread: host decode keeps flowing
+                # through task boundaries too
+                for tid_, task_, batches_ in stream:
+                    if task_.type == int(TaskType.TRAINING):
+                        yield dp.TaskMark(dp.TaskMark.START, tid_, task_)
+                        for item in batches_:
+                            yield item
+                        yield dp.TaskMark(dp.TaskMark.END, tid_, task_)
+                    else:
+                        # non-training batches are not canonical train
+                        # groups: carry them AROUND the stager as a
+                        # serial payload at their stream position (rare
+                        # in this stream — the master pauses it for
+                        # eval/save phases)
+                        yield dp.TaskMark(
+                            dp.TaskMark.END, tid_, task_,
+                            payload=list(batches_),
+                        )
+
+            stager = dp.DeviceStager(
+                lambda: self._trainer,
+                feed(),
+                1,
+                self._canonical_rows,
+                depth=dp.stage_depth(anat, self._pipeline_depth),
+            )
+            span = None
+            cur_task = None
+            try:
+                while True:
+                    kind, payload = stager.next_event(anat)
+                    if kind == dp._STAGE_KIND_DONE:
+                        break
+                    if kind == dp._STAGE_KIND_ERROR:
+                        raise payload
+                    if kind == dp._STAGE_KIND_MARK:
+                        if payload.kind == dp.TaskMark.START:
+                            cur_task = payload.task
+                            span = trace_span(
+                                SPAN_TASK_EXECUTE,
+                                trace_ctx=payload.task.trace,
+                                task_id=payload.task.task_id,
+                                shard=payload.task.shard_name,
+                            )
+                            span.__enter__()
+                        else:
+                            if payload.payload is not None:
+                                with trace_span(
+                                    SPAN_TASK_EXECUTE,
+                                    trace_ctx=payload.task.trace,
+                                    task_id=payload.task.task_id,
+                                    shard=payload.task.shard_name,
+                                ):
+                                    run_serial(
+                                        payload.task,
+                                        iter(payload.payload),
+                                    )
+                            cur_task = None
+                            if span is not None:
+                                span.__exit__(None, None, None)
+                                span = None
+                        continue
+                    handle_staged_group(cur_task, payload)
+            finally:
+                if span is not None:
+                    span.__exit__(None, None, None)
+                stager.close()
+
         try:
+            if self._boundary_fusion:
+                stream = iter(prefetcher)
+                # serial preamble: until the trainer exists, tasks run
+                # on the serial path (staging needs the trainer for
+                # placement) — normally exactly the first task
+                while self._trainer is None:
+                    nxt = next(stream, None)
+                    if nxt is None:
+                        return total
+                    _tid0, task0, batches0 = nxt
+                    with trace_span(
+                        SPAN_TASK_EXECUTE,
+                        trace_ctx=task0.trace,
+                        task_id=task0.task_id,
+                        shard=task0.shard_name,
+                    ):
+                        run_serial(task0, batches0)
+                run_fused(stream)
+                return total
             for _tid, task, batches in prefetcher:
                 with trace_span(
                     SPAN_TASK_EXECUTE,
@@ -641,6 +772,9 @@ class Worker:
                         # canonical train groups), and the off path
                         run_serial(task, batches)
         finally:
+            # a pending mark must never attribute cross-stream idle
+            # time (eval phases, the next stream) to a later dispatch
+            clear_boundary_mark()
             prefetcher.close()
         return total
 

@@ -42,6 +42,11 @@ _MODEL_RE = re.compile(
 _HEADLINE_RE = re.compile(
     r'\{"metric":\s*"([^"]+)",\s*"value":\s*([0-9][0-9_.eE+-]*)'
 )
+# the measured-roofline pair inside a compact e2e entry (r06+ artifacts;
+# the compact writer emits them adjacent and unspaced)
+_ROOFM_RE = re.compile(
+    r'"(\w+)":\{[^{}]*?"roofm":([0-9.eE+-]+),"roofm0":([0-9.eE+-]+)'
+)
 
 
 def _round_number(filename: str) -> int:
@@ -58,6 +63,59 @@ def _models_from_parsed(parsed: dict) -> dict[str, float]:
         if isinstance(value, (int, float)):
             models[name] = float(value)
     return models
+
+
+def _roofm_pair(on, off) -> dict | None:
+    if not isinstance(on, (int, float)) or not isinstance(
+        off, (int, float)
+    ):
+        return None
+    return {
+        "on": float(on),
+        "off": float(off),
+        # the within-round pipelining win: measured roofline ratio with
+        # --device_prefetch on minus the serial-staging baseline
+        "delta": round(float(on) - float(off), 3),
+    }
+
+
+def _roofm_from_parsed(parsed: dict) -> dict[str, dict]:
+    """The measured roofm/roofm0 pair per e2e config — from the compact
+    shape (``roofm``/``roofm0`` keys, r06+) or the full-artifact shape
+    (``anatomy.prefetch_on/off.e2e_vs_roofline``).  Rounds that predate
+    the pair (r01–r03 single-window or no anatomy at all) simply
+    contribute nothing — absence is not an error."""
+    out = {}
+    for name, stats in (parsed.get("models") or {}).items():
+        if not isinstance(stats, dict):
+            continue
+        on, off = stats.get("roofm"), stats.get("roofm0")
+        if on is None or off is None:
+            anatomy = stats.get("anatomy") or {}
+            if on is None:
+                on = (anatomy.get("prefetch_on") or {}).get(
+                    "e2e_vs_roofline"
+                )
+            if off is None:
+                off = (anatomy.get("prefetch_off") or {}).get(
+                    "e2e_vs_roofline"
+                )
+        pair = _roofm_pair(on, off)
+        if pair is not None:
+            out[name] = pair
+    return out
+
+
+def _roofm_from_tail(tail: str) -> dict[str, dict]:
+    out = {}
+    for name, on, off in _ROOFM_RE.findall(tail or ""):
+        try:
+            pair = _roofm_pair(float(on), float(off))
+        except ValueError:
+            continue
+        if pair is not None:
+            out[name] = pair
+    return out
 
 
 def _models_from_tail(tail: str) -> dict[str, float]:
@@ -84,6 +142,7 @@ def load_round(path: str) -> dict:
         "headline_value": None,
         "vs_baseline": None,
         "models": {},
+        "roofm": {},
         "error": None,
     }
     parsed = raw.get("parsed")
@@ -93,6 +152,7 @@ def load_round(path: str) -> dict:
         entry["headline_value"] = parsed.get("value")
         entry["vs_baseline"] = parsed.get("vs_baseline")
         entry["models"] = _models_from_parsed(parsed)
+        entry["roofm"] = _roofm_from_parsed(parsed)
         if parsed.get("value") is None and parsed.get("error"):
             entry["status"] = "device_unreachable"
             entry["error"] = parsed["error"]
@@ -101,11 +161,16 @@ def load_round(path: str) -> dict:
         # was truncated — recover what survived rather than dropping
         # the whole round from the history
         entry["models"] = _models_from_tail(tail)
+        entry["roofm"] = _roofm_from_tail(tail)
         headline = _HEADLINE_RE.search(tail)
         if headline:
             entry["headline_metric"] = headline.group(1)
             entry["headline_value"] = float(headline.group(2))
-        if entry["models"] or entry["headline_value"] is not None:
+        if (
+            entry["models"]
+            or entry["roofm"]
+            or entry["headline_value"] is not None
+        ):
             entry["status"] = "recovered_from_tail"
         else:
             entry["status"] = "unparsable"
@@ -215,11 +280,15 @@ def build_history(repo: str) -> dict:
         if entry["status"] == "ok":
             prev = entry
     model_names = sorted({m for e in train for m in e["models"]})
+    roofm_names = sorted(
+        {m for e in train for m in e.get("roofm") or {}}
+    )
     return {
         "repo": repo,
         "train_rounds": train,
         "serving_rounds": serving,
         "models": model_names,
+        "roofm_models": roofm_names,
     }
 
 
@@ -257,6 +326,43 @@ def format_history(history: dict) -> str:
                     for i, (cell, width) in enumerate(zip(row, widths))
                 )
             )
+        if history.get("roofm_models"):
+            # the measured-roofline pair per round: roofm (prefetch on)
+            # / roofm0 (off) with the within-round delta.  Rounds that
+            # predate the pair (r01–r03) and unreachable-device stamps
+            # render "-" — the column tolerates every health state.
+            lines.append(
+                "measured roofline ratio (roofm on / roofm0 off, "
+                "delta = pipelining win):"
+            )
+            header = ["model"] + [f"r{e['round']:02d}" for e in train]
+            rows = [header]
+            for model in history["roofm_models"]:
+                cells = [model]
+                for entry in train:
+                    pair = (entry.get("roofm") or {}).get(model)
+                    cells.append(
+                        "{:.3f}/{:.3f} ({:+.3f})".format(
+                            pair["on"], pair["off"], pair["delta"]
+                        )
+                        if pair
+                        else "-"
+                    )
+                rows.append(cells)
+            widths = [
+                max(len(row[col]) for row in rows)
+                for col in range(len(header))
+            ]
+            for row in rows:
+                lines.append(
+                    "  "
+                    + "  ".join(
+                        cell.rjust(width) if i else cell.ljust(width)
+                        for i, (cell, width) in enumerate(
+                            zip(row, widths)
+                        )
+                    )
+                )
         for entry in train:
             if entry["status"] == "device_unreachable":
                 lines.append(

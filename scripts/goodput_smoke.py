@@ -2,8 +2,9 @@
 """Tier-1 goodput smoke (wired into scripts/run_tier1.sh).
 
 Runs a tiny LocalExecutor mnist job with ``--step_anatomy`` + telemetry
-on the CPU backend TWICE — device prefetch off, then on — and requires
-the step-anatomy contract to hold in both windows:
+on the CPU backend THREE times — device prefetch off, prefetch on, and
+prefetch + cross-task staging (``--boundary_fusion``) — and requires
+the step-anatomy contract to hold in every window:
 
 1. every dispatch emitted a ``step_anatomy`` event whose phases
    (host_fetch / assemble / h2d_transfer / device_compute /
@@ -20,9 +21,16 @@ the step-anatomy contract to hold in both windows:
 5. with ``--device_prefetch`` on, the CONSUMER-VISIBLE ``h2d_transfer``
    share is measurably lower than the prefetch-off run's (staging
    moved assembly + placement off the dispatch thread) — or already
-   negligible (< 0.5% of wall, the intended end state).
+   negligible (< 0.5% of wall, the intended end state);
+6. with ``--boundary_fusion`` on top, the ``boundary_stall`` share
+   (device-idle time between one task's last retire and the next
+   task's first dispatch, measured per window off the heartbeat
+   counter) drops versus prefetch-only — or is already negligible
+   (< 0.5% of wall) — while sum-exactness still holds (the counter is
+   NOT a member of the per-dispatch phase sum).
 
-Fast by construction: 512 records, one epoch, one process per window.
+Fast by construction: 512 records, one epoch, all windows in one
+process.
 """
 
 from __future__ import annotations
@@ -44,9 +52,13 @@ SUM_RESIDUAL_MS = 1e-3
 # an ON h2d share below this is "negligible" even if the OFF share was
 # also tiny (CPU memcpy placement): the pipeline did its job
 H2D_NEGLIGIBLE_SHARE = 0.005
+# same rationale for the fused window's boundary-stall share
+BOUNDARY_NEGLIGIBLE_SHARE = 0.005
 
 
-def _run_window(workdir: str, train: str, prefetch: bool) -> dict | int:
+def _run_window(
+    workdir: str, train: str, prefetch: bool, fusion: bool = False
+) -> dict | int:
     """One instrumented LocalExecutor window; returns the measured
     sums + report section, or a non-zero rc on a gate failure."""
     from elasticdl_tpu.telemetry import anatomy as anatomy_mod
@@ -54,10 +66,11 @@ def _run_window(workdir: str, train: str, prefetch: bool) -> dict | int:
     from elasticdl_tpu.telemetry.anatomy import TRACKED_PHASES
     from elasticdl_tpu.telemetry.events import read_events
     from elasticdl_tpu.telemetry.report import build_report
+    from elasticdl_tpu.trainer import device_pipeline as device_pipeline_mod
     from elasticdl_tpu.trainer.local_executor import LocalExecutor
     from elasticdl_tpu.utils.args import parse_master_args
 
-    mode = "on" if prefetch else "off"
+    mode = "fused" if fusion else ("on" if prefetch else "off")
     rundir = os.path.join(workdir, f"prefetch_{mode}")
     os.makedirs(rundir, exist_ok=True)
     telemetry_dir = os.path.join(rundir, "telemetry")
@@ -85,8 +98,14 @@ def _run_window(workdir: str, train: str, prefetch: bool) -> dict | int:
             "true",
             "--device_prefetch",
             "true" if prefetch else "false",
+            "--boundary_fusion",
+            "true" if fusion else "false",
         ]
     )
+    # the boundary-stall totals are process-global monotone counters
+    # (heartbeat-shipped in production); per-window attribution needs a
+    # before/after diff
+    snap_before = device_pipeline_mod.heartbeat_snapshot()
     try:
         LocalExecutor(args).run()
     finally:
@@ -95,6 +114,13 @@ def _run_window(workdir: str, train: str, prefetch: bool) -> dict | int:
         anatomy_mod.uninstall()
         worker_hooks.uninstall()
         tracing.uninstall()
+    snap_after = device_pipeline_mod.heartbeat_snapshot()
+    boundary_stall_ms = snap_after.get(
+        "boundary_stall_ms", 0
+    ) - snap_before.get("boundary_stall_ms", 0)
+    boundaries = snap_after.get("boundaries", 0) - snap_before.get(
+        "boundaries", 0
+    )
 
     events = read_events(os.path.join(telemetry_dir, "events.jsonl"))
     anat = [e for e in events if e.get("event") == "step_anatomy"]
@@ -190,6 +216,10 @@ def _run_window(workdir: str, train: str, prefetch: bool) -> dict | int:
         "roofline": roofline,
         "untracked_share": untracked_share,
         "h2d_share": h2d_total / wall_total,
+        # boundary_stall is a COUNTER, deliberately outside the phase
+        # sum; its share of the same measured wall is the comparable
+        "boundary_share": boundary_stall_ms / wall_total,
+        "boundaries": boundaries,
     }
 
 
@@ -215,11 +245,15 @@ def main() -> int:
         on = _run_window(workdir, train, prefetch=True)
         if isinstance(on, int):
             return on
+        fused = _run_window(workdir, train, prefetch=True, fusion=True)
+        if isinstance(fused, int):
+            return fused
 
         # 4. sampled phase spans + the analyzer's steady-state section —
-        # gated in BOTH windows, so the pipelined (production) path's
-        # trace output is validated too, not just the serial baseline
-        for mode, window in (("off", off), ("on", on)):
+        # gated in EVERY window, so the pipelined and fused (production)
+        # paths' trace output is validated too, not just the serial
+        # baseline
+        for mode, window in (("off", off), ("on", on), ("fused", fused)):
             spans = read_spans(
                 os.path.join(window["telemetry_dir"], SPANS_FILENAME)
             )
@@ -258,18 +292,45 @@ def main() -> int:
             )
             return 1
 
+        # 6. cross-task staging closed the dispatch gap between tasks:
+        # the boundary-stall share must DROP versus prefetch-only (or
+        # already be negligible)
+        if not (
+            fused["boundary_share"] < on["boundary_share"]
+            or fused["boundary_share"] < BOUNDARY_NEGLIGIBLE_SHARE
+        ):
+            print(
+                "goodput_smoke: --boundary_fusion did not lower the "
+                f"boundary-stall share (on "
+                f"{on['boundary_share'] * 100:.2f}% -> fused "
+                f"{fused['boundary_share'] * 100:.2f}%)",
+                file=sys.stderr,
+            )
+            return 1
+
     print(
         "goodput_smoke: OK (off: {} dispatches, roofline {:.3f}, h2d "
-        "{:.2f}%, untracked {:.2f}% | on: {} dispatches, roofline "
-        "{:.3f}, h2d {:.2f}%, untracked {:.2f}%)".format(
+        "{:.2f}%, untracked {:.2f}%, bstall {:.2f}% | on: {} "
+        "dispatches, roofline {:.3f}, h2d {:.2f}%, untracked {:.2f}%, "
+        "bstall {:.2f}% | fused: {} dispatches, roofline {:.3f}, h2d "
+        "{:.2f}%, untracked {:.2f}%, bstall {:.2f}% over {} "
+        "boundaries)".format(
             off["overall"]["dispatches"],
             off["roofline"],
             off["h2d_share"] * 100.0,
             off["untracked_share"] * 100.0,
+            off["boundary_share"] * 100.0,
             on["overall"]["dispatches"],
             on["roofline"],
             on["h2d_share"] * 100.0,
             on["untracked_share"] * 100.0,
+            on["boundary_share"] * 100.0,
+            fused["overall"]["dispatches"],
+            fused["roofline"],
+            fused["h2d_share"] * 100.0,
+            fused["untracked_share"] * 100.0,
+            fused["boundary_share"] * 100.0,
+            fused["boundaries"],
         )
     )
     return 0

@@ -52,6 +52,11 @@ def _fully_populated_models():
         "e2e_vs_roofline": 0.912,
         "binding": "device_path",
         "phases": {"device_compute": {"p50_ms": 210.0, "p99_ms": 260.0}},
+        "boundary_stall": {
+            "boundaries": 3,
+            "stall_ms": 412,
+            "share_of_wall": 0.0312,
+        },
     }
     e2e = {
         "e2e_samples_per_sec_per_chip": 234517.3,
@@ -148,6 +153,9 @@ def test_compact_line_fits_the_driver_tail(bench):
     # measured anatomy ratios: prefetch ON is roofm, OFF is roofm0
     assert compact["mnist_e2e"]["roofm"] == 0.912
     assert compact["mnist_e2e"]["roofm0"] == 0.695
+    # the between-task idle share rides in both windows' compact keys
+    assert compact["mnist_e2e"]["bst"] == 0.0312
+    assert compact["mnist_e2e"]["bst0"] == 0.0312
     assert compact["transformer_seq8192"]["tok"] == 137000
     assert compact["accuracy"]["mnist"] == [0.9712, 1]
     assert compact["elastic_reform"]["ok"] == 1

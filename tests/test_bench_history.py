@@ -1,0 +1,181 @@
+"""bench_history's roofm trend column (ISSUE 20 satellite): the
+measured-roofline pair is recovered from every artifact health state —
+compact parsed lines (r06+), full-artifact anatomy shapes (r02/r03),
+truncated tails — and the rendered table tolerates rounds that predate
+the pair or where the device was unreachable.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+_SPEC = importlib.util.spec_from_file_location(
+    "bench_history",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+        "bench_history.py",
+    ),
+)
+bench_history = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(bench_history)
+
+
+def test_roofm_pair_rounds_delta_and_rejects_non_numeric():
+    pair = bench_history._roofm_pair(0.912, 0.695)
+    assert pair == {"on": 0.912, "off": 0.695, "delta": 0.217}
+    assert bench_history._roofm_pair(None, 0.5) is None
+    assert bench_history._roofm_pair(0.5, "n/a") is None
+
+
+def test_roofm_from_parsed_compact_and_anatomy_shapes():
+    # the compact shape (r06+): roofm/roofm0 keys straight on the model
+    compact = {
+        "models": {
+            "mnist_e2e": {"roofm": 0.91, "roofm0": 0.7, "spsc": 100.0}
+        }
+    }
+    assert bench_history._roofm_from_parsed(compact) == {
+        "mnist_e2e": {"on": 0.91, "off": 0.7, "delta": 0.21}
+    }
+    # the full-artifact shape (r02/r03 parsed blocks): the pair lives
+    # under anatomy.prefetch_on/off.e2e_vs_roofline
+    full = {
+        "models": {
+            "mnist_e2e": {
+                "anatomy": {
+                    "prefetch_on": {"e2e_vs_roofline": 0.8},
+                    "prefetch_off": {"e2e_vs_roofline": 0.6},
+                }
+            },
+            # single-window rounds contribute nothing, not an error
+            "mnist_step": {"samples_per_sec_per_chip": 9.0},
+        }
+    }
+    assert bench_history._roofm_from_parsed(full) == {
+        "mnist_e2e": {"on": 0.8, "off": 0.6, "delta": 0.2}
+    }
+
+
+def test_roofm_from_tail_recovers_truncated_compact_fragment():
+    tail = (
+        '... {"metric":"samples_per_sec_per_chip","value":123.4,'
+        '"models":{"mnist_e2e":{"spsc":123.4,"roofm":0.905,'
+        '"roofm0":0.688,"bst":0.031'
+    )
+    assert bench_history._roofm_from_tail(tail) == {
+        "mnist_e2e": {"on": 0.905, "off": 0.688, "delta": 0.217}
+    }
+    assert bench_history._roofm_from_tail("") == {}
+
+
+def _write_round(tmp_path, n, body):
+    path = tmp_path / f"BENCH_r{n:02d}.json"
+    path.write_text(json.dumps(body))
+    return str(path)
+
+
+def test_load_round_tail_only_roofm_counts_as_recovery(tmp_path):
+    path = _write_round(
+        tmp_path,
+        7,
+        {
+            "n": 7,
+            "rc": 0,
+            "parsed": None,
+            "tail": '"mnist_e2e":{"roofm":0.912,"roofm0":0.695,"bst"',
+        },
+    )
+    entry = bench_history.load_round(path)
+    # a tail whose ONLY surviving fragment is the roofm pair is still a
+    # recovered round, not "no result recovered"
+    assert entry["status"] == "recovered_from_tail"
+    assert entry["roofm"]["mnist_e2e"]["delta"] == 0.217
+
+
+def test_history_renders_roofm_table_across_health_states(tmp_path):
+    # r01: predates the pair entirely (headline only)
+    _write_round(
+        tmp_path,
+        1,
+        {
+            "n": 1,
+            "rc": 0,
+            "parsed": {
+                "metric": "samples_per_sec_per_chip",
+                "value": 100.0,
+            },
+        },
+    )
+    # r02: device unreachable
+    _write_round(
+        tmp_path,
+        2,
+        {
+            "n": 2,
+            "rc": 1,
+            "parsed": {
+                "metric": "samples_per_sec_per_chip",
+                "value": None,
+                "error": "no TPU reachable",
+            },
+        },
+    )
+    # r03: compact round carrying the pair
+    _write_round(
+        tmp_path,
+        3,
+        {
+            "n": 3,
+            "rc": 0,
+            "parsed": {
+                "metric": "samples_per_sec_per_chip",
+                "value": 120.0,
+                "models": {
+                    "mnist_e2e": {
+                        "spsc": 120.0,
+                        "roofm": 0.912,
+                        "roofm0": 0.695,
+                    }
+                },
+            },
+        },
+    )
+    history = bench_history.build_history(str(tmp_path))
+    assert history["roofm_models"] == ["mnist_e2e"]
+    text = bench_history.format_history(history)
+    assert "measured roofline ratio" in text
+    assert "0.912/0.695 (+0.217)" in text
+    # the pre-pair and unreachable rounds render "-" in the new table
+    roofm_lines = [
+        line
+        for line in text.splitlines()
+        if line.strip().startswith("mnist_e2e")
+        and "0.912/0.695" in line
+    ]
+    assert len(roofm_lines) == 1
+    assert roofm_lines[0].count("-") >= 2
+
+
+def test_history_without_pairs_renders_no_roofm_table(tmp_path):
+    _write_round(
+        tmp_path,
+        1,
+        {
+            "n": 1,
+            "rc": 0,
+            "parsed": {
+                "metric": "samples_per_sec_per_chip",
+                "value": 100.0,
+                "models": {
+                    "mnist_step": {"samples_per_sec_per_chip": 100.0}
+                },
+            },
+        },
+    )
+    history = bench_history.build_history(str(tmp_path))
+    assert history["roofm_models"] == []
+    text = bench_history.format_history(history)
+    assert "measured roofline ratio" not in text

@@ -1,0 +1,588 @@
+"""Boundary fusion (ISSUE 20): cross-task staging through one
+persistent stager, the fused task loop's exactly-once discipline under
+boundary-timed preemption, the tunable pipeline depth, the admission
+degrade of the staging memory ledger, and the boundary_stall counter's
+trip from heartbeat to the master's /metrics mirror.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.trainer import device_pipeline
+from elasticdl_tpu.trainer.device_pipeline import (
+    BOUNDARY_FUSION_ENV,
+    DEVICE_PREFETCH_ENV,
+    PIPELINE_DEPTH_ENV,
+    STAGING_BUDGET_ENV,
+    DeviceStager,
+    TaskMark,
+    resolve_boundary_fusion,
+    resolve_pipeline_depth,
+    run_pipelined_task_stream,
+    stage_depth,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for env in (
+        DEVICE_PREFETCH_ENV,
+        BOUNDARY_FUSION_ENV,
+        PIPELINE_DEPTH_ENV,
+        STAGING_BUDGET_ENV,
+    ):
+        monkeypatch.delenv(env, raising=False)
+    device_pipeline._reset_totals_for_tests()
+    yield
+    device_pipeline._reset_totals_for_tests()
+
+
+class _LogCapture(logging.Handler):
+    """default_logger doesn't propagate (stderr handler only), so
+    caplog can't see it — attach directly."""
+
+    def __init__(self):
+        super().__init__()
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+@pytest.fixture()
+def framework_log():
+    from elasticdl_tpu.utils.log_utils import default_logger
+
+    handler = _LogCapture()
+    default_logger.addHandler(handler)
+    yield handler
+    default_logger.removeHandler(handler)
+
+
+# ---- flag / env resolution ---------------------------------------------------
+
+
+def test_resolve_boundary_fusion_flag_wins_env_falls_back(
+    monkeypatch, framework_log
+):
+    assert resolve_boundary_fusion(None) is False
+    assert resolve_boundary_fusion(True) is True
+    assert resolve_boundary_fusion(False) is False
+    monkeypatch.setenv(BOUNDARY_FUSION_ENV, "1")
+    assert resolve_boundary_fusion(None) is True
+    assert resolve_boundary_fusion(False) is False
+    for falsey in ("0", "false", "no", "off", ""):
+        monkeypatch.setenv(BOUNDARY_FUSION_ENV, falsey)
+        assert resolve_boundary_fusion(None) is False
+    assert not framework_log.records
+    # a typo fails SAFE (off) and complains loudly
+    monkeypatch.setenv(BOUNDARY_FUSION_ENV, "ture")
+    assert resolve_boundary_fusion(None) is False
+    assert any(
+        r.levelno == logging.ERROR and BOUNDARY_FUSION_ENV in r.getMessage()
+        for r in framework_log.records
+    )
+
+
+def test_resolve_pipeline_depth_flag_env_and_malformed(
+    monkeypatch, framework_log
+):
+    assert resolve_pipeline_depth(None) == device_pipeline.RETIRE_WINDOW
+    assert resolve_pipeline_depth(4) == 4
+    assert resolve_pipeline_depth(0) == 1  # clamp, never a dead pipeline
+    monkeypatch.setenv(PIPELINE_DEPTH_ENV, "3")
+    assert resolve_pipeline_depth(None) == 3
+    assert resolve_pipeline_depth(5) == 5  # flag still beats env
+    assert not framework_log.records
+    for bad in ("zero", "0", "-2", "2.5"):
+        framework_log.records.clear()
+        monkeypatch.setenv(PIPELINE_DEPTH_ENV, bad)
+        # malformed env fails SAFE to the proven default, loudly
+        assert (
+            resolve_pipeline_depth(None) == device_pipeline.RETIRE_WINDOW
+        )
+        assert any(
+            r.levelno == logging.ERROR
+            and PIPELINE_DEPTH_ENV in r.getMessage()
+            for r in framework_log.records
+        )
+
+
+def test_stage_depth_honors_pipeline_depth():
+    assert stage_depth(None) == device_pipeline.RETIRE_WINDOW
+    assert stage_depth(None, 4) == 4
+    assert stage_depth(None, 1) == 1
+    # --step_anatomy still wins: exact per-group walls need the barrier
+    assert stage_depth(object(), 4) == 1
+
+
+def test_new_flags_never_reach_worker_argv():
+    from elasticdl_tpu.utils.args import (
+        build_worker_arguments,
+        parse_master_args,
+    )
+
+    base = [
+        "--model_def",
+        "mnist_functional_api.mnist_functional_api.custom_model",
+        "--training_data",
+        "/tmp/x",
+    ]
+    off = parse_master_args(base)
+    on = parse_master_args(
+        base
+        + [
+            "--device_prefetch",
+            "true",
+            "--boundary_fusion",
+            "true",
+            "--pipeline_depth",
+            "4",
+        ]
+    )
+    argv_off = build_worker_arguments(off, 0, "localhost:1")
+    argv_on = build_worker_arguments(on, 0, "localhost:1")
+    assert "--boundary_fusion" not in argv_on
+    assert "--pipeline_depth" not in argv_on
+    # the whole feature travels by env: worker argv stays byte-identical
+    assert argv_on == argv_off
+
+
+# ---- fused task stream: grouping, ordering, exactly-once ---------------------
+
+
+class _FakeTrainer:
+    """Host-only trainer double: real padding, identity placement."""
+
+    step = 0
+
+    def __init__(self):
+        self.dispatched = []  # (kind, first feature value) per dispatch
+
+    def pad_to(self, tree, rows):
+        def _pad(x):
+            x = np.asarray(x)
+            if x.shape[0] == rows:
+                return x
+            return np.concatenate(
+                [x, np.repeat(x[-1:], rows - x.shape[0], axis=0)]
+            )
+
+        import jax
+
+        return jax.tree_util.tree_map(_pad, tree)
+
+    def row_mask(self, n, rows):
+        mask = np.zeros(rows, np.float32)
+        mask[:n] = 1.0
+        return mask
+
+    def place_batch(self, tree):
+        return tree
+
+    def place_stacked(self, tree):
+        return tree
+
+    def train_step(self, f, l, w=None):
+        self.dispatched.append(("single", float(np.asarray(f).flat[0])))
+        return np.float32(0.0)
+
+    def train_steps_stacked(self, f, l, w=None):
+        self.dispatched.append(("stacked", float(np.asarray(f).flat[0])))
+        return np.float32(0.0)
+
+
+def _task_batches(tid, sizes):
+    # every row of a task's features carries the task id, so a dispatch
+    # record tells us exactly which task's data it consumed
+    return [
+        (
+            np.full((n, 4), float(tid), np.float32),
+            np.zeros((n,), np.int32),
+        )
+        for n in sizes
+    ]
+
+
+def _tasks(n_tasks, sizes):
+    for tid in range(1, n_tasks + 1):
+        yield tid, f"task-{tid}", iter(_task_batches(tid, sizes))
+
+
+def test_task_stream_grouping_resets_per_task():
+    """The END/START marks flush the producer's grouping, so a task's
+    trailing odd batch NEVER stacks with the next task's first batch —
+    the dispatch-shape sequence is identical to running each task
+    through the serial loop."""
+    trainer = _FakeTrainer()
+    total = run_pipelined_task_stream(
+        lambda: trainer,
+        _tasks(3, [8, 8, 8]),
+        2,
+        canonical_rows=8,
+    )
+    assert total == 3 * 24
+    # per task: one stacked [8,8] group + one trailing single — thrice
+    assert trainer.dispatched == [
+        ("stacked", 1.0),
+        ("single", 1.0),
+        ("stacked", 2.0),
+        ("single", 2.0),
+        ("stacked", 3.0),
+        ("single", 3.0),
+    ]
+
+
+def test_task_stream_reports_exactly_once_in_order():
+    trainer = _FakeTrainer()
+    starts, dones = [], []
+    total = run_pipelined_task_stream(
+        lambda: trainer,
+        _tasks(3, [8, 8]),
+        2,
+        canonical_rows=8,
+        task_start=lambda tid, task: starts.append((tid, task)),
+        task_done=lambda tid, task, n: dones.append((tid, task, n)),
+    )
+    assert total == 3 * 16
+    assert starts == [(1, "task-1"), (2, "task-2"), (3, "task-3")]
+    assert dones == [
+        (1, "task-1", 16),
+        (2, "task-2", 16),
+        (3, "task-3", 16),
+    ]
+
+
+def test_task_stream_retires_window_before_reporting(monkeypatch):
+    """Exactly-once across the async window: when task_done(tid) runs,
+    every dispatch so far has retired — a report can never cover an
+    un-retired group whose compute might still fail."""
+    events = []
+    real_block = device_pipeline.jax.block_until_ready
+
+    def tracked_block(out):
+        events.append(("retire",))
+        return real_block(out)
+
+    monkeypatch.setattr(
+        device_pipeline.jax, "block_until_ready", tracked_block
+    )
+
+    class _Tracking(_FakeTrainer):
+        def train_step(self, f, l, w=None):
+            events.append(("dispatch",))
+            return super().train_step(f, l, w)
+
+        def train_steps_stacked(self, f, l, w=None):
+            events.append(("dispatch",))
+            return super().train_steps_stacked(f, l, w)
+
+    trainer = _Tracking()
+    run_pipelined_task_stream(
+        lambda: trainer,
+        _tasks(3, [8] * 6),
+        2,
+        canonical_rows=8,
+        task_done=lambda tid, task, n: events.append(("done", tid)),
+    )
+    for i, event in enumerate(events):
+        if event[0] == "done":
+            before = events[:i]
+            dispatched = sum(1 for e in before if e[0] == "dispatch")
+            retired = sum(1 for e in before if e[0] == "retire")
+            assert retired == dispatched, (
+                f"task {event[1]} reported with "
+                f"{dispatched - retired} un-retired dispatches"
+            )
+    assert [e[1] for e in events if e[0] == "done"] == [1, 2, 3]
+
+
+def test_boundary_timed_preemption_discards_staged_groups(monkeypatch):
+    """The reclaim fence exactly at a boundary: task N's report raises
+    (lease reclaimed) AFTER its window retired and BEFORE task N+1's
+    first dispatch — the already-staged next-task groups die un-taken
+    (never dispatched, never reported), so a re-lease replays them from
+    scratch without double-reporting task N."""
+    stagers = []
+    real_stager = device_pipeline.DeviceStager
+
+    def capture(*args, **kwargs):
+        stager = real_stager(*args, **kwargs)
+        stagers.append(stager)
+        return stager
+
+    monkeypatch.setattr(device_pipeline, "DeviceStager", capture)
+
+    trainer = _FakeTrainer()
+    dones = []
+
+    def task_done(tid, task, n):
+        dones.append((tid, n))
+        if tid == 2:
+            raise RuntimeError("lease reclaimed")
+
+    with pytest.raises(RuntimeError, match="lease reclaimed"):
+        run_pipelined_task_stream(
+            lambda: trainer,
+            _tasks(4, [8, 8]),
+            2,
+            canonical_rows=8,
+            task_done=task_done,
+        )
+    # tasks 1 and 2 reported exactly once; 3 and 4 never
+    assert dones == [(1, 16), (2, 16)]
+    # no group from task 3 or 4 was ever dispatched, even though the
+    # stager was pre-staging them while task 2 computed
+    assert {tag for _, tag in trainer.dispatched} == {1.0, 2.0}
+    # the fused loop closed its stager on the way out: the producer is
+    # dead and the staged-but-undispatched groups are unreachable
+    for stager in stagers:
+        stager._thread.join(timeout=5)
+        assert not stager._thread.is_alive()
+
+
+def test_task_stream_reraises_boundary_staging_errors():
+    """A pad/place failure while staging ACROSS a boundary keeps the
+    serial path's crash contract in the grouped runtimes: the error
+    surfaces at the failed group's dispatch position (the worker's
+    per-group serial fallback is pinned separately in its own loop)."""
+
+    class _BadPadAfterFirstTask(_FakeTrainer):
+        pads = 0
+
+        def pad_to(self, tree, rows):
+            type(self).pads += 1
+            # task 1 is one full group (2 batches x features+labels =
+            # 4 pads) on the serial warmup; every later pad happens on
+            # the cross-task stager thread
+            if type(self).pads > 4:
+                raise ValueError("bad batch at the boundary")
+            return super().pad_to(tree, rows)
+
+    trainer = _BadPadAfterFirstTask()
+    dones = []
+    with pytest.raises(ValueError, match="bad batch at the boundary"):
+        run_pipelined_task_stream(
+            lambda: trainer,
+            _tasks(3, [8, 8]),
+            2,
+            canonical_rows=8,
+            task_done=lambda tid, task, n: dones.append(tid),
+        )
+    # task 1 completed and reported before the boundary stage failed;
+    # task 2 never reported (its group never dispatched)
+    assert dones == [1]
+    assert {tag for _, tag in trainer.dispatched} == {1.0}
+
+
+def test_worker_fused_feed_carries_non_training_tasks_as_payload():
+    """The worker's fused stream routes non-training tasks AROUND the
+    stager as an END-mark payload: the stager must hand marks through
+    in stream order with the payload intact (the serial fallback at the
+    boundary consumes it)."""
+    marks = []
+    batches = _task_batches(1, [8, 8])
+
+    def feed():
+        yield TaskMark(TaskMark.START, 1, "train")
+        for item in batches:
+            yield item
+        yield TaskMark(TaskMark.END, 1, "train")
+        yield TaskMark(TaskMark.END, 2, "eval", payload=["sentinel"])
+
+    stager = DeviceStager(
+        lambda: _FakeTrainer(), feed(), 2, canonical_rows=8
+    )
+    groups = 0
+    try:
+        while True:
+            kind, payload = stager.next_event()
+            if kind == device_pipeline._STAGE_KIND_DONE:
+                break
+            if kind == device_pipeline._STAGE_KIND_MARK:
+                marks.append((payload.kind, payload.tid, payload.payload))
+            else:
+                groups += 1
+    finally:
+        stager.close()
+    assert groups == 1  # [8,8] staged as one stacked group
+    assert marks == [
+        (TaskMark.START, 1, None),
+        (TaskMark.END, 1, None),
+        (TaskMark.END, 2, ["sentinel"]),
+    ]
+
+
+# ---- admission control (memory ledger) ---------------------------------------
+
+
+def test_staging_budget_degrades_depth_to_one_loudly(
+    monkeypatch, framework_log
+):
+    monkeypatch.setenv(STAGING_BUDGET_ENV, "1")
+    stager = DeviceStager(
+        lambda: _FakeTrainer(),
+        iter(_task_batches(1, [8] * 6)),
+        2,
+        canonical_rows=8,
+        depth=3,
+    )
+    try:
+        groups = list(stager)
+    finally:
+        stager.close()
+    assert len(groups) == 3  # every group still arrives, just serially
+    assert stager._admitted == 1
+    warnings = [
+        r.getMessage()
+        for r in framework_log.records
+        if r.levelno == logging.WARNING
+    ]
+    assert any("degrading staging depth" in m for m in warnings)
+    # loud but not noisy: the degrade logs ONCE for the stager's life
+    assert (
+        sum("degrading staging depth" in m for m in warnings) == 1
+    )
+
+
+def test_staging_budget_malformed_env_falls_back(
+    monkeypatch, framework_log
+):
+    monkeypatch.setenv(STAGING_BUDGET_ENV, "lots")
+    # malformed byte count: ERROR + headroom fallback, never a crash
+    device_pipeline.staging_budget_bytes()
+    assert any(
+        r.levelno == logging.ERROR and STAGING_BUDGET_ENV in r.getMessage()
+        for r in framework_log.records
+    )
+
+
+# ---- boundary_stall: counter -> heartbeat -> master mirror -------------------
+
+
+def test_boundary_counters_unarmed_cost_nothing_armed_accumulate():
+    # unarmed (no stager ever ran, no anatomy): pure gate, no totals
+    device_pipeline.note_task_boundary()
+    device_pipeline.note_boundary_dispatch()
+    assert device_pipeline.heartbeat_snapshot() == {}
+    # arm via staging activity, then measure one boundary gap
+    device_pipeline._note_staged(0.0)
+    device_pipeline.note_task_boundary()
+    time.sleep(0.02)
+    device_pipeline.note_boundary_dispatch()
+    snap = device_pipeline.heartbeat_snapshot()
+    assert set(snap) == {
+        "groups",
+        "stall_ms",
+        "stage_ms",
+        "boundaries",
+        "boundary_stall_ms",
+    }
+    assert snap["boundaries"] == 1
+    assert snap["boundary_stall_ms"] >= 10
+    # a dispatch with no pending mark adds nothing
+    device_pipeline.note_boundary_dispatch()
+    assert device_pipeline.heartbeat_snapshot()["boundaries"] == 1
+    # clear disarms a pending mark (end of run): no phantom boundary
+    device_pipeline.note_task_boundary()
+    device_pipeline.clear_boundary_mark()
+    device_pipeline.note_boundary_dispatch()
+    assert device_pipeline.heartbeat_snapshot()["boundaries"] == 1
+
+
+def _servicer():
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+    shards = {"s": (0, 8)}
+    return MasterServicer(4, TaskDispatcher(shards, records_per_task=4))
+
+
+def test_master_mirrors_boundary_stall_counter():
+    from elasticdl_tpu.rpc import messages as msg
+    from elasticdl_tpu.telemetry.master_hooks import MasterTelemetry
+
+    servicer = _servicer()
+    servicer.heartbeat(
+        msg.HeartbeatRequest(
+            worker_id=0,
+            step=1,
+            prefetch={
+                "groups": 7,
+                "stall_ms": 3,
+                "stage_ms": 29,
+                "boundaries": 4,
+                "boundary_stall_ms": 57,
+            },
+        )
+    )
+    totals = servicer.prefetch_stats_totals()
+    assert totals["boundaries"] == 4
+    assert totals["boundary_stall_ms"] == 57
+    telemetry = MasterTelemetry()
+    telemetry._servicer = servicer
+    text = telemetry.registry.exposition()
+    assert "elasticdl_boundary_stall_ms_total 57" in text
+
+
+# ---- LocalExecutor e2e: fused-vs-off bit-exact parity ------------------------
+
+
+def test_local_executor_fused_parity_bitexact(tmp_path):
+    """The whole fused path (reader -> decode -> TaskPrefetcher ->
+    cross-task stager -> fused dispatch loop) is bit-identical to the
+    serial path across FOUR task boundaries: same step program, same
+    grouping, same pinned shuffle — only the boundary discipline
+    differs."""
+    import jax as _jax
+
+    from elasticdl_tpu.data.recordio_gen import synthetic
+    from elasticdl_tpu.trainer.local_executor import LocalExecutor
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    train_dir = synthetic.gen_mnist(
+        str(tmp_path / "train"), num_records=256, num_shards=2, seed=0
+    )
+
+    def run(fused: str):
+        args = parse_master_args(
+            [
+                "--model_def",
+                "mnist_functional_api.mnist_functional_api.custom_model",
+                "--training_data",
+                train_dir,
+                "--minibatch_size",
+                "32",
+                "--records_per_task",
+                "64",
+                "--num_epochs",
+                "1",
+                "--compute_dtype",
+                "float32",
+                "--steps_per_dispatch",
+                "2",
+                "--shuffle_seed",
+                "7",
+                "--device_prefetch",
+                fused,
+                "--boundary_fusion",
+                fused,
+            ]
+        )
+        ex = LocalExecutor(args)
+        ex.run()
+        return _jax.device_get(ex.state.params), int(ex.state.step)
+
+    params_off, steps_off = run("false")
+    params_on, steps_on = run("true")
+    assert steps_off == steps_on == 8
+    for x, y in zip(
+        _jax.tree_util.tree_leaves(params_off),
+        _jax.tree_util.tree_leaves(params_on),
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
